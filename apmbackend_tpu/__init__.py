@@ -1,0 +1,20 @@
+"""apmbackend_tpu — a TPU-native APM streaming backend.
+
+A ground-up rebuild of the capabilities of ztaylor797/APMBackend (real-time
+transaction stats, multi-window smoothed z-score anomaly baselining, alert rule
+evaluation, Postgres persistence, supervised module runtime) where the heavy
+math runs as a batched, sharded JAX/XLA step function over dense
+``[services, metrics, window]`` state tensors on TPU.
+
+Layering (bottom-up):
+- ``config`` / ``logging_util`` / ``entries`` / ``utils``: core runtime.
+- ``transport``: broker abstraction (in-memory + AMQP) with the pause/drain
+  backpressure contract.
+- ``ingest``: log tailing, correlation parsing, replay, JMX polling.
+- ``ops``: the device engine — registry, stats tick, z-score, alert rules.
+- ``parallel``: mesh/sharding for pod scale-out.
+- ``runtime``: TPU worker loop, supervisor/manager, checkpoint/resume.
+- ``sinks``: Postgres batch writer, Grafana, email.
+"""
+
+__version__ = "0.1.0"

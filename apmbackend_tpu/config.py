@@ -1,0 +1,364 @@
+"""Configuration system for the TPU-native APM backend.
+
+Reproduces the reference's config semantics (see /root/reference/util_methods.js:253-348
+and /root/reference/config/apm_config.json):
+
+- A single JSON file shared by every module, allowing ``//`` line comments that are
+  stripped before parsing unless preceded by ``:`` (so URLs like ``amqp://`` survive).
+- Hard failure (exit code 2) when the file is missing on first load.
+- Hot reload: the file is watched; a change is debounced, then md5+size compared,
+  and only a *parseable* new config is applied — a broken edit keeps the old config
+  live until corrected (util_methods.js:297-348).
+- ``restart_required_vars``: dotted paths that only warn when changed at runtime.
+- Hierarchical per-service overrides (e.g. z-score THRESHOLD/INFLUENCE per lag,
+  apm_config.json:152-172) are resolved by :func:`resolve_path` helpers.
+
+Unlike the reference the watcher here is polling-based (mtime+md5), which behaves
+identically on NFS where inotify is unreliable — the same reason the reference
+shipped a patched Perl File::Tail.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import re
+import sys
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+# Strip // comments unless preceded by ':' (keeps URLs intact). Mirrors
+# JSONstrip (util_methods.js:265-268) which removes '[^:]//...' to end of line.
+_COMMENT_RE = re.compile(r"(?<!:)//[^\n]*")
+
+
+def strip_json_comments(text: str) -> str:
+    """Remove ``//`` comments (not ``://``) from JSON text."""
+    out_lines = []
+    for line in text.splitlines():
+        stripped = line.lstrip()
+        if stripped.startswith("//"):
+            out_lines.append("")
+            continue
+        out_lines.append(_COMMENT_RE.sub("", line))
+    return "\n".join(out_lines)
+
+
+def resolve_path(obj: Any, path: str, separator: str = ".") -> Any:
+    """Resolve a dotted path into nested dicts, returning None when absent.
+
+    Mirrors ``resolve`` (util_methods.js:248-251).
+    """
+    cur = obj
+    for part in path.split(separator):
+        if cur is None:
+            return None
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+    return cur
+
+
+class ConfigError(Exception):
+    pass
+
+
+def load_config(path: str, *, logger=None, exit_on_missing: bool = False) -> dict:
+    """Read + parse the APM config file.
+
+    Mirrors ``readAPMConfig`` (util_methods.js:253-295): missing file is fatal
+    (exit 2) when ``exit_on_missing``; unparseable content returns None-equivalent
+    (raises ConfigError) so a watcher can keep the previous config.
+    """
+    if not os.path.exists(path):
+        msg = f"APM config file does not exist, can't continue: {path}"
+        if logger:
+            logger.warning(msg)
+        if exit_on_missing:
+            sys.exit(2)
+        raise ConfigError(msg)
+    with open(path, "r", encoding="utf-8") as fh:
+        content = fh.read()
+    try:
+        config = json.loads(strip_json_comments(content))
+    except json.JSONDecodeError as e:
+        raise ConfigError(f"Could not parse JSON content from APM config file: {path}: {e}") from e
+    config["apmConfigFilePath"] = path
+    return config
+
+
+class ConfigWatcher:
+    """Poll a config file and invoke a callback when its content changes.
+
+    Debounce + md5/size change detection per util_methods.js:301-316. A parse
+    failure keeps the previous config and waits for a correction. Vars listed in
+    ``restart_required_vars`` only produce a warning when changed.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        update_callback: Callable[[dict], None],
+        restart_required_vars: Iterable[str] = (),
+        *,
+        poll_interval: float = 0.5,
+        logger=None,
+    ):
+        self.path = path
+        self.update_callback = update_callback
+        self.restart_required_vars = list(restart_required_vars)
+        self.poll_interval = poll_interval
+        self.logger = logger
+        self._prev_md5 = self._digest()
+        self._current = load_config(path, logger=logger)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def current(self) -> dict:
+        return self._current
+
+    def _digest(self) -> str:
+        try:
+            with open(self.path, "rb") as fh:
+                return hashlib.md5(fh.read()).hexdigest()
+        except OSError:
+            return ""
+
+    def check_once(self) -> Optional[dict]:
+        """Single poll step; returns the new config if one was applied."""
+        digest = self._digest()
+        if digest == self._prev_md5 or not digest:
+            return None
+        self._prev_md5 = digest
+        try:
+            new_config = load_config(self.path, logger=self.logger)
+        except ConfigError:
+            if self.logger:
+                self.logger.warning(
+                    "The config file JSON could not be processed, proceeding with NO "
+                    "config changes. Future config corrections will be picked up."
+                )
+            return None
+        prev = self._current
+        for var in self.restart_required_vars:
+            old_val = resolve_path(prev, var)
+            new_val = resolve_path(new_config, var)
+            if json.dumps(old_val, sort_keys=True) != json.dumps(new_val, sort_keys=True):
+                if self.logger:
+                    self.logger.warning(
+                        f"{var} was changed on settings reload, but this will not take "
+                        f"effect without a restart. Old={old_val!r} New={new_val!r}"
+                    )
+        self._current = new_config
+        self.update_callback(new_config)
+        return new_config
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.wait(self.poll_interval):
+                try:
+                    self.check_once()
+                except Exception as e:  # watcher must never die
+                    if self.logger:
+                        self.logger.error(f"Config watcher error: {e}")
+
+        self._thread = threading.Thread(target=_loop, name="apm-config-watcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def service_zscore_settings(zscore_config: dict, service: str) -> list[dict]:
+    """Resolve per-service z-score lag settings with overrides applied.
+
+    Mirrors ``getServiceSettingsFromConfig`` (stream_calc_z_score.js:106-132):
+    defaults is a list of {LAG, THRESHOLD, INFLUENCE}; overrides.services.<name>
+    maps lag-string -> partial {THRESHOLD, INFLUENCE}.
+    """
+    settings = [dict(s) for s in zscore_config.get("defaults", [])]
+    overrides = (zscore_config.get("overrides", {}) or {}).get("services", {}) or {}
+    service_overrides = overrides.get(service)
+    if service_overrides:
+        for setting in settings:
+            for lag_key, vals in service_overrides.items():
+                if int(setting["LAG"]) == int(lag_key):
+                    if vals.get("THRESHOLD"):
+                        setting["THRESHOLD"] = vals["THRESHOLD"]
+                    if vals.get("INFLUENCE"):
+                        setting["INFLUENCE"] = vals["INFLUENCE"]
+    return settings
+
+
+def service_alert_overrides(alerts_config: dict, service: str) -> Optional[dict]:
+    """Per-service alert threshold overrides (stream_process_alerts.js:335-346)."""
+    overrides = (alerts_config.get("overrides", {}) or {}).get("services", {}) or {}
+    return overrides.get(service)
+
+
+def default_config() -> dict:
+    """A complete default config mirroring the reference's shipped apm_config.json
+
+    (structure and defaults from /root/reference/config/apm_config.json), with
+    paths relative to the repo and TPU-engine settings added under ``tpuEngine``.
+    """
+    return copy.deepcopy(_DEFAULT_CONFIG)
+
+
+_DEFAULT_CONFIG: dict = {
+    "appDirectory": ".",
+    "amqpConnectionString": "amqp://localhost:5672",
+    "brokerBackend": "memory",  # "memory" | "amqp"
+    "logDir": "logs",
+    "statLogIntervalInSeconds": 60,
+    "dbInsertQueue": "db_insert",
+    "statistics": [
+        {"type": "average"},
+        {"type": "percentile", "percentileValue": 75},
+        {"type": "percentile", "percentileValue": 95},
+    ],
+    "applicationManager": {
+        "logFilePrefix": "apm_manager",
+        "fromEmail": "apm@example.com",
+        "emailsEnabled": False,
+        "emailList": "admin@example.com",
+        "alertCollectionIntervalInSeconds": 60,
+        "increaseCollectionIntervalAfterAlert": True,
+        "maxCollectionIntervalInSeconds": 3840,
+        "queueMessageAlertThreshold": 1000000,
+        "queueMemoryAlertThreshold": 150,
+        "moduleMemoryAlertThreshold": 350,
+        "moduleSwapAlertThreshold": 200,
+        "diskSpaceGBAvailableThreshold": 100,
+        "diskSpacePercentageUsedThreshold": 80,
+        "inspectionFrequencySeconds": 60,
+        "sendAlertOnUnexpectedScriptEnd": True,
+        "triggerGCThreshold": 500,
+        "appLogRetentionDays": 7,
+        "moduleSettings": [
+            {"module": "apmbackend_tpu.ingest.parser_main"},
+            {"module": "apmbackend_tpu.runtime.worker", "moduleMemoryAlertThreshold": 700},
+            {"module": "apmbackend_tpu.sinks.insert_db_main"},
+            {"module": "apmbackend_tpu.ingest.jmx_main"},
+        ],
+    },
+    "streamParseTransactions": {
+        "logFilePrefix": "stream_parse_transactions",
+        "outQueue": "transactions",
+        "verboseQueueWrite": False,
+        "tailPauseFileFullPath": "state/PAUSE_TAILS.switch",
+        "appLogDirMaskPrefix": "fixtures/logs",
+        "maskSuffixes": ["app*log", "server.log", "soap_io*log"],
+    },
+    "streamCalcStats": {
+        "logFilePrefix": "stream_calc_stats",
+        "logDebug": False,
+        "inQueue": "transactions",
+        "outQueue": "stats",
+        "consumeQueue": True,
+        "verboseQueueWrite": False,
+        "resumeFileFullPath": "save/stream_calc_stats.resume",
+        "resumeFileSaveFrequencyInSeconds": 60,
+        "intervalLengthInSeconds": 10,
+        "windowSizeInIntervals": 30,
+        "bufferSizeInIntervals": 6,
+    },
+    "streamCalcZScore": {
+        "logFilePrefix": "stream_calc_z_score",
+        "inQueue": "stats",
+        "outQueue": "z_score",
+        "consumeQueue": True,
+        "verboseQueueWrite": False,
+        "resumeFileFullPath": "save/stream_calc_z_score.resume",
+        "resumeFileSaveFrequencyInSeconds": 60,
+        "defaults": [
+            {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1},
+            {"LAG": 8640, "THRESHOLD": 15.0, "INFLUENCE": 0.0},
+        ],
+        "overrides": {"services": {}},
+    },
+    "streamProcessAlerts": {
+        "logFilePrefix": "stream_process_alerts",
+        "inQueue": "z_score",
+        "consumeQueue": True,
+        "verboseQueueWrite": False,
+        "alertsResumeFileFullPath": "save/stream_process_alerts.resume",
+        "resumeFileSaveFrequencyInSeconds": 60,
+        "ignoreOldAlertsDuringCatchupLimitInMinutes": 60,
+        "hardMinMsAlertThreshold": 200,
+        "hardMaxMsAlertThreshold": 10000,
+        "hardMinTpmAlertThreshold": 1.0,
+        "alertOnBothOnly": True,
+        "overrides": {"services": {}},
+        "suppressedLags": [],
+        "rollingAlertWindowSizeInIntervals": 60,
+        "requiredNumberBadIntervalsInAlertWindowToTrigger": 45,
+        "suppressedServices": [],
+        "perServiceAlertCooldownInMinutes": 15,
+        "alertCollectionIntervalInSeconds": 60,
+        "increaseCollectionIntervalAfterAlert": True,
+        "maxCollectionIntervalInSeconds": 960,
+        "fromEmail": "apm@example.com",
+        "emailsEnabled": False,
+        "emailList": "oncall@example.com",
+        "testEmailList": "admin@example.com",
+    },
+    "streamInsertDb": {
+        "logFilePrefix": "stream_insert_db",
+        "consumeQueue": True,
+        "bufferResumeFileFullPath": "save/stream_insert_db_buffer.resume",
+        "dbBackend": "fake",  # "fake" | "postgres" | "sqlite"
+        "dbUser": "prod",
+        "dbHost": "localhost",
+        "dbDatabase": "apm",
+        "dbTxTable": "tx",
+        "dbStatTable": "stats",
+        "dbAlertTable": "alerts",
+        "dbJmxTable": "jmx",
+        "dbInsertBufferLimit": 1000,
+        "dbMaxTimeBetweenInsertsMs": 5000,
+    },
+    "pullJvmStats": {
+        "logFilePrefix": "pull_jvm_stats",
+        "verboseQueueWrite": False,
+        "jmxCliCommand": None,  # e.g. "java -jar jboss-cli-client.jar ..."; None => disabled
+        "jvmHosts": [],
+        "shortenHostname": True,
+        "jmxPort": 9990,
+        "clientTimeoutMs": 2000,
+        "pollingIntervalSeconds": 60,
+    },
+    "grafana": {
+        "grafanaURL": "",
+        "grafanaHostname": "",
+        "alertInspectorRelativeURL": "/d/alert-inspector",
+        "grafanaNowDelayIntervalMs": 90000,
+        "bearerToken": "",
+        "renderDir": "renders",
+        "renderWidth": 1800,
+        "renderHeightMultiple": 750,
+        "renderExtraParams": "&autofitpanels",
+        "renderTimeout": 90000,
+    },
+    # TPU-native engine settings (no reference equivalent: this is the device
+    # configuration for the batched step function that replaces the per-message
+    # stream_calc_stats/z_score/process_alerts event loops).
+    "tpuEngine": {
+        "serviceCapacity": 1024,  # static [S] rows; grows by power-of-2 recompile
+        "samplesPerBucket": 128,  # per-key per-bucket elapsed sample capacity
+        "meshAxis": "services",
+        "dtype": "float32",
+        "checkpointDir": "save/tpu_engine",
+        "microBatchSize": 65536,
+    },
+}

@@ -1,0 +1,422 @@
+"""Typed record schema + pipe-delimited CSV wire format.
+
+Wire-compatible with the reference's entries.js so the two systems interoperate
+on the same broker queues:
+
+- ``TxEntry``      ``tx|server|service|logId|acctNum|startTs|endTs|elapsed|topLevel``
+  (entries.js:19)
+- ``StatEntry``    ``st|ts|server|service|tpm|avg|p75|p95`` (entries.js:72)
+- ``FullStatEntry````fs|ts|server|service|lag|tpm|avg:avgAvg:avgLB:avgUB:sig|...``
+  (entries.js:117) — note the *average* signal is serialized as a bare int while
+  the per75/per95 signals go through nf() and render as ``1.0``/``0.0``; kept.
+- ``AlertEntry``   ``al|alertTs|entryTs|server|service|cause|entry-with-&``
+  (entries.js:215) — the nested entry's pipes are re-delimited to ``&``.
+- ``JmxEntry``     ``jx|ts|server|<16 numeric fields>`` (entries.js:307)
+
+Numeric-quirk parity: JS ``parseInt``/``parseFloat`` return NaN for empty or
+non-numeric strings, and the reference's ``nf()`` (entries.js:65-69) formats
+NaN/undefined as the literal string ``undefined`` — which parses back to NaN.
+``js_to_fixed`` mirrors Number.prototype.toFixed (round-half-toward-+inf on the
+exact binary value) so CSV output is byte-identical to the reference's.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from datetime import datetime, timezone
+from decimal import Decimal, ROUND_HALF_UP, ROUND_HALF_DOWN
+from typing import Optional, Union
+
+NAN = float("nan")
+
+_NUM_PREFIX_INT = re.compile(r"^\s*[+-]?\d+")
+_NUM_PREFIX_FLOAT = re.compile(r"^\s*[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?")
+
+
+def js_parse_int(value) -> float:
+    """JS parseInt: leading integer prefix or NaN. Returns float to carry NaN."""
+    if value is None:
+        return NAN
+    if isinstance(value, bool):
+        return NAN
+    if isinstance(value, (int, float)):
+        if isinstance(value, float) and (math.isnan(value) or math.isinf(value)):
+            return NAN
+        return float(int(value))
+    m = _NUM_PREFIX_INT.match(str(value))
+    return float(int(m.group(0))) if m else NAN
+
+
+def js_parse_float(value) -> float:
+    """JS parseFloat: leading float prefix or NaN."""
+    if value is None:
+        return NAN
+    if isinstance(value, bool):
+        return NAN
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value)
+    m = _NUM_PREFIX_FLOAT.match(s)
+    if m:
+        return float(m.group(0))
+    m = re.match(r"^\s*([+-]?)Infinity", s)
+    if m:
+        return float("-inf") if m.group(1) == "-" else float("inf")
+    return NAN
+
+
+def js_to_fixed(num: float, digits: int = 1) -> str:
+    """Number.prototype.toFixed: nearest decimal with f digits; on an exact tie
+    the *larger* n is chosen (ECMA-262 Number.prototype.toFixed step 10.c)."""
+    if math.isnan(num):
+        return "NaN"
+    if math.isinf(num):
+        return "Infinity" if num > 0 else "-Infinity"
+    d = Decimal(num)  # exact binary value
+    q = Decimal(1).scaleb(-digits)
+    rounding = ROUND_HALF_UP if num >= 0 else ROUND_HALF_DOWN  # "larger n" => toward +inf
+    out = d.quantize(q, rounding=rounding)
+    if out == 0 and num == 0:
+        out = abs(out)  # (0).toFixed/( -0).toFixed give "0.0"; keep "-0.0" for x<0
+    return f"{out:.{digits}f}"
+
+
+def nf(num: Optional[float], digits: int = 1) -> str:
+    """Reference nf(): falsy-but-not-zero (NaN/None) -> 'undefined' (entries.js:65-69)."""
+    if num is None or (isinstance(num, float) and math.isnan(num)):
+        return "undefined"
+    return js_to_fixed(float(num), digits)
+
+
+def _num_str(value: float) -> str:
+    """Bare `${num}` interpolation: NaN -> 'NaN', integral floats without '.0'."""
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if value.is_integer():
+            return str(int(value))
+    return str(value)
+
+
+def _ms_to_dt(ms: float) -> Optional[datetime]:
+    if ms is None or (isinstance(ms, float) and math.isnan(ms)):
+        return None
+    return datetime.fromtimestamp(ms / 1000.0, tz=timezone.utc)
+
+
+@dataclass
+class TxEntry:
+    """One completed transaction (entries.js:1-43)."""
+
+    server: str
+    service: str
+    log_id: str
+    acct_num: float  # NaN when unknown
+    start_ts: float  # ms
+    end_ts: float  # ms
+    elapsed: float  # ms
+    top_level: str  # 'Y' | 'N'
+    type: str = "tx"
+
+    def __post_init__(self):
+        self.acct_num = js_parse_int(self.acct_num)
+        self.start_ts = js_parse_int(self.start_ts)
+        self.end_ts = js_parse_int(self.end_ts)
+        self.elapsed = js_parse_int(self.elapsed)
+
+    def to_csv(self) -> str:
+        return (
+            f"tx|{self.server}|{self.service}|{self.log_id}|{_num_str(self.acct_num)}|"
+            f"{_num_str(self.start_ts)}|{_num_str(self.end_ts)}|{_num_str(self.elapsed)}|{self.top_level}"
+        )
+
+    def to_postgres(self) -> dict:
+        return {
+            "endts": _ms_to_dt(self.end_ts),
+            "startts": _ms_to_dt(self.start_ts),
+            "server": self.server,
+            "service": self.service,
+            "logid": self.log_id,
+            "acctnum": None if math.isnan(self.acct_num) else int(self.acct_num),
+            "elapsed": None if math.isnan(self.elapsed) else int(self.elapsed),
+            "toplevel": self.top_level,
+        }
+
+
+@dataclass
+class StatEntry:
+    """Windowed TPM/avg/p75/p95 for one (server, service) (entries.js:52-84)."""
+
+    timestamp: float
+    server: str
+    service: str
+    tpm: float
+    average: float
+    per75: float
+    per95: float
+    type: str = "st"
+
+    def __post_init__(self):
+        self.timestamp = js_parse_int(self.timestamp)
+        self.tpm = js_parse_float(self.tpm)
+        self.average = js_parse_float(self.average)
+        self.per75 = js_parse_float(self.per75)
+        self.per95 = js_parse_float(self.per95)
+
+    def to_csv(self) -> str:
+        return (
+            f"st|{_num_str(self.timestamp)}|{self.server}|{self.service}|"
+            f"{nf(self.tpm, 2)}|{nf(self.average)}|{nf(self.per75)}|{nf(self.per95)}"
+        )
+
+
+@dataclass
+class FullStatEntry:
+    """StatEntry + per-lag z-score bands/signals (entries.js:86-152)."""
+
+    timestamp: float
+    server: str
+    service: str
+    tpm: float
+    lag: Union[int, str]
+    average: float
+    average_avg: float
+    average_lb: float
+    average_ub: float
+    average_signal: float
+    per75: float
+    per75_avg: float
+    per75_lb: float
+    per75_ub: float
+    per75_signal: float
+    per95: float
+    per95_avg: float
+    per95_lb: float
+    per95_ub: float
+    per95_signal: float
+    type: str = "fs"
+
+    def __post_init__(self):
+        self.timestamp = js_parse_int(self.timestamp)
+        self.tpm = js_parse_float(self.tpm)
+        for name in (
+            "average", "average_avg", "average_lb", "average_ub",
+            "per75", "per75_avg", "per75_lb", "per75_ub",
+            "per95", "per95_avg", "per95_lb", "per95_ub",
+        ):
+            setattr(self, name, js_parse_float(getattr(self, name)))
+        for name in ("average_signal", "per75_signal", "per95_signal"):
+            setattr(self, name, js_parse_int(getattr(self, name)))
+
+    def _sig_str(self, v: float) -> str:
+        return "NaN" if math.isnan(v) else str(int(v))
+
+    def to_csv(self) -> str:
+        # average signal bare; per75/per95 signals via nf() => "1.0"/"0.0"
+        # (entries.js:117 interpolates nf(per75Signal) but averageSignal raw).
+        return (
+            f"fs|{_num_str(self.timestamp)}|{self.server}|{self.service}|{self.lag}|{nf(self.tpm, 2)}|"
+            f"{nf(self.average)}:{nf(self.average_avg)}:{nf(self.average_lb)}:{nf(self.average_ub)}:{self._sig_str(self.average_signal)}|"
+            f"{nf(self.per75)}:{nf(self.per75_avg)}:{nf(self.per75_lb)}:{nf(self.per75_ub)}:{nf(self.per75_signal)}|"
+            f"{nf(self.per95)}:{nf(self.per95_avg)}:{nf(self.per95_lb)}:{nf(self.per95_ub)}:{nf(self.per95_signal)}"
+        )
+
+    def to_postgres(self) -> dict:
+        def _n(v):
+            return None if (isinstance(v, float) and math.isnan(v)) else v
+
+        def _sig(v):
+            # Signals are ints in the reference's stats jsonb (entries.js:95-105).
+            return None if (isinstance(v, float) and math.isnan(v)) else int(v)
+
+        return {
+            "timestamp": _ms_to_dt(self.timestamp),
+            "server": self.server,
+            "service": self.service,
+            "tpm": _n(self.tpm),
+            "lag": self.lag,
+            "stats": {
+                "average": _n(self.average),
+                "averageavg": _n(self.average_avg),
+                "averagelb": _n(self.average_lb),
+                "averageub": _n(self.average_ub),
+                "averagesignal": _sig(self.average_signal),
+                "per75": _n(self.per75),
+                "per75avg": _n(self.per75_avg),
+                "per75lb": _n(self.per75_lb),
+                "per75ub": _n(self.per75_ub),
+                "per75signal": _sig(self.per75_signal),
+                "per95": _n(self.per95),
+                "per95avg": _n(self.per95_avg),
+                "per95lb": _n(self.per95_lb),
+                "per95ub": _n(self.per95_ub),
+                "per95signal": _sig(self.per95_signal),
+            },
+        }
+
+
+@dataclass
+class AlertEntry:
+    """A raised alert wrapping the offending entry (entries.js:202-241)."""
+
+    alert_timestamp: float
+    entry_timestamp: float
+    server: str
+    service: str
+    cause: str
+    entry: str  # CSV string of nested entry; pipes re-delimited to '&'
+
+    type: str = "al"
+
+    def __post_init__(self):
+        self.alert_timestamp = js_parse_int(self.alert_timestamp)
+        self.entry_timestamp = js_parse_int(self.entry_timestamp)
+        self.entry = self.entry.replace("|", "&")
+
+    def to_csv(self) -> str:
+        return (
+            f"al|{_num_str(self.alert_timestamp)}|{_num_str(self.entry_timestamp)}|"
+            f"{self.server}|{self.service}|{self.cause}|{self.entry}"
+        )
+
+    def to_postgres(self) -> dict:
+        nested = EntryFactory().from_csv(self.entry, delim="&")
+        return {
+            "alerttimestamp": _ms_to_dt(self.alert_timestamp),
+            "entrytimestamp": _ms_to_dt(self.entry_timestamp),
+            "server": self.server,
+            "service": self.service,
+            "cause": self.cause,
+            "entry": nested.to_postgres() if nested is not None else None,
+        }
+
+
+_JMX_FIELDS = (
+    "ds_in_use_nodes", "ds_active_nodes", "ds_available_nodes",
+    "heap_used", "heap_committed", "heap_max",
+    "meta_used", "meta_committed", "meta_max",
+    "sys_load", "class_cnt", "thread_cnt", "daemon_thread_cnt",
+    "bean_pool_available_count", "bean_pool_current_size", "bean_pool_max_size",
+)
+
+
+@dataclass
+class JmxEntry:
+    """One JMX poll sample for a JVM host (entries.js:243-332)."""
+
+    timestamp: float
+    server: str
+    ds_in_use_nodes: float = NAN
+    ds_active_nodes: float = NAN
+    ds_available_nodes: float = NAN
+    heap_used: float = NAN
+    heap_committed: float = NAN
+    heap_max: float = NAN
+    meta_used: float = NAN
+    meta_committed: float = NAN
+    meta_max: float = NAN
+    sys_load: float = NAN
+    class_cnt: float = NAN
+    thread_cnt: float = NAN
+    daemon_thread_cnt: float = NAN
+    bean_pool_available_count: float = NAN
+    bean_pool_current_size: float = NAN
+    bean_pool_max_size: float = NAN
+    type: str = "jx"
+
+    def __post_init__(self):
+        self.timestamp = js_parse_int(self.timestamp)
+        for name in _JMX_FIELDS:
+            parse = js_parse_float if name == "sys_load" else js_parse_int
+            setattr(self, name, parse(getattr(self, name)))
+
+    @classmethod
+    def from_jmx_stats(cls, timestamp, server: str, stats: dict) -> "JmxEntry":
+        """Build from the raw jboss-cli JSON blobs (entries.js:246-273)."""
+        return cls(
+            timestamp=timestamp,
+            server=server,
+            ds_in_use_nodes=stats["ds"]["result"]["InUseCount"],
+            ds_active_nodes=stats["ds"]["result"]["ActiveCount"],
+            ds_available_nodes=stats["ds"]["result"]["AvailableCount"],
+            heap_used=stats["heap"]["result"]["used"],
+            heap_committed=stats["heap"]["result"]["committed"],
+            heap_max=stats["heap"]["result"]["max"],
+            meta_used=stats["meta"]["result"]["used"],
+            meta_committed=stats["meta"]["result"]["committed"],
+            meta_max=stats["meta"]["result"]["max"],
+            sys_load=stats["sysload"]["result"],
+            class_cnt=stats["classcnt"]["result"],
+            thread_cnt=stats["threading"]["result"]["thread-count"],
+            daemon_thread_cnt=stats["threading"]["result"]["daemon-thread-count"],
+            bean_pool_available_count=stats["bean"]["result"][0]["result"]["pool-available-count"],
+            bean_pool_current_size=stats["bean"]["result"][0]["result"]["pool-current-size"],
+            bean_pool_max_size=stats["bean"]["result"][0]["result"]["pool-max-size"],
+        )
+
+    def to_csv(self) -> str:
+        parts = ["jx", _num_str(self.timestamp), self.server]
+        parts += [_num_str(getattr(self, name)) for name in _JMX_FIELDS]
+        return "|".join(parts)
+
+    def to_postgres(self) -> dict:
+        def _n(v):
+            if isinstance(v, float) and math.isnan(v):
+                return None
+            return int(v) if isinstance(v, float) and v.is_integer() else v
+
+        return {
+            "timestamp": _ms_to_dt(self.timestamp),
+            "server": self.server,
+            "dsinusenodes": _n(self.ds_in_use_nodes),
+            "dsactivenodes": _n(self.ds_active_nodes),
+            "dsavailablenodes": _n(self.ds_available_nodes),
+            "heapused": _n(self.heap_used),
+            "heapcommitted": _n(self.heap_committed),
+            "heapmax": _n(self.heap_max),
+            "metaused": _n(self.meta_used),
+            "metacommitted": _n(self.meta_committed),
+            "metamax": _n(self.meta_max),
+            "sysload": self.sys_load if not math.isnan(self.sys_load) else None,
+            "classcnt": _n(self.class_cnt),
+            "threadcnt": _n(self.thread_cnt),
+            "daemonthreadcnt": _n(self.daemon_thread_cnt),
+            "beanpoolavailablecnt": _n(self.bean_pool_available_count),
+            "beanpoolcurrentsize": _n(self.bean_pool_current_size),
+            "beanpoolmaxsize": _n(self.bean_pool_max_size),
+        }
+
+
+Entry = Union[TxEntry, StatEntry, FullStatEntry, AlertEntry, JmxEntry]
+
+
+class EntryFactory:
+    """CSV -> typed entry dispatch on the 2-char tag (entries.js:174-193)."""
+
+    def from_csv(self, line: str, delim: str = "|") -> Optional[Entry]:
+        arr = line.split(delim)
+        tag = arr[0]
+        try:
+            if tag == "tx":
+                return TxEntry(arr[1], arr[2], arr[3], arr[4], arr[5], arr[6], arr[7], arr[8])
+            if tag == "st":
+                return StatEntry(arr[1], arr[2], arr[3], arr[4], arr[5], arr[6], arr[7])
+            if tag == "fs":
+                avg = arr[6].split(":")
+                p75 = arr[7].split(":")
+                p95 = arr[8].split(":")
+                return FullStatEntry(
+                    arr[1], arr[2], arr[3], arr[5], arr[4],
+                    avg[0], avg[1], avg[2], avg[3], avg[4],
+                    p75[0], p75[1], p75[2], p75[3], p75[4],
+                    p95[0], p95[1], p95[2], p95[3], p95[4],
+                )
+            if tag == "al":
+                return AlertEntry(arr[1], arr[2], arr[3], arr[4], arr[5], arr[6])
+            if tag == "jx":
+                return JmxEntry(arr[1], arr[2], *arr[3:19])
+        except (IndexError, ValueError):
+            return None
+        return None

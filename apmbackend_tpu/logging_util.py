@@ -1,0 +1,112 @@
+"""Rolling file logger.
+
+TPU-native analog of the reference's logger.js: a per-module, date-rotated file
+logger (``<prefix>.log.<YYYYMMDD>``) with ANSI-colorized levels on the console
+(logger.js:8-53), installed as the process-wide logger.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import os
+import sys
+from typing import Optional
+
+_COLORS = {
+    "DEBUG": "\x1b[36m",  # cyan
+    "INFO": "\x1b[32m",  # green
+    "WARNING": "\x1b[33m",  # yellow
+    "ERROR": "\x1b[31m",  # red
+    "CRITICAL": "\x1b[35m",  # magenta
+}
+_RESET = "\x1b[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        color = _COLORS.get(record.levelname)
+        if color and sys.stderr.isatty():
+            return f"{color}{base}{_RESET}"
+        return base
+
+
+class DateRotatingFileHandler(logging.Handler):
+    """Writes to ``<dir>/<prefix>.log.<YYYYMMDD>``, switching files at midnight.
+
+    Mirrors the `simple-node-logger` rolling-file setup in logger.js: the date
+    stamp is part of the file name, and retention is enforced externally by the
+    manager (apm_manager.js:532-566 analog in runtime/manager.py).
+    """
+
+    def __init__(self, log_dir: str, prefix: str):
+        super().__init__()
+        self.log_dir = log_dir
+        self.prefix = prefix
+        self._current_date: Optional[str] = None
+        self._stream = None
+        os.makedirs(log_dir, exist_ok=True)
+
+    def _path_for(self, datestr: str) -> str:
+        return os.path.join(self.log_dir, f"{self.prefix}.log.{datestr}")
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            datestr = _dt.date.today().strftime("%Y%m%d")
+            if datestr != self._current_date:
+                if self._stream:
+                    self._stream.close()
+                self._stream = open(self._path_for(datestr), "a", encoding="utf-8")
+                self._current_date = datestr
+            self._stream.write(self.format(record) + "\n")
+            self._stream.flush()
+        except Exception:
+            self.handleError(record)
+
+    def close(self) -> None:
+        if self._stream:
+            self._stream.close()
+            self._stream = None
+        super().close()
+
+
+_FMT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+
+def get_logger(
+    log_dir: Optional[str] = None,
+    prefix: str = "apm",
+    *,
+    level: int = logging.INFO,
+    console: bool = True,
+) -> logging.Logger:
+    """Configure and return the module logger (setGlobalLogger analog,
+
+    util_methods.js:419-428). Repeated calls with the same prefix reuse the
+    logger; a changed log_dir swaps the file handler (hot-reload support).
+    """
+    logger = logging.getLogger(f"apm.{prefix}")
+    logger.setLevel(level)
+    logger.propagate = False
+
+    desired_path = os.path.abspath(log_dir) if log_dir else None
+    have_file = None
+    for h in list(logger.handlers):
+        if isinstance(h, DateRotatingFileHandler):
+            if desired_path is None or (os.path.abspath(h.log_dir) == desired_path and h.prefix == prefix):
+                # log_dir omitted => fetch the logger as-is, keep existing file handler
+                have_file = h
+            else:
+                logger.removeHandler(h)
+                h.close()
+    if desired_path and have_file is None:
+        fh = DateRotatingFileHandler(desired_path, prefix)
+        fh.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(fh)
+
+    if console and not any(isinstance(h, logging.StreamHandler) and not isinstance(h, DateRotatingFileHandler) for h in logger.handlers):
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(_ColorFormatter(_FMT))
+        logger.addHandler(sh)
+    return logger

@@ -1,0 +1,10 @@
+from .jsmath import (  # noqa: F401
+    js_average,
+    js_percentile,
+    js_standard_deviation,
+    binary_concat,
+    binary_insert,
+)
+from .heap import MinHeap  # noqa: F401
+from .counters import DBStats, QueueStats  # noqa: F401
+from .resume import load_resume_file, save_resume_file  # noqa: F401

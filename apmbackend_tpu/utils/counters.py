@@ -1,0 +1,85 @@
+"""Throughput counters.
+
+QueueStats: one counter per queue per direction, logged and reset on a
+second-aligned interval as ``IN<q: n - OUT>q: m`` (queue.js:4-64).
+DBStats: rows inserted + avg per-row insert ms (dbstats.js:1-41).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class QueueStats:
+    def __init__(self, interval_seconds: int = 60, logger=None):
+        self.interval = interval_seconds
+        self.logger = logger
+        self._counters: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+
+    def set_interval(self, interval_seconds: int) -> None:
+        self.interval = interval_seconds
+
+    def add_counter(self, name: str, ctype: str, init_val: int = 0) -> None:
+        with self._lock:
+            self._counters[name] = {"type": ctype, "cnt": init_val}
+            need_timer = self._timer is None
+        if need_timer:
+            self._schedule()
+
+    def incr(self, name: str, val: int = 1) -> None:
+        with self._lock:
+            if name in self._counters:
+                self._counters[name]["cnt"] += val
+
+    def snapshot_and_reset(self) -> str:
+        parts = []
+        with self._lock:
+            for name, obj in self._counters.items():
+                prefix = "IN<" if obj["type"] == "c" else "OUT>"
+                parts.append(f"{prefix}{name}: {obj['cnt']}")
+                obj["cnt"] = 0
+        return " - ".join(parts)
+
+    def _schedule(self) -> None:
+        # Second-aligned like logQueueStatsRecurs (queue.js:54-63).
+        timeout = self.interval - (int(time.time()) % self.interval)
+        self._timer = threading.Timer(timeout, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire(self) -> None:
+        line = self.snapshot_and_reset()
+        if line and self.logger:
+            self.logger.info(line)
+        self._schedule()
+
+    def stop(self) -> None:
+        if self._timer:
+            self._timer.cancel()
+            self._timer = None
+
+
+class DBStats:
+    def __init__(self):
+        self.rec_ins_counter = 0
+        self.ins_elap_total_ms = 0.0
+        self._lock = threading.Lock()
+
+    def add_inserted(self, count: int) -> None:
+        with self._lock:
+            self.rec_ins_counter += count
+
+    def add_elapsed_ms(self, ms: float) -> None:
+        with self._lock:
+            self.ins_elap_total_ms += ms
+
+    def snapshot_and_reset(self) -> str:
+        with self._lock:
+            cnt, total = self.rec_ins_counter, self.ins_elap_total_ms
+            self.rec_ins_counter, self.ins_elap_total_ms = 0, 0.0
+        avg = (total / cnt) if cnt else 0.0
+        return f"DB> inserted: {cnt} - total ms: {total:.1f} - avg ms/rec: {avg:.3f}"

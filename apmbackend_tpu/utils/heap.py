@@ -1,0 +1,42 @@
+"""Array-backed min-heap keyed by a score function.
+
+Role parity with binary_heap.js: re-sorts the roughly-ordered transaction
+stream by ``end_ts`` before records go to the DB sink (stream_calc_stats.js:
+136-155). ``pop_all_leq`` mirrors ``popAllLessOrEqualToScore``
+(binary_heap.js:32-38).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, List
+
+
+class MinHeap:
+    def __init__(self, score_fn: Callable[[Any], float]):
+        self.score_fn = score_fn
+        self._heap: List = []
+        self._counter = itertools.count()  # tie-breaker; keeps pops stable
+
+    def push(self, item: Any) -> None:
+        heapq.heappush(self._heap, (self.score_fn(item), next(self._counter), item))
+
+    def peek(self) -> Any:
+        return self._heap[0][2]
+
+    def pop(self) -> Any:
+        return heapq.heappop(self._heap)[2]
+
+    def size(self) -> int:
+        return len(self._heap)
+
+    def pop_all_leq(self, score: float) -> List[Any]:
+        out = []
+        while self._heap and self._heap[0][0] <= score:
+            out.append(self.pop())
+        return out
+
+    def items(self) -> List[Any]:
+        """Unordered snapshot (resume-file serialization)."""
+        return [t[2] for t in self._heap]

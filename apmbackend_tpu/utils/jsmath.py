@@ -1,0 +1,95 @@
+"""Host-side golden implementations of the reference's statistics helpers.
+
+These mirror util_methods.js:10-142 *including the quirks*, and serve as the
+float64 "exactness parity" oracle the device kernels are tested against
+(SURVEY.md §7.3):
+
+- ``js_average``: NaN/None entries are skipped; all-invalid -> None
+  (util_methods.js:10-24).
+- ``js_standard_deviation``: population std over valid entries, BUT a zero
+  variance yields **None** (not 0.0) because of the reference's
+  ``if (avgSquareDiff && avgSquareDiff != 0)`` guard (util_methods.js:44-48).
+  This is load-bearing: constant series never produce z-score signals.
+- ``js_percentile``: the reference's idiosyncratic index math over a sorted
+  array (util_methods.js:112-142) — NOT numpy's linear interpolation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional, Sequence
+
+
+def _valid(x) -> bool:
+    if x is None:
+        return False
+    try:
+        return not math.isnan(float(x))
+    except (TypeError, ValueError):
+        return False
+
+
+def js_average(values: Iterable) -> Optional[float]:
+    cnt = 0
+    total = 0.0
+    for v in values:
+        if _valid(v):
+            cnt += 1
+            total += float(v)
+    return total / cnt if cnt > 0 else None
+
+
+def js_standard_deviation(values: Sequence) -> Optional[float]:
+    avg = js_average(values)
+    if avg is None:
+        return None
+    sq = [((float(v) - avg) ** 2) if _valid(v) else None for v in values]
+    avg_sq = js_average(sq)
+    if avg_sq:  # falsy 0.0 -> undefined: zero-variance windows have no std-dev
+        return math.sqrt(avg_sq)
+    return None
+
+
+def js_percentile(sorted_values: Sequence[float], percentile: float) -> Optional[float]:
+    """Percentile over an ascending-sorted array, reference index math.
+
+    index = p/100*n - 1; integer index -> arr[index]; otherwise the mean of
+    arr[ceil] and arr[ceil+1] unless ceil is the last element.
+    """
+    n = len(sorted_values)
+    if n == 0:
+        return None
+    if percentile == 0:
+        return sorted_values[0]
+    if percentile == 100:
+        return sorted_values[-1]
+    index = (percentile / 100.0) * n - 1.0
+    if n == 1 or index == int(index):
+        return sorted_values[int(index)]
+    index = int(math.ceil(index))
+    if index == n - 1:
+        return sorted_values[index]
+    return (sorted_values[index] + sorted_values[index + 1]) / 2.0
+
+
+def binary_insert(arr: List, target, duplicate: bool = True) -> int:
+    """Insert into a sorted list, optionally skipping duplicates
+
+    (util_methods.js:84-95). Returns the insertion index."""
+    lo, hi = 0, len(arr)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if arr[mid] < target:
+            lo = mid + 1
+        else:
+            hi = mid
+    if not duplicate and lo < len(arr) and arr[lo] == target:
+        return lo
+    arr.insert(lo, target)
+    return lo
+
+
+def binary_concat(dest: List, source: Iterable, duplicate: bool = True) -> None:
+    """Merge ``source`` into sorted ``dest`` (util_methods.js:102-106)."""
+    for el in source:
+        binary_insert(dest, el, duplicate)

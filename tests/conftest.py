@@ -1,0 +1,23 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported anywhere,
+so multi-chip sharding (mesh over the service axis) is exercised without TPU
+hardware. The driver's dryrun_multichip uses the same mechanism.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_logger():
+    import logging
+
+    return logging.getLogger("apm.test")

@@ -1,0 +1,110 @@
+"""Config system tests: // stripping, hot reload, override hierarchy."""
+
+import json
+import os
+
+import pytest
+
+from apmbackend_tpu.config import (
+    ConfigError,
+    ConfigWatcher,
+    default_config,
+    load_config,
+    resolve_path,
+    service_alert_overrides,
+    service_zscore_settings,
+    strip_json_comments,
+)
+
+
+def test_strip_comments_keeps_urls():
+    txt = '{\n  // full line comment\n  "url": "amqp://localhost:5672", // trailing\n  "x": 1\n}'
+    parsed = json.loads(strip_json_comments(txt))
+    assert parsed["url"] == "amqp://localhost:5672"
+    assert parsed["x"] == 1
+
+
+def test_load_config(tmp_path):
+    p = tmp_path / "apm_config.json"
+    p.write_text('{\n// comment\n"a": {"b": 2}\n}')
+    cfg = load_config(str(p))
+    assert cfg["a"]["b"] == 2
+    assert cfg["apmConfigFilePath"] == str(p)
+
+
+def test_load_config_missing(tmp_path):
+    with pytest.raises(ConfigError):
+        load_config(str(tmp_path / "nope.json"))
+
+
+def test_load_config_bad_json(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{nope")
+    with pytest.raises(ConfigError):
+        load_config(str(p))
+
+
+def test_resolve_path():
+    obj = {"a": {"b": {"c": 3}}}
+    assert resolve_path(obj, "a.b.c") == 3
+    assert resolve_path(obj, "a.x.c") is None
+
+
+def test_watcher_applies_only_valid_changes(tmp_path):
+    p = tmp_path / "apm_config.json"
+    p.write_text('{"v": 1}')
+    seen = []
+    w = ConfigWatcher(str(p), seen.append, ["v2"], poll_interval=0.05)
+    assert w.current["v"] == 1
+
+    p.write_text("{broken")
+    assert w.check_once() is None
+    assert w.current["v"] == 1  # old config retained
+
+    p.write_text('{"v": 2}')
+    new = w.check_once()
+    assert new["v"] == 2
+    assert seen and seen[-1]["v"] == 2
+
+
+def test_watcher_no_change_no_callback(tmp_path):
+    p = tmp_path / "apm_config.json"
+    p.write_text('{"v": 1}')
+    seen = []
+    w = ConfigWatcher(str(p), seen.append, poll_interval=0.05)
+    assert w.check_once() is None
+    assert not seen
+
+
+def test_zscore_settings_overrides():
+    zcfg = {
+        "defaults": [
+            {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1},
+            {"LAG": 8640, "THRESHOLD": 15.0, "INFLUENCE": 0.0},
+        ],
+        "overrides": {"services": {"S:special": {"360": {"THRESHOLD": 25.0}}}},
+    }
+    default = service_zscore_settings(zcfg, "S:normal")
+    assert default[0]["THRESHOLD"] == 20.0
+    special = service_zscore_settings(zcfg, "S:special")
+    assert special[0]["THRESHOLD"] == 25.0
+    assert special[0]["INFLUENCE"] == 0.1  # untouched
+    assert special[1]["THRESHOLD"] == 15.0  # other lag untouched
+    # settings are deep-copied: defaults must not be mutated by override reads
+    assert zcfg["defaults"][0]["THRESHOLD"] == 20.0
+
+
+def test_alert_overrides():
+    acfg = {"overrides": {"services": {"svcA": {"hardMaxMsAlertThreshold": 9000}}}}
+    assert service_alert_overrides(acfg, "svcA")["hardMaxMsAlertThreshold"] == 9000
+    assert service_alert_overrides(acfg, "svcB") is None
+
+
+def test_default_config_shape():
+    cfg = default_config()
+    assert cfg["streamCalcStats"]["intervalLengthInSeconds"] == 10
+    assert cfg["streamCalcZScore"]["defaults"][0]["LAG"] == 360
+    assert cfg["tpuEngine"]["serviceCapacity"] >= 1
+    # mutation of one copy must not leak into the next
+    cfg["streamCalcStats"]["intervalLengthInSeconds"] = 99
+    assert default_config()["streamCalcStats"]["intervalLengthInSeconds"] == 10

@@ -1,0 +1,179 @@
+"""Wire-format parity tests for entries.py against reference entries.js semantics."""
+
+import math
+
+from apmbackend_tpu.entries import (
+    AlertEntry,
+    EntryFactory,
+    FullStatEntry,
+    JmxEntry,
+    StatEntry,
+    TxEntry,
+    js_parse_float,
+    js_parse_int,
+    js_to_fixed,
+    nf,
+)
+
+
+def test_js_parse_int():
+    assert js_parse_int("123") == 123
+    assert js_parse_int("123abc") == 123
+    assert js_parse_int("12.9") == 12
+    assert math.isnan(js_parse_int(""))
+    assert math.isnan(js_parse_int("abc"))
+    assert math.isnan(js_parse_int(None))
+    assert math.isnan(js_parse_int("undefined"))
+    assert math.isnan(js_parse_int("NaN"))
+    assert js_parse_int("-5") == -5
+
+
+def test_js_parse_float():
+    assert js_parse_float("1.5") == 1.5
+    assert math.isnan(js_parse_float("undefined"))
+    assert js_parse_float("2.5e2") == 250.0
+    assert js_parse_float("7") == 7.0
+
+
+def test_js_to_fixed_matches_js_tofixed():
+    # Values cross-checked against Node: (x).toFixed(d)
+    assert js_to_fixed(0.15, 1) == "0.1"  # 0.15 is < .15 in binary
+    assert js_to_fixed(0.25, 1) == "0.3"  # exact tie -> larger n
+    assert js_to_fixed(-0.25, 1) == "-0.2"  # exact tie -> larger n (toward +inf)
+    assert js_to_fixed(2.5, 0) == "3"
+    assert js_to_fixed(1234.999, 1) == "1235.0"
+    assert js_to_fixed(0.0, 1) == "0.0"
+    assert js_to_fixed(123.456, 2) == "123.46"
+
+
+def test_nf():
+    assert nf(float("nan")) == "undefined"
+    assert nf(None) == "undefined"
+    assert nf(0) == "0.0"
+    assert nf(12.34) == "12.3"
+    assert nf(12.34, 2) == "12.34"
+
+
+def test_tx_roundtrip():
+    tx = TxEntry("srv1", "S:getFoo", "abc123", "999", 1000, 2500, 1500, "Y")
+    line = tx.to_csv()
+    assert line == "tx|srv1|S:getFoo|abc123|999|1000|2500|1500|Y"
+    back = EntryFactory().from_csv(line)
+    assert isinstance(back, TxEntry)
+    assert back.server == "srv1" and back.elapsed == 1500 and back.acct_num == 999
+
+
+def test_tx_missing_fields():
+    tx = TxEntry("srv1", "svc", "", "", 900, 1000, 100, "N")
+    line = tx.to_csv()
+    assert "|NaN|" in line  # acctNum interpolates as NaN like JS template strings
+    back = EntryFactory().from_csv(line)
+    assert math.isnan(back.acct_num)
+    pg = back.to_postgres()
+    assert pg["acctnum"] is None
+
+
+def test_stat_roundtrip_undefined():
+    st = StatEntry(1700000000000, "s1", "svc", 1.234, float("nan"), float("nan"), float("nan"))
+    line = st.to_csv()
+    assert line == "st|1700000000000|s1|svc|1.23|undefined|undefined|undefined"
+    back = EntryFactory().from_csv(line)
+    assert math.isnan(back.average) and back.tpm == 1.23
+
+
+def test_fullstat_csv_signal_formats():
+    fs = FullStatEntry(
+        1700000000000, "s1", "svc", 2.0, 360,
+        100.0, 90.0, 80.0, 110.0, 1,
+        120.0, 95.0, 85.0, 115.0, 0,
+        150.0, 99.0, 89.0, 119.0, -1,
+    )
+    line = fs.to_csv()
+    # average signal bare int; per75/95 signals via nf()
+    assert "|100.0:90.0:80.0:110.0:1|" in line
+    assert ":0.0|" in line  # per75 signal
+    assert line.endswith(":-1.0")  # per95 signal
+    back = EntryFactory().from_csv(line)
+    assert back.average_signal == 1 and back.per75_signal == 0 and back.per95_signal == -1
+    assert back.lag == "360"
+    assert back.tpm == 2.0
+
+
+def test_fullstat_undefined_roundtrip():
+    nan = float("nan")
+    fs = FullStatEntry(
+        1700000000000, "s1", "svc", 0.0, 8640,
+        nan, nan, nan, nan, 0,
+        nan, nan, nan, nan, 0,
+        nan, nan, nan, nan, 0,
+    )
+    line = fs.to_csv()
+    assert "undefined:undefined:undefined:undefined:0|" in line
+    back = EntryFactory().from_csv(line)
+    assert math.isnan(back.average) and back.average_signal == 0
+
+
+def test_alert_entry_pipe_redelimit():
+    fs_line = "fs|1|s1|svc|360|1.00|2.0:3.0:1.0:4.0:0|2.0:3.0:1.0:4.0:0.0|2.0:3.0:1.0:4.0:0.0"
+    al = AlertEntry(1700000000123, 1700000000000, "s1", "svc", "average exceeded hard ms threshold", fs_line)
+    line = al.to_csv()
+    assert "|" not in line.split("|")[6]  # nested entry uses & only
+    back = EntryFactory().from_csv(line)
+    assert isinstance(back, AlertEntry)
+    pg = back.to_postgres()
+    assert pg["entry"]["server"] == "s1"
+    assert pg["entry"]["stats"]["average"] == 2.0
+
+
+def test_jmx_roundtrip():
+    jx = JmxEntry(1700000000000, "jvm1", 1, 2, 3, 4, 5, 6, 7, 8, 9, 0.25, 11, 12, 13, 14, 15, 16)
+    line = jx.to_csv()
+    assert line.startswith("jx|1700000000000|jvm1|1|2|3|")
+    back = EntryFactory().from_csv(line)
+    assert back.sys_load == 0.25 and back.bean_pool_max_size == 16
+    pg = back.to_postgres()
+    assert pg["sysload"] == 0.25 and pg["dsinusenodes"] == 1
+
+
+def test_jmx_from_stats_blob():
+    stats = {
+        "ds": {"result": {"InUseCount": 1, "ActiveCount": 2, "AvailableCount": 3}},
+        "heap": {"result": {"used": 10, "committed": 20, "max": 30}},
+        "meta": {"result": {"used": 1, "committed": 2, "max": 3}},
+        "sysload": {"result": 0.5},
+        "classcnt": {"result": 1000},
+        "threading": {"result": {"thread-count": 50, "daemon-thread-count": 40}},
+        "bean": {"result": [{"result": {"pool-available-count": 5, "pool-current-size": 6, "pool-max-size": 7}}]},
+    }
+    jx = JmxEntry.from_jmx_stats(1700000000000, "jvm1", stats)
+    assert jx.heap_used == 10 and jx.thread_cnt == 50 and jx.bean_pool_max_size == 7
+
+
+def test_factory_unknown_type():
+    assert EntryFactory().from_csv("zz|1|2") is None
+
+
+def test_infinity_handling():
+    assert js_parse_float("Infinity") == float("inf")
+    assert js_parse_float("-Infinity") == float("-inf")
+    assert js_to_fixed(float("inf"), 1) == "Infinity"
+    assert nf(float("inf")) == "Infinity"
+
+
+def test_negative_zero_tofixed():
+    # (-0.04).toFixed(1) === "-0.0" in JS; (0).toFixed(1) === "0.0"
+    assert js_to_fixed(-0.04, 1) == "-0.0"
+    assert js_to_fixed(0.0, 1) == "0.0"
+    assert js_to_fixed(-0.0, 1) == "0.0"
+
+
+def test_fullstat_postgres_signal_ints():
+    fs = FullStatEntry(
+        1, "s", "svc", 1.0, 360,
+        1.0, 1.0, 1.0, 1.0, 1,
+        1.0, 1.0, 1.0, 1.0, 0,
+        1.0, 1.0, 1.0, 1.0, -1,
+    )
+    stats = fs.to_postgres()["stats"]
+    assert stats["averagesignal"] == 1 and isinstance(stats["averagesignal"], int)
+    assert stats["per95signal"] == -1 and isinstance(stats["per95signal"], int)

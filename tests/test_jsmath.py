@@ -1,0 +1,88 @@
+"""Golden-math tests: JS-quirk parity for average/std/percentile/heap/resume."""
+
+import math
+
+from apmbackend_tpu.utils import (
+    MinHeap,
+    binary_concat,
+    js_average,
+    js_percentile,
+    js_standard_deviation,
+    load_resume_file,
+    save_resume_file,
+)
+
+
+def test_average_skips_nan():
+    assert js_average([1, 2, 3]) == 2.0
+    assert js_average([1, float("nan"), 3]) == 2.0
+    assert js_average([None, float("nan")]) is None
+    assert js_average([]) is None
+    assert js_average([0, 0]) == 0.0
+
+
+def test_std_population_and_zero_variance_quirk():
+    vals = [2, 4, 4, 4, 5, 5, 7, 9]
+    assert abs(js_standard_deviation(vals) - 2.0) < 1e-12  # population std
+    # zero variance -> undefined (None), NOT 0.0 (util_methods.js:44-48)
+    assert js_standard_deviation([5, 5, 5]) is None
+    assert js_standard_deviation([]) is None
+    assert js_standard_deviation([float("nan")]) is None
+    # NaN entries skipped
+    assert abs(js_standard_deviation([2, 4, float("nan"), 4, 4, 5, 5, 7, 9]) - 2.0) < 1e-12
+
+
+def test_percentile_reference_index_math():
+    # n=4, p=75: index = 2.0 integer -> arr[2]
+    assert js_percentile([1, 2, 3, 4], 75) == 3
+    # n=5, p=75: index = 2.75 -> ceil 3, not last -> (arr[3]+arr[4])/2
+    assert js_percentile([1, 2, 3, 4, 5], 75) == 4.5
+    # n=2, p=95: index 0.9 -> ceil 1 == n-1 -> arr[1]
+    assert js_percentile([10, 20], 95) == 20
+    assert js_percentile([7], 75) == 7
+    assert js_percentile([], 75) is None
+    assert js_percentile([1, 2, 3], 0) == 1
+    assert js_percentile([1, 2, 3], 100) == 3
+    # n=20, p=95: index=18 integer -> arr[18]
+    arr = list(range(20))
+    assert js_percentile(arr, 95) == 18
+    # n=21, p=95: index=18.95 -> ceil 19, not last -> (arr[19]+arr[20])/2
+    arr = list(range(21))
+    assert js_percentile(arr, 95) == 19.5
+
+
+def test_binary_concat_sorted_with_dups():
+    dest = [1, 5, 9]
+    binary_concat(dest, [5, 2, 9], duplicate=True)
+    assert dest == [1, 2, 5, 5, 9, 9]
+    dest2 = [1, 5]
+    binary_concat(dest2, [5, 2], duplicate=False)
+    assert dest2 == [1, 2, 5]
+
+
+def test_minheap_pop_all_leq():
+    h = MinHeap(lambda x: x["end_ts"])
+    for ts in [50, 10, 30, 20, 40]:
+        h.push({"end_ts": ts})
+    out = h.pop_all_leq(30)
+    assert [o["end_ts"] for o in out] == [10, 20, 30]
+    assert h.size() == 2
+    assert h.peek()["end_ts"] == 40
+
+
+def test_resume_file_roundtrip(tmp_path):
+    p = str(tmp_path / "x.resume")
+    save_resume_file(p, {"a": [1, 2], "m": {"k": "v"}})
+    assert load_resume_file(p) == {"a": [1, 2], "m": {"k": "v"}}
+    # Map-wrapper interop (reference replacer format, util_methods.js:189-208)
+    (tmp_path / "m.resume").write_text('{"dataType": "Map", "value": [["tx", [1]], ["fs", []]]}')
+    assert load_resume_file(str(tmp_path / "m.resume")) == {"tx": [1], "fs": []}
+    assert load_resume_file(str(tmp_path / "missing.resume")) is None
+
+
+def test_resume_file_nan_becomes_null(tmp_path):
+    p = str(tmp_path / "nan.resume")
+    save_resume_file(p, {"x": float("nan"), "l": [1.0, float("inf")]})
+    raw = open(p).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    assert load_resume_file(p) == {"x": None, "l": [1.0, None]}
