@@ -1,0 +1,2 @@
+from .registry import CapacityExceeded, ServiceRegistry  # noqa: F401
+from . import alerts, stats, zscore  # noqa: F401

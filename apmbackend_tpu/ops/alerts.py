@@ -1,0 +1,253 @@
+"""Alert rule evaluation: vectorized device step + host-side alert manager.
+
+Device part — the per-entry rule ladder of stream_process_alerts.js:348-471
+evaluated for every (service-row, lag) at once:
+
+- hard max: average/per75 > per-service hardMaxMsAlertThreshold (:398-408)
+- upper-bound signals gated by hardMin ms and min TPM (:411-420)
+- ``alertOnBothOnly``: both avg and p75 UB must fire together (:421-423)
+- rolling bad-interval counter per (row, lag): one increment per entry
+  regardless of cause count, capped at window size + 1; decrement on quiet
+  entries; trigger only at >= required bad intervals (:366-391)
+- suppression lists zero the causes (so counters decay) (:395-396)
+
+Host part — AlertsManager: per-*service* cooldown (keyed by service name only,
+like this.alerts[en.service] :449-467), alert buffering with collection-interval
+doubling (:269-333), HTML table formatting, Grafana render URL, email dispatch
+(gated), resume files.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..entries import AlertEntry, EntryFactory, FullStatEntry
+from ..utils.resume import load_resume_file, save_resume_file
+
+# cause bits, in the reference's evaluation (and string join) order
+CAUSE_AVG_HARD = 1 << 0  # 'average exceeded hard ms threshold'
+CAUSE_P75_HARD = 1 << 1  # 'per75 exceeded hard ms threshold'
+CAUSE_AVG_UB = 1 << 2  # 'average UB exceeded' (only when not alertOnBothOnly)
+CAUSE_P75_UB = 1 << 3  # 'per75 UB exceeded'  (only when not alertOnBothOnly)
+CAUSE_BOTH_UB = 1 << 4  # 'average and per75 UB exceeded'
+
+_CAUSE_STRINGS = (
+    (CAUSE_AVG_HARD, "average exceeded hard ms threshold"),
+    (CAUSE_P75_HARD, "per75 exceeded hard ms threshold"),
+    (CAUSE_AVG_UB, "average UB exceeded"),
+    (CAUSE_P75_UB, "per75 UB exceeded"),
+    (CAUSE_BOTH_UB, "average and per75 UB exceeded"),
+)
+
+
+def cause_string(bits: int) -> str:
+    return ",".join(s for b, s in _CAUSE_STRINGS if bits & b)
+
+
+class AlertRuleConfig(NamedTuple):
+    hard_min_ms: float  # hardMinMsAlertThreshold
+    hard_min_tpm: float  # hardMinTpmAlertThreshold
+    alert_on_both_only: bool
+    window_sz: int  # rollingAlertWindowSizeInIntervals
+    required_bad: int  # requiredNumberBadIntervalsInAlertWindowToTrigger
+    lag_suppressed: bool  # this lag is in suppressedLags
+
+
+class AlertRuleResult(NamedTuple):
+    trigger: jnp.ndarray  # [S] bool
+    cause_bits: jnp.ndarray  # [S] int32
+    counters: jnp.ndarray  # [S] int32 (new state)
+
+
+def eval_rules(
+    counters: jnp.ndarray,  # [S] int32 rolling bad-interval counts for this lag
+    cfg: AlertRuleConfig,
+    average: jnp.ndarray,  # [S] wire-rounded window average
+    per75: jnp.ndarray,  # [S]
+    tpm: jnp.ndarray,  # [S]
+    avg_signal: jnp.ndarray,  # [S] int
+    p75_signal: jnp.ndarray,  # [S] int
+    hard_max_ms: jnp.ndarray,  # [S] per-service override vector
+    suppressed: jnp.ndarray,  # [S] bool per-service suppression
+) -> AlertRuleResult:
+    c_avg_hard = average > hard_max_ms  # NaN compares False, like JS undefined
+    c_p75_hard = per75 > hard_max_ms
+
+    ub_avg = (avg_signal > 0) & (average > cfg.hard_min_ms) & (tpm > cfg.hard_min_tpm)
+    ub_p75 = (p75_signal > 0) & (per75 > cfg.hard_min_ms) & (tpm > cfg.hard_min_tpm)
+
+    if cfg.alert_on_both_only:
+        c_avg_ub = jnp.zeros_like(ub_avg)
+        c_p75_ub = jnp.zeros_like(ub_p75)
+        c_both = ub_avg & ub_p75
+    else:
+        c_avg_ub, c_p75_ub = ub_avg, ub_p75
+        c_both = jnp.zeros_like(ub_avg)
+
+    blocked = suppressed | cfg.lag_suppressed
+    c_avg_hard, c_p75_hard, c_avg_ub, c_p75_ub, c_both = (
+        c & ~blocked for c in (c_avg_hard, c_p75_hard, c_avg_ub, c_p75_ub, c_both)
+    )
+
+    attempted = c_avg_hard | c_p75_hard | c_avg_ub | c_p75_ub | c_both
+    # one increment per entry, only while counter <= window size (:372-377)
+    counters = counters + jnp.where(attempted & (counters <= cfg.window_sz), 1, 0)
+
+    windowed = cfg.window_sz > 1 and cfg.required_bad > 1
+    passes = counters >= cfg.required_bad if windowed else jnp.ones_like(attempted)
+
+    cause_bits = (
+        jnp.where(c_avg_hard & passes, CAUSE_AVG_HARD, 0)
+        | jnp.where(c_p75_hard & passes, CAUSE_P75_HARD, 0)
+        | jnp.where(c_avg_ub & passes, CAUSE_AVG_UB, 0)
+        | jnp.where(c_p75_ub & passes, CAUSE_P75_UB, 0)
+        | jnp.where(c_both & passes, CAUSE_BOTH_UB, 0)
+    ).astype(jnp.int32)
+    trigger = cause_bits != 0
+
+    # quiet entry: decay (:427-434)
+    counters = jnp.where(~attempted & (counters > 0), counters - 1, counters)
+    counters = jnp.maximum(counters, 0)
+
+    return AlertRuleResult(trigger, cause_bits, counters)
+
+
+class AlertsManager:
+    """Host-side: per-service cooldown, batching, formatting, dispatch.
+
+    State mirrors the reference AlertsManager (stream_process_alerts.js:89-482):
+    ``alerts`` maps service -> last AlertEntry (cooldown anchor), ``alert_buffer``
+    holds unsent alerts; both persist via resume files.
+    """
+
+    def __init__(self, alerts_config: dict, *, logger=None, email_sender=None, grafana=None, clock=time.time):
+        self.config = alerts_config
+        self.logger = logger
+        self.email_sender = email_sender  # callable(subject, html, image_path)
+        self.grafana = grafana
+        self.clock = clock
+        self.alerts: dict = {}  # service -> alert dict (cooldown state)
+        self.alert_buffer: List[dict] = []
+        self.current_interval_s: Optional[float] = None
+
+    def set_config(self, alerts_config: dict) -> None:
+        self.config = alerts_config
+
+    # -- cooldown ------------------------------------------------------------
+    def process_trigger(self, entry: FullStatEntry, cause_bits: int) -> Optional[AlertEntry]:
+        """Apply the per-service cooldown to a device-side trigger; returns the
+
+        AlertEntry to persist/send, or None when suppressed (:436-468)."""
+        now_ms = self.clock() * 1000.0
+        alert = AlertEntry(
+            now_ms, entry.timestamp, entry.server, entry.service,
+            cause_string(cause_bits), entry.to_csv(),
+        )
+        prior = self.alerts.get(entry.service)
+        if prior is not None:
+            interval_s = (alert.alert_timestamp - prior["alertTimestamp"]) / 1000.0
+            cooldown_s = self.config.get("perServiceAlertCooldownInMinutes", 15) * 60
+            if interval_s <= cooldown_s:
+                return None
+        self.alerts[entry.service] = {"alertTimestamp": alert.alert_timestamp}
+        return alert
+
+    def add_to_buffer(self, alert: AlertEntry) -> None:
+        self.alert_buffer.append(
+            {
+                "alertTimestamp": alert.alert_timestamp,
+                "entryTimestamp": alert.entry_timestamp,
+                "server": alert.server,
+                "service": alert.service,
+                "cause": alert.cause,
+                "entry": alert.entry,
+            }
+        )
+
+    # -- batched send with interval doubling (:269-333) ----------------------
+    def flush(self, interval_s: Optional[float] = None) -> Tuple[int, float]:
+        """Send buffered alerts (if any); returns (sent_count, next_interval_s).
+
+        The collection interval doubles after a batch went out, up to
+        maxCollectionIntervalInSeconds, then resets once a quiet flush happens.
+        """
+        base = float(self.config.get("alertCollectionIntervalInSeconds", 60))
+        if interval_s is None:
+            interval_s = self.current_interval_s or base
+        # The whole send/clear/double block is gated on having alerts AND a
+        # live dispatch path (reference gates on emailsEnabled,
+        # stream_process_alerts.js:273); otherwise the buffer is retained so
+        # alerts are not lost, and the interval resets to base.
+        can_send = self.email_sender is not None and bool(self.config.get("emailsEnabled"))
+        if not self.alert_buffer or not can_send:
+            self.current_interval_s = base
+            return 0, base
+        count = len(self.alert_buffer)
+        if self.config.get("increaseCollectionIntervalAfterAlert") and interval_s < float(
+            self.config.get("maxCollectionIntervalInSeconds", 960)
+        ):
+            interval_s *= 2
+        html = self.format_alerts_html()
+        image_path = None
+        if self.grafana is not None:
+            try:
+                _url, render_url = self.grafana.alert_urls(self.alert_buffer)
+                image_path = self.grafana.render(render_url)
+            except Exception as e:  # render failure falls back to plain email
+                if self.logger:
+                    self.logger.error(f"Error while trying to render graph: {e}")
+        self.email_sender("APM Alerts Triggered!", html, image_path)
+        self.alert_buffer = []
+        self.current_interval_s = interval_s
+        return count, interval_s
+
+    def format_alerts_html(self) -> str:
+        """Two-row-per-alert HTML table (:208-267)."""
+        css = (
+            '<style type="text/css" media="all"> table { border-collapse: collapse; }'
+            ' td { font-family: "Calibri"; font-size: 11pt; white-space: nowrap; }'
+            " td, th { padding: 7px; }"
+            " td.bb, th.bb { border-bottom: 2px solid black }"
+            " td.center { text-align: center; } </style>"
+        )
+        head = (
+            '<table><tr bgcolor="#1ab2ff"><th>Server</th><th>Service</th><th>Timestamp</th>'
+            '<th>Lag</th><th>Cause</th></tr><tr bgcolor="#94DBFF"><th class="bb">TPM</th>'
+            '<th class="bb">Avg</th><th class="bb">Avg UB</th><th class="bb">75%</th>'
+            '<th class="bb">75% UB</th></tr>'
+        )
+        rows = []
+        fac = EntryFactory()
+        for el in self.alert_buffer:
+            en = fac.from_csv(el["entry"], delim="&")
+            if en is None:  # corrupted resume data must not poison the flush path
+                if self.logger:
+                    self.logger.error(f"Unparseable buffered alert entry skipped: {el['entry']!r}")
+                continue
+            ts = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(en.timestamp / 1000.0))
+
+            def fx(v):
+                return "NaN" if (isinstance(v, float) and math.isnan(v)) else f"{v:.1f}"
+
+            rows.append(
+                f'<tr bgcolor="white"><td>{en.server}</td><td>{en.service}</td><td>{ts}</td>'
+                f'<td class="center">{en.lag}</td><td>{el["cause"]}</td></tr>'
+                f'<tr bgcolor="#e5f8ff"><td class="bb">{fx(en.tpm)}</td><td class="bb">{fx(en.average)}</td>'
+                f'<td class="bb">{fx(en.average_ub)}</td><td class="bb">{fx(en.per75)}</td>'
+                f'<td class="bb">{fx(en.per75_ub)}</td></tr>'
+            )
+        return css + head + "".join(rows) + "</table>"
+
+    # -- resume (:111-142) ---------------------------------------------------
+    def save_resume(self, path: str, quiet: bool = True) -> None:
+        save_resume_file(path, {"alerts": self.alerts, "alertBuffer": self.alert_buffer}, logger=self.logger, quiet=quiet)
+
+    def load_resume(self, path: str) -> None:
+        data = load_resume_file(path, logger=self.logger)
+        if data:
+            self.alerts = data.get("alerts", {}) or {}
+            self.alert_buffer = data.get("alertBuffer", []) or []

@@ -1,0 +1,121 @@
+"""Service registry: dynamic (server, service) keys onto static device rows.
+
+The reference keeps all per-key state in nested dicts
+``servers[server].services[service]`` that grow as keys appear
+(stream_calc_stats.js:124-129, stream_calc_z_score.js:200-208) and are never
+removed. On TPU, state lives in dense ``[S, ...]`` tensors with static shapes,
+so this registry maps each key to a stable row index. When capacity is
+exhausted the caller grows to the next power-of-two capacity and re-jits
+(growth-by-recompile, SURVEY.md §7.3 "dynamic key space on static shapes").
+
+Also materializes per-row parameter vectors from config (z-score
+threshold/influence per lag, alert overrides, suppression flags) so the device
+step reads them as gathered arrays instead of dict lookups per message.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import service_alert_overrides, service_zscore_settings
+
+
+class CapacityExceeded(Exception):
+    def __init__(self, needed: int, capacity: int):
+        super().__init__(f"Service registry needs {needed} rows but capacity is {capacity}")
+        self.needed = needed
+        self.capacity = capacity
+
+
+class ServiceRegistry:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._index: Dict[Tuple[str, str], int] = {}
+        self._rows: List[Tuple[str, str]] = []
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @property
+    def count(self) -> int:
+        return len(self._rows)
+
+    def key_of(self, row: int) -> Tuple[str, str]:
+        return self._rows[row]
+
+    def rows(self) -> List[Tuple[str, str]]:
+        return list(self._rows)
+
+    def lookup(self, server: str, service: str) -> Optional[int]:
+        return self._index.get((server, service))
+
+    def lookup_or_add(self, server: str, service: str) -> int:
+        key = (server, service)
+        row = self._index.get(key)
+        if row is not None:
+            return row
+        if len(self._rows) >= self.capacity:
+            raise CapacityExceeded(len(self._rows) + 1, self.capacity)
+        row = len(self._rows)
+        self._rows.append(key)
+        self._index[key] = row
+        return row
+
+    def lookup_or_add_batch(self, keys: Iterable[Tuple[str, str]]) -> np.ndarray:
+        return np.fromiter(
+            (self.lookup_or_add(srv, svc) for srv, svc in keys), dtype=np.int32
+        )
+
+    def grown(self, new_capacity: Optional[int] = None) -> "ServiceRegistry":
+        """A copy with doubled (or given) capacity; row assignments preserved."""
+        if new_capacity is None:
+            new_capacity = max(2 * self.capacity, 1)
+        if new_capacity < len(self._rows):
+            raise ValueError("new capacity below current row count")
+        out = ServiceRegistry(new_capacity)
+        out._rows = list(self._rows)
+        out._index = dict(self._index)
+        return out
+
+    # -- per-row parameter vectors ------------------------------------------
+
+    def zscore_params(self, zscore_config: dict, lags: Sequence[int]) -> Dict[int, dict]:
+        """Per-lag {threshold: [S], influence: [S]} float32 vectors.
+
+        Rows beyond the registered count carry the defaults. Overrides follow
+        stream_calc_z_score.js:106-132 (keyed by service name only).
+        """
+        defaults = {int(d["LAG"]): d for d in zscore_config.get("defaults", [])}
+        out = {}
+        for lag in lags:
+            d = defaults.get(int(lag), {"THRESHOLD": 0.0, "INFLUENCE": 0.0})
+            thr = np.full(self.capacity, float(d["THRESHOLD"]), dtype=np.float32)
+            infl = np.full(self.capacity, float(d["INFLUENCE"]), dtype=np.float32)
+            out[int(lag)] = {"threshold": thr, "influence": infl}
+        for row, (_server, service) in enumerate(self._rows):
+            for setting in service_zscore_settings(zscore_config, service):
+                lag = int(setting["LAG"])
+                if lag in out:
+                    out[lag]["threshold"][row] = float(setting["THRESHOLD"])
+                    out[lag]["influence"][row] = float(setting["INFLUENCE"])
+        return out
+
+    def alert_params(self, alerts_config: dict) -> dict:
+        """Per-row alert vectors: hard-max override and service suppression.
+
+        Mirrors stream_process_alerts.js:395-398: a service override of
+        hardMaxMsAlertThreshold applies when set and non-zero.
+        """
+        hard_max_default = float(alerts_config.get("hardMaxMsAlertThreshold", np.inf))
+        hard_max = np.full(self.capacity, hard_max_default, dtype=np.float32)
+        suppressed = np.zeros(self.capacity, dtype=bool)
+        suppressed_services = set(alerts_config.get("suppressedServices", []))
+        for row, (_server, service) in enumerate(self._rows):
+            ov = service_alert_overrides(alerts_config, service)
+            if ov and ov.get("hardMaxMsAlertThreshold"):
+                hard_max[row] = float(ov["hardMaxMsAlertThreshold"])
+            if service in suppressed_services:
+                suppressed[row] = True
+        return {"hard_max_ms": hard_max, "suppressed": suppressed}

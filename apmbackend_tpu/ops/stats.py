@@ -1,0 +1,265 @@
+"""Device-resident windowed statistics engine (the stream_calc_stats rebuild).
+
+The reference buckets elapsed times into 10 s intervals per (server, service)
+dict key and, on each new interval, walks every key computing TPM / average /
+p75 / p95 over a sliding window (stream_calc_stats.js:157-203). Here the same
+computation is one batched XLA program over dense tensors:
+
+- state: ``counts [S, NB]``, ``sums [S, NB]``, ``samples [S, NB, CAP]``,
+  ``nsamples [S, NB]`` — a bucket ring keyed ``slot = label % NB`` with
+  ``NB = windowSize + bufferSize + 1`` slots, exactly the label range the
+  reference retains after ``removeOldBuckets`` (stream_calc_stats.js:103-113).
+- :func:`ingest`: scatter-add a micro-batch of (row, bucket-label, elapsed)
+  triples, including within-batch duplicate-key sample placement.
+- :func:`tick`: on a new latest label, compute per-row window stats for ALL
+  rows at once. Window = labels ``[latest-keep, latest-buffer]`` inclusive — 31
+  labels for the stock config, reproducing the reference's inclusive range
+  (stream_calc_stats.js:172).
+
+Exactness notes (SURVEY.md §7.3):
+- Percentiles are exact order statistics over the stored window samples,
+  using the reference's index formula (util_methods.js:112-142) evaluated in
+  *integer* arithmetic — provably equal to the reference's float64 index math
+  for p in {75, 95} and realistic n.
+- Each (row, bucket) stores at most CAP samples; if a bucket overflows,
+  percentiles are computed over the first CAP samples (counts/averages stay
+  exact). ``overflowed`` in the tick output reports when this happened.
+- ``average`` is sum/count like the reference; NaN where the window is empty
+  (the reference's ``undefined``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StatsConfig(NamedTuple):
+    capacity: int  # S rows
+    window_sz: int = 30  # windowSizeInIntervals
+    buffer_sz: int = 6  # bufferSizeInIntervals
+    interval_len_s: int = 10  # intervalLengthInSeconds
+    samples_per_bucket: int = 128  # CAP
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_keep(self) -> int:
+        # NUM_KEEP_INTERVALS = window + buffer (stream_calc_stats.js:233)
+        return self.window_sz + self.buffer_sz
+
+    @property
+    def num_buckets(self) -> int:
+        # ring must hold labels latest-num_keep .. latest
+        return self.num_keep + 1
+
+    @property
+    def window_label_count(self) -> int:
+        # inclusive [latest-keep, latest-buffer]
+        return self.num_keep - self.buffer_sz + 1
+
+
+class StatsState(NamedTuple):
+    latest_bucket: jnp.ndarray  # int32 scalar
+    counts: jnp.ndarray  # [S, NB] int32
+    sums: jnp.ndarray  # [S, NB] float
+    samples: jnp.ndarray  # [S, NB, CAP] float (NaN = empty)
+    nsamples: jnp.ndarray  # [S, NB] int32 (clamped at CAP)
+
+
+def init_state(cfg: StatsConfig) -> StatsState:
+    S, NB, CAP = cfg.capacity, cfg.num_buckets, cfg.samples_per_bucket
+    return StatsState(
+        latest_bucket=jnp.zeros((), jnp.int32),
+        counts=jnp.zeros((S, NB), jnp.int32),
+        sums=jnp.zeros((S, NB), cfg.dtype),
+        samples=jnp.full((S, NB, CAP), jnp.nan, cfg.dtype),
+        nsamples=jnp.zeros((S, NB), jnp.int32),
+    )
+
+
+def bucket_label(end_ts_ms) -> np.ndarray:
+    """ms timestamp -> 10 s bucket label: the reference truncates the last 4
+
+    digits of the decimal string (stream_calc_stats.js:89-96) == floor/10^4.
+    Host-side (numpy): ms timestamps need 64-bit; the device only ever sees
+    the int32 labels."""
+    return (np.asarray(end_ts_ms, np.int64) // 10000).astype(np.int32)
+
+
+def ts_from_bucket_label(label) -> int:
+    return int(label) * 10000  # stream_calc_stats.js:98-101
+
+
+def _batch_cumcount(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-entry occurrence index among equal keys, in arrival order.
+
+    Used to place duplicate (row, bucket) samples at consecutive slots within
+    one scatter. Invalid entries get arbitrary values (masked by caller).
+    """
+    B = keys.shape[0]
+    big = jnp.where(valid, keys, jnp.iinfo(jnp.int32).max)
+    perm = jnp.argsort(big, stable=True)
+    sorted_keys = big[perm]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
+    )
+    idx = jnp.arange(B, dtype=jnp.int32)
+    run_start = jax.lax.cummax(jnp.where(seg_start, idx, 0))
+    idx_in_run = idx - run_start
+    out = jnp.zeros((B,), jnp.int32).at[perm].set(idx_in_run)
+    return out
+
+
+def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) -> StatsState:
+    """Scatter a micro-batch into the bucket ring.
+
+    rows [B] int32, labels [B] int32, elapsed [B] float, valid [B] bool.
+    Entries whose label is stale (<= latest - NB) or beyond latest are dropped:
+    the driver must tick() to advance latest BEFORE ingesting newer labels
+    (mirroring consumeMsg's stats-before-addData order,
+    stream_calc_stats.js:348-370).
+    """
+    NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
+    rows = jnp.asarray(rows, jnp.int32)
+    labels = jnp.asarray(labels, jnp.int32)
+    elapsed = jnp.asarray(elapsed, cfg.dtype)
+
+    in_range = (labels > state.latest_bucket - NB) & (labels <= state.latest_bucket)
+    valid = jnp.asarray(valid, bool) & in_range
+    slots = jnp.where(valid, labels % NB, 0)
+    srows = jnp.where(valid, rows, 0)
+
+    one = jnp.where(valid, 1, 0).astype(jnp.int32)
+    counts = state.counts.at[srows, slots].add(one, mode="drop")
+    sums = state.sums.at[srows, slots].add(jnp.where(valid, elapsed, 0), mode="drop")
+
+    key = srows * NB + slots
+    cum = _batch_cumcount(key, valid)
+    pos = state.nsamples[srows, slots] + cum
+    ok = valid & (pos < CAP)
+    pos = jnp.where(ok, pos, CAP)  # CAP is out of bounds -> dropped
+    samples = state.samples.at[srows, slots, pos].set(
+        jnp.where(ok, elapsed, jnp.nan), mode="drop"
+    )
+    nsamples = jnp.minimum(state.nsamples.at[srows, slots].add(one, mode="drop"), CAP)
+
+    return state._replace(counts=counts, sums=sums, samples=samples, nsamples=nsamples)
+
+
+def _advance(state: StatsState, cfg: StatsConfig, new_label: jnp.ndarray) -> StatsState:
+    """Zero ring slots claimed by labels (old_latest, new_label] and bump latest."""
+    NB = cfg.num_buckets
+    old = state.latest_bucket
+    k = jnp.minimum(new_label - old, NB)
+    offsets = jnp.arange(1, NB + 1, dtype=jnp.int32)
+    slot_ids = (old + offsets) % NB
+    clear = jnp.zeros((NB,), bool).at[slot_ids].max(offsets <= k)
+    counts = jnp.where(clear[None, :], 0, state.counts)
+    sums = jnp.where(clear[None, :], 0, state.sums)
+    nsamples = jnp.where(clear[None, :], 0, state.nsamples)
+    samples = jnp.where(clear[None, :, None], jnp.nan, state.samples)
+    return StatsState(new_label.astype(jnp.int32), counts, sums, samples, nsamples)
+
+
+def reference_percentile_sorted(sorted_vals: jnp.ndarray, n: jnp.ndarray, p: int) -> jnp.ndarray:
+    """Vectorized util_methods.js:112-142 over ``[..., K]`` ascending-sorted
+
+    arrays (NaN tail) with ``n`` valid entries per row, integer-exact index
+    math: index = p*n/100 - 1; integral -> arr[index]; else mean of arr[ceil]
+    and arr[ceil+1] unless ceil is the last element."""
+    pn = p * n  # int32
+    is_int = (pn % 100) == 0
+    idx_exact = pn // 100 - 1
+    idx_ceil = (pn - 1) // 100  # ceil(pn/100 - 1) for non-integral pn/100
+
+    last = n - 1
+    idx1 = jnp.where(is_int | (n == 1), jnp.maximum(idx_exact, 0), idx_ceil)
+    take_pair = (~is_int) & (n > 1) & (idx_ceil != last)
+    idx1 = jnp.clip(idx1, 0, sorted_vals.shape[-1] - 1)
+    idx2 = jnp.clip(jnp.where(take_pair, idx1 + 1, idx1), 0, sorted_vals.shape[-1] - 1)
+
+    v1 = jnp.take_along_axis(sorted_vals, idx1[..., None], axis=-1)[..., 0]
+    v2 = jnp.take_along_axis(sorted_vals, idx2[..., None], axis=-1)[..., 0]
+    out = jnp.where(take_pair, (v1 + v2) / 2.0, v1)
+    return jnp.where(n > 0, out, jnp.nan)
+
+
+def edge_ts_ms(new_label: int, cfg: StatsConfig) -> int:
+    """Host-side: the timestamp all stats emitted by tick(new_label) carry —
+
+    the end of the last window bucket, (latest - buffer - 1) * 1e4
+    (stream_calc_stats.js:356). Host int to avoid int64-on-device issues."""
+    return (int(new_label) - cfg.buffer_sz - 1) * 10000
+
+
+class TickResult(NamedTuple):
+    tpm: jnp.ndarray  # [S]
+    average: jnp.ndarray  # [S] (NaN = undefined)
+    per75: jnp.ndarray  # [S]
+    per95: jnp.ndarray  # [S]
+    count: jnp.ndarray  # [S] int32 window tx count
+    overflowed: jnp.ndarray  # [S] bool: percentile computed on truncated samples
+
+
+def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, StatsState]:
+    """New-interval step: compute window stats for all rows, then advance.
+
+    Mirrors the consumeMsg new-bucket branch (stream_calc_stats.js:348-366):
+    latestBucket = new_label; removeOldBuckets; stats over
+    [latest-keep, latest-buffer] stamped edgeTs = (latest - buffer - 1) * 1e4.
+    """
+    # Guard against non-increasing labels (the reference only advances on
+    # strictly greater, stream_calc_stats.js:348): clamping makes a stale tick
+    # a harmless re-emission for the current window instead of state corruption.
+    new_label = jnp.maximum(jnp.asarray(new_label, jnp.int32), state.latest_bucket)
+    state = _advance(state, cfg, new_label)
+
+    NB, CAP, W = cfg.num_buckets, cfg.samples_per_bucket, cfg.window_label_count
+    # window labels: latest-keep .. latest-buffer (31 for stock config)
+    offsets = jnp.arange(cfg.buffer_sz, cfg.num_keep + 1, dtype=jnp.int32)
+    slots_w = (new_label - offsets) % NB  # [W]
+
+    cnt = jnp.sum(state.counts[:, slots_w], axis=1)  # [S]
+    total = jnp.sum(state.sums[:, slots_w], axis=1)  # [S]
+    average = jnp.where(cnt > 0, total / cnt, jnp.nan)
+
+    stored = jnp.sum(state.nsamples[:, slots_w], axis=1)  # [S]
+    overflowed = stored < cnt
+
+    window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
+    sorted_samples = jnp.sort(window_samples, axis=-1)  # NaN sorts to the end
+    per75 = reference_percentile_sorted(sorted_samples, stored, 75)
+    per95 = reference_percentile_sorted(sorted_samples, stored, 95)
+
+    tpm = cnt / (cfg.window_sz * cfg.interval_len_s / 60.0)  # stream_calc_stats.js:186
+
+    return TickResult(tpm, average.astype(cfg.dtype), per75, per95, cnt, overflowed), state
+
+
+def quantize_half_up(x: jnp.ndarray, digits: int) -> jnp.ndarray:
+    """Round to ``digits`` decimals, ties toward +inf — the wire rounding the
+
+    reference applies via toFixed/parseFloat between pipeline stages
+    (entries.js:72,117). NaN passes through."""
+    scale = 10.0**digits
+    return jnp.floor(x * scale + 0.5) / scale
+
+
+def grow_state(state: StatsState, cfg: StatsConfig, new_capacity: int) -> Tuple[StatsState, StatsConfig]:
+    """Re-allocate state for a larger row capacity (growth-by-recompile)."""
+    S_old = state.counts.shape[0]
+    if new_capacity < S_old:
+        raise ValueError("cannot shrink")
+    pad = new_capacity - S_old
+    new_cfg = cfg._replace(capacity=new_capacity)
+    return StatsState(
+        latest_bucket=state.latest_bucket,
+        counts=jnp.pad(state.counts, ((0, pad), (0, 0))),
+        sums=jnp.pad(state.sums, ((0, pad), (0, 0))),
+        samples=jnp.pad(state.samples, ((0, pad), (0, 0), (0, 0)), constant_values=jnp.nan),
+        nsamples=jnp.pad(state.nsamples, ((0, pad), (0, 0))),
+    ), new_cfg

@@ -1,0 +1,135 @@
+"""Device-resident smoothed z-score anomaly baselining (stream_calc_z_score rebuild).
+
+The reference keeps, per (server, service, lag), three rolling JS arrays
+(avg/p75/p95 histories) and on every StatEntry recomputes mean + population
+std over the whole window, derives bounds avg ± threshold*std, emits a signal
+in {-1, 0, +1}, and appends an influence-damped value
+(stream_calc_z_score.js:66-104, 195-311). Here the state is a dense ring
+``values [S, 3, L]`` and the whole key space steps in one fused XLA program.
+
+Quirk parity (tested against the float64 host oracle in tests/):
+- Warm-up gating is on *raw pushed length* (including NaN entries):
+  ``prevValuesList.length >= lag`` (stream_calc_z_score.js:75) — modeled by a
+  per-row ``fill`` counter; all 3 metric lists always share one length.
+- mean/std skip NaN entries (util_methods.js:10-50); all-NaN -> undefined.
+- zero variance -> std undefined -> no bounds, no signal
+  (util_methods.js:44-48).
+- signal iff |new - avg| > threshold*std strictly; NaN new value -> 0.
+- influence damping applies only when a signal fired AND the most recently
+  pushed value is non-NaN (stream_calc_z_score.js:96-97); the *damped* value
+  is what enters the ring.
+- stats are computed over the window BEFORE the shift+push.
+
+The per-step cost is a masked reduction over [S, 3, L] — bandwidth-bound and
+embarrassingly parallel, exactly what the VPU + HBM pipeline wants; at stock
+shapes one step is far under the 10 s cadence, and throughput is benchmarked
+in metrics/sec (bench.py). An O(1) incremental running-sum variant is a
+planned optimization; the full reduction is the exactness baseline.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+N_METRICS = 3  # average, per75, per95 (in that order on axis 1)
+
+
+class ZScoreConfig(NamedTuple):
+    capacity: int  # S
+    lag: int  # L (window length in intervals)
+    dtype: jnp.dtype = jnp.float32
+
+
+class ZScoreState(NamedTuple):
+    values: jnp.ndarray  # [S, 3, L] ring (NaN where never written)
+    fill: jnp.ndarray  # [S] int32: list length (0..L)
+    pos: jnp.ndarray  # [S] int32: next write slot once full
+
+
+def init_state(cfg: ZScoreConfig) -> ZScoreState:
+    S, L = cfg.capacity, cfg.lag
+    return ZScoreState(
+        values=jnp.full((S, N_METRICS, L), jnp.nan, cfg.dtype),
+        fill=jnp.zeros((S,), jnp.int32),
+        pos=jnp.zeros((S,), jnp.int32),
+    )
+
+
+class ZScoreResult(NamedTuple):
+    # each [S, 3] on the metric axis (average, per75, per95)
+    window_avg: jnp.ndarray  # NaN = undefined
+    lower_bound: jnp.ndarray
+    upper_bound: jnp.ndarray
+    signal: jnp.ndarray  # int32 in {-1, 0, 1}
+
+
+def step(
+    state: ZScoreState,
+    cfg: ZScoreConfig,
+    new_values: jnp.ndarray,  # [S, 3]: this tick's average/per75/per95 per row
+    threshold: jnp.ndarray,  # [S]
+    influence: jnp.ndarray,  # [S]
+) -> Tuple[ZScoreResult, ZScoreState]:
+    S, L = cfg.capacity, cfg.lag
+    vals = state.values  # [S, 3, L]
+    fill = state.fill  # [S]
+    full = fill >= L  # [S] — signal eligibility (raw length incl. NaN pushes)
+
+    valid = ~jnp.isnan(vals)  # [S, 3, L]
+    cnt = jnp.sum(valid, axis=-1)  # [S, 3]
+    total = jnp.sum(jnp.where(valid, vals, 0), axis=-1)
+    has_avg = (cnt > 0) & full[:, None]
+    mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
+
+    diff = jnp.where(valid, vals - mean[..., None], 0)
+    var = jnp.where(has_avg, jnp.sum(diff * diff, axis=-1) / jnp.maximum(cnt, 1), jnp.nan)
+    has_std = has_avg & (var > 0)  # var==0 -> std undefined (the quirk)
+    std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
+
+    thr = threshold[:, None]
+    lb = jnp.where(has_std, mean - thr * std, jnp.nan)
+    ub = jnp.where(has_std, mean + thr * std, jnp.nan)
+
+    new_ok = ~jnp.isnan(new_values)
+    exceeds = has_std & new_ok & (jnp.abs(new_values - mean) > thr * std)
+    signal = jnp.where(
+        exceeds, jnp.where(new_values > mean, 1, -1), 0
+    ).astype(jnp.int32)
+
+    # influence damping: only on signal and when the last pushed value is defined
+    last_idx = jnp.where(full, (state.pos - 1) % L, jnp.maximum(fill - 1, 0))  # [S]
+    last_val = jnp.take_along_axis(vals, last_idx[:, None, None].repeat(N_METRICS, 1), axis=-1)[..., 0]
+    can_damp = exceeds & ~jnp.isnan(last_val) & (fill > 0)[:, None]
+    infl = influence[:, None]
+    pushed = jnp.where(can_damp, infl * new_values + (1 - infl) * last_val, new_values)
+
+    # shift-at-lag semantics: write slot = pos when full (overwriting the
+    # oldest), else fill (append); fill grows to L then stays
+    write_idx = jnp.where(full, state.pos, fill)  # [S]
+    new_vals = jax.vmap(lambda v, i, p: v.at[:, i].set(p))(vals, write_idx, pushed.astype(cfg.dtype))
+    new_fill = jnp.minimum(fill + 1, L)
+    new_pos = jnp.where(full, (state.pos + 1) % L, state.pos)
+
+    result = ZScoreResult(
+        window_avg=mean.astype(cfg.dtype),
+        lower_bound=lb.astype(cfg.dtype),
+        upper_bound=ub.astype(cfg.dtype),
+        signal=signal,
+    )
+    return result, ZScoreState(new_vals, new_fill, new_pos)
+
+
+def grow_state(state: ZScoreState, cfg: ZScoreConfig, new_capacity: int) -> Tuple[ZScoreState, ZScoreConfig]:
+    S_old = state.fill.shape[0]
+    if new_capacity < S_old:
+        raise ValueError("cannot shrink")
+    pad = new_capacity - S_old
+    new_cfg = cfg._replace(capacity=new_capacity)
+    return ZScoreState(
+        values=jnp.pad(state.values, ((0, pad), (0, 0), (0, 0)), constant_values=jnp.nan),
+        fill=jnp.pad(state.fill, (0, pad)),
+        pos=jnp.pad(state.pos, (0, pad)),
+    ), new_cfg

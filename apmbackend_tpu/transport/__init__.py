@@ -1,0 +1,31 @@
+from .base import Channel, ConsumerQueue, EventEmitter, ProducerQueue, QueueManager  # noqa: F401
+from .memory import MemoryBroker, MemoryChannel  # noqa: F401
+from .amqp import AmqpChannel, HAVE_PIKA  # noqa: F401
+
+
+def make_queue_manager(config: dict, *, broker=None, logger=None) -> QueueManager:
+    """Build a QueueManager for the configured backend.
+
+    ``brokerBackend: "memory"`` shares the passed (or a fresh) MemoryBroker
+    between the producer and consumer channels; ``"amqp"`` connects to
+    ``amqpConnectionString`` per channel like the reference (queue.js:120-137).
+    """
+    backend = config.get("brokerBackend", "memory")
+    interval = config.get("statLogIntervalInSeconds", 60)
+    if backend == "memory":
+        shared = broker if broker is not None else MemoryBroker()
+
+        def factory(_kind: str):
+            return MemoryChannel(shared)
+
+        qm = QueueManager(factory, interval, logger=logger)
+        qm.broker = shared
+        return qm
+    if backend == "amqp":
+        conn = config["amqpConnectionString"]
+
+        def factory(_kind: str):
+            return AmqpChannel(conn)
+
+        return QueueManager(factory, interval, logger=logger)
+    raise ValueError(f"Unknown brokerBackend: {backend}")

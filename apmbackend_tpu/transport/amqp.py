@@ -1,0 +1,77 @@
+"""AMQP/RabbitMQ backend.
+
+Role parity with queue.js: named durable queues on a RabbitMQ broker,
+ack-on-receipt consumption, publish backpressure. Uses ``pika`` when present;
+this environment ships without an AMQP client, so construction raises a clear
+error and the rest of the framework (which only depends on the Channel
+interface) runs on the memory backend. Wire format on the queues is identical
+(UTF-8 pipe-CSV), so a deployment with RabbitMQ interoperates with reference
+modules consuming the same queues.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .base import Channel
+
+try:  # pragma: no cover - optional dependency
+    import pika  # type: ignore
+
+    HAVE_PIKA = True
+except ImportError:  # pragma: no cover
+    pika = None
+    HAVE_PIKA = False
+
+
+class AmqpChannel(Channel):  # pragma: no cover - requires live broker
+    def __init__(self, connection_string: str):
+        if not HAVE_PIKA:
+            raise RuntimeError(
+                "AMQP backend requires the 'pika' package, which is not installed. "
+                "Use brokerBackend='memory' or install pika."
+            )
+        params = pika.URLParameters(connection_string)
+        self._connection = pika.BlockingConnection(params)
+        self._channel = self._connection.channel()
+        self._drain_callbacks = []
+        self._consumer_tags = {}
+
+    def assert_queue(self, name: str) -> None:
+        self._channel.queue_declare(queue=name, durable=True)
+
+    def send(self, name: str, payload: bytes) -> bool:
+        try:
+            self._channel.basic_publish(
+                exchange="",
+                routing_key=name,
+                body=payload,
+                properties=pika.BasicProperties(delivery_mode=2),
+            )
+            return True
+        except pika.exceptions.AMQPError:
+            return False
+
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+        def _on_message(ch, method, properties, body):
+            ch.basic_ack(delivery_tag=method.delivery_tag)  # ack-on-receipt
+            callback(body)
+
+        tag = self._channel.basic_consume(queue=name, on_message_callback=_on_message, consumer_tag=consumer_tag)
+        self._consumer_tags[consumer_tag] = tag
+
+    def cancel(self, consumer_tag: str) -> None:
+        self._channel.basic_cancel(consumer_tag)
+
+    def on_drain(self, callback) -> None:
+        self._drain_callbacks.append(callback)
+
+    def close(self) -> None:
+        try:
+            self._channel.close()
+        finally:
+            self._connection.close()
+
+    def start_io(self) -> None:
+        """Blocking consume loop (call from a dedicated thread)."""
+        self._channel.start_consuming()

@@ -1,0 +1,224 @@
+"""Broker abstraction with the reference's backpressure contract.
+
+Contract parity with queue.js:
+
+- ``QueueManager.get_queue(name, 'p'|'c', consume_cb)`` returns a producer or
+  consumer handle for a named durable queue (queue.js:108-154).
+- ``ProducerQueue.write_line(line)``: when the underlying channel refuses the
+  message (full), the line is buffered locally and a global ``pause`` event is
+  emitted (queue.js:245-263). Stream modules react by cancelling consumption;
+  the parser additionally creates the tail pause file.
+- On drain, the manager retries every producer buffer; once ALL buffers are
+  empty a global ``resume`` event fires (queue.js:88-106).
+- ``ConsumerQueue``: messages are acked on receipt, before processing
+  (at-most-once past the ack, queue.js:277-283). ``start_consume`` /
+  ``stop_consume`` toggle delivery.
+
+Backends: :mod:`.memory` (bounded in-process queues — the fake broker the
+reference never had, SURVEY.md §4) and :mod:`.amqp` (RabbitMQ via an AMQP
+client when available).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from ..utils.counters import QueueStats
+
+
+class EventEmitter:
+    """Minimal synchronous event emitter (Node EventEmitter analog)."""
+
+    def __init__(self):
+        self._handlers: Dict[str, List[Callable]] = defaultdict(list)
+
+    def on(self, event: str, handler: Callable) -> None:
+        self._handlers[event].append(handler)
+
+    def emit(self, event: str, *args) -> None:
+        for handler in list(self._handlers[event]):
+            handler(*args)
+
+
+class Channel:
+    """Transport-level channel a backend must provide."""
+
+    def assert_queue(self, name: str) -> None:
+        raise NotImplementedError
+
+    def send(self, name: str, payload: bytes) -> bool:
+        """Returns False when the channel/queue is full (backpressure)."""
+        raise NotImplementedError
+
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+        raise NotImplementedError
+
+    def cancel(self, consumer_tag: str) -> None:
+        raise NotImplementedError
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ProducerQueue(EventEmitter):
+    def __init__(self, queue_name: str, channel: Channel, queue_stats: QueueStats, logger=None):
+        super().__init__()
+        self.queue_name = queue_name
+        self.channel = channel
+        self.queue_stats = queue_stats
+        self.logger = logger
+        self.buffer: List[str] = []
+        self.paused = False
+        self.type = "p"
+        self._lock = threading.Lock()
+        self.queue_stats.add_counter(queue_name, "p")
+        channel.assert_queue(queue_name)
+
+    def buffer_count(self) -> int:
+        return len(self.buffer)
+
+    def write_line(self, line: str, verbose: bool = False) -> None:
+        with self._lock:
+            if self.paused:
+                self.buffer.append(line)
+                return
+            ok = self.channel.send(self.queue_name, line.encode("utf-8"))
+            if not ok:
+                self.buffer.append(line)
+                self.paused = True
+                emit_pause = True
+            else:
+                emit_pause = False
+                if verbose and self.logger:
+                    self.logger.info(f"QUEUE: {self.queue_name} ::: {line}")
+                self.queue_stats.incr(self.queue_name)
+        if emit_pause:
+            if self.logger:
+                self.logger.info(
+                    f"--- PRODUCER CHANNEL BUFFER FULL (Q={self.queue_name}) --- Pausing until drain event"
+                )
+            self.emit("pause")
+
+    def retry_buffer(self) -> None:
+        """Re-send buffered lines until empty or the channel refuses again
+
+        (queue.js:230-243)."""
+        self.paused = False
+        while self.buffer and not self.paused:
+            line = self.buffer.pop(0)
+            self.write_line(line)
+        if self.buffer and self.logger:
+            self.logger.info(
+                f"Records still remaining in {self.queue_name} buffer, waiting for next drain: "
+                f"{len(self.buffer)} records"
+            )
+
+
+class ConsumerQueue(EventEmitter):
+    def __init__(
+        self,
+        queue_name: str,
+        channel: Channel,
+        queue_stats: QueueStats,
+        consume_cb: Callable[[str], None],
+        logger=None,
+    ):
+        super().__init__()
+        self.queue_name = queue_name
+        self.channel = channel
+        self.queue_stats = queue_stats
+        self.consume_cb = consume_cb
+        self.logger = logger
+        self.consumer_tag = f"xConsumerTagx-{queue_name}"
+        self.is_consuming = False
+        self.type = "c"
+        self.queue_stats.add_counter(queue_name, "c")
+        channel.assert_queue(queue_name)
+
+    def _wrapped(self, payload: bytes) -> None:
+        # Ack-on-receipt semantics: the backend has already removed the message
+        # by the time we see it (queue.js:277-283).
+        self.queue_stats.incr(self.queue_name)
+        self.consume_cb(payload.decode("utf-8"))
+
+    def start_consume(self) -> None:
+        if not self.is_consuming:
+            self.is_consuming = True
+            self.channel.consume(self.queue_name, self._wrapped, self.consumer_tag)
+
+    def stop_consume(self) -> None:
+        self.is_consuming = False
+        try:
+            self.channel.cancel(self.consumer_tag)
+        except Exception as e:  # reference swallows cancel errors (queue.js:297-304)
+            if self.logger:
+                self.logger.error(f"channel.cancel() threw an error: {e}")
+
+
+class QueueManager(EventEmitter):
+    """One producer channel + one consumer channel per process, named queues,
+
+    pause/resume propagation (queue.js:67-189)."""
+
+    def __init__(self, backend_factory: Callable[[str], Channel], stat_log_interval_s: int = 60, logger=None):
+        super().__init__()
+        self._backend_factory = backend_factory
+        self.queue_stats = QueueStats(stat_log_interval_s, logger=logger)
+        self.logger = logger
+        self.producer_channel: Optional[Channel] = None
+        self.consumer_channel: Optional[Channel] = None
+        self.queue_map: Dict[str, object] = {}
+
+    def set_interval(self, interval_s: int) -> None:
+        self.queue_stats.set_interval(interval_s)
+
+    def retry_all_queue_buffers(self) -> None:
+        for queue in self.queue_map.values():
+            if queue.type == "p":
+                queue.retry_buffer()
+        total = sum(q.buffer_count() for q in self.queue_map.values() if q.type == "p")
+        if total == 0:
+            self.emit("resume")
+
+    def get_queue(self, queue_name: str, qtype: str, consume_cb=None):
+        if queue_name in self.queue_map:
+            return self.queue_map[queue_name]
+        if qtype not in ("p", "c"):
+            raise ValueError("Type must be either 'p' or 'c'.")
+        if qtype == "c" and consume_cb is None:
+            raise ValueError("A callback must be provided when consuming a queue.")
+
+        if qtype == "p":
+            if self.producer_channel is None:
+                self.producer_channel = self._backend_factory("p")
+                self.producer_channel.on_drain(self._on_drain)
+            queue = ProducerQueue(queue_name, self.producer_channel, self.queue_stats, self.logger)
+            queue.on("pause", lambda: self.emit("pause"))
+        else:
+            if self.consumer_channel is None:
+                self.consumer_channel = self._backend_factory("c")
+            queue = ConsumerQueue(queue_name, self.consumer_channel, self.queue_stats, consume_cb, self.logger)
+        self.queue_map[queue_name] = queue
+        return queue
+
+    def _on_drain(self) -> None:
+        if self.logger:
+            self.logger.info("+++ DRAIN EVENT +++ on producer channel")
+        self.retry_all_queue_buffers()
+
+    def shutdown(self) -> None:
+        self.queue_stats.stop()
+        for ch in (self.producer_channel, self.consumer_channel):
+            if ch is not None:
+                try:
+                    ch.close()
+                except Exception as e:
+                    if self.logger:
+                        self.logger.error(f"channel.close() error: {e}")
+        self.producer_channel = None
+        self.consumer_channel = None

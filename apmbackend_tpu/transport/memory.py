@@ -1,0 +1,169 @@
+"""In-memory broker backend: the fake broker the reference never had.
+
+Bounded per-queue deques with the pause/drain contract: ``send`` returns False
+when a queue crosses its high-water mark; once the depth falls to the
+low-water mark a drain callback fires on the producer channel. Delivery is
+either *pumped* deterministically (tests, single-process pipelines) or driven
+by a background thread (live mode).
+
+The broker object is shareable between modules in one process, standing in for
+the external RabbitMQ server; queue depth/memory introspection mirrors what
+``rabbitmqctl list_queues`` provided the manager (apm_manager.js:429-453).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .base import Channel
+
+
+class _NamedQueue:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.items: deque = deque()
+        self.consumers: List[Tuple[str, Callable[[bytes], None]]] = []
+
+
+class MemoryBroker:
+    """Process-local named-queue store shared by producer/consumer channels."""
+
+    def __init__(self, capacity: int = 10000, low_water_ratio: float = 0.5):
+        self.capacity = capacity
+        self.low_water_ratio = low_water_ratio
+        self._queues: Dict[str, _NamedQueue] = {}
+        self._lock = threading.RLock()
+        self._drain_callbacks: List[Callable[[], None]] = []
+        self._was_full = False
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._work = threading.Event()
+
+    # -- queue admin ---------------------------------------------------------
+    def assert_queue(self, name: str) -> None:
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = _NamedQueue(self.capacity)
+
+    def queue_depth(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return len(q.items) if q else 0
+
+    def queue_names(self) -> List[str]:
+        with self._lock:
+            return list(self._queues)
+
+    def queue_memory_bytes(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return sum(len(p) for p in q.items) if q else 0
+
+    # -- producer side -------------------------------------------------------
+    def send(self, name: str, payload: bytes) -> bool:
+        with self._lock:
+            q = self._queues[name]
+            if len(q.items) >= q.capacity:
+                self._was_full = True
+                return False
+            q.items.append(payload)
+        self._work.set()
+        return True
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        with self._lock:
+            self._drain_callbacks.append(callback)
+
+    # -- consumer side -------------------------------------------------------
+    def consume(self, name: str, callback: Callable[[bytes], None], tag: str) -> None:
+        with self._lock:
+            q = self._queues[name]
+            if not any(t == tag for t, _ in q.consumers):
+                q.consumers.append((tag, callback))
+        self._work.set()
+
+    def cancel(self, tag: str) -> None:
+        with self._lock:
+            for q in self._queues.values():
+                q.consumers = [(t, cb) for t, cb in q.consumers if t != tag]
+
+    # -- delivery ------------------------------------------------------------
+    def pump(self, max_messages: Optional[int] = None) -> int:
+        """Deliver pending messages to registered consumers; returns count.
+
+        Messages are removed before the callback runs (ack-on-receipt).
+        """
+        delivered = 0
+        while max_messages is None or delivered < max_messages:
+            with self._lock:
+                batch = []
+                budget = None if max_messages is None else max_messages - delivered
+                for q in self._queues.values():
+                    if budget is not None and len(batch) >= budget:
+                        break
+                    if q.consumers and q.items:
+                        payload = q.items.popleft()
+                        batch.append((q.consumers[0][1], payload))
+                if not batch:
+                    break
+            for cb, payload in batch:
+                cb(payload)
+                delivered += 1
+            self._maybe_drain()
+        self._maybe_drain()
+        return delivered
+
+    def _maybe_drain(self) -> None:
+        with self._lock:
+            if not self._was_full:
+                return
+            if any(len(q.items) > q.capacity * self.low_water_ratio for q in self._queues.values()):
+                return
+            self._was_full = False
+            callbacks = list(self._drain_callbacks)
+        for cb in callbacks:
+            cb()
+
+    def start_pump_thread(self) -> None:
+        if self._pump_thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.is_set():
+                if self.pump() == 0:
+                    self._work.clear()
+                    self._work.wait(timeout=0.05)
+
+        self._pump_thread = threading.Thread(target=_loop, name="memory-broker-pump", daemon=True)
+        self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+            self._pump_thread = None
+
+
+class MemoryChannel(Channel):
+    """Channel view over a shared MemoryBroker."""
+
+    def __init__(self, broker: MemoryBroker):
+        self.broker = broker
+
+    def assert_queue(self, name: str) -> None:
+        self.broker.assert_queue(name)
+
+    def send(self, name: str, payload: bytes) -> bool:
+        return self.broker.send(name, payload)
+
+    def consume(self, name: str, callback, consumer_tag: str) -> None:
+        self.broker.consume(name, callback, consumer_tag)
+
+    def cancel(self, consumer_tag: str) -> None:
+        self.broker.cancel(consumer_tag)
+
+    def on_drain(self, callback) -> None:
+        self.broker.on_drain(callback)

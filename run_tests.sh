@@ -1,0 +1,10 @@
+#!/bin/sh
+# Run the test suite on the virtual 8-device CPU platform.
+#
+# PYTHONPATH is stripped because the environment's axon sitecustomize dials the
+# TPU relay at interpreter start; tests must not depend on (or block on) the
+# tunnel. conftest.py additionally pins JAX_PLATFORMS=cpu and 8 host devices.
+cd "$(dirname "$0")"
+exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m pytest tests/ "$@"

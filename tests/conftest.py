@@ -7,11 +7,19 @@ hardware. The driver's dryrun_multichip uses the same mechanism.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of the environment's JAX_PLATFORMS (the axon TPU tunnel
+# must never be touched by unit tests). NOTE: if the axon sitecustomize is on
+# PYTHONPATH it may already have dialed the TPU relay at interpreter start —
+# use ./run_tests.sh, which strips PYTHONPATH, as the canonical entry point.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "True"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

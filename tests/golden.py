@@ -1,0 +1,129 @@
+"""Pure-Python float64 oracles reproducing the reference modules' semantics.
+
+These re-implement StatParser (stream_calc_stats.js:28-204) and ZScoreParser
+(stream_calc_z_score.js:26-312) behavior exactly — dicts, lists, per-message —
+so the batched device engine can be property-tested against them.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from apmbackend_tpu.utils.jsmath import (
+    binary_concat,
+    js_average,
+    js_percentile,
+    js_standard_deviation,
+)
+
+NAN = float("nan")
+
+
+def _nan(x: Optional[float]) -> float:
+    return NAN if x is None else x
+
+
+class GoldenStats:
+    """Per-message bucket dicts + tick stats, reference semantics."""
+
+    def __init__(self, window_sz=30, buffer_sz=6, interval_len=10):
+        self.window_sz = window_sz
+        self.buffer_sz = buffer_sz
+        self.interval_len = interval_len
+        self.num_keep = window_sz + buffer_sz
+        self.latest_bucket = 0
+        self.servers: Dict[str, Dict[str, Dict[int, List[int]]]] = {}
+
+    def add(self, server: str, service: str, end_ts_ms: int, elapsed: int):
+        """Returns list of stat rows emitted if this entry opened a new bucket."""
+        label = end_ts_ms // 10000
+        out = []
+        if label > self.latest_bucket:
+            self.latest_bucket = label
+            self._remove_old()
+            edge_ts = (self.latest_bucket - self.buffer_sz - 1) * 10000
+            out = self.generate_all(edge_ts)
+        key = self.servers.setdefault(server, {}).setdefault(service, {})
+        key.setdefault(label, []).append(int(elapsed))
+        return out
+
+    def _remove_old(self):
+        for services in self.servers.values():
+            for buckets in services.values():
+                for label in [l for l in buckets if l < self.latest_bucket - self.num_keep]:
+                    del buckets[label]
+
+    def generate_all(self, edge_ts: int):
+        rows = []
+        for server, services in self.servers.items():
+            for service, buckets in services.items():
+                cnt = 0
+                total = 0.0
+                sorted_elaps: List[int] = []
+                for label, arr in buckets.items():
+                    if (
+                        label >= self.latest_bucket - self.num_keep
+                        and label <= self.latest_bucket - self.buffer_sz
+                    ):
+                        cnt += len(arr)
+                        total += sum(arr)
+                        binary_concat(sorted_elaps, arr, True)
+                avg = p75 = p95 = None
+                if cnt != 0:
+                    avg = total / cnt
+                    p75 = js_percentile(sorted_elaps, 75)
+                    p95 = js_percentile(sorted_elaps, 95)
+                tpm = cnt / (self.window_sz * self.interval_len / 60.0)
+                rows.append(
+                    {
+                        "ts": edge_ts, "server": server, "service": service,
+                        "tpm": tpm, "average": _nan(avg), "per75": _nan(p75), "per95": _nan(p95),
+                        "count": cnt,
+                    }
+                )
+        return rows
+
+
+class GoldenZScore:
+    """Per-message rolling lists, reference semantics incl. influence damping."""
+
+    def __init__(self, lag: int, threshold: float, influence: float):
+        self.lag = lag
+        self.threshold = threshold
+        self.influence = influence
+        self.lists: Dict[Tuple[str, str], Dict[str, List[float]]] = {}
+
+    def _process_metric(self, new_value: float, lst: List[float]):
+        infl_new = new_value
+        avg = std = lb = ub = None
+        signal = 0
+        if len(lst) >= self.lag:
+            avg = js_average(lst)
+            std = js_standard_deviation(lst)
+            if (avg is not None) and (std is not None):
+                lb = avg - self.threshold * std
+                ub = avg + self.threshold * std
+            if avg is None or std is None:
+                signal = 0
+            elif math.isnan(new_value):
+                signal = 0
+            elif abs(new_value - avg) > self.threshold * std:
+                signal = 1 if new_value > avg else -1
+                last = lst[-1] if lst else None
+                if last is not None and not math.isnan(last):
+                    infl_new = self.influence * new_value + (1 - self.influence) * last
+        return infl_new, _nan(avg), _nan(lb), _nan(ub), signal
+
+    def step(self, server: str, service: str, average: float, per75: float, per95: float):
+        key = (server, service)
+        lists = self.lists.setdefault(key, {"avg": [], "p75": [], "p95": []})
+        out = {}
+        for metric, val in (("avg", average), ("p75", per75), ("p95", per95)):
+            lst = lists[metric]
+            infl, avg, lb, ub, sig = self._process_metric(val, lst)
+            if len(lst) >= self.lag:
+                lst.pop(0)
+            lst.append(infl)
+            out[metric] = {"avg": avg, "lb": lb, "ub": ub, "signal": sig}
+        return out

@@ -1,0 +1,256 @@
+"""Alert rules: device eval vs a per-entry golden oracle + host manager tests."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from apmbackend_tpu.entries import FullStatEntry
+from apmbackend_tpu.ops import alerts as da
+
+
+class GoldenAlertCounter:
+    """processFSEntry's counter/trigger ladder for ONE (server,service,lag)
+
+    (stream_process_alerts.js:348-434), minus cooldown (host-side)."""
+
+    def __init__(self, cfg: da.AlertRuleConfig):
+        self.cfg = cfg
+        self.count = 0
+
+    def step(self, average, per75, tpm, avg_sig, p75_sig, hard_max, svc_suppressed):
+        causes = []
+        incremented = False
+        triggered = []
+
+        def alert(s):
+            nonlocal incremented
+            if not incremented:
+                if self.count <= self.cfg.window_sz:
+                    self.count += 1
+                incremented = True
+            windowed = self.cfg.window_sz > 1 and self.cfg.required_bad > 1
+            if windowed:
+                if self.count >= self.cfg.required_bad:
+                    triggered.append(s)
+            else:
+                triggered.append(s)
+
+        if not self.cfg.lag_suppressed and not svc_suppressed:
+            if not math.isnan(average) and average > hard_max:
+                alert("average exceeded hard ms threshold")
+            if not math.isnan(per75) and per75 > hard_max:
+                alert("per75 exceeded hard ms threshold")
+            both = 0
+            if avg_sig > 0 and average > self.cfg.hard_min_ms and tpm > self.cfg.hard_min_tpm:
+                if not self.cfg.alert_on_both_only:
+                    alert("average UB exceeded")
+                else:
+                    both += 1
+            if p75_sig > 0 and per75 > self.cfg.hard_min_ms and tpm > self.cfg.hard_min_tpm:
+                if not self.cfg.alert_on_both_only:
+                    alert("per75 UB exceeded")
+                else:
+                    both += 1
+            if self.cfg.alert_on_both_only and both >= 2:
+                alert("average and per75 UB exceeded")
+
+        if not incremented and self.count > 0:
+            self.count -= 1
+        self.count = max(self.count, 0)
+        return triggered
+
+
+def run_pair(cfg, entries, hard_max=10000.0, suppressed=False):
+    golden = GoldenAlertCounter(cfg)
+    counters = jnp.zeros(1, jnp.int32)
+    mism = []
+    for e in entries:
+        avg, p75, tpm, a_sig, p_sig = e
+        g_causes = golden.step(avg, p75, tpm, a_sig, p_sig, hard_max, suppressed)
+        res = da.eval_rules(
+            counters, cfg,
+            jnp.array([avg]), jnp.array([p75]), jnp.array([tpm]),
+            jnp.array([a_sig]), jnp.array([p_sig]),
+            jnp.array([hard_max]), jnp.array([suppressed]),
+        )
+        counters = res.counters
+        d_causes = da.cause_string(int(res.cause_bits[0]))
+        g_str = ",".join(g_causes)
+        if g_str != d_causes or (bool(res.trigger[0]) != bool(g_causes)):
+            mism.append((e, g_str, d_causes))
+        assert golden.count == int(counters[0]), (e, golden.count, int(counters[0]))
+    assert not mism, mism
+
+
+def cfg_windowed(**kw):
+    d = dict(hard_min_ms=200.0, hard_min_tpm=1.0, alert_on_both_only=True,
+             window_sz=5, required_bad=3, lag_suppressed=False)
+    d.update(kw)
+    return da.AlertRuleConfig(**d)
+
+
+def test_hard_threshold_with_window():
+    cfg = cfg_windowed()
+    entries = [(20000.0, 100.0, 5.0, 0, 0)] * 6  # avg over hard max repeatedly
+    run_pair(cfg, entries)
+
+
+def test_both_only_gate():
+    cfg = cfg_windowed(window_sz=1, required_bad=1)
+    # only avg signal: no alert in both-only mode
+    run_pair(cfg, [(300.0, 300.0, 5.0, 1, 0)] * 3)
+    # both signals: alert
+    run_pair(cfg, [(300.0, 300.0, 5.0, 1, 1)] * 3)
+
+
+def test_min_gates_block():
+    cfg = cfg_windowed(window_sz=1, required_bad=1)
+    run_pair(cfg, [(100.0, 100.0, 5.0, 1, 1)])  # below hardMin ms
+    run_pair(cfg, [(300.0, 300.0, 0.5, 1, 1)])  # below min tpm
+
+
+def test_counter_decay_and_cap():
+    cfg = cfg_windowed(window_sz=3, required_bad=2)
+    entries = (
+        [(20000.0, 100.0, 5.0, 0, 0)] * 6  # bad x6 (cap at window+1)
+        + [(100.0, 100.0, 5.0, 0, 0)] * 10  # quiet: decay to 0
+        + [(20000.0, 100.0, 5.0, 0, 0)] * 2  # needs 2 bad again
+    )
+    run_pair(cfg, entries)
+
+
+def test_suppressed_service_decays():
+    cfg = cfg_windowed(window_sz=1, required_bad=1)
+    run_pair(cfg, [(20000.0, 100.0, 5.0, 1, 1)] * 3, suppressed=True)
+
+
+def test_lag_suppressed():
+    cfg = cfg_windowed(window_sz=1, required_bad=1, lag_suppressed=True)
+    run_pair(cfg, [(20000.0, 100.0, 5.0, 1, 1)] * 3)
+
+
+def test_nan_stats_never_alert():
+    cfg = cfg_windowed(window_sz=1, required_bad=1)
+    nan = float("nan")
+    run_pair(cfg, [(nan, nan, 0.0, 0, 0)] * 3)
+
+
+def test_not_both_only_individual_causes():
+    cfg = cfg_windowed(alert_on_both_only=False, window_sz=1, required_bad=1)
+    run_pair(cfg, [(300.0, 100.0, 5.0, 1, 0)])
+    run_pair(cfg, [(100.0, 300.0, 5.0, 0, 1)])
+
+
+def test_fuzz_rules():
+    rng = np.random.RandomState(5)
+    for both in (True, False):
+        for wsz, req in ((1, 1), (5, 3), (60, 45)):
+            cfg = cfg_windowed(alert_on_both_only=both, window_sz=wsz, required_bad=req)
+            entries = []
+            for _ in range(200):
+                avg = float(rng.choice([50, 250, 15000, float("nan")]))
+                p75 = float(rng.choice([50, 250, 15000, float("nan")]))
+                tpm = float(rng.choice([0.0, 0.5, 5.0]))
+                entries.append((avg, p75, tpm, int(rng.randint(-1, 2)), int(rng.randint(-1, 2))))
+            run_pair(cfg, entries)
+
+
+# -- host-side AlertsManager ------------------------------------------------
+
+
+def make_fs(service="svcA", ts=1_700_000_000_000):
+    return FullStatEntry(
+        ts, "srv1", service, 5.0, 360,
+        300.0, 100.0, 50.0, 150.0, 1,
+        300.0, 100.0, 50.0, 150.0, 1,
+        300.0, 100.0, 50.0, 150.0, 0,
+    )
+
+
+def manager(clock, emails):
+    cfg = {
+        "perServiceAlertCooldownInMinutes": 15,
+        "alertCollectionIntervalInSeconds": 60,
+        "increaseCollectionIntervalAfterAlert": True,
+        "maxCollectionIntervalInSeconds": 960,
+        "emailsEnabled": True,
+    }
+    return da.AlertsManager(
+        cfg, email_sender=lambda subj, html, img: emails.append((subj, html, img)), clock=clock
+    )
+
+
+def test_cooldown_per_service():
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    a1 = mgr.process_trigger(make_fs("svcA"), da.CAUSE_BOTH_UB)
+    assert a1 is not None and a1.cause == "average and per75 UB exceeded"
+    # within cooldown: suppressed
+    now[0] += 60
+    assert mgr.process_trigger(make_fs("svcA"), da.CAUSE_BOTH_UB) is None
+    # different service: not suppressed (cooldown keyed by service only)
+    assert mgr.process_trigger(make_fs("svcB"), da.CAUSE_AVG_HARD) is not None
+    # past cooldown: fires again
+    now[0] += 15 * 60 + 1
+    assert mgr.process_trigger(make_fs("svcA"), da.CAUSE_BOTH_UB) is not None
+
+
+def test_flush_interval_doubling_and_reset():
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    alert = mgr.process_trigger(make_fs(), da.CAUSE_BOTH_UB)
+    mgr.add_to_buffer(alert)
+    sent, interval = mgr.flush(60)
+    assert sent == 1 and interval == 120
+    assert len(emails) == 1
+    assert "svcA" in emails[0][1] and "<table>" in emails[0][1]
+    # quiet flush resets to base
+    sent, interval = mgr.flush(interval)
+    assert sent == 0 and interval == 60
+
+
+def test_resume_roundtrip(tmp_path):
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    alert = mgr.process_trigger(make_fs(), da.CAUSE_AVG_HARD)
+    mgr.add_to_buffer(alert)
+    p = str(tmp_path / "alerts.resume")
+    mgr.save_resume(p)
+
+    mgr2 = manager(lambda: now[0], emails)
+    mgr2.load_resume(p)
+    assert len(mgr2.alert_buffer) == 1
+    # cooldown state restored: immediate re-trigger suppressed
+    assert mgr2.process_trigger(make_fs(), da.CAUSE_AVG_HARD) is None
+
+
+def test_flush_retains_buffer_when_emails_disabled():
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    mgr.config["emailsEnabled"] = False
+    alert = mgr.process_trigger(make_fs(), da.CAUSE_BOTH_UB)
+    mgr.add_to_buffer(alert)
+    sent, interval = mgr.flush(60)
+    assert sent == 0 and interval == 60
+    assert len(mgr.alert_buffer) == 1  # NOT lost
+    assert not emails
+    mgr.config["emailsEnabled"] = True
+    sent, _ = mgr.flush(60)
+    assert sent == 1 and len(emails) == 1
+
+
+def test_flush_skips_corrupted_buffer_entry():
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    mgr.alert_buffer.append({"entry": "zz&broken", "cause": "x"})
+    alert = mgr.process_trigger(make_fs(), da.CAUSE_AVG_HARD)
+    mgr.add_to_buffer(alert)
+    sent, _ = mgr.flush(60)
+    assert sent == 2 and len(emails) == 1  # no crash; good row still in the email
+    assert "svcA" in emails[0][1]

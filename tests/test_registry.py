@@ -1,0 +1,71 @@
+"""ServiceRegistry: row assignment, growth, parameter vector materialization."""
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.ops.registry import CapacityExceeded, ServiceRegistry
+
+
+def test_assign_and_lookup():
+    reg = ServiceRegistry(4)
+    r0 = reg.lookup_or_add("s1", "a")
+    r1 = reg.lookup_or_add("s1", "b")
+    assert (r0, r1) == (0, 1)
+    assert reg.lookup_or_add("s1", "a") == 0  # stable
+    assert reg.lookup("s2", "x") is None
+    assert reg.key_of(1) == ("s1", "b")
+    assert reg.count == 2
+
+
+def test_capacity_and_growth():
+    reg = ServiceRegistry(2)
+    reg.lookup_or_add("s", "a")
+    reg.lookup_or_add("s", "b")
+    with pytest.raises(CapacityExceeded):
+        reg.lookup_or_add("s", "c")
+    big = reg.grown()
+    assert big.capacity == 4
+    assert big.lookup("s", "a") == 0  # rows preserved
+    assert big.lookup_or_add("s", "c") == 2
+
+
+def test_batch_lookup():
+    reg = ServiceRegistry(8)
+    rows = reg.lookup_or_add_batch([("s", "a"), ("s", "b"), ("s", "a")])
+    assert rows.tolist() == [0, 1, 0]
+    assert rows.dtype == np.int32
+
+
+def test_zscore_param_vectors():
+    zcfg = {
+        "defaults": [
+            {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1},
+            {"LAG": 8640, "THRESHOLD": 15.0, "INFLUENCE": 0.0},
+        ],
+        "overrides": {"services": {"hot": {"360": {"THRESHOLD": 25.0}}}},
+    }
+    reg = ServiceRegistry(4)
+    reg.lookup_or_add("s", "cold")
+    reg.lookup_or_add("s", "hot")
+    params = reg.zscore_params(zcfg, [360, 8640])
+    assert params[360]["threshold"][0] == 20.0
+    assert params[360]["threshold"][1] == 25.0
+    assert params[360]["threshold"][2] == 20.0  # unregistered rows: defaults
+    assert params[8640]["threshold"][1] == 15.0  # other lag untouched
+    assert params[360]["influence"][1] == np.float32(0.1)
+
+
+def test_alert_param_vectors():
+    acfg = {
+        "hardMaxMsAlertThreshold": 10000,
+        "overrides": {"services": {"slow": {"hardMaxMsAlertThreshold": 90000}}},
+        "suppressedServices": ["noisy"],
+    }
+    reg = ServiceRegistry(4)
+    reg.lookup_or_add("s", "normal")
+    reg.lookup_or_add("s", "slow")
+    reg.lookup_or_add("s", "noisy")
+    p = reg.alert_params(acfg)
+    assert p["hard_max_ms"][0] == 10000
+    assert p["hard_max_ms"][1] == 90000
+    assert not p["suppressed"][0] and p["suppressed"][2]

@@ -1,0 +1,227 @@
+"""Device stats engine vs the float64 golden oracle (reference semantics)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apmbackend_tpu.ops import stats as dstats
+from apmbackend_tpu.ops.registry import ServiceRegistry
+
+from golden import GoldenStats
+
+BASE_LABEL = 170_000_000  # ~2023 in 10s-bucket units
+
+
+def make_cfg(capacity=8, cap=64, dtype=jnp.float64):
+    return dstats.StatsConfig(capacity=capacity, samples_per_bucket=cap, dtype=dtype)
+
+
+def drive_both(events, cfg):
+    """events: list of (server, service, end_ts_ms, elapsed). Returns
+    (golden emissions, device emissions) as lists of dicts keyed identically."""
+    golden = GoldenStats()
+    reg = ServiceRegistry(cfg.capacity)
+    state = dstats.init_state(cfg)
+    tick = jax.jit(dstats.tick, static_argnums=1)
+    ingest = jax.jit(dstats.ingest, static_argnums=1)
+
+    g_rows, d_rows = [], []
+    for server, service, ts, elapsed in events:
+        label = int(dstats.bucket_label(ts))
+        g_rows.extend(golden.add(server, service, ts, elapsed))
+        if label > int(state.latest_bucket):
+            res, state = tick(state, cfg, label)
+            edge = dstats.edge_ts_ms(label, cfg)
+            for row in range(reg.count):
+                srv, svc = reg.key_of(row)
+                d_rows.append(
+                    {
+                        "ts": edge, "server": srv, "service": svc,
+                        "tpm": float(res.tpm[row]), "average": float(res.average[row]),
+                        "per75": float(res.per75[row]), "per95": float(res.per95[row]),
+                        "count": int(res.count[row]),
+                    }
+                )
+        row = reg.lookup_or_add(server, service)
+        state = ingest(
+            state, cfg,
+            jnp.array([row], jnp.int32),
+            jnp.array([label], jnp.int32),
+            jnp.array([elapsed], cfg.dtype),
+            jnp.array([True]),
+        )
+    return g_rows, d_rows
+
+
+def assert_rows_match(g_rows, d_rows):
+    gk = {(r["ts"], r["server"], r["service"]): r for r in g_rows}
+    dk = {(r["ts"], r["server"], r["service"]): r for r in d_rows}
+    assert set(gk) == set(dk)
+    for key, g in gk.items():
+        d = dk[key]
+        for f in ("tpm", "average", "per75", "per95"):
+            gv, dv = g[f], d[f]
+            if math.isnan(gv):
+                assert math.isnan(dv), (key, f, gv, dv)
+            else:
+                assert gv == pytest.approx(dv, rel=1e-9), (key, f, gv, dv)
+        assert g["count"] == d["count"], key
+
+
+def test_single_key_basic_window():
+    cfg = make_cfg()
+    events = []
+    # populate 40 consecutive buckets with 3 tx each for one key
+    for i in range(40):
+        ts = (BASE_LABEL + i) * 10000 + 1234
+        for e in (100, 200, 300):
+            events.append(("srv1", "svcA", ts, e + i))
+    g, d = drive_both(events, cfg)
+    assert len(g) > 0
+    assert_rows_match(g, d)
+
+
+def test_multi_key_sparse_traffic():
+    rng = np.random.RandomState(42)
+    cfg = make_cfg(capacity=8, cap=64)
+    keys = [("s1", "a"), ("s1", "b"), ("s2", "a"), ("s2", "c")]
+    events = []
+    label = BASE_LABEL
+    for _ in range(300):
+        label += int(rng.rand() < 0.3)  # advance bucket sometimes
+        srv, svc = keys[rng.randint(len(keys))]
+        ts = label * 10000 + rng.randint(0, 9999)
+        events.append((srv, svc, ts, int(rng.randint(1, 5000))))
+    g, d = drive_both(events, cfg)
+    assert_rows_match(g, d)
+
+
+def test_bucket_gap_clears_stale_slots():
+    cfg = make_cfg()
+    events = [("s", "x", BASE_LABEL * 10000, 100)]
+    # jump far beyond the ring size: all old data must vanish from stats
+    events.append(("s", "x", (BASE_LABEL + 100) * 10000, 500))
+    events.append(("s", "x", (BASE_LABEL + 101) * 10000, 700))
+    g, d = drive_both(events, cfg)
+    assert_rows_match(g, d)
+
+
+def test_percentile_duplicates_and_singletons():
+    cfg = make_cfg()
+    events = []
+    ts0 = BASE_LABEL * 10000
+    for e in (5, 5, 5, 9):  # duplicates kept (binaryConcat duplicate=true)
+        events.append(("s", "dup", ts0, e))
+    events.append(("s", "single", ts0, 42))
+    events.append(("s", "dup", (BASE_LABEL + 1) * 10000, 1))  # trigger tick
+    g, d = drive_both(events, cfg)
+    assert_rows_match(g, d)
+
+
+def test_old_label_data_dropped_not_corrupting():
+    """A label older than the ring must not alias into a live slot."""
+    cfg = make_cfg()
+    NB = cfg.num_buckets
+    label = BASE_LABEL
+    state = dstats.init_state(cfg)
+    res, state = dstats.tick(state, cfg, label)
+    state = dstats.ingest(
+        state, cfg,
+        jnp.array([0], jnp.int32),
+        jnp.array([label - NB], jnp.int32),  # aliases slot of `label`
+        jnp.array([999.0], cfg.dtype),
+        jnp.array([True]),
+    )
+    assert int(jnp.sum(state.counts)) == 0  # dropped entirely
+
+
+def test_sample_overflow_flags_and_keeps_counts():
+    cfg = make_cfg(capacity=2, cap=4)
+    label = BASE_LABEL
+    state = dstats.init_state(cfg)
+    _, state = dstats.tick(state, cfg, label)
+    n = 10  # > CAP
+    state = dstats.ingest(
+        state, cfg,
+        jnp.zeros(n, jnp.int32),
+        jnp.full(n, label, jnp.int32),
+        jnp.arange(1, n + 1, dtype=cfg.dtype),
+        jnp.ones(n, bool),
+    )
+    # advance past the buffer zone so `label` lands inside [latest-36, latest-6]
+    res, state = dstats.tick(state, cfg, label + cfg.buffer_sz + 1)
+    assert int(res.count[0]) == 10
+    assert bool(res.overflowed[0])
+    assert float(res.average[0]) == pytest.approx(5.5)  # counts/sums stay exact
+    # percentile computed over first CAP samples [1..4]
+    assert not math.isnan(float(res.per75[0]))
+
+
+def test_batched_ingest_equals_sequential():
+    """One big scatter with duplicate keys == many single ingests."""
+    cfg = make_cfg(capacity=4, cap=32)
+    label = BASE_LABEL
+    rng = np.random.RandomState(7)
+    rows = rng.randint(0, 4, size=50).astype(np.int32)
+    elaps = rng.randint(1, 100, size=50).astype(np.float64)
+
+    st_a = dstats.init_state(cfg)
+    _, st_a = dstats.tick(st_a, cfg, label)
+    st_a = dstats.ingest(st_a, cfg, rows, np.full(50, label, np.int32), elaps, np.ones(50, bool))
+
+    st_b = dstats.init_state(cfg)
+    _, st_b = dstats.tick(st_b, cfg, label)
+    for i in range(50):
+        st_b = dstats.ingest(
+            st_b, cfg,
+            np.array([rows[i]]), np.array([label], np.int32),
+            np.array([elaps[i]]), np.array([True]),
+        )
+    assert np.array_equal(np.asarray(st_a.counts), np.asarray(st_b.counts))
+    assert np.allclose(np.asarray(st_a.sums), np.asarray(st_b.sums))
+    # sample multisets per (row, slot) must match (order within bucket may differ)
+    sa = np.sort(np.nan_to_num(np.asarray(st_a.samples), nan=-1), axis=-1)
+    sb = np.sort(np.nan_to_num(np.asarray(st_b.samples), nan=-1), axis=-1)
+    assert np.allclose(sa, sb)
+
+
+def test_quantize_half_up():
+    x = jnp.array([0.25, 0.15, -0.25, 1.05, float("nan")])
+    q = dstats.quantize_half_up(x, 1)
+    assert float(q[0]) == 0.3
+    assert float(q[2]) == -0.2
+    assert math.isnan(float(q[4]))
+
+
+def test_grow_state_preserves():
+    cfg = make_cfg(capacity=2)
+    state = dstats.init_state(cfg)
+    _, state = dstats.tick(state, cfg, BASE_LABEL)
+    state = dstats.ingest(
+        state, cfg, jnp.array([1], jnp.int32), jnp.array([BASE_LABEL], jnp.int32),
+        jnp.array([50.0], cfg.dtype), jnp.array([True]),
+    )
+    grown, gcfg = dstats.grow_state(state, cfg, 8)
+    assert gcfg.capacity == 8
+    assert grown.counts.shape[0] == 8
+    assert int(jnp.sum(grown.counts)) == 1
+    res, _ = dstats.tick(grown, gcfg, BASE_LABEL + gcfg.buffer_sz + 1)
+    assert int(res.count[1]) == 1 and math.isnan(float(res.average[2]))
+
+
+def test_tick_non_increasing_label_is_safe():
+    """A stale/equal label must not corrupt the ring (clamped to latest)."""
+    cfg = make_cfg(capacity=2)
+    state = dstats.init_state(cfg)
+    _, state = dstats.tick(state, cfg, BASE_LABEL)
+    state = dstats.ingest(
+        state, cfg, jnp.array([0], jnp.int32), jnp.array([BASE_LABEL], jnp.int32),
+        jnp.array([50.0], cfg.dtype), jnp.array([True]),
+    )
+    before = np.asarray(state.counts).copy()
+    _, state = dstats.tick(state, cfg, BASE_LABEL - 5)  # regressed label
+    assert int(state.latest_bucket) == BASE_LABEL
+    assert np.array_equal(np.asarray(state.counts), before)
