@@ -1,0 +1,4 @@
+from .parser import TransactionParser, convert_log_date_to_ms  # noqa: F401
+from .replay import FixtureGenerator, ReplayDriver, write_fixture_logs  # noqa: F401
+from .tailer import NativeTailer, PauseFile, PyTailer, TailManager, discover_log_files  # noqa: F401
+from .ttlcache import TTLCache  # noqa: F401

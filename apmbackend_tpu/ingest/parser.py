@@ -1,0 +1,472 @@
+"""Log-correlation parser: raw JVM log lines -> complete TxEntry records.
+
+Reproduces the correlation semantics of stream_parse_transactions.js (the
+reference's design notes :3-44):
+
+- **SOAP logs** build a logId -> accountNumber map: an ``IO=I`` header opens a
+  per-file context carrying the logId; a later ``<accountNumber>`` (or the
+  riskid two-line ``<key>AccountNumber</key>`` / ``<value>`` form) saves the
+  account number (:352-376).
+- **CommonTiming entry/exit join**: entry lines park a partial record keyed
+  (logId, service) in a TTL cache; the exit line joins it with the account
+  cache into a full record (:378-446 EJB form, :451-565 standard form). A
+  missing account number parks the joined record in a second, shorter-TTL
+  cache that is flushed when the SOAP parser later finds the number
+  (saveAcctNum backfill :294-327) or emitted without it on expiry (:226-239).
+- **BAF salvage**: exit lines on BAF logs may carry the account number inside
+  bracketed metadata before INFO; used as a last resort (:486-504).
+- **Audit-trail state machine** (APP logs): a mapping line links autrId ->
+  logId; the "Audit Trail id :" line activates a per-file context; the
+  RequestTrace elapsed section collects per-subservice elapsed arrays (same
+  subservice can repeat, consumed FIFO); the stopWatchList XML supplies
+  start/stop timestamps per subservice; each completed subservice emits a
+  record, with non-Provider records routed straight to the DB queue
+  (insert_to_db) to skip stats processing (:578-731).
+- Emitted records may lack logId/acctNum/startTs; startTs falls back to
+  endTs - elapsed (:264-290). ``Provider[...]`` is normalized to
+  ``Provider:...`` and ``S:`` marks top-level (:258,274,282).
+
+Output is roughly ordered only (cache timeouts) — downstream re-orders via the
+min-heap, like the reference (:7, stream_calc_stats.js:136-155).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Callable, Dict, List, Optional
+
+from ..entries import TxEntry
+from .ttlcache import TTLCache
+
+_TOPLEVEL_RE = re.compile(r"^S:")
+_PROVIDER_RE = re.compile(r"Provider\[", re.IGNORECASE)
+
+_SOAP_IN_RE = re.compile(r"^=== jbossId.*IO=I")
+_SOAP_OUT_RE = re.compile(r"^=== jbossId.*IO=O")
+_SOAP_ACCT_RE = re.compile(r"<accountNumber>", re.IGNORECASE)
+_SOAP_ALT_KEY_RE = re.compile(r"<key>AccountNumber</key>", re.IGNORECASE)
+_SOAP_ALT_VALUE_RE = re.compile(r"<value>")
+
+_EJB_ENTRY_RE = re.compile(r"INFO *\[CommonTiming] The EJB")
+_EJB_EXIT_RE = re.compile(r"INFO *\[CommonTiming] Total time")
+_CT_ENTRY_RE = re.compile(r"INFO *CommonTiming::Start")
+_CT_EXIT_RE = re.compile(r"INFO *CommonTiming::Stop")
+
+_BAF_META_RE = re.compile(r"\[[^ ]+] +INFO ")
+
+_AUTR_MAP_RE = re.compile(r"INFO  auditTrailId=")
+_AUTR_LINE_RE = re.compile(r"^Audit Trail id *:")
+_ELAPSED_START_RE = re.compile(r": RequestTrace \[stopWatchList=")
+_ELAPSED_END_RE = re.compile(r"^]")
+_SW_XML_START_RE = re.compile(r"<stopWatchList>")
+_SW_XML_END_RE = re.compile(r"</stopWatchList>")
+_SW_NAME_RE = re.compile(r"<name>")
+_SW_START_RE = re.compile(r"<startTime>")
+_SW_STOP_RE = re.compile(r"<stopTime>")
+
+_SOAP_FILE_RE = re.compile(r"soap_io")
+_SERVER_FILE_RE = re.compile(r"server\.log")
+
+_ISO_TZ_RE = re.compile(r"T.*-")
+_DIGITS_RE = re.compile(r"^[0-9]+$")
+
+
+def convert_log_date_to_ms(date_str: str) -> str:
+    """'' for falsy; audit ISO-with-offset or 'YYYY-MM-DD HH:MM:SS,mmm' (local
+    time) -> epoch ms (stream_parse_transactions.js:242-256)."""
+    if not date_str:
+        return ""
+    if _ISO_TZ_RE.search(date_str):
+        return str(int(datetime.fromisoformat(date_str).timestamp() * 1000))
+    parts = re.split(r"-|\s+|:|,", date_str.strip())
+    dt = datetime(
+        int(parts[0]), int(parts[1]), int(parts[2]),
+        int(parts[3]), int(parts[4]), int(parts[5]), int(parts[6]) * 1000,
+    )
+    return str(int(dt.timestamp() * 1000))
+
+
+def _strip_brackets(token: str) -> str:
+    return token.replace("[", "").replace("]", "")
+
+
+def _xml_text(line: str) -> str:
+    """Text content of a single-tag XML line: strip the closing tag FIRST,
+
+    then everything through the remaining (opening) '>' — order matters with
+    greedy matching (stream_parse_transactions.js:669,677,682)."""
+    return re.sub(r".*>", "", re.sub(r"</.*", "", line), count=1)
+
+
+@dataclass
+class _AutrContext:
+    """Per-file audit-trail state (the reference's context map entry :579-731)."""
+
+    autr_id_map: Dict[str, dict] = field(default_factory=dict)
+    active_autr_id: Optional[str] = None
+    active_log_id: Optional[str] = None
+    active_alt_acct: Optional[str] = None
+    elapsed_flag: bool = False
+    sw_flag: bool = False
+    active_service: Optional[str] = None
+    service_map: Optional[Dict[str, List[dict]]] = None
+
+
+@dataclass
+class _SoapContext:
+    log_id: str
+    pull_next_value: bool = False
+
+
+class TransactionParser:
+    """Stateful multi-file log parser. Feed lines via read_line(file_path, line);
+
+    completed records arrive at ``on_record(tx, insert_to_db)``."""
+
+    def __init__(
+        self,
+        on_record: Callable[[TxEntry, bool], None],
+        *,
+        logger=None,
+        clock: Callable[[], float] = time.monotonic,
+        server_from_path: Optional[Callable[[str], str]] = None,
+        record_ttl_s: float = 120.0,
+        need_num_ttl_s: float = 30.0,
+        acct_ttl_s: float = 120.0,
+    ):
+        self.on_record = on_record
+        self.logger = logger
+        self.server_from_path = server_from_path or (lambda fp: fp.split("/")[2] if len(fp.split("/")) > 2 else fp)
+        # per-file contexts: SOAP logId tracking + audit-trail state machines
+        self._soap_ctx: Dict[str, _SoapContext] = {}
+        self._autr_ctx: Dict[str, _AutrContext] = {}
+        # logId -> acctNum (backfill source)
+        self.acct_cache = TTLCache(acct_ttl_s, clock=clock)
+        # logId -> {service: partial record}; expiry = no exit line found
+        self.record_cache = TTLCache(record_ttl_s, clock=clock, on_expired=self._on_partial_expired)
+        # logId -> {service: joined-but-numberless record}; expiry = emit anyway
+        self.need_num_cache = TTLCache(need_num_ttl_s, clock=clock, on_expired=self._on_neednum_expired)
+
+    # -- cache expiry --------------------------------------------------------
+    def _on_partial_expired(self, log_id: str, service_map: dict) -> None:
+        for service, rec in service_map.items():
+            if self.logger:
+                self.logger.error(
+                    f"Partial record expired! No matching timing exit found. "
+                    f"Discarding. Service: {service} logId: {log_id}"
+                )
+
+    def _on_neednum_expired(self, log_id: str, need_map: dict) -> None:
+        for service, rec in need_map.items():
+            self._output(
+                rec.get("server", ""), service, log_id,
+                rec.get("alt_acct") or "",
+                rec.get("start_ts", ""), rec["end_ts"], rec["elapsed"],
+                rec.get("insert_to_db", False),
+            )
+
+    def sweep(self) -> None:
+        self.acct_cache.sweep()
+        self.record_cache.sweep()
+        self.need_num_cache.sweep()
+
+    def drain(self) -> None:
+        """End-of-replay: flush numberless records out, drop partials."""
+        self.need_num_cache.flush_all()
+        self.record_cache._store.clear()
+
+    def cache_stats(self) -> dict:
+        return {
+            "acct": self.acct_cache.stats(),
+            "record": self.record_cache.stats(),
+            "need": self.need_num_cache.stats(),
+        }
+
+    # -- record emission -----------------------------------------------------
+    def _output(self, server, service, log_id, acct_num, start_ts, end_ts, elapsed, insert_to_db=False):
+        start_ms = convert_log_date_to_ms(start_ts)
+        end_ms = convert_log_date_to_ms(end_ts)
+        service = _PROVIDER_RE.sub("Provider:", service).replace("]", "")
+        if not start_ms and end_ms:
+            try:
+                start_ms = str(int(end_ms) - int(elapsed))
+            except (TypeError, ValueError):
+                start_ms = ""
+        top = "Y" if _TOPLEVEL_RE.match(service) else "N"
+        tx = TxEntry(server, service, log_id, acct_num, start_ms, end_ms, elapsed, top)
+        self.on_record(tx, insert_to_db)
+
+    # -- account numbers -----------------------------------------------------
+    def _save_acct_num(self, acct_num: str, file_path: str, source: str, alt_log_id: Optional[str] = None):
+        acct_num = acct_num.strip()
+        if not _DIGITS_RE.match(acct_num):
+            if self.logger:
+                self.logger.error(f"Invalid acctNum (SRC={source}): {acct_num!r} from {file_path}")
+            return
+        if source == "bafmetainfo":
+            log_id = alt_log_id
+            if not log_id:
+                return
+        else:
+            ctx = self._soap_ctx.get(file_path)
+            if ctx is None:
+                return
+            log_id = ctx.log_id
+        self.acct_cache.set(log_id, acct_num)
+        if source != "bafmetainfo":
+            self._soap_ctx.pop(file_path, None)
+        # backfill: release any parked numberless records for this logId
+        need_map = self.need_num_cache.get(log_id)
+        if need_map:
+            server = self.server_from_path(file_path)
+            for service in list(need_map):
+                rec = need_map.pop(service)
+                self._output(
+                    rec.get("server") or server, service, log_id, acct_num,
+                    rec.get("start_ts", ""), rec["end_ts"], rec["elapsed"],
+                    rec.get("insert_to_db", False),
+                )
+
+    def _baf_meta_acct(self, line: str, file_path: str, log_id: str, tokens: List[str]) -> str:
+        """Account number from BAF bracketed metadata, '' if absent (:486-497)."""
+        if not _BAF_META_RE.search(line) or len(tokens) < 4:
+            return ""
+        info = re.sub(r".*]\[", "", tokens[3])
+        info = _strip_brackets(info)
+        acct = info.split(":")[-1]
+        if acct:
+            self._save_acct_num(acct, file_path, "bafmetainfo", log_id)
+        return acct
+
+    # -- SOAP ----------------------------------------------------------------
+    def _parse_soap(self, line: str, file_path: str) -> None:
+        if _SOAP_IN_RE.match(line):
+            token = line.split()[1]
+            self._soap_ctx[file_path] = _SoapContext(log_id=token.split("=")[1])
+        elif _SOAP_OUT_RE.match(line):
+            self._soap_ctx.pop(file_path, None)
+        else:
+            ctx = self._soap_ctx.get(file_path)
+            if ctx is None:
+                return
+            if _SOAP_ACCT_RE.search(line):
+                self._save_acct_num(re.split(r"<|>", line.strip())[2], file_path, "standard")
+            elif _SOAP_ALT_KEY_RE.search(line):
+                ctx.pull_next_value = True
+            elif _SOAP_ALT_VALUE_RE.search(line) and ctx.pull_next_value:
+                self._save_acct_num(re.split(r"<|>", line.strip())[2], file_path, "riskStrategy")
+
+    # -- CommonTiming (EJB + standard) --------------------------------------
+    def _park_partial(self, log_id: str, service: str, server: str, start_ts: str) -> None:
+        smap = self.record_cache.get(log_id)
+        if smap is None:
+            smap = {}
+            self.record_cache.set(log_id, smap)
+        smap[service] = {"server": server, "start_ts": start_ts}
+
+    def _join_exit(self, line, file_path, log_id, service, server, end_ts, elapsed, tokens, salvage: bool):
+        smap = self.record_cache.get(log_id)
+        partial = smap.get(service) if smap else None
+        if partial is None:
+            if self.logger:
+                self.logger.error(
+                    f"CommonTiming exit had no matching entry in the record cache. "
+                    f"logId: {log_id} service: {service}"
+                )
+            if salvage:
+                acct = self._baf_meta_acct(line, file_path, log_id, tokens)
+                self._output(server, service, "", acct, "", end_ts, elapsed)
+            else:
+                self._output(server, service, "", "", "", end_ts, elapsed)
+            return
+        acct = self.acct_cache.get(log_id)
+        if acct:
+            self._output(server, service, log_id, acct, partial["start_ts"], end_ts, elapsed)
+        else:
+            alt = self._baf_meta_acct(line, file_path, log_id, tokens) if salvage else ""
+            need = self.need_num_cache.get(log_id)
+            if need is None:
+                need = {}
+                self.need_num_cache.set(log_id, need)
+            need[service] = {
+                "server": partial["server"], "start_ts": partial["start_ts"],
+                "end_ts": end_ts, "elapsed": elapsed, "alt_acct": alt,
+            }
+        smap.pop(service, None)
+
+    def _parse_ejb_entry(self, line: str, server: str) -> None:
+        arr = line.split()
+        log_id = _strip_brackets(arr[0])
+        if not log_id:
+            return
+        self._park_partial(log_id, f"S:{arr[13]}", server, f"{arr[1]} {arr[2]}")
+
+    def _parse_ejb_exit(self, line: str, file_path: str, server: str) -> None:
+        arr = line.split()
+        log_id = _strip_brackets(arr[0])
+        end_ts = f"{arr[1]} {arr[2]}"
+        service = f"S:{arr[9]}"
+        elapsed = arr[11]
+        if not log_id:
+            self._output(server, service, "", "", "", end_ts, elapsed)
+            return
+        self._join_exit(line, file_path, log_id, service, server, end_ts, elapsed, arr, salvage=False)
+
+    def _parse_ct_entry(self, line: str, server: str) -> None:
+        arr = line.split()
+        log_id = _strip_brackets(arr[0])
+        if not log_id:
+            return
+        # split on INFO: BAF logs interleave bracketed metadata that breaks
+        # positional token counts (:459)
+        half = line.split("INFO", 1)[1].strip().split()
+        self._park_partial(log_id, half[1], server, f"{arr[1]} {arr[2]}")
+
+    def _parse_ct_exit(self, line: str, file_path: str, server: str) -> None:
+        arr = line.split()
+        half = line.split("INFO", 1)[1].strip().split()
+        log_id = _strip_brackets(arr[0])
+        end_ts = f"{arr[1]} {arr[2]}"
+        service, elapsed = half[1], half[5]
+        if not log_id:
+            acct = self._baf_meta_acct(line, file_path, log_id, arr)
+            self._output(server, service, "", acct, "", end_ts, elapsed)
+            return
+        self._join_exit(line, file_path, log_id, service, server, end_ts, elapsed, arr, salvage=True)
+
+    # -- audit trail ---------------------------------------------------------
+    def _parse_app_line(self, line: str, file_path: str, server: str) -> None:
+        if _AUTR_MAP_RE.search(line):
+            arr = line.split()
+            log_id = _strip_brackets(arr[0])
+            autr_id = arr[5].split("=")[1]
+            ctx = self._autr_ctx.setdefault(file_path, _AutrContext())
+            alt = self._baf_meta_acct(line, file_path, log_id, arr)
+            ctx.autr_id_map[autr_id] = {"log_id": log_id, "alt_acct": alt}
+            return
+        if _AUTR_LINE_RE.match(line):
+            ctx = self._autr_ctx.get(file_path)
+            if ctx is None:
+                if self.logger:
+                    self.logger.error("Missing context for audit trail id line (startup race)")
+                return
+            autr_id = line.split(":")[1].strip()
+            mapping = ctx.autr_id_map.pop(autr_id, None)
+            if mapping is None or not mapping.get("log_id"):
+                if self.logger:
+                    self.logger.error(f"Could not resolve autrId {autr_id} to a logId")
+                return
+            ctx.service_map = {}
+            ctx.active_autr_id = autr_id
+            ctx.active_log_id = mapping["log_id"]
+            ctx.active_alt_acct = mapping.get("alt_acct")
+            ctx.elapsed_flag = False
+            ctx.sw_flag = False
+            ctx.active_service = None
+            return
+
+        ctx = self._autr_ctx.get(file_path)
+        if ctx is None or not ctx.active_log_id:
+            return  # random log line
+
+        if _ELAPSED_START_RE.search(line):
+            ctx.elapsed_flag = True
+        elif ctx.elapsed_flag:
+            if _ELAPSED_END_RE.match(line):
+                ctx.elapsed_flag = False
+            else:
+                arr = line.split(":")
+                service = arr[0].strip()
+                elapsed = _strip_brackets(arr[1].split()[0])
+                ctx.service_map.setdefault(service, []).append({"elapsed": elapsed})
+        elif _SW_XML_START_RE.search(line):
+            ctx.sw_flag = True
+        elif ctx.sw_flag:
+            if _SW_XML_END_RE.search(line):
+                ctx.active_autr_id = None
+                ctx.active_log_id = None
+                ctx.active_alt_acct = None
+                ctx.elapsed_flag = False
+                ctx.sw_flag = False
+                ctx.active_service = None
+                ctx.service_map = None
+            elif _SW_NAME_RE.search(line):
+                ctx.active_service = _xml_text(line)
+            elif ctx.active_service:
+                if _SW_START_RE.search(line):
+                    entries = ctx.service_map.get(ctx.active_service)
+                    if not entries:
+                        if self.logger:
+                            self.logger.error(
+                                f"No serviceMap entry for {ctx.active_service} on startTime"
+                            )
+                        return
+                    entries[0]["start_ts"] = _xml_text(line)
+                elif _SW_STOP_RE.search(line):
+                    end_ts = _xml_text(line)
+                    service = ctx.active_service
+                    entries = ctx.service_map.get(service)
+                    if not entries:
+                        if self.logger:
+                            self.logger.error(f"No serviceMap entry for {service} on stopTime")
+                        return
+                    rec = entries.pop(0)
+                    log_id = ctx.active_log_id
+                    acct = self.acct_cache.get(log_id)
+                    # non-Provider audit records bypass stats straight to DB (:697)
+                    insert_to_db = not _PROVIDER_RE.search(service)
+                    if acct:
+                        self._output(
+                            server, service, log_id, acct,
+                            rec.get("start_ts", ""), end_ts, rec["elapsed"], insert_to_db,
+                        )
+                    else:
+                        need = self.need_num_cache.get(log_id)
+                        if need is None:
+                            need = {}
+                            self.need_num_cache.set(log_id, need)
+                        need[service] = {
+                            "server": server, "start_ts": rec.get("start_ts", ""),
+                            "end_ts": end_ts, "elapsed": rec["elapsed"],
+                            "alt_acct": ctx.active_alt_acct, "insert_to_db": insert_to_db,
+                        }
+
+    # -- dispatch ------------------------------------------------------------
+    def read_line(self, file_path: str, line: str) -> None:
+        """Per-line dispatch; malformed lines are logged and skipped, never
+
+        fatal (JS's out-of-range indexing yields undefined where Python would
+        raise — fail-open is the equivalent robustness)."""
+        try:
+            self._read_line(file_path, line)
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Unparseable log line in {file_path}: {e}: {line[:200]!r}")
+
+    def _read_line(self, file_path: str, line: str) -> None:
+        if not line:
+            return
+        name = file_path.rsplit("/", 1)[-1]
+        server = self.server_from_path(file_path)
+
+        if _SOAP_FILE_RE.search(name):
+            self._parse_soap(line, file_path)
+        elif _SERVER_FILE_RE.search(name):
+            if _EJB_ENTRY_RE.search(line):
+                self._parse_ejb_entry(line, server)
+            elif _EJB_EXIT_RE.search(line):
+                self._parse_ejb_exit(line, file_path, server)
+            elif _CT_ENTRY_RE.search(line):
+                self._parse_ct_entry(line, server)
+            elif _CT_EXIT_RE.search(line):
+                self._parse_ct_exit(line, file_path, server)
+        else:  # APP log
+            if _CT_ENTRY_RE.search(line):
+                self._parse_ct_entry(line, server)
+            elif _CT_EXIT_RE.search(line):
+                self._parse_ct_exit(line, file_path, server)
+            else:
+                self._parse_app_line(line, file_path, server)

@@ -1,0 +1,202 @@
+"""Synthetic WildFly log fixtures + replay driver.
+
+The reference was only ever tested against live NFS-mounted JVM logs
+(SURVEY.md §4); this module provides what it never had: a deterministic
+fixture generator producing coherent soap_io / server.log / app log triples
+(SOAP account headers, EJB + standard CommonTiming entry/exit pairs,
+audit-trail RequestTrace sections), and a replay driver that feeds them
+through the parser — BASELINE.json config[0] ("WildFly log replay ->
+stream_parse_transactions -> stream_calc_z_score").
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from datetime import datetime, timedelta
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..entries import TxEntry
+from .parser import TransactionParser
+
+
+def _log_ts(dt: datetime) -> str:
+    return dt.strftime("%Y-%m-%d %H:%M:%S,") + f"{dt.microsecond // 1000:03d}"
+
+
+def _iso_ts(dt: datetime) -> str:
+    # audit-trail style ISO with offset (parser detects via 'T.*-')
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}" + "-00:00"
+
+
+class FixtureGenerator:
+    """Emit (file_name, line) streams describing synthetic transactions."""
+
+    def __init__(self, server: str = "jvmhost1", seed: int = 0, start: Optional[datetime] = None):
+        self.server = server
+        self.rng = random.Random(seed)
+        self.t = start or datetime(2024, 1, 10, 9, 0, 0)
+        self._next_id = 0
+
+    def _log_id(self) -> str:
+        self._next_id += 1
+        return f"jb{self._next_id:08d}"
+
+    def advance(self, seconds: float) -> None:
+        self.t += timedelta(seconds=seconds)
+
+    def soap_transaction(
+        self, service: str, elapsed_ms: int, acct: Optional[int] = None, riskid: bool = False
+    ) -> List[Tuple[str, str]]:
+        """A SOAP-correlated EJB transaction: soap_io header with account
+        number + server.log EJB CommonTiming entry/exit pair."""
+        log_id = self._log_id()
+        start = self.t
+        end = start + timedelta(milliseconds=elapsed_ms)
+        out: List[Tuple[str, str]] = []
+        soap = f"soap_io_{self.server}.log"
+        out.append((soap, f"=== jbossId={log_id} ts={_log_ts(start)} IO=I ==="))
+        if acct is not None:
+            if riskid:
+                out.append((soap, "    <key>AccountNumber</key>"))
+                out.append((soap, f"    <value>{acct}</value>"))
+            else:
+                out.append((soap, f"    <accountNumber>{acct}</accountNumber>"))
+        out.append((soap, "  <payload>...</payload>"))
+        out.append((soap, f"=== jbossId={log_id} ts={_log_ts(end)} IO=O ==="))
+        srv = "server.log"
+        out.append(
+            (srv, f"[{log_id}] {_log_ts(start)} INFO [CommonTiming] The EJB timing entry has begun for method {service}")
+        )
+        out.append(
+            (srv, f"[{log_id}] {_log_ts(end)} INFO [CommonTiming] Total time for EJB {service} call: {elapsed_ms} ms")
+        )
+        return out
+
+    def standard_ct_transaction(
+        self, service: str, elapsed_ms: int, acct: Optional[int] = None,
+        baf_meta: bool = False, app_file: Optional[str] = None,
+    ) -> List[Tuple[str, str]]:
+        """A standard CommonTiming pair on an app log; optional BAF metadata
+        carries the account number for the salvage path."""
+        log_id = self._log_id()
+        start = self.t
+        end = start + timedelta(milliseconds=elapsed_ms)
+        fname = app_file or f"app_{self.server}.log"
+        meta = f"[ch:7:{acct}] " if (baf_meta and acct is not None) else ""
+        out = [
+            (fname, f"[{log_id}] {_log_ts(start)} {meta}INFO CommonTiming::Start {service} begin"),
+            (fname, f"[{log_id}] {_log_ts(end)} {meta}INFO CommonTiming::Stop {service} completed in time: {elapsed_ms} ms"),
+        ]
+        return out
+
+    def audit_trail(
+        self, subservices: List[Tuple[str, int]], acct: Optional[int] = None,
+        app_file: Optional[str] = None,
+    ) -> List[Tuple[str, str]]:
+        """An audit-trail block: map line, id line, RequestTrace elapsed
+        section, stopWatchList XML with per-subservice timestamps."""
+        log_id = self._log_id()
+        autr_id = f"AUTR{self._next_id:06d}"
+        fname = app_file or f"app_{self.server}.log"
+        meta = f"[ch:9:{acct}] " if acct is not None else "[ch:9:x] "
+        out = [(fname, f"[{log_id}] {_log_ts(self.t)} {meta}INFO  auditTrailId={autr_id} begin")]
+        out.append((fname, f"Audit Trail id : {autr_id}"))
+        out.append((fname, "summary: RequestTrace [stopWatchList="))
+        for svc, ms in subservices:
+            out.append((fname, f"{svc} :[{ms} millis] step"))
+        out.append((fname, "]"))
+        out.append((fname, "<stopWatchList>"))
+        t = self.t
+        for svc, ms in subservices:
+            t_end = t + timedelta(milliseconds=ms)
+            out.append((fname, f"  <name>{svc}</name>"))
+            out.append((fname, f"  <startTime>{_iso_ts(t)}</startTime>"))
+            out.append((fname, f"  <stopTime>{_iso_ts(t_end)}</stopTime>"))
+            t = t_end
+        out.append((fname, "</stopWatchList>"))
+        return out
+
+
+def write_fixture_logs(
+    out_dir: str,
+    *,
+    n_transactions: int = 200,
+    services: Tuple[str, ...] = ("getAccountInfo", "getOffers", "Provider[credit-check]"),
+    seed: int = 0,
+    server: str = "jvmhost1",
+) -> Dict[str, str]:
+    """Generate a mixed fixture log directory; returns {file_name: path}."""
+    gen = FixtureGenerator(server=server, seed=seed)
+    rng = random.Random(seed + 1)
+    lines_by_file: Dict[str, List[str]] = {}
+
+    def put(pairs):
+        for fname, line in pairs:
+            lines_by_file.setdefault(fname, []).append(line)
+
+    for i in range(n_transactions):
+        service = services[rng.randrange(len(services))]
+        elapsed = rng.randint(50, 1200)
+        acct = rng.randint(10**8, 10**9 - 1)
+        kind = rng.random()
+        if kind < 0.5:
+            put(gen.soap_transaction(service, elapsed, acct, riskid=rng.random() < 0.2))
+        elif kind < 0.8:
+            put(gen.standard_ct_transaction(service, elapsed, acct, baf_meta=True))
+        else:
+            put(gen.audit_trail([(service, elapsed), ("bcottag", rng.randint(5, 50))], acct))
+        gen.advance(rng.uniform(0.05, 2.0))
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {}
+    for fname, lines in lines_by_file.items():
+        p = os.path.join(out_dir, fname)
+        with open(p, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+        paths[fname] = p
+    return paths
+
+
+class ReplayDriver:
+    """Feed fixture (or captured production) logs through the parser.
+
+    Interleaves lines across files in generation order when given explicit
+    (file, line) pairs, or round-robins whole files from disk. Drains the
+    numberless-record cache at the end so replay is loss-free.
+    """
+
+    def __init__(self, parser: TransactionParser):
+        self.parser = parser
+        self.lines_fed = 0
+
+    def feed_pairs(self, pairs) -> int:
+        for file_name, line in pairs:
+            self.parser.read_line(file_name, line)
+            self.lines_fed += 1
+        return self.lines_fed
+
+    def feed_dir(self, log_dir: str, *, interleave: int = 64) -> int:
+        files = sorted(
+            os.path.join(log_dir, f) for f in os.listdir(log_dir) if not f.startswith(".")
+        )
+        handles = [(p, open(p, "r", encoding="utf-8", errors="replace")) for p in files]
+        live = list(handles)
+        while live:
+            nxt = []
+            for path, fh in live:
+                for _ in range(interleave):
+                    line = fh.readline()
+                    if not line:
+                        break
+                    self.parser.read_line(path, line.rstrip("\n"))
+                    self.lines_fed += 1
+                else:
+                    nxt.append((path, fh))
+            live = nxt
+        for _p, fh in handles:
+            fh.close()
+        return self.lines_fed
+
+    def finish(self) -> None:
+        self.parser.drain()

@@ -1,0 +1,263 @@
+"""File tailing with the pause-file backpressure protocol.
+
+Role parity with perl_tail.pl: one tailer per log file, robust against
+truncation/rotation, holding its read position while a shared pause file
+exists (perl_tail.pl:36-41) — the pause file IS the cross-process
+backpressure signal created by the parser when downstream queues fill
+(stream_parse_transactions.js:834-897).
+
+Two implementations:
+- :class:`PyTailer` — in-process thread, used by default and in tests.
+- :class:`NativeTailer` — spawns the C++ ``apm_tail`` binary (native/tailer.cpp)
+  per file like the reference spawns perl, reading its stdout; preferred in
+  production for the ~70-file fan-in.
+Both deliver lines to a callback as (file_path, line).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+def discover_log_files(mask_prefix: str, mask_suffixes: Sequence[str]) -> List[str]:
+    """Glob the configured masks (streamParseTransactions.appLogDirMaskPrefix /
+    maskSuffixes, config parity with stream_parse_transactions.js:814-825)."""
+    files: List[str] = []
+    for suffix in mask_suffixes:
+        files.extend(globlib.glob(os.path.join(mask_prefix, suffix)))
+    return sorted(set(files))
+
+
+class PauseFile:
+    """The shared pause switch (tailPauseFileFullPath)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def create(self) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(self.path, "a", encoding="utf-8"):
+            pass
+
+    def delete(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+
+class PyTailer:
+    """Polling tailer for one file: start at EOF, follow appends, re-open on
+
+    truncation (size shrink) — net-mount-safe (no inode assumptions, the
+    reason the reference patched File::Tail)."""
+
+    def __init__(
+        self,
+        file_path: str,
+        on_line: Callable[[str, str], None],
+        pause_file: Optional[PauseFile] = None,
+        *,
+        poll_interval_s: float = 0.2,
+        from_start: bool = False,
+        on_exit: Optional[Callable[[str, Optional[int]], None]] = None,
+    ):
+        self.file_path = file_path
+        self.on_line = on_line
+        self.pause_file = pause_file
+        self.poll_interval_s = poll_interval_s
+        self.from_start = from_start
+        self.on_exit = on_exit
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name=f"tail-{os.path.basename(self.file_path)}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        try:
+            pos = 0
+            fh = None
+            buf = ""
+            inode = None
+            while not self._stop.is_set():
+                if self.pause_file is not None and self.pause_file.exists():
+                    # hold position while paused (perl_tail.pl:36-41)
+                    time.sleep(self.poll_interval_s)
+                    continue
+                if fh is None:
+                    try:
+                        fh = open(self.file_path, "r", encoding="utf-8", errors="replace")
+                    except FileNotFoundError:
+                        time.sleep(self.poll_interval_s)
+                        continue
+                    if not self.from_start:
+                        fh.seek(0, os.SEEK_END)
+                    pos = fh.tell()
+                    try:
+                        inode = os.fstat(fh.fileno()).st_ino
+                    except OSError:
+                        inode = None
+                try:
+                    st = os.stat(self.file_path)
+                    size, cur_inode = st.st_size, st.st_ino
+                except OSError:
+                    size, cur_inode = 0, inode
+                if size < pos or (inode is not None and cur_inode != inode):
+                    # truncated, or rename-rotation swapped the inode: reopen
+                    # the new file from the start (but drain the old handle
+                    # first so nothing written pre-rotation is lost)
+                    tail_chunk = fh.read()
+                    if tail_chunk:
+                        buf += tail_chunk
+                        while "\n" in buf:
+                            line, buf = buf.split("\n", 1)
+                            try:
+                                self.on_line(self.file_path, line)
+                            except Exception:
+                                pass
+                    fh.close()
+                    fh = None
+                    self.from_start = True  # new file: read from beginning
+                    continue
+                chunk = fh.read()
+                if chunk:
+                    pos = fh.tell()
+                    buf += chunk
+                    while "\n" in buf:
+                        line, buf = buf.split("\n", 1)
+                        try:
+                            self.on_line(self.file_path, line)
+                        except Exception:
+                            # a consumer bug must not kill the tail; fail-fast
+                            # (on_exit) is reserved for file-level problems
+                            pass
+                else:
+                    time.sleep(self.poll_interval_s)
+            if fh:
+                fh.close()
+            if self.on_exit:
+                self.on_exit(self.file_path, 0)
+        except Exception:
+            if self.on_exit:
+                self.on_exit(self.file_path, 1)
+
+
+class NativeTailer:
+    """Spawn the C++ tail binary (one process per file, stdout line stream),
+
+    mirroring the reference's per-file perl spawn
+    (stream_parse_transactions.js:902-975). Tail process death is fail-fast:
+    on_exit is invoked so the supervisor can restart the whole parser
+    (:919-922 semantics)."""
+
+    def __init__(
+        self,
+        binary_path: str,
+        file_path: str,
+        pause_file_path: str,
+        on_line: Callable[[str, str], None],
+        on_exit: Optional[Callable[[str, Optional[int]], None]] = None,
+    ):
+        self.binary_path = binary_path
+        self.file_path = file_path
+        self.pause_file_path = pause_file_path
+        self.on_line = on_line
+        self.on_exit = on_exit
+        self._proc: Optional[subprocess.Popen] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, from_start: bool = False) -> None:
+        argv = [self.binary_path, self.file_path, self.pause_file_path]
+        if from_start:
+            argv.append("--from-start")
+        self._proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, bufsize=1
+        )
+
+        def _pump():
+            assert self._proc is not None and self._proc.stdout is not None
+            for line in self._proc.stdout:
+                self.on_line(self.file_path, line.rstrip("\n"))
+            rc = self._proc.wait()
+            if self.on_exit:
+                self.on_exit(self.file_path, rc)
+
+        self._thread = threading.Thread(target=_pump, name=f"ntail-{os.path.basename(self.file_path)}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._proc and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+class TailManager:
+    """All tails for the configured log masks + the pause switch."""
+
+    def __init__(
+        self,
+        config: dict,
+        on_line: Callable[[str, str], None],
+        *,
+        logger=None,
+        native_binary: Optional[str] = None,
+        on_tail_exit: Optional[Callable[[str, Optional[int]], None]] = None,
+        from_start: bool = False,
+    ):
+        self.config = config
+        self.on_line = on_line
+        self.logger = logger
+        self.native_binary = native_binary
+        self.on_tail_exit = on_tail_exit
+        self.from_start = from_start
+        self.pause = PauseFile(config["tailPauseFileFullPath"])
+        self.tailers: List = []
+
+    def start(self) -> int:
+        self.pause.delete()  # clear stale pause on boot (:899-900)
+        files = discover_log_files(self.config["appLogDirMaskPrefix"], self.config["maskSuffixes"])
+        for f in files:
+            if self.native_binary:
+                t = NativeTailer(self.native_binary, f, self.pause.path, self.on_line, self.on_tail_exit)
+                t.start(from_start=self.from_start)
+            else:
+                t = PyTailer(
+                    f, self.on_line, self.pause,
+                    from_start=self.from_start, on_exit=self.on_tail_exit,
+                )
+                t.start()
+            self.tailers.append(t)
+        if self.logger:
+            self.logger.info(f"Started {len(self.tailers)} tails")
+        return len(self.tailers)
+
+    def pause_reads(self) -> None:
+        self.pause.create()
+
+    def resume_reads(self) -> None:
+        self.pause.delete()
+
+    def stop(self) -> None:
+        for t in self.tailers:
+            t.stop()
+        self.tailers.clear()

@@ -1,0 +1,88 @@
+"""TTL cache with expiry callbacks.
+
+Role parity with the reference's NodeCache usage
+(stream_parse_transactions.js:211-239): per-key TTL, periodic sweep, an
+``expired`` callback that lets the parser salvage or discard incomplete
+correlation state, and hit/miss statistics (logged every 60 s, :329-335).
+The clock is injectable so log replay is deterministic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+
+class TTLCache:
+    def __init__(
+        self,
+        ttl_s: float,
+        *,
+        on_expired: Optional[Callable[[str, Any], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sweep_interval_s: Optional[float] = None,
+    ):
+        self.ttl_s = ttl_s
+        self.on_expired = on_expired
+        self.clock = clock
+        self.sweep_interval_s = sweep_interval_s if sweep_interval_s is not None else max(ttl_s / 4, 1)
+        self._store: Dict[str, Tuple[float, Any]] = {}  # key -> (expires_at, value)
+        self._last_sweep = clock()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def set(self, key: str, value: Any) -> None:
+        self._store[key] = (self.clock() + self.ttl_s, value)
+
+    def get(self, key: str) -> Optional[Any]:
+        self.maybe_sweep()
+        item = self._store.get(key)
+        if item is None:
+            self.misses += 1
+            return None
+        expires_at, value = item
+        if self.clock() >= expires_at:
+            del self._store[key]
+            if self.on_expired:
+                self.on_expired(key, value)
+            self.misses += 1
+            return None
+        self.hits += 1
+        return value
+
+    def has(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def delete(self, key: str) -> None:
+        self._store.pop(key, None)
+
+    def maybe_sweep(self) -> None:
+        now = self.clock()
+        if now - self._last_sweep >= self.sweep_interval_s:
+            self.sweep()
+
+    def sweep(self) -> int:
+        """Expire all overdue entries, firing callbacks. Returns count."""
+        now = self.clock()
+        self._last_sweep = now
+        expired = [(k, v) for k, (exp, v) in self._store.items() if now >= exp]
+        for key, value in expired:
+            del self._store[key]
+            if self.on_expired:
+                self.on_expired(key, value)
+        return len(expired)
+
+    def flush_all(self) -> int:
+        """Expire everything regardless of TTL (end-of-replay drain)."""
+        items = list(self._store.items())
+        self._store.clear()
+        for key, (_exp, value) in items:
+            if self.on_expired:
+                self.on_expired(key, value)
+        return len(items)
+
+    def stats(self) -> dict:
+        return {"keys": len(self._store), "hits": self.hits, "misses": self.misses}

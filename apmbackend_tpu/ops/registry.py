@@ -81,18 +81,20 @@ class ServiceRegistry:
 
     # -- per-row parameter vectors ------------------------------------------
 
-    def zscore_params(self, zscore_config: dict, lags: Sequence[int]) -> Dict[int, dict]:
-        """Per-lag {threshold: [S], influence: [S]} float32 vectors.
+    def zscore_params(self, zscore_config: dict, lags: Sequence[int], dtype=np.float32) -> Dict[int, dict]:
+        """Per-lag {threshold: [S], influence: [S]} vectors in the engine dtype
 
-        Rows beyond the registered count carry the defaults. Overrides follow
+        (float64 in parity mode: 0.1 differs between f32 and f64, and the
+        influence constant enters the stored history). Rows beyond the
+        registered count carry the defaults. Overrides follow
         stream_calc_z_score.js:106-132 (keyed by service name only).
         """
         defaults = {int(d["LAG"]): d for d in zscore_config.get("defaults", [])}
         out = {}
         for lag in lags:
             d = defaults.get(int(lag), {"THRESHOLD": 0.0, "INFLUENCE": 0.0})
-            thr = np.full(self.capacity, float(d["THRESHOLD"]), dtype=np.float32)
-            infl = np.full(self.capacity, float(d["INFLUENCE"]), dtype=np.float32)
+            thr = np.full(self.capacity, float(d["THRESHOLD"]), dtype=dtype)
+            infl = np.full(self.capacity, float(d["INFLUENCE"]), dtype=dtype)
             out[int(lag)] = {"threshold": thr, "influence": infl}
         for row, (_server, service) in enumerate(self._rows):
             for setting in service_zscore_settings(zscore_config, service):
@@ -102,14 +104,14 @@ class ServiceRegistry:
                     out[lag]["influence"][row] = float(setting["INFLUENCE"])
         return out
 
-    def alert_params(self, alerts_config: dict) -> dict:
+    def alert_params(self, alerts_config: dict, dtype=np.float32) -> dict:
         """Per-row alert vectors: hard-max override and service suppression.
 
         Mirrors stream_process_alerts.js:395-398: a service override of
         hardMaxMsAlertThreshold applies when set and non-zero.
         """
         hard_max_default = float(alerts_config.get("hardMaxMsAlertThreshold", np.inf))
-        hard_max = np.full(self.capacity, hard_max_default, dtype=np.float32)
+        hard_max = np.full(self.capacity, hard_max_default, dtype=dtype)
         suppressed = np.zeros(self.capacity, dtype=bool)
         suppressed_services = set(alerts_config.get("suppressedServices", []))
         for row, (_server, service) in enumerate(self._rows):

@@ -30,7 +30,6 @@ Exactness notes (SURVEY.md §7.3):
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Tuple
 
 import jax

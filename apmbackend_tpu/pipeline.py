@@ -1,0 +1,454 @@
+"""The fused device pipeline + host driver.
+
+This is the TPU-native replacement for three whole reference processes —
+stream_calc_stats, stream_calc_z_score, stream_process_alerts — collapsed into
+ONE jitted step function over dense state (SURVEY.md §7.2 steps 4-6). Where the
+reference hops RabbitMQ between stages per message, here a 10 s tick runs:
+
+    stats.tick  ->  wire-quantize  ->  zscore.step (per lag)  ->  alerts.eval
+
+entirely on device, for every (server, service) row at once. The host driver
+around it keeps the string<->row registry, splits incoming micro-batches at
+tick boundaries (preserving the reference's stats-before-addData event order,
+stream_calc_stats.js:348-370), re-orders raw tx for the DB sink via the
+min-heap (stream_calc_stats.js:136-155 role), applies per-service alert
+cooldowns, and snapshots/restores the full device state (resume files, §5.4).
+
+Wire parity: ``quantize=True`` rounds avg/p75/p95 to 1 decimal and tpm to 2
+before the z-score step — exactly what the reference's CSV hop does
+(StatEntry.toCSVString -> parseFloat, entries.js:72) — so device FullStat
+output matches a reference pipeline reading the same queues.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .entries import FullStatEntry, StatEntry, TxEntry
+from .ops import alerts as dalerts
+from .ops import stats as dstats
+from .ops import zscore as dzscore
+from .ops.registry import CapacityExceeded, ServiceRegistry
+from .utils.heap import MinHeap
+
+
+class LagSpec(NamedTuple):
+    lag: int
+    suppressed: bool  # lag in suppressedLags
+
+
+class EngineConfig(NamedTuple):
+    stats: dstats.StatsConfig
+    lags: Tuple[LagSpec, ...]
+    alert_rules: Tuple[dalerts.AlertRuleConfig, ...]  # one per lag
+    quantize: bool = True
+
+    @property
+    def capacity(self) -> int:
+        return self.stats.capacity
+
+
+class EngineState(NamedTuple):
+    stats: dstats.StatsState
+    zscores: Tuple[dzscore.ZScoreState, ...]  # one per lag
+    alert_counters: Tuple[jnp.ndarray, ...]  # [S] int32 per lag
+
+
+class EngineParams(NamedTuple):
+    """Per-row parameter vectors gathered from config (refreshed on hot reload
+    or registry growth)."""
+
+    thresholds: Tuple[jnp.ndarray, ...]  # [S] per lag
+    influences: Tuple[jnp.ndarray, ...]  # [S] per lag
+    hard_max_ms: jnp.ndarray  # [S]
+    suppressed: jnp.ndarray  # [S] bool
+
+
+class LagEmission(NamedTuple):
+    window_avg: jnp.ndarray  # [S, 3]
+    lower_bound: jnp.ndarray  # [S, 3]
+    upper_bound: jnp.ndarray  # [S, 3]
+    signal: jnp.ndarray  # [S, 3] int32
+    trigger: jnp.ndarray  # [S] bool
+    cause_bits: jnp.ndarray  # [S] int32
+
+
+class TickEmission(NamedTuple):
+    tpm: jnp.ndarray  # [S] (wire-rounded when quantize)
+    average: jnp.ndarray  # [S, 3] = (avg, p75, p95), wire-rounded
+    count: jnp.ndarray  # [S] int32
+    overflowed: jnp.ndarray  # [S] bool
+    lags: Tuple[LagEmission, ...]
+
+
+def engine_init(cfg: EngineConfig) -> EngineState:
+    S = cfg.capacity
+    return EngineState(
+        stats=dstats.init_state(cfg.stats),
+        zscores=tuple(
+            dzscore.init_state(dzscore.ZScoreConfig(S, spec.lag, cfg.stats.dtype))
+            for spec in cfg.lags
+        ),
+        alert_counters=tuple(jnp.zeros((S,), jnp.int32) for _ in cfg.lags),
+    )
+
+
+def engine_tick(
+    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams
+) -> Tuple[TickEmission, EngineState]:
+    """The fused per-interval step — the flagship jittable function."""
+    res, stats_state = dstats.tick(state.stats, cfg.stats, new_label)
+
+    if cfg.quantize:
+        tpm = dstats.quantize_half_up(res.tpm, 2)
+        avg = dstats.quantize_half_up(res.average, 1)
+        p75 = dstats.quantize_half_up(res.per75, 1)
+        p95 = dstats.quantize_half_up(res.per95, 1)
+    else:
+        tpm, avg, p75, p95 = res.tpm, res.average, res.per75, res.per95
+
+    new_values = jnp.stack([avg, p75, p95], axis=1)  # [S, 3]
+
+    lag_emissions = []
+    new_zstates = []
+    new_counters = []
+    for i, spec in enumerate(cfg.lags):
+        zcfg = dzscore.ZScoreConfig(cfg.capacity, spec.lag, cfg.stats.dtype)
+        zres, zstate = dzscore.step(
+            state.zscores[i], zcfg, new_values, params.thresholds[i], params.influences[i]
+        )
+        ares = dalerts.eval_rules(
+            state.alert_counters[i],
+            cfg.alert_rules[i],
+            avg, p75, tpm,
+            zres.signal[:, 0], zres.signal[:, 1],
+            params.hard_max_ms, params.suppressed,
+        )
+        lag_emissions.append(
+            LagEmission(
+                zres.window_avg, zres.lower_bound, zres.upper_bound, zres.signal,
+                ares.trigger, ares.cause_bits,
+            )
+        )
+        new_zstates.append(zstate)
+        new_counters.append(ares.counters)
+
+    emission = TickEmission(tpm, new_values, res.count, res.overflowed, tuple(lag_emissions))
+    return emission, EngineState(stats_state, tuple(new_zstates), tuple(new_counters))
+
+
+def engine_ingest(state: EngineState, cfg: EngineConfig, rows, labels, elapsed, valid) -> EngineState:
+    return state._replace(
+        stats=dstats.ingest(state.stats, cfg.stats, rows, labels, elapsed, valid)
+    )
+
+
+def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> EngineConfig:
+    """Derive EngineConfig from the APM config tree (apm_config.json shape)."""
+    eng = apm_config.get("tpuEngine", {})
+    calc = apm_config.get("streamCalcStats", {})
+    zcfg = apm_config.get("streamCalcZScore", {})
+    acfg = apm_config.get("streamProcessAlerts", {})
+
+    if capacity is None:
+        capacity = int(eng.get("serviceCapacity", 1024))
+    dtype = jnp.float64 if eng.get("dtype") == "float64" else jnp.float32
+    stats_cfg = dstats.StatsConfig(
+        capacity=capacity,
+        window_sz=int(calc.get("windowSizeInIntervals", 30)),
+        buffer_sz=int(calc.get("bufferSizeInIntervals", 6)),
+        interval_len_s=int(calc.get("intervalLengthInSeconds", 10)),
+        samples_per_bucket=int(eng.get("samplesPerBucket", 128)),
+        dtype=dtype,
+    )
+    suppressed_lags = {int(x) for x in acfg.get("suppressedLags", [])}
+    lags = tuple(
+        LagSpec(int(d["LAG"]), int(d["LAG"]) in suppressed_lags)
+        for d in zcfg.get("defaults", [])
+    )
+    rules = tuple(
+        dalerts.AlertRuleConfig(
+            hard_min_ms=float(acfg.get("hardMinMsAlertThreshold", 200)),
+            hard_min_tpm=float(acfg.get("hardMinTpmAlertThreshold", 1.0)),
+            alert_on_both_only=bool(acfg.get("alertOnBothOnly", True)),
+            window_sz=int(acfg.get("rollingAlertWindowSizeInIntervals", 60)),
+            required_bad=int(acfg.get("requiredNumberBadIntervalsInAlertWindowToTrigger", 45)),
+            lag_suppressed=spec.suppressed,
+        )
+        for spec in lags
+    )
+    return EngineConfig(stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True)
+
+
+class PipelineDriver:
+    """Host loop around the fused device step.
+
+    Consumes TxEntry objects (or raw CSV lines), micro-batches them, splits at
+    tick boundaries, and emits:
+    - ordered raw tx lines for the DB sink (min-heap drain up to the window
+      edge, stream_calc_stats.js:364 role),
+    - StatEntry lines ('stats' queue parity),
+    - FullStatEntry lines per lag ('z_score' queue parity),
+    - AlertEntry via the provided AlertsManager (cooldown applied).
+    """
+
+    def __init__(
+        self,
+        apm_config: dict,
+        *,
+        capacity: Optional[int] = None,
+        alerts_manager=None,
+        on_stat: Optional[Callable[[StatEntry], None]] = None,
+        on_fullstat: Optional[Callable[[FullStatEntry], None]] = None,
+        on_ordered_tx: Optional[Callable[[TxEntry], None]] = None,
+        on_alert: Optional[Callable] = None,
+        logger=None,
+        micro_batch_size: int = 8192,
+    ):
+        self.apm_config = apm_config
+        self.cfg = build_engine_config(apm_config, capacity)
+        self.state = engine_init(self.cfg)
+        self.registry = ServiceRegistry(self.cfg.capacity)
+        self.alerts_manager = alerts_manager
+        self.on_stat = on_stat
+        self.on_fullstat = on_fullstat
+        self.on_ordered_tx = on_ordered_tx
+        self.on_alert = on_alert
+        self.logger = logger
+        self.micro_batch_size = micro_batch_size
+        self.heap = MinHeap(lambda tx: tx.end_ts)
+        self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
+        self._latest_label = 0  # host mirror of stats.latest_bucket (hot path)
+        self._refresh_params()
+        self._jit_cache: Dict[int, Tuple[Callable, Callable]] = {}
+
+    # -- params / growth -----------------------------------------------------
+    def _refresh_params(self) -> None:
+        zcfg = self.apm_config.get("streamCalcZScore", {})
+        acfg = self.apm_config.get("streamProcessAlerts", {})
+        lag_values = [spec.lag for spec in self.cfg.lags]
+        np_dtype = self._np_dtype()
+        zparams = self.registry.zscore_params(zcfg, lag_values, dtype=np_dtype)
+        aparams = self.registry.alert_params(acfg, dtype=np_dtype)
+        self.params = EngineParams(
+            thresholds=tuple(jnp.asarray(zparams[l]["threshold"]) for l in lag_values),
+            influences=tuple(jnp.asarray(zparams[l]["influence"]) for l in lag_values),
+            hard_max_ms=jnp.asarray(aparams["hard_max_ms"]),
+            suppressed=jnp.asarray(aparams["suppressed"]),
+        )
+
+    def apply_config(self, apm_config: dict) -> None:
+        """Hot-reload hook: re-derive per-row params (thresholds, overrides,
+
+        suppression) without touching device state — the live-actionable
+        subset, like the reference's watcher callbacks (§5.6)."""
+        self.apm_config = apm_config
+        self._refresh_params()
+        if self.alerts_manager is not None:
+            self.alerts_manager.set_config(apm_config.get("streamProcessAlerts", {}))
+
+    def _grow(self) -> None:
+        new_capacity = self.cfg.capacity * 2
+        if self.logger:
+            self.logger.warning(f"Growing service capacity {self.cfg.capacity} -> {new_capacity} (recompile)")
+        self.registry = self.registry.grown(new_capacity)
+        stats_state, stats_cfg = dstats.grow_state(self.state.stats, self.cfg.stats, new_capacity)
+        zstates = []
+        for i, spec in enumerate(self.cfg.lags):
+            zc = dzscore.ZScoreConfig(self.cfg.capacity, spec.lag, self.cfg.stats.dtype)
+            zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
+            zstates.append(zs)
+        counters = tuple(
+            jnp.pad(c, (0, new_capacity - self.cfg.capacity)) for c in self.state.alert_counters
+        )
+        self.cfg = self.cfg._replace(stats=stats_cfg)
+        self.state = EngineState(stats_state, tuple(zstates), counters)
+        self._refresh_params()
+
+    def _row_for(self, server: str, service: str) -> int:
+        try:
+            return self.registry.lookup_or_add(server, service)
+        except CapacityExceeded:
+            self._flush_pending()
+            self._grow()
+            return self.registry.lookup_or_add(server, service)
+
+    # -- jitted callables (cached per capacity) ------------------------------
+    def _fns(self):
+        key = self.cfg.capacity
+        if key not in self._jit_cache:
+            tick = jax.jit(engine_tick, static_argnums=1)
+            ingest = jax.jit(engine_ingest, static_argnums=1)
+            self._jit_cache = {key: (tick, ingest)}
+        return self._jit_cache[key]
+
+    # -- feed ----------------------------------------------------------------
+    def feed(self, tx: TxEntry) -> None:
+        """One transaction (consumeMsg parity, stream_calc_stats.js:331-371)."""
+        if math.isnan(tx.end_ts):
+            if self.logger:
+                self.logger.error(f"NaN bucket label generated from txEntry: {tx}")
+            return
+        label = int(tx.end_ts) // 10000
+        # host-side label mirror: avoids a device->host sync per message
+        if label > self._latest_label:
+            self._flush_pending()
+            self._run_tick(label)
+            self._latest_label = label
+        row = self._row_for(tx.server, tx.service)
+        self._pending.append((row, label, float(tx.elapsed)))
+        self.heap.push(tx)
+        if len(self._pending) >= self.micro_batch_size:
+            self._flush_pending()
+
+    def feed_batch(self, txs: Sequence[TxEntry]) -> None:
+        for tx in txs:
+            self.feed(tx)
+
+    def flush(self) -> None:
+        self._flush_pending()
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        _, ingest = self._fns()
+        n = len(self._pending)
+        pad = self.micro_batch_size if n <= self.micro_batch_size else n
+        rows = np.zeros(pad, np.int32)
+        labels = np.zeros(pad, np.int32)
+        elaps = np.zeros(pad, self._np_dtype())
+        valid = np.zeros(pad, bool)
+        for i, (r, l, e) in enumerate(self._pending):
+            rows[i], labels[i], elaps[i], valid[i] = r, l, e, True
+        self._pending.clear()
+        self.state = ingest(self.state, self.cfg, rows, labels, elaps, valid)
+
+    def _np_dtype(self):
+        return np.float64 if self.cfg.stats.dtype == jnp.float64 else np.float32
+
+    # -- tick ----------------------------------------------------------------
+    def _run_tick(self, new_label: int) -> None:
+        tick, _ = self._fns()
+        emission, self.state = tick(self.state, self.cfg, new_label, self.params)
+        edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
+
+        # ordered tx drain to DB (heap pop up to edge timestamp)
+        if self.on_ordered_tx is not None:
+            for tx in self.heap.pop_all_leq(edge_ts):
+                self.on_ordered_tx(tx)
+        else:
+            self.heap.pop_all_leq(edge_ts)
+
+        count = self.registry.count
+        if count == 0:
+            return
+        tpm = np.asarray(emission.tpm[:count])
+        metrics = np.asarray(emission.average[:count])  # [count, 3]
+
+        if self.on_stat is not None:
+            for row in range(count):
+                server, service = self.registry.key_of(row)
+                self.on_stat(
+                    StatEntry(edge_ts, server, service, float(tpm[row]),
+                              float(metrics[row, 0]), float(metrics[row, 1]), float(metrics[row, 2]))
+                )
+
+        for i, spec in enumerate(self.cfg.lags):
+            lag_em = emission.lags[i]
+            need_fs = self.on_fullstat is not None
+            need_alert = (self.on_alert is not None or self.alerts_manager is not None)
+            if not (need_fs or need_alert):
+                continue
+            wavg = np.asarray(lag_em.window_avg[:count])
+            lb = np.asarray(lag_em.lower_bound[:count])
+            ub = np.asarray(lag_em.upper_bound[:count])
+            sig = np.asarray(lag_em.signal[:count])
+            trig = np.asarray(lag_em.trigger[:count])
+            bits = np.asarray(lag_em.cause_bits[:count])
+            for row in range(count):
+                is_alert = need_alert and trig[row]
+                if not (need_fs or is_alert):
+                    continue
+                server, service = self.registry.key_of(row)
+                fs = FullStatEntry(
+                    edge_ts, server, service, float(tpm[row]), spec.lag,
+                    float(metrics[row, 0]), float(wavg[row, 0]), float(lb[row, 0]), float(ub[row, 0]), int(sig[row, 0]),
+                    float(metrics[row, 1]), float(wavg[row, 1]), float(lb[row, 1]), float(ub[row, 1]), int(sig[row, 1]),
+                    float(metrics[row, 2]), float(wavg[row, 2]), float(lb[row, 2]), float(ub[row, 2]), int(sig[row, 2]),
+                )
+                if need_fs:
+                    self.on_fullstat(fs)
+                if is_alert and self.alerts_manager is not None:
+                    alert = self.alerts_manager.process_trigger(fs, int(bits[row]))
+                    if alert is not None:
+                        self.alerts_manager.add_to_buffer(alert)
+                        if self.on_alert is not None:
+                            self.on_alert(alert)
+                elif is_alert and self.on_alert is not None:
+                    self.on_alert((fs, int(bits[row])))
+
+    # -- checkpoint / resume (§5.4) ------------------------------------------
+    def save_resume(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        arrays = {
+            "latest_bucket": np.asarray(self.state.stats.latest_bucket),
+            "counts": np.asarray(self.state.stats.counts),
+            "sums": np.asarray(self.state.stats.sums),
+            "samples": np.asarray(self.state.stats.samples),
+            "nsamples": np.asarray(self.state.stats.nsamples),
+        }
+        for i, spec in enumerate(self.cfg.lags):
+            z = self.state.zscores[i]
+            arrays[f"z{spec.lag}_values"] = np.asarray(z.values)
+            arrays[f"z{spec.lag}_fill"] = np.asarray(z.fill)
+            arrays[f"z{spec.lag}_pos"] = np.asarray(z.pos)
+            arrays[f"z{spec.lag}_counters"] = np.asarray(self.state.alert_counters[i])
+        keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
+        np.savez_compressed(path, registry=keys, **arrays)
+
+    def load_resume(self, path: str) -> bool:
+        if not os.path.exists(path):
+            return False
+        data = np.load(path, allow_pickle=True)
+        keys = [tuple(k.split("\x00", 1)) for k in data["registry"].tolist()]
+        needed = len(keys)
+        while needed > self.cfg.capacity:
+            self._grow()
+        self.registry = ServiceRegistry(self.cfg.capacity)
+        for server, service in keys:
+            self.registry.lookup_or_add(server, service)
+
+        def pad_rows(a: np.ndarray) -> np.ndarray:
+            if a.shape and a.shape[0] < self.cfg.capacity:
+                pad_width = [(0, self.cfg.capacity - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                fill = np.nan if np.issubdtype(a.dtype, np.floating) else 0
+                return np.pad(a, pad_width, constant_values=fill)
+            return a[: self.cfg.capacity]
+
+        stats_state = dstats.StatsState(
+            latest_bucket=jnp.asarray(data["latest_bucket"]),
+            counts=jnp.asarray(pad_rows(data["counts"])),
+            sums=jnp.asarray(pad_rows(data["sums"])),
+            samples=jnp.asarray(pad_rows(data["samples"])),
+            nsamples=jnp.asarray(pad_rows(data["nsamples"])),
+        )
+        zstates, counters = [], []
+        for spec in self.cfg.lags:
+            zstates.append(
+                dzscore.ZScoreState(
+                    values=jnp.asarray(pad_rows(data[f"z{spec.lag}_values"])),
+                    fill=jnp.asarray(pad_rows(data[f"z{spec.lag}_fill"])),
+                    pos=jnp.asarray(pad_rows(data[f"z{spec.lag}_pos"])),
+                )
+            )
+            counters.append(jnp.asarray(pad_rows(data[f"z{spec.lag}_counters"])))
+        self.state = EngineState(stats_state, tuple(zstates), tuple(counters))
+        self._latest_label = int(data["latest_bucket"])
+        self._refresh_params()
+        return True

@@ -82,22 +82,25 @@ class ProducerQueue(EventEmitter):
     def buffer_count(self) -> int:
         return len(self.buffer)
 
+    def _send_locked(self, line: str, verbose: bool) -> bool:
+        """Caller holds self._lock. Returns True when a pause was entered."""
+        if self.paused:
+            self.buffer.append(line)
+            return False
+        ok = self.channel.send(self.queue_name, line.encode("utf-8"))
+        if not ok:
+            self.buffer.append(line)
+            self.paused = True
+            return True
+        if verbose and self.logger:
+            self.logger.info(f"QUEUE: {self.queue_name} ::: {line}")
+        self.queue_stats.incr(self.queue_name)
+        return False
+
     def write_line(self, line: str, verbose: bool = False) -> None:
         with self._lock:
-            if self.paused:
-                self.buffer.append(line)
-                return
-            ok = self.channel.send(self.queue_name, line.encode("utf-8"))
-            if not ok:
-                self.buffer.append(line)
-                self.paused = True
-                emit_pause = True
-            else:
-                emit_pause = False
-                if verbose and self.logger:
-                    self.logger.info(f"QUEUE: {self.queue_name} ::: {line}")
-                self.queue_stats.incr(self.queue_name)
-        if emit_pause:
+            entered_pause = self._send_locked(line, verbose)
+        if entered_pause:
             if self.logger:
                 self.logger.info(
                     f"--- PRODUCER CHANNEL BUFFER FULL (Q={self.queue_name}) --- Pausing until drain event"
@@ -107,15 +110,18 @@ class ProducerQueue(EventEmitter):
     def retry_buffer(self) -> None:
         """Re-send buffered lines until empty or the channel refuses again
 
-        (queue.js:230-243)."""
-        self.paused = False
-        while self.buffer and not self.paused:
-            line = self.buffer.pop(0)
-            self.write_line(line)
-        if self.buffer and self.logger:
+        (queue.js:230-243). Runs under the lock so a concurrent write_line
+        cannot jump the FIFO order while the buffer drains."""
+        with self._lock:
+            self.paused = False
+            while self.buffer and not self.paused:
+                line = self.buffer.pop(0)
+                self._send_locked(line, False)
+            remaining = len(self.buffer)
+        if remaining and self.logger:
             self.logger.info(
                 f"Records still remaining in {self.queue_name} buffer, waiting for next drain: "
-                f"{len(self.buffer)} records"
+                f"{remaining} records"
             )
 
 

@@ -1,0 +1,103 @@
+"""Tailer tests: follow, pause-file hold, truncation recovery, discovery."""
+
+import os
+import time
+
+from apmbackend_tpu.ingest.tailer import PauseFile, PyTailer, discover_log_files
+
+
+def wait_until(pred, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_follow_appends(tmp_path):
+    p = tmp_path / "server.log"
+    p.write_text("old line\n")
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.1)
+    with open(p, "a") as fh:
+        fh.write("new1\nnew2\n")
+    assert wait_until(lambda: len(lines) == 2)
+    assert lines == ["new1", "new2"]  # started at EOF: 'old line' skipped
+    t.stop()
+
+
+def test_from_start(tmp_path):
+    p = tmp_path / "app.log"
+    p.write_text("a\nb\n")
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), poll_interval_s=0.02, from_start=True)
+    t.start()
+    assert wait_until(lambda: len(lines) == 2)
+    t.stop()
+
+
+def test_pause_file_holds_position(tmp_path):
+    p = tmp_path / "x.log"
+    p.write_text("")
+    pause = PauseFile(str(tmp_path / "PAUSE"))
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), pause, poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.1)
+    pause.create()
+    time.sleep(0.05)
+    with open(p, "a") as fh:
+        fh.write("while-paused\n")
+    time.sleep(0.2)
+    assert lines == []  # held
+    pause.delete()
+    assert wait_until(lambda: lines == ["while-paused"])  # resumed from held position
+    t.stop()
+
+
+def test_truncation_reopens(tmp_path):
+    p = tmp_path / "t.log"
+    p.write_text("aaaaaaaaaa\n")
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.1)
+    p.write_text("")  # truncate
+    time.sleep(0.1)
+    with open(p, "a") as fh:
+        fh.write("fresh\n")
+    assert wait_until(lambda: "fresh" in lines)
+    t.stop()
+
+
+def test_discover_masks(tmp_path):
+    for name in ("app1.log", "app2.log", "server.log", "soap_io_x.log", "hibernate.log"):
+        (tmp_path / name).write_text("")
+    files = discover_log_files(str(tmp_path), ["app*log", "server.log", "soap_io*log"])
+    names = {os.path.basename(f) for f in files}
+    assert names == {"app1.log", "app2.log", "server.log", "soap_io_x.log"}
+
+
+def test_rename_rotation_reopens(tmp_path):
+    """logrotate-style rename + recreate: new inode detected even when the new
+    file grows past the old read position; pre-rotation tail is drained."""
+    p = tmp_path / "r.log"
+    p.write_text("")
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.1)
+    with open(p, "a") as fh:
+        fh.write("before-rotate\n")
+    assert wait_until(lambda: "before-rotate" in lines)
+    os.rename(str(p), str(tmp_path / "r.log.1"))
+    with open(p, "w") as fh:  # new file immediately larger than old pos
+        fh.write("x" * 200 + "\n")
+    assert wait_until(lambda: any(l.startswith("xxx") for l in lines))
+    with open(p, "a") as fh:
+        fh.write("after-rotate\n")
+    assert wait_until(lambda: "after-rotate" in lines)
+    t.stop()
