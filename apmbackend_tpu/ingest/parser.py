@@ -74,6 +74,10 @@ _ISO_TZ_RE = re.compile(r"T.*-")
 _DIGITS_RE = re.compile(r"^[0-9]+$")
 
 
+class ConsumerError(Exception):
+    """A downstream on_record consumer raised — NOT a malformed log line."""
+
+
 def convert_log_date_to_ms(date_str: str) -> str:
     """'' for falsy; audit ISO-with-offset or 'YYYY-MM-DD HH:MM:SS,mmm' (local
     time) -> epoch ms (stream_parse_transactions.js:242-256)."""
@@ -197,7 +201,10 @@ class TransactionParser:
                 start_ms = ""
         top = "Y" if _TOPLEVEL_RE.match(service) else "N"
         tx = TxEntry(server, service, log_id, acct_num, start_ms, end_ms, elapsed, top)
-        self.on_record(tx, insert_to_db)
+        try:
+            self.on_record(tx, insert_to_db)
+        except Exception as e:
+            raise ConsumerError(e) from e
 
     # -- account numbers -----------------------------------------------------
     def _save_acct_num(self, acct_num: str, file_path: str, source: str, alt_log_id: Optional[str] = None):
@@ -442,6 +449,10 @@ class TransactionParser:
         raise — fail-open is the equivalent robustness)."""
         try:
             self._read_line(file_path, line)
+        except ConsumerError as e:
+            # downstream (engine/sink) failure, not bad input — surface loudly
+            if self.logger:
+                self.logger.error(f"Record consumer failed (record dropped): {e.__cause__!r}")
         except Exception as e:
             if self.logger:
                 self.logger.error(f"Unparseable log line in {file_path}: {e}: {line[:200]!r}")

@@ -150,10 +150,12 @@ class PyTailer:
                     time.sleep(self.poll_interval_s)
             if fh:
                 fh.close()
-            if self.on_exit:
+            # graceful stop() is not a tail death: fail-fast on_exit fires
+            # only for unexpected termination
+            if self.on_exit and not self._stop.is_set():
                 self.on_exit(self.file_path, 0)
         except Exception:
-            if self.on_exit:
+            if self.on_exit and not self._stop.is_set():
                 self.on_exit(self.file_path, 1)
 
 
@@ -180,6 +182,7 @@ class NativeTailer:
         self.on_exit = on_exit
         self._proc: Optional[subprocess.Popen] = None
         self._thread: Optional[threading.Thread] = None
+        self._stopping = False
 
     def start(self, from_start: bool = False) -> None:
         argv = [self.binary_path, self.file_path, self.pause_file_path]
@@ -192,15 +195,19 @@ class NativeTailer:
         def _pump():
             assert self._proc is not None and self._proc.stdout is not None
             for line in self._proc.stdout:
-                self.on_line(self.file_path, line.rstrip("\n"))
+                try:
+                    self.on_line(self.file_path, line.rstrip("\n"))
+                except Exception:
+                    pass
             rc = self._proc.wait()
-            if self.on_exit:
+            if self.on_exit and not self._stopping:
                 self.on_exit(self.file_path, rc)
 
         self._thread = threading.Thread(target=_pump, name=f"ntail-{os.path.basename(self.file_path)}", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        self._stopping = True
         if self._proc and self._proc.poll() is None:
             self._proc.terminate()
             try:

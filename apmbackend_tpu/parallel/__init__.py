@@ -1,0 +1,8 @@
+from .mesh import SERVICE_AXIS, make_mesh, padded_capacity, replicated, row_sharding, shard_rows  # noqa: F401
+from .sharded import (  # noqa: F401
+    FleetRollup,
+    local_config,
+    make_sharded_ingest,
+    make_sharded_tick,
+    route_batch,
+)

@@ -1,0 +1,59 @@
+"""Device mesh + sharding layout for pod scale-out.
+
+The shardable axis is the service-key dimension: the reference's per-key state
+dicts have zero cross-key interaction (SURVEY.md §2.5 point 3), so every
+``[S, ...]`` state tensor shards cleanly over a 1-D ``services`` mesh axis.
+Cross-shard communication exists only in fleet-level rollups (psum over ICI,
+:mod:`.sharded`) — the analog of the reference's single-process global view.
+
+Multi-host: the same mesh spans hosts; jax.distributed initializes the
+backend, DCN carries the host-batch scatter (each host feeds the rows it
+owns), ICI carries the rollup all-reduce. This module only fixes the layout;
+it works identically on 1 real chip, a v5e-8, or the 8-device CPU test mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SERVICE_AXIS = "services"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = SERVICE_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (the service-row axis) across the mesh."""
+    return NamedSharding(mesh, P(SERVICE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(tree, mesh: Mesh):
+    """Place every array in a pytree with dim-0 row sharding (scalars and
+    0-d arrays replicated)."""
+    rs = row_sharding(mesh)
+    rep = replicated(mesh)
+
+    def place(x):
+        arr = jax.numpy.asarray(x)
+        if arr.ndim == 0:
+            return jax.device_put(arr, rep)
+        return jax.device_put(arr, rs)
+
+    return jax.tree_util.tree_map(place, tree)
+
+
+def padded_capacity(capacity: int, n_shards: int) -> int:
+    """Round capacity up so every shard gets an equal row block."""
+    return ((capacity + n_shards - 1) // n_shards) * n_shards

@@ -1,0 +1,177 @@
+"""Sharded engine step: shard_map over the service axis + ICI rollups.
+
+Each shard runs the full fused tick (stats -> quantize -> zscore -> alerts) on
+its local row block — zero cross-shard traffic, since per-key state is
+independent (SURVEY.md §2.5 point 3) — and contributes to fleet-level rollup
+baselines via ``jax.lax.psum`` over the ``services`` axis: the ICI all-reduce
+of BASELINE.json's north star. The rollup is the pod-scale replacement for the
+reference's single-process global view (queue-depth/throughput logging and
+fleet dashboards, SURVEY.md §5.5):
+
+- total window tx count + global mean elapsed across every service
+- fleet signal counts per direction (how many services are anomalous NOW)
+- alert-trigger counts per lag
+
+Ingest is also shard_mapped: the host routes each record to the shard that
+owns its row (rows block-partitioned: shard = row // rows_per_shard), so the
+scatter stays shard-local — on a multi-host pod this is the DCN host-batch
+scatter, on one host it is just a reshape.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.stats import StatsState
+from ..ops.zscore import ZScoreState
+from ..pipeline import (
+    EngineConfig,
+    EngineParams,
+    EngineState,
+    LagEmission,
+    TickEmission,
+    engine_ingest,
+    engine_tick,
+)
+from .mesh import SERVICE_AXIS
+
+
+class FleetRollup(NamedTuple):
+    """Pod-wide aggregates, psum'd over ICI; replicated on every shard."""
+
+    total_tx: jnp.ndarray  # scalar int: window tx count across the fleet
+    mean_elapsed: jnp.ndarray  # scalar: global mean of per-service averages
+    signals_high: jnp.ndarray  # [n_lags] int: services signalling +1 (avg metric)
+    signals_low: jnp.ndarray  # [n_lags] int: services signalling -1
+    alerts: jnp.ndarray  # [n_lags] int: alert triggers this tick
+
+
+def _local_tick_with_rollup(cfg: EngineConfig):
+    def fn(state: EngineState, new_label, params: EngineParams):
+        emission, new_state = engine_tick(state, cfg, new_label, params)
+        total_tx = jax.lax.psum(jnp.sum(emission.count), SERVICE_AXIS)
+        avg = emission.average[:, 0]
+        defined = ~jnp.isnan(avg)
+        s = jax.lax.psum(jnp.sum(jnp.where(defined, avg, 0)), SERVICE_AXIS)
+        n = jax.lax.psum(jnp.sum(defined), SERVICE_AXIS)
+        mean_elapsed = jnp.where(n > 0, s / jnp.maximum(n, 1), jnp.nan)
+        sig_hi = jnp.stack(
+            [jax.lax.psum(jnp.sum(l.signal[:, 0] == 1), SERVICE_AXIS) for l in emission.lags]
+        )
+        sig_lo = jnp.stack(
+            [jax.lax.psum(jnp.sum(l.signal[:, 0] == -1), SERVICE_AXIS) for l in emission.lags]
+        )
+        alerts = jnp.stack(
+            [jax.lax.psum(jnp.sum(l.trigger), SERVICE_AXIS) for l in emission.lags]
+        )
+        rollup = FleetRollup(total_tx, mean_elapsed, sig_hi, sig_lo, alerts)
+        return emission, rollup, new_state
+
+    return fn
+
+
+_ROW = P(SERVICE_AXIS)
+
+
+def _state_specs(cfg: EngineConfig) -> EngineState:
+    return EngineState(
+        stats=StatsState(latest_bucket=P(), counts=_ROW, sums=_ROW, samples=_ROW, nsamples=_ROW),
+        zscores=tuple(ZScoreState(values=_ROW, fill=_ROW, pos=_ROW) for _ in cfg.lags),
+        alert_counters=tuple(_ROW for _ in cfg.lags),
+    )
+
+
+def _params_specs(cfg: EngineConfig) -> EngineParams:
+    return EngineParams(
+        thresholds=tuple(_ROW for _ in cfg.lags),
+        influences=tuple(_ROW for _ in cfg.lags),
+        hard_max_ms=_ROW,
+        suppressed=_ROW,
+    )
+
+
+def _emission_specs(cfg: EngineConfig) -> TickEmission:
+    lag_spec = LagEmission(
+        window_avg=_ROW, lower_bound=_ROW, upper_bound=_ROW, signal=_ROW,
+        trigger=_ROW, cause_bits=_ROW,
+    )
+    return TickEmission(
+        tpm=_ROW, average=_ROW, count=_ROW, overflowed=_ROW,
+        lags=tuple(lag_spec for _ in cfg.lags),
+    )
+
+
+def local_config(cfg: EngineConfig, n_shards: int) -> EngineConfig:
+    if cfg.capacity % n_shards != 0:
+        raise ValueError(f"capacity {cfg.capacity} not divisible by mesh size {n_shards}")
+    return cfg._replace(stats=cfg.stats._replace(capacity=cfg.capacity // n_shards))
+
+
+def make_sharded_tick(mesh: Mesh, cfg: EngineConfig):
+    """jit(shard_map(tick + ICI rollup)) over the service-axis mesh."""
+    n = mesh.devices.size
+    fn = _local_tick_with_rollup(local_config(cfg, n))
+    mapped = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(_state_specs(cfg), P(), _params_specs(cfg)),
+        out_specs=(_emission_specs(cfg), FleetRollup(P(), P(), P(), P(), P()), _state_specs(cfg)),
+    )
+    return jax.jit(mapped)
+
+
+def make_sharded_ingest(mesh: Mesh, cfg: EngineConfig):
+    """jit(shard_map(ingest)): batches arrive pre-routed as
+    [n_shards, B_local] arrays with shard-local row indices."""
+    n = mesh.devices.size
+    lcfg = local_config(cfg, n)
+
+    def fn(state: EngineState, rows, labels, elapsed, valid):
+        return engine_ingest(state, lcfg, rows[0], labels[0], elapsed[0], valid[0])
+
+    batch_spec = P(SERVICE_AXIS)  # leading axis = shard
+    mapped = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(_state_specs(cfg), batch_spec, batch_spec, batch_spec, batch_spec),
+        out_specs=_state_specs(cfg),
+    )
+    return jax.jit(mapped)
+
+
+def route_batch(rows, labels, elapsed, valid, *, capacity: int, n_shards: int, batch_per_shard: int):
+    """Host-side: route a global batch into per-shard slots with local row ids.
+
+    Returns [n_shards, batch_per_shard] arrays (the DCN scatter layout)."""
+    rows = np.asarray(rows)
+    rows_per_shard = capacity // n_shards
+    out_rows = np.zeros((n_shards, batch_per_shard), np.int32)
+    out_labels = np.zeros((n_shards, batch_per_shard), np.int32)
+    out_elapsed = np.zeros((n_shards, batch_per_shard), np.float32)
+    out_valid = np.zeros((n_shards, batch_per_shard), bool)
+    fill = np.zeros(n_shards, np.int32)
+    dropped = 0
+    for i in range(len(rows)):
+        if not valid[i]:
+            continue
+        shard = int(rows[i]) // rows_per_shard
+        j = int(fill[shard])
+        if j >= batch_per_shard:
+            dropped += 1
+            continue
+        out_rows[shard, j] = int(rows[i]) % rows_per_shard
+        out_labels[shard, j] = labels[i]
+        out_elapsed[shard, j] = elapsed[i]
+        out_valid[shard, j] = True
+        fill[shard] += 1
+    return out_rows, out_labels, out_elapsed, out_valid, dropped

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import math
 import os
-from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -226,7 +226,10 @@ class PipelineDriver:
         self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
         self._latest_label = 0  # host mirror of stats.latest_bucket (hot path)
         self._refresh_params()
-        self._jit_cache: Dict[int, Tuple[Callable, Callable]] = {}
+        # jax.jit memoizes per static EngineConfig, so growth (a new cfg)
+        # recompiles automatically through these two callables
+        self._tick = jax.jit(engine_tick, static_argnums=1)
+        self._ingest = jax.jit(engine_ingest, static_argnums=1)
 
     # -- params / growth -----------------------------------------------------
     def _refresh_params(self) -> None:
@@ -279,15 +282,6 @@ class PipelineDriver:
             self._grow()
             return self.registry.lookup_or_add(server, service)
 
-    # -- jitted callables (cached per capacity) ------------------------------
-    def _fns(self):
-        key = self.cfg.capacity
-        if key not in self._jit_cache:
-            tick = jax.jit(engine_tick, static_argnums=1)
-            ingest = jax.jit(engine_ingest, static_argnums=1)
-            self._jit_cache = {key: (tick, ingest)}
-        return self._jit_cache[key]
-
     # -- feed ----------------------------------------------------------------
     def feed(self, tx: TxEntry) -> None:
         """One transaction (consumeMsg parity, stream_calc_stats.js:331-371)."""
@@ -317,9 +311,10 @@ class PipelineDriver:
     def _flush_pending(self) -> None:
         if not self._pending:
             return
-        _, ingest = self._fns()
-        n = len(self._pending)
-        pad = self.micro_batch_size if n <= self.micro_batch_size else n
+        ingest = self._ingest
+        # feed() flushes at micro_batch_size, so pending never exceeds it:
+        # a single fixed batch shape => one compiled ingest program
+        pad = self.micro_batch_size
         rows = np.zeros(pad, np.int32)
         labels = np.zeros(pad, np.int32)
         elaps = np.zeros(pad, self._np_dtype())
@@ -334,8 +329,7 @@ class PipelineDriver:
 
     # -- tick ----------------------------------------------------------------
     def _run_tick(self, new_label: int) -> None:
-        tick, _ = self._fns()
-        emission, self.state = tick(self.state, self.cfg, new_label, self.params)
+        emission, self.state = self._tick(self.state, self.cfg, new_label, self.params)
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
 
         # ordered tx drain to DB (heap pop up to edge timestamp)
@@ -395,6 +389,8 @@ class PipelineDriver:
 
     # -- checkpoint / resume (§5.4) ------------------------------------------
     def save_resume(self, path: str) -> None:
+        """Atomic snapshot (tmp + rename); `path` is used verbatim — no .npz
+        suffix magic — so load_resume(path) always finds what was saved."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         arrays = {
             "latest_bucket": np.asarray(self.state.stats.latest_bucket),
@@ -410,12 +406,27 @@ class PipelineDriver:
             arrays[f"z{spec.lag}_pos"] = np.asarray(z.pos)
             arrays[f"z{spec.lag}_counters"] = np.asarray(self.state.alert_counters[i])
         keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
-        np.savez_compressed(path, registry=keys, **arrays)
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                np.savez_compressed(fh, registry=keys, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
 
     def load_resume(self, path: str) -> bool:
         if not os.path.exists(path):
             return False
-        data = np.load(path, allow_pickle=True)
+        try:
+            data = np.load(path, allow_pickle=True)
+        except Exception:
+            if self.logger:
+                self.logger.error(f"Could not load resume snapshot (starting fresh): {path}")
+            return False
         keys = [tuple(k.split("\x00", 1)) for k in data["registry"].tolist()]
         needed = len(keys)
         while needed > self.cfg.capacity:

@@ -250,3 +250,20 @@ def test_malformed_lines_never_fatal():
         "[jb9] 2024-01-10 09:00:01,000 INFO [CommonTiming] Total time for EJB alive call: 10 ms",
     )
     assert records and records[-1][0].service == "S:alive"
+
+
+def test_consumer_error_distinguished(caplog):
+    import logging
+
+    def bad_consumer(tx, db):
+        raise RuntimeError("sink exploded")
+
+    parser = TransactionParser(bad_consumer, server_from_path=lambda fp: SERVER)
+    parser.logger = logging.getLogger("t")
+    with caplog.at_level(logging.ERROR):
+        parser.read_line(
+            "server.log",
+            "[jb9] 2024-01-10 09:00:01,000 INFO [CommonTiming] Total time for EJB x call: 10 ms",
+        )
+    assert any("Record consumer failed" in r.message for r in caplog.records)
+    assert not any("Unparseable" in r.message for r in caplog.records)

@@ -195,3 +195,23 @@ def test_hot_reload_params():
     new_cfg["streamCalcZScore"]["overrides"]["services"] = {"S:special": {"6": {"THRESHOLD": 9.0}}}
     drv.apply_config(new_cfg)
     assert float(drv.params.thresholds[0][row]) == 9.0
+
+
+def test_resume_path_without_npz_suffix(tmp_path):
+    cfg = small_config()
+    drv = PipelineDriver(cfg)
+    drv.feed(TxEntry("s", "x", "", "1", (BASE * 10000) - 100, BASE * 10000, 100, "N"))
+    drv.flush()
+    p = str(tmp_path / "engine.resume")  # no .npz suffix
+    drv.save_resume(p)
+    drv2 = PipelineDriver(cfg)
+    assert drv2.load_resume(p)
+    assert drv2.registry.rows() == drv.registry.rows()
+
+
+def test_resume_corrupt_file_starts_fresh(tmp_path):
+    cfg = small_config()
+    p = str(tmp_path / "bad.resume")
+    open(p, "wb").write(b"not a zip at all")
+    drv = PipelineDriver(cfg)
+    assert drv.load_resume(p) is False  # no crash
