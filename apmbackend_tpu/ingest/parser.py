@@ -452,7 +452,10 @@ class TransactionParser:
         except ConsumerError as e:
             # downstream (engine/sink) failure, not bad input — surface loudly
             if self.logger:
-                self.logger.error(f"Record consumer failed (record dropped): {e.__cause__!r}")
+                self.logger.error(
+                    f"Record consumer failed (record dropped) in {file_path}: "
+                    f"{e.__cause__!r}: {line[:200]!r}"
+                )
         except Exception as e:
             if self.logger:
                 self.logger.error(f"Unparseable log line in {file_path}: {e}: {line[:200]!r}")

@@ -26,6 +26,14 @@ SERVICE_AXIS = "services"
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = SERVICE_AXIS) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devices):
+            # A short mesh would make route_batch's [n_shards, B] layout hand
+            # multiple shards' rows to one device, silently dropping the rest.
+            raise ValueError(
+                f"Requested a {n_devices}-device mesh but only {len(devices)} "
+                f"JAX device(s) are visible (set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=N for CPU testing)"
+            )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis_name,))
 

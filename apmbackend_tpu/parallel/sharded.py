@@ -154,6 +154,12 @@ def route_batch(rows, labels, elapsed, valid, *, capacity: int, n_shards: int, b
 
     Returns [n_shards, batch_per_shard] arrays (the DCN scatter layout)."""
     rows = np.asarray(rows)
+    if capacity % n_shards != 0:
+        raise ValueError(
+            f"capacity {capacity} is not divisible by n_shards {n_shards}; "
+            f"pad to {((capacity + n_shards - 1) // n_shards) * n_shards} "
+            f"(see mesh.padded_capacity)"
+        )
     rows_per_shard = capacity // n_shards
     out_rows = np.zeros((n_shards, batch_per_shard), np.int32)
     out_labels = np.zeros((n_shards, batch_per_shard), np.int32)

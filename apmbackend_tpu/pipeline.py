@@ -186,6 +186,44 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     return EngineConfig(stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True)
 
 
+def make_demo_engine(
+    capacity: int,
+    samples_per_bucket: int,
+    lag_settings: Sequence[Tuple[int, float, float]],
+    *,
+    hard_max_ms: float = 10000.0,
+) -> Tuple[EngineConfig, EngineState, EngineParams]:
+    """(cfg, fresh state, uniform params) for benches/dryruns/tests.
+
+    ``lag_settings`` is [(lag, threshold, influence), ...]. Single source for
+    the engine-setup boilerplate shared by bench.py, __graft_entry__.py and
+    the sharding tests.
+    """
+    from .config import default_config
+
+    cfg_tree = default_config()
+    cfg_tree["streamCalcZScore"]["defaults"] = [
+        {"LAG": lag, "THRESHOLD": thr, "INFLUENCE": infl}
+        for lag, thr, infl in lag_settings
+    ]
+    cfg_tree["tpuEngine"]["serviceCapacity"] = capacity
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = samples_per_bucket
+    cfg = build_engine_config(cfg_tree, capacity)
+    state = engine_init(cfg)
+    S = cfg.capacity
+    params = EngineParams(
+        thresholds=tuple(
+            jnp.full(S, thr, cfg.stats.dtype) for _lag, thr, _infl in lag_settings
+        ),
+        influences=tuple(
+            jnp.full(S, infl, cfg.stats.dtype) for _lag, _thr, infl in lag_settings
+        ),
+        hard_max_ms=jnp.full(S, hard_max_ms, cfg.stats.dtype),
+        suppressed=jnp.zeros(S, bool),
+    )
+    return cfg, state, params
+
+
 class PipelineDriver:
     """Host loop around the fused device step.
 
@@ -421,13 +459,24 @@ class PipelineDriver:
     def load_resume(self, path: str) -> bool:
         if not os.path.exists(path):
             return False
+        # Fully materialize the snapshot before touching any state: np.load
+        # succeeds on any readable zip, and member reads (KeyError, zlib
+        # errors on truncation) raise lazily — a corrupt file must mean
+        # "start fresh", never a crash or a half-mutated driver.
         try:
-            data = np.load(path, allow_pickle=True)
+            with np.load(path, allow_pickle=True) as npz:
+                data = {name: npz[name] for name in npz.files}
+            keys = [tuple(k.split("\x00", 1)) for k in data["registry"].tolist()]
+            required = ["latest_bucket", "counts", "sums", "samples", "nsamples"]
+            for spec in self.cfg.lags:
+                required += [f"z{spec.lag}_{f}" for f in ("values", "fill", "pos", "counters")]
+            missing = [name for name in required if name not in data]
+            if missing:
+                raise KeyError(missing[0])
         except Exception:
             if self.logger:
                 self.logger.error(f"Could not load resume snapshot (starting fresh): {path}")
             return False
-        keys = [tuple(k.split("\x00", 1)) for k in data["registry"].tolist()]
         needed = len(keys)
         while needed > self.cfg.capacity:
             self._grow()

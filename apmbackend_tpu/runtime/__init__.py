@@ -1,0 +1,3 @@
+"""Process runtime: shared module scaffolding + the TPU pipeline worker."""
+
+from .module_base import ModuleRuntime, make_queue_manager  # noqa: F401

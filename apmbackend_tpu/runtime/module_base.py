@@ -1,0 +1,191 @@
+"""Shared scaffolding for pipeline module processes.
+
+Every reference stream module repeats the same boot litany — read config, set
+the global logger, watch the config file, open its queues, install
+SIGINT/SIGTERM handlers that snapshot state and drain, and listen for the
+manager's ``requestGC`` IPC message (e.g. stream_calc_stats.js's main IIFE;
+util_methods.js:463-467). :class:`ModuleRuntime` centralizes that litany so a
+module main is just: construct, wire queues, loop.
+
+Differences from the reference, by design:
+
+- IPC: the manager's ``requestGC`` rides SIGUSR1 instead of a Node IPC channel
+  (portable to detached processes; apm_manager.js:505-509 role).
+- Exit: handlers run in LIFO order (resume-save before queue shutdown), and a
+  second signal forces immediate exit.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..config import ConfigWatcher, default_config, load_config
+from ..logging_util import get_logger
+from ..transport.base import QueueManager
+from ..transport.memory import MemoryBroker, MemoryChannel
+
+CONFIG_ENV_VAR = "APM_CONFIG"
+
+
+def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBroker] = None) -> QueueManager:
+    """QueueManager with the backend named by config ``brokerBackend``.
+
+    ``memory``: channels share one in-process :class:`MemoryBroker` (passed in
+    for single-process pipelines, else created + pump-started here).
+    ``amqp``: one pika connection per channel against ``amqpConnectionString``,
+    mirroring the reference's one-connection-per-direction design
+    (queue.js:73-78).
+    """
+    backend = config.get("brokerBackend", "memory")
+    if backend == "memory":
+        shared = broker or MemoryBroker()
+        if broker is None:
+            shared.start_pump_thread()
+        factory = lambda _qtype: MemoryChannel(shared)  # noqa: E731
+    elif backend == "amqp":
+        from ..transport.amqp import AmqpChannel
+
+        conn_str = config.get("amqpConnectionString", "amqp://localhost:5672")
+        factory = lambda _qtype: AmqpChannel(conn_str)  # noqa: E731
+    else:
+        raise ValueError(f"Unknown brokerBackend: {backend!r}")
+    qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger)
+    return qm
+
+
+class ModuleRuntime:
+    """Boot + lifecycle for one module process."""
+
+    def __init__(
+        self,
+        section: str,
+        *,
+        config_path: Optional[str] = None,
+        config: Optional[dict] = None,
+        broker: Optional[MemoryBroker] = None,
+        install_signals: bool = True,
+        console_log: bool = True,
+    ):
+        self.section = section
+        self.config_path = config_path or os.environ.get(CONFIG_ENV_VAR)
+        if config is not None:
+            self.config = config
+        elif self.config_path:
+            self.config = load_config(self.config_path, exit_on_missing=True)
+        else:
+            self.config = default_config()
+        self.module_config = self.config.get(section, {})
+        prefix = self.module_config.get("logFilePrefix", section)
+        log_dir = self.config.get("logDir")
+        self.logger = get_logger(log_dir, prefix, console=console_log)
+        self.qm = make_queue_manager(self.config, self.logger, broker=broker)
+        self._exit_handlers: List[Callable[[], None]] = []
+        self._reload_handlers: List[Callable[[dict], None]] = []
+        self._exiting = False
+        self._stop = threading.Event()
+        self._timers: List[threading.Thread] = []
+        self.watcher: Optional[ConfigWatcher] = None
+        if self.config_path:
+            self.watcher = ConfigWatcher(
+                self.config_path, self._on_config_change, logger=self.logger
+            )
+            self.watcher.start()
+        if install_signals:
+            self._install_signals()
+
+    # -- config hot reload (§5.6) --------------------------------------------
+    def on_reload(self, handler: Callable[[dict], None]) -> None:
+        self._reload_handlers.append(handler)
+
+    def _on_config_change(self, new_config: dict) -> None:
+        self.config = new_config
+        self.module_config = new_config.get(self.section, {})
+        self.qm.set_interval(int(new_config.get("statLogIntervalInSeconds", 60)))
+        for handler in self._reload_handlers:
+            try:
+                handler(new_config)
+            except Exception as e:
+                self.logger.error(f"Config reload handler error: {e}")
+
+    # -- lifecycle -----------------------------------------------------------
+    def on_exit(self, handler: Callable[[], None]) -> None:
+        """Handlers run LIFO on shutdown (state snapshot first, transport last)."""
+        self._exit_handlers.append(handler)
+
+    def _install_signals(self) -> None:
+        def _term(signum, _frame):
+            self.logger.info(f"Caught signal {signal.Signals(signum).name}")
+            if self._exiting:
+                os._exit(1)
+            self.exit()
+
+        def _gc(_signum, _frame):
+            # requestGC analog (util_methods.js:398-417): full collection +
+            # a log line with before/after RSS when available.
+            before = _rss_mb()
+            gc.collect()
+            self.logger.info(f"Garbage collection requested: RSS {before:.1f} -> {_rss_mb():.1f} MB")
+
+        signal.signal(signal.SIGINT, _term)
+        signal.signal(signal.SIGTERM, _term)
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _gc)
+
+    def every(self, seconds: float, fn: Callable[[], None], *, name: str = "timer", align: bool = False) -> None:
+        """Run ``fn`` every ``seconds`` until shutdown; ``align`` starts on a
+        wall-clock multiple (the reference's second-aligned recursions)."""
+
+        def _loop():
+            if align:
+                self._stop.wait(seconds - (time.time() % seconds))
+            while not self._stop.is_set():
+                try:
+                    fn()
+                except Exception as e:
+                    self.logger.error(f"{name} error: {e}")
+                self._stop.wait(seconds)
+
+        t = threading.Thread(target=_loop, daemon=True, name=name)
+        t.start()
+        self._timers.append(t)
+
+    def run_forever(self) -> None:
+        try:
+            while not self._stop.is_set():
+                self._stop.wait(3600)
+        except KeyboardInterrupt:
+            self.exit()
+
+    def exit(self, code: int = 0) -> None:
+        if self._exiting:
+            return
+        self._exiting = True
+        self._stop.set()
+        if self.watcher is not None:
+            self.watcher.stop()
+        for handler in reversed(self._exit_handlers):
+            try:
+                handler()
+            except Exception as e:
+                self.logger.error(f"Exit handler error: {e}")
+        try:
+            self.qm.shutdown()
+        except Exception as e:
+            self.logger.error(f"qm.shutdown() error: {e}")
+        self.logger.info("Exiting...")
+        sys.exit(code)
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1024 * 1024)
+    except (OSError, ValueError, IndexError):
+        return 0.0

@@ -1,0 +1,12 @@
+"""Terminal sinks: batched DB writer (stream_insert_db.js role) and the
+outbound adapters it feeds (Postgres/SQLite/fake executors)."""
+
+from .db import (  # noqa: F401
+    ColumnSet,
+    DBWriter,
+    FakeExecutor,
+    PostgresExecutor,
+    SQLiteExecutor,
+    column_sets_from_config,
+    make_executor,
+)

@@ -1,0 +1,391 @@
+"""Batched DB sink.
+
+Role parity with the reference's terminal stage (stream_insert_db.js):
+
+- per-entry-type buffers keyed by the 2-char tag (``tx``/``fs``/``al``/``jx``;
+  plain ``st`` entries are rejected just like consumeMsg does,
+  stream_insert_db.js:355-376),
+- flush when a buffer reaches ``dbInsertBufferLimit`` records or when
+  ``dbMaxTimeBetweenInsertsMs`` elapses since the first record entered an
+  empty buffer (stream_insert_db.js:329-353; config/apm_config.json:230-231),
+- one multi-row INSERT per flush (the pg-promise ``helpers.insert`` role,
+  stream_insert_db.js:298-302),
+- on insert failure the drained rows are pushed back onto the FRONT of the
+  live buffer — ahead of anything that arrived during the attempt — giving the
+  same retry-forever, order-preserving semantics as the unshift loop at
+  stream_insert_db.js:310-320,
+- un-inserted buffers survive restarts via a JSON resume file
+  (stream_insert_db.js:166, 225; SURVEY.md §5.4).
+
+The executor is pluggable: a fake (in-memory, for tests — the seam the
+reference never had), SQLite (stdlib, always available), or Postgres (gated on
+a driver being installed; the reference's production target). Executors own
+value adaptation (datetime -> ISO-8601, dict -> JSON for the jsonb columns).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from datetime import datetime, timezone
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..entries import Entry, EntryFactory
+from ..utils.counters import DBStats
+from ..utils.resume import load_resume_file, save_resume_file
+
+
+class ColumnSet:
+    """Table name + ordered column list for one entry type
+    (pg-promise ColumnSet role, stream_insert_db.js:149-160)."""
+
+    def __init__(self, table: str, columns: Sequence[str]):
+        self.table = table
+        self.columns = tuple(columns)
+
+
+def column_sets_from_config(db_config: dict) -> Dict[str, ColumnSet]:
+    """The four column sets of getColumnSets (stream_insert_db.js:149-160)."""
+    return {
+        "tx": ColumnSet(
+            db_config.get("dbTxTable", "tx"),
+            ("endts", "startts", "server", "service", "logid", "acctnum", "elapsed", "toplevel"),
+        ),
+        "fs": ColumnSet(
+            db_config.get("dbStatTable", "stats"),
+            ("timestamp", "server", "service", "tpm", "lag", "stats"),
+        ),
+        "al": ColumnSet(
+            db_config.get("dbAlertTable", "alerts"),
+            ("entrytimestamp", "alerttimestamp", "server", "service", "cause", "entry"),
+        ),
+        "jx": ColumnSet(
+            db_config.get("dbJmxTable", "jmx"),
+            (
+                "timestamp", "server", "dsinusenodes", "dsactivenodes", "dsavailablenodes",
+                "heapused", "heapcommitted", "heapmax", "metaused", "metacommitted",
+                "metamax", "sysload", "classcnt", "threadcnt", "daemonthreadcnt",
+                "beanpoolavailablecnt", "beanpoolcurrentsize", "beanpoolmaxsize",
+            ),
+        ),
+    }
+
+
+def _adapt(value):
+    """Common scalar adaptation: datetime -> ISO-8601 Z (JS Date.toJSON shape),
+    dict -> compact JSON (jsonb columns), NaN -> None."""
+    if isinstance(value, datetime):
+        return value.astimezone(timezone.utc).isoformat(timespec="milliseconds").replace("+00:00", "Z")
+    if isinstance(value, dict):
+        return json.dumps(value, separators=(",", ":"), allow_nan=False)
+    if isinstance(value, float) and math.isnan(value):
+        return None
+    return value
+
+
+class FakeExecutor:
+    """In-memory executor for tests: records every batch; can be told to fail."""
+
+    def __init__(self):
+        self.tables: Dict[str, List[tuple]] = {}
+        self.batches: List[Tuple[str, int]] = []
+        self.fail = False
+
+    def insert_many(self, cs: ColumnSet, rows: List[dict]) -> None:
+        if self.fail:
+            raise RuntimeError("injected insert failure")
+        table = self.tables.setdefault(cs.table, [])
+        for row in rows:
+            table.append(tuple(_adapt(row.get(c)) for c in cs.columns))
+        self.batches.append((cs.table, len(rows)))
+
+    def close(self) -> None:
+        pass
+
+
+class SQLiteExecutor:
+    """SQLite executor (stdlib). Tables are created on demand with TEXT-affinity
+    columns — SQLite's dynamic typing keeps numerics numeric."""
+
+    def __init__(self, path: str = ":memory:"):
+        import sqlite3
+
+        # The writer may flush from its timer thread while the consumer thread
+        # adds rows; a single connection guarded by the writer's buffer lock
+        # would serialize anyway, but check_same_thread must be off for the
+        # timer-thread flush path.
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._created: set = set()
+        self._lock = threading.Lock()
+
+    def insert_many(self, cs: ColumnSet, rows: List[dict]) -> None:
+        cols = ", ".join(cs.columns)
+        ph = ", ".join("?" for _ in cs.columns)
+        with self._lock:
+            if cs.table not in self._created:
+                self._conn.execute(f"CREATE TABLE IF NOT EXISTS {cs.table} ({cols})")
+                self._created.add(cs.table)
+            self._conn.executemany(
+                f"INSERT INTO {cs.table} ({cols}) VALUES ({ph})",
+                [tuple(_adapt(r.get(c)) for c in cs.columns) for r in rows],
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class PostgresExecutor:  # pragma: no cover - requires a driver + live server
+    """Postgres executor (the production target, stream_insert_db.js:133-143).
+
+    Gated: constructed only when a driver (psycopg2 or pg8000) is importable.
+    One multi-row INSERT per call, matching the pg-promise helpers.insert path.
+    """
+
+    def __init__(self, *, user: str, host: str, database: str, password: Optional[str] = None, port: int = 5432):
+        driver = None
+        try:
+            import psycopg2  # type: ignore
+
+            driver = "psycopg2"
+            self._conn = psycopg2.connect(
+                user=user, host=host, dbname=database, password=password, port=port
+            )
+        except ImportError:
+            try:
+                import pg8000.native  # type: ignore
+
+                driver = "pg8000"
+                self._conn = pg8000.native.Connection(
+                    user, host=host, database=database, password=password, port=port
+                )
+            except ImportError:
+                raise RuntimeError(
+                    "No Postgres driver available (psycopg2/pg8000); "
+                    "use dbBackend 'sqlite' or 'fake'"
+                )
+        self._driver = driver
+        self._lock = threading.Lock()
+
+    def insert_many(self, cs: ColumnSet, rows: List[dict]) -> None:
+        cols = ", ".join(cs.columns)
+        values = [tuple(_adapt(r.get(c)) for c in cs.columns) for r in rows]
+        with self._lock:
+            if self._driver == "psycopg2":
+                ph = ", ".join("%s" for _ in cs.columns)
+                with self._conn.cursor() as cur:
+                    cur.executemany(f"INSERT INTO {cs.table} ({cols}) VALUES ({ph})", values)
+                self._conn.commit()
+            else:
+                ph = ", ".join(f":p{i}" for i in range(len(cs.columns)))
+                for row in values:
+                    self._conn.run(
+                        f"INSERT INTO {cs.table} ({cols}) VALUES ({ph})",
+                        **{f"p{i}": v for i, v in enumerate(row)},
+                    )
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+def make_executor(db_config: dict):
+    """Executor from config ``dbBackend``: fake | sqlite | postgres."""
+    backend = db_config.get("dbBackend", "fake")
+    if backend == "fake":
+        return FakeExecutor()
+    if backend == "sqlite":
+        return SQLiteExecutor(db_config.get("dbFileFullPath", ":memory:"))
+    if backend == "postgres":
+        return PostgresExecutor(
+            user=db_config.get("dbUser", "prod"),
+            host=db_config.get("dbHost", "localhost"),
+            database=db_config.get("dbDatabase", "apm"),
+            password=db_config.get("dbPassword"),
+            port=int(db_config.get("dbPort", 5432)),
+        )
+    raise ValueError(f"Unknown dbBackend: {backend!r}")
+
+
+class DBWriter:
+    """Per-type buffering + batch flush around a pluggable executor.
+
+    Thread model: ``add``/``consume_line`` may be called from a consumer
+    thread while the flush timer fires on the writer's own daemon thread; a
+    single lock guards the buffers, and flushes drain to a temp list first so
+    concurrent adds never interleave into a half-written batch (the async race
+    the reference comments on at stream_insert_db.js:288-301).
+    """
+
+    REJECTED_TYPES = ("st",)
+
+    def __init__(
+        self,
+        executor,
+        db_config: dict,
+        *,
+        db_stats: Optional[DBStats] = None,
+        logger=None,
+        clock: Callable[[], float] = time.monotonic,
+        start_timer: bool = True,
+    ):
+        self.executor = executor
+        self.column_sets = column_sets_from_config(db_config)
+        self.buffer_limit = int(db_config.get("dbInsertBufferLimit", 1000))
+        self.max_ms = float(db_config.get("dbMaxTimeBetweenInsertsMs", 5000))
+        self.db_stats = db_stats
+        self.logger = logger
+        self.clock = clock
+        self._factory = EntryFactory()
+        self._lock = threading.RLock()
+        self._buffers: Dict[str, List[dict]] = {t: [] for t in self.column_sets}
+        # Deadline per type, armed on first insert into an empty buffer
+        # (stream_insert_db.js:332-343); None = disarmed.
+        self._deadlines: Dict[str, Optional[float]] = {t: None for t in self.column_sets}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start_timer:
+            self._thread = threading.Thread(target=self._timer_loop, daemon=True, name="dbwriter-timer")
+            self._thread.start()
+
+    # -- intake --------------------------------------------------------------
+    def consume_line(self, line: str) -> None:
+        """CSV line off the db_insert queue (consumeMsg, stream_insert_db.js:355-376)."""
+        entry = self._factory.from_csv(line)
+        if entry is None:
+            if self.logger:
+                self.logger.info(f"Entry undefined: {line}")
+            return
+        self.add_entry(entry)
+
+    def add_entry(self, entry: Entry) -> None:
+        if entry.type not in self.column_sets:
+            if self.logger:
+                self.logger.info(f"Not a tx, fs, al, or jx: {entry}")
+            return
+        try:
+            obj = entry.to_postgres()
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"to_postgres for type:{entry.type} threw an error: {e}")
+            return
+        self.add(entry.type, obj)
+
+    def add(self, etype: str, obj: dict) -> None:
+        flush_now = False
+        with self._lock:
+            buf = self._buffers[etype]
+            if not buf:
+                self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
+                self._wake.set()
+            if len(buf) >= self.buffer_limit:
+                flush_now = True
+        # Reference order: flush the full buffer first, then append
+        # (stream_insert_db.js:345-352).
+        if flush_now:
+            self.process_buffer(etype)
+        with self._lock:
+            self._buffers[etype].append(obj)
+
+    # -- flush ---------------------------------------------------------------
+    def process_buffer(self, etype: str) -> bool:
+        """Flush one type's buffer. Returns True when the insert succeeded
+        (or the buffer was empty)."""
+        with self._lock:
+            drained = self._buffers[etype]
+            if not drained:
+                return True
+            self._buffers[etype] = []
+            self._deadlines[etype] = None
+        start = time.perf_counter()
+        try:
+            self.executor.insert_many(self.column_sets[etype], drained)
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Error during insert attempt: {e}")
+            with self._lock:
+                # Drained rows go back in FRONT of anything newer
+                # (stream_insert_db.js:310-320) and the timeout re-arms so
+                # retry happens even if no new rows arrive.
+                self._buffers[etype] = drained + self._buffers[etype]
+                if self._deadlines[etype] is None:
+                    self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
+                    self._wake.set()
+            return False
+        if self.db_stats is not None:
+            self.db_stats.add_inserted(len(drained))
+            self.db_stats.add_elapsed_ms((time.perf_counter() - start) * 1000.0)
+        return True
+
+    def process_all(self) -> None:
+        """Flush everything (processAllBuffers, on shutdown)."""
+        for etype in self.column_sets:
+            self.process_buffer(etype)
+
+    def process_due(self, now: Optional[float] = None) -> List[str]:
+        """Flush every buffer whose deadline has passed; returns flushed types."""
+        now = self.clock() if now is None else now
+        due = []
+        with self._lock:
+            for etype, deadline in self._deadlines.items():
+                if deadline is not None and now >= deadline:
+                    due.append(etype)
+        for etype in due:
+            self.process_buffer(etype)
+        return due
+
+    def _timer_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                deadlines = [d for d in self._deadlines.values() if d is not None]
+            if not deadlines:
+                self._wake.wait(timeout=1.0)
+                self._wake.clear()
+                continue
+            wait = min(deadlines) - self.clock()
+            if wait > 0:
+                self._wake.wait(timeout=wait)
+                self._wake.clear()
+                continue
+            self.process_due()
+
+    # -- resume (§5.4) -------------------------------------------------------
+    def buffered_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {t: len(b) for t, b in self._buffers.items()}
+
+    def save_resume(self, path: str) -> None:
+        with self._lock:
+            payload = {t: [self._resume_row(r) for r in b] for t, b in self._buffers.items()}
+        save_resume_file(path, payload, logger=self.logger)
+
+    @staticmethod
+    def _resume_row(row: dict) -> dict:
+        return {k: _adapt(v) if isinstance(v, datetime) else v for k, v in row.items()}
+
+    def load_resume(self, path: str) -> bool:
+        data = load_resume_file(path, logger=self.logger)
+        if not isinstance(data, dict):
+            return False
+        with self._lock:
+            for etype in self.column_sets:
+                rows = data.get(etype)
+                if isinstance(rows, list) and rows:
+                    self._buffers[etype] = list(rows) + self._buffers[etype]
+                    if self._deadlines[etype] is None:
+                        self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
+            self._wake.set()
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, *, flush: bool = True) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if flush:
+            self.process_all()
+        self.executor.close()

@@ -1,0 +1,65 @@
+"""DB sink module process (stream_insert_db.js role).
+
+Consumes the ``db_insert`` queue, buffers per entry type, batch-inserts via
+the configured executor. Honors the pause/resume backpressure events (stops
+and restarts consumption like the reference's qm 'pause'/'resume' handlers),
+saves un-inserted buffers to a resume file on shutdown and loads them on boot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..transport.memory import MemoryBroker
+from ..utils.counters import DBStats
+from .db import DBWriter, make_executor
+
+
+def build(runtime) -> DBWriter:
+    """Wire the sink onto an existing ModuleRuntime (shared by main() and the
+    single-process standalone pipeline)."""
+    cfg = runtime.module_config
+    db_stats = DBStats()
+    writer = DBWriter(
+        make_executor(cfg),
+        cfg,
+        db_stats=db_stats,
+        logger=runtime.logger,
+    )
+    resume_path = cfg.get("bufferResumeFileFullPath")
+    if resume_path:
+        writer.load_resume(resume_path)
+
+    in_queue = runtime.qm.get_queue(
+        runtime.config.get("dbInsertQueue", "db_insert"), "c", writer.consume_line
+    )
+    if cfg.get("consumeQueue", True):
+        in_queue.start_consume()
+
+    runtime.qm.on("pause", in_queue.stop_consume)
+    runtime.qm.on("resume", lambda: in_queue.start_consume() if cfg.get("consumeQueue", True) else None)
+
+    interval = int(runtime.config.get("statLogIntervalInSeconds", 60))
+    runtime.every(interval, lambda: runtime.logger.info(db_stats.snapshot_and_reset()),
+                  name="dbstats-log", align=True)
+
+    def _exit():
+        writer.close(flush=True)
+        if resume_path:
+            writer.save_resume(resume_path)
+
+    runtime.on_exit(_exit)
+    return writer
+
+
+def main(config_path: Optional[str] = None, broker: Optional[MemoryBroker] = None) -> None:
+    from ..runtime.module_base import ModuleRuntime
+
+    runtime = ModuleRuntime("streamInsertDb", config_path=config_path, broker=broker)
+    build(runtime)
+    runtime.logger.info("DB sink started")
+    runtime.run_forever()
+
+
+if __name__ == "__main__":
+    main()

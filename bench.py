@@ -35,32 +35,13 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from apmbackend_tpu.config import default_config
-    from apmbackend_tpu.pipeline import (
-        EngineParams,
-        build_engine_config,
-        engine_ingest,
-        engine_init,
-        engine_tick,
-    )
+    from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
 
     device = jax.devices()[0]
-    cfg_tree = default_config()
-    cfg_tree["streamCalcZScore"]["defaults"] = [
-        {"LAG": lag, "THRESHOLD": 20.0, "INFLUENCE": 0.1} for lag in args.lags
-    ]
-    cfg_tree["tpuEngine"]["serviceCapacity"] = args.capacity
-    cfg_tree["tpuEngine"]["samplesPerBucket"] = args.samples_per_bucket
-    cfg = build_engine_config(cfg_tree, args.capacity)
-
-    S = cfg.capacity
-    state = engine_init(cfg)
-    params = EngineParams(
-        thresholds=tuple(jnp.full(S, 20.0, cfg.stats.dtype) for _ in cfg.lags),
-        influences=tuple(jnp.full(S, 0.1, cfg.stats.dtype) for _ in cfg.lags),
-        hard_max_ms=jnp.full(S, 10000.0, cfg.stats.dtype),
-        suppressed=jnp.zeros(S, bool),
+    cfg, state, params = make_demo_engine(
+        args.capacity, args.samples_per_bucket, [(lag, 20.0, 0.1) for lag in args.lags]
     )
+    S = cfg.capacity
 
     tick = jax.jit(engine_tick, static_argnums=1)
     ingest = jax.jit(engine_ingest, static_argnums=1)

@@ -215,3 +215,16 @@ def test_resume_corrupt_file_starts_fresh(tmp_path):
     open(p, "wb").write(b"not a zip at all")
     drv = PipelineDriver(cfg)
     assert drv.load_resume(p) is False  # no crash
+
+
+def test_resume_valid_zip_wrong_contents_starts_fresh(tmp_path):
+    # np.load accepts any readable zip; missing members must mean "start
+    # fresh", not a lazy KeyError mid-restore.
+    cfg = small_config()
+    p = str(tmp_path / "wrong.resume.npz")
+    np.savez_compressed(p, unrelated=np.arange(4))
+    drv = PipelineDriver(cfg)
+    assert drv.load_resume(p) is False
+    # driver still usable after the rejected load
+    drv.feed(TxEntry("s", "x", "", "1", (BASE * 10000) - 100, BASE * 10000, 100, "N"))
+    drv.flush()

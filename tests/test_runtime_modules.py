@@ -1,0 +1,62 @@
+"""Module runtime scaffolding + DB sink module wiring (single-process, memory broker)."""
+
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.entries import TxEntry
+from apmbackend_tpu.runtime.module_base import ModuleRuntime, make_queue_manager
+from apmbackend_tpu.sinks import insert_db_main
+from apmbackend_tpu.transport.memory import MemoryBroker
+
+
+def make_runtime(section, cfg=None, broker=None):
+    cfg = cfg or default_config()
+    return ModuleRuntime(section, config=cfg, broker=broker, install_signals=False, console_log=False)
+
+
+def test_make_queue_manager_memory_backend():
+    qm = make_queue_manager({"brokerBackend": "memory", "statLogIntervalInSeconds": 60})
+    q = qm.get_queue("t1", "p")
+    q.write_line("tx|a|b|c|1|2|3|4|Y")
+    qm.shutdown()
+
+
+def test_make_queue_manager_unknown_backend():
+    with pytest.raises(ValueError):
+        make_queue_manager({"brokerBackend": "zeromq"})
+
+
+def test_insert_db_module_end_to_end(tmp_path):
+    broker = MemoryBroker()
+    cfg = default_config()
+    cfg["streamInsertDb"]["bufferResumeFileFullPath"] = str(tmp_path / "db.resume")
+    cfg["streamInsertDb"]["dbMaxTimeBetweenInsertsMs"] = 100000  # no timer flush
+    runtime = make_runtime("streamInsertDb", cfg, broker)
+    writer = insert_db_main.build(runtime)
+
+    # a producer in "another process": separate manager, same broker
+    producer_qm = make_queue_manager({"brokerBackend": "memory"}, broker=broker)
+    producer = producer_qm.get_queue("db_insert", "p")
+    tx = TxEntry("srv1", "svc", "log1", 42, 1700000000000, 1700000005000, 5000, "Y")
+    for _ in range(5):
+        producer.write_line(tx.to_csv())
+    broker.pump()
+    assert writer.buffered_counts()["tx"] == 5
+    writer.process_all()
+    assert writer.executor.batches == [("tx", 5)]
+
+    # exit handler flushes + saves resume (empty buffers here)
+    for handler in reversed(runtime._exit_handlers):
+        handler()
+    assert (tmp_path / "db.resume").exists()
+
+
+def test_module_runtime_reload_handlers():
+    runtime = make_runtime("streamInsertDb")
+    seen = []
+    runtime.on_reload(seen.append)
+    new_cfg = default_config()
+    new_cfg["statLogIntervalInSeconds"] = 5
+    runtime._on_config_change(new_cfg)
+    assert seen == [new_cfg]
+    assert runtime.qm.queue_stats.interval == 5

@@ -25,25 +25,21 @@ from apmbackend_tpu.pipeline import (
 BASE = 170_000_000
 
 
+from apmbackend_tpu.pipeline import make_demo_engine
+
+# thresholds differ per lag in the demo config but make_params historically
+# used 2.0 for both; keep that via explicit settings
+LAG_SETTINGS = [(4, 2.0, 0.1), (8, 2.0, 0.0)]
+
+
 def small_cfg(capacity=64):
-    cfg = default_config()
-    cfg["streamCalcZScore"]["defaults"] = [
-        {"LAG": 4, "THRESHOLD": 2.0, "INFLUENCE": 0.1},
-        {"LAG": 8, "THRESHOLD": 3.0, "INFLUENCE": 0.0},
-    ]
-    cfg["tpuEngine"]["serviceCapacity"] = capacity
-    cfg["tpuEngine"]["samplesPerBucket"] = 16
-    return build_engine_config(cfg, capacity)
+    cfg, _state, _params = make_demo_engine(capacity, 16, LAG_SETTINGS)
+    return cfg
 
 
 def make_params(cfg):
-    S = cfg.capacity
-    return EngineParams(
-        thresholds=tuple(jnp.full(S, 2.0, cfg.stats.dtype) for _ in cfg.lags),
-        influences=tuple(jnp.full(S, 0.1, cfg.stats.dtype) for _ in cfg.lags),
-        hard_max_ms=jnp.full(S, 10000.0, cfg.stats.dtype),
-        suppressed=jnp.zeros(S, bool),
-    )
+    _cfg, _state, params = make_demo_engine(cfg.capacity, 16, LAG_SETTINGS)
+    return params
 
 
 def test_mesh_and_padding():

@@ -1,0 +1,168 @@
+"""DB sink: buffering, flush triggers, failure requeue, resume (stream_insert_db.js role)."""
+
+import math
+
+from apmbackend_tpu.entries import AlertEntry, FullStatEntry, JmxEntry, StatEntry, TxEntry
+from apmbackend_tpu.sinks import (
+    DBWriter,
+    FakeExecutor,
+    SQLiteExecutor,
+    column_sets_from_config,
+)
+from apmbackend_tpu.utils.counters import DBStats
+
+
+def make_writer(limit=3, max_ms=5000, executor=None, **kw):
+    executor = executor or FakeExecutor()
+    cfg = {"dbInsertBufferLimit": limit, "dbMaxTimeBetweenInsertsMs": max_ms}
+    clock = FakeClock()
+    w = DBWriter(executor, cfg, clock=clock, start_timer=False, **kw)
+    return w, executor, clock
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def tx(i=0):
+    return TxEntry("srv1", "svc", f"log{i}", 42, 1700000000000 + i, 1700000005000 + i, 5000, "Y")
+
+
+def test_column_sets_table_names():
+    cs = column_sets_from_config({"dbTxTable": "mytx", "dbStatTable": "st8"})
+    assert cs["tx"].table == "mytx"
+    assert cs["fs"].table == "st8"
+    assert cs["al"].table == "alerts"
+    assert "acctnum" in cs["tx"].columns
+    assert len(cs["jx"].columns) == 18
+
+
+def test_flush_at_buffer_limit_reference_order():
+    # The flush fires when a new row finds the buffer already AT the limit:
+    # the full batch is inserted first, then the new row starts a fresh buffer
+    # (stream_insert_db.js:345-352).
+    w, ex, _ = make_writer(limit=3)
+    for i in range(3):
+        w.add_entry(tx(i))
+    assert ex.batches == []  # at limit but not over: no flush yet
+    w.add_entry(tx(3))
+    assert ex.batches == [("tx", 3)]
+    assert w.buffered_counts()["tx"] == 1
+
+
+def test_timeout_flush_via_deadline():
+    w, ex, clock = make_writer(limit=100, max_ms=5000)
+    w.add_entry(tx())
+    assert w.process_due() == []  # not due yet
+    clock.t += 5.1
+    assert w.process_due() == ["tx"]
+    assert ex.batches == [("tx", 1)]
+    # deadline disarmed after flush
+    clock.t += 10
+    assert w.process_due() == []
+
+
+def test_failure_requeues_in_front_and_rearms():
+    w, ex, clock = make_writer(limit=2)
+    w.add_entry(tx(1))
+    w.add_entry(tx(2))
+    ex.fail = True
+    w.add_entry(tx(3))  # triggers flush of [1,2], which fails
+    assert w.buffered_counts()["tx"] == 3
+    ex.fail = False
+    clock.t += 6
+    w.process_due()
+    assert ex.batches == [("tx", 3)]
+    # order preserved: 1, 2, 3
+    logids = [row[4] for row in ex.tables["tx"]]
+    assert logids == ["log1", "log2", "log3"]
+
+
+def test_consume_line_types():
+    w, ex, _ = make_writer(limit=100)
+    w.consume_line(tx().to_csv())
+    st = StatEntry(1700000000000, "s", "svc", 2.5, 100.0, 120.0, 200.0)
+    w.consume_line(st.to_csv())  # plain stats are rejected (consumeMsg :364-376)
+    w.consume_line("garbage line")
+    fs = FullStatEntry(
+        1700000000000, "s", "svc", 2.5, 360,
+        100.0, 90.0, 80.0, 110.0, 0,
+        120.0, 100.0, 90.0, 130.0, 0,
+        200.0, 150.0, 100.0, 220.0, 1,
+    )
+    w.consume_line(fs.to_csv())
+    al = AlertEntry(1700000001000, 1700000000000, "s", "svc", "cause", fs.to_csv())
+    w.consume_line(al.to_csv())
+    jx = JmxEntry(1700000000000, "host1", *range(16))
+    w.consume_line(jx.to_csv())
+    counts = w.buffered_counts()
+    assert counts == {"tx": 1, "fs": 1, "al": 1, "jx": 1}
+
+
+def test_resume_roundtrip(tmp_path):
+    path = str(tmp_path / "db_buffer.resume")
+    w, ex, _ = make_writer(limit=100)
+    w.add_entry(tx(7))
+    w.add_entry(JmxEntry(1700000000000, "host1", *range(16)))
+    w.save_resume(path)
+
+    w2, ex2, clock2 = make_writer(limit=100)
+    assert w2.load_resume(path)
+    counts = w2.buffered_counts()
+    assert counts["tx"] == 1 and counts["jx"] == 1
+    clock2.t += 6
+    w2.process_due()
+    assert ("tx", 1) in ex2.batches and ("jmx", 1) in ex2.batches
+    # datetimes survived as ISO-8601 Z strings (JS Date.toJSON shape)
+    endts = ex2.tables["tx"][0][0]
+    assert isinstance(endts, str) and endts.endswith("Z")
+
+
+def test_load_resume_missing(tmp_path):
+    w, _, _ = make_writer()
+    assert not w.load_resume(str(tmp_path / "nope.resume"))
+
+
+def test_sqlite_executor_end_to_end():
+    ex = SQLiteExecutor(":memory:")
+    stats = DBStats()
+    w, _, _ = make_writer(limit=2, executor=ex, db_stats=stats)
+    for i in range(5):
+        w.add_entry(tx(i))
+    w.process_all()
+    rows = ex._conn.execute("SELECT COUNT(*), MIN(acctnum) FROM tx").fetchone()
+    assert rows == (5, 42)
+    assert stats.rec_ins_counter == 5
+    snap = stats.snapshot_and_reset()
+    assert "inserted: 5" in snap
+    w.close()
+
+
+def test_nan_becomes_null_in_sqlite():
+    ex = SQLiteExecutor(":memory:")
+    w, _, _ = make_writer(limit=100, executor=ex)
+    t = tx()
+    t.acct_num = math.nan
+    t.elapsed = math.nan
+    w.add_entry(t)
+    w.process_all()
+    row = ex._conn.execute("SELECT acctnum, elapsed FROM tx").fetchone()
+    assert row == (None, None)
+    w.close()
+
+
+def test_background_timer_thread_flushes():
+    ex = FakeExecutor()
+    w = DBWriter(ex, {"dbInsertBufferLimit": 100, "dbMaxTimeBetweenInsertsMs": 50}, start_timer=True)
+    w.add_entry(tx())
+    import time
+
+    deadline = time.monotonic() + 2.0
+    while not ex.batches and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert ex.batches == [("tx", 1)]
+    w.close()
