@@ -1,0 +1,58 @@
+"""CLI dispatcher: ``python -m apmbackend_tpu <command> [...]``.
+
+Commands map to the reference's process/tool set:
+
+- ``worker``      TPU pipeline worker (stats+zscore+alerts fused)
+- ``parser``      transaction parser / log tailer
+- ``insertdb``    DB sink
+- ``jmx``         JMX poller
+- ``standalone``  whole pipeline in one process (memory broker)
+- ``dequeue``     destructive queue peek (dequeue.js)
+- ``qstat``       queue depth/memory (qstat.sh)
+"""
+
+import sys
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    cmd, argv = sys.argv[1], sys.argv[2:]
+    sys.argv = [f"apmbackend_tpu {cmd}"] + argv
+    if cmd == "worker":
+        from .runtime.worker import main as m
+
+        m()
+    elif cmd == "parser":
+        from .ingest.parser_main import main as m
+
+        m()
+    elif cmd == "insertdb":
+        from .sinks.insert_db_main import main as m
+
+        m()
+    elif cmd == "jmx":
+        from .ingest.jmx_main import main as m
+
+        m()
+    elif cmd == "standalone":
+        from .standalone import main as m
+
+        return m(argv)
+    elif cmd == "dequeue":
+        from .tools.dequeue import main as m
+
+        return m(argv)
+    elif cmd == "qstat":
+        from .tools.qstat import main as m
+
+        return m(argv)
+    else:
+        print(f"Unknown command: {cmd}\n{__doc__}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

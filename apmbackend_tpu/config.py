@@ -259,6 +259,14 @@ _DEFAULT_CONFIG: dict = {
         "tailPauseFileFullPath": "state/PAUSE_TAILS.switch",
         "appLogDirMaskPrefix": "fixtures/logs",
         "maskSuffixes": ["app*log", "server.log", "soap_io*log"],
+        # server-name extraction from a log path: regex (group 1), else path
+        # component (reference: split('/')[2]), else fixed default, else basename
+        "serverFromPathPattern": None,
+        "serverPathComponentIndex": 2,
+        "defaultServerName": None,
+        # optional path to the native C++ tail binary (native/apm_tail);
+        # Python tailer threads are used when absent
+        "nativeTailBinary": None,
     },
     "streamCalcStats": {
         "logFilePrefix": "stream_calc_stats",
@@ -331,12 +339,25 @@ _DEFAULT_CONFIG: dict = {
     "pullJvmStats": {
         "logFilePrefix": "pull_jvm_stats",
         "verboseQueueWrite": False,
-        "jmxCliCommand": None,  # e.g. "java -jar jboss-cli-client.jar ..."; None => disabled
+        "clientJarFullPath": None,  # path to jboss-cli-client.jar; None => polling disabled
         "jvmHosts": [],
         "shortenHostname": True,
+        "adminUser": "",
+        "adminPass": "",
         "jmxPort": 9990,
         "clientTimeoutMs": 2000,
         "pollingIntervalSeconds": 60,
+        # resource label -> jboss-cli command; order defines blob labeling
+        # (config/apm_config.json:246-254)
+        "statCmdMap": {
+            "ds": "/subsystem=datasources/data-source=DefaultDS/statistics=pool:read-resource(include-runtime=true,recursive=true)",
+            "heap": "/core-service=platform-mbean/type=memory :read-attribute(name=heap-memory-usage)",
+            "meta": "/core-service=platform-mbean/type=memory :read-attribute(name=non-heap-memory-usage)",
+            "sysload": "/core-service=platform-mbean/type=operating-system :read-attribute(name=system-load-average)",
+            "classcnt": "/core-service=platform-mbean/type=class-loading :read-attribute(name=loaded-class-count)",
+            "threading": "/core-service=platform-mbean/type=threading :read-resource",
+            "bean": "/deployment=App.ear/subdeployment=*/subsystem=ejb3/stateless-session-bean=MainBean :read-resource(recursive=true,include-runtime=true)",
+        },
     },
     "grafana": {
         "grafanaURL": "",
@@ -354,11 +375,17 @@ _DEFAULT_CONFIG: dict = {
     # configuration for the batched step function that replaces the per-message
     # stream_calc_stats/z_score/process_alerts event loops).
     "tpuEngine": {
+        "logFilePrefix": "tpu_worker",
         "serviceCapacity": 1024,  # static [S] rows; grows by power-of-2 recompile
         "samplesPerBucket": 128,  # per-key per-bucket elapsed sample capacity
         "meshAxis": "services",
         "dtype": "float32",
         "checkpointDir": "save/tpu_engine",
+        "resumeFileFullPath": "save/tpu_engine.resume.npz",
         "microBatchSize": 65536,
+        # mirror StatEntry/FullStatEntry lines onto the reference's 'stats' /
+        # 'z_score' queues for per-stage inspection and interop (SURVEY.md §4)
+        "emitStatsQueue": False,
+        "emitZScoreQueue": False,
     },
 }

@@ -1,0 +1,131 @@
+"""WildFly JMX poller (pull_jvm_stats.js role).
+
+Every ``pollingIntervalSeconds`` (second-aligned, first tick skipped —
+pull_jvm_stats.js:141-149) each configured JVM host is queried through the
+jboss-cli client jar for the datasource pool, heap/metaspace, system load,
+class/thread counts and EJB bean pool; the resulting :class:`JmxEntry` rows go
+to the db_insert queue.
+
+The CLI prints one bare JSON blob per command plus free-text warnings;
+:func:`cli_to_json` reshapes that concatenation into a single labeled JSON
+object exactly like cliToJSON (pull_jvm_stats.js:15-33). The command runner is
+injectable so polling is testable without Java/WildFly.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..entries import JmxEntry
+
+_LETTER_LINE = re.compile(r"^[a-zA-Z]")
+_BLOB_BOUNDARY = re.compile(r"\n}\n{")
+
+
+def cli_to_json(resources: List[str], output: str) -> dict:
+    """Concatenated jboss-cli JSON blobs -> one dict keyed by resource name."""
+    res_copy = list(resources)
+    fixed = _BLOB_BOUNDARY.sub("\n},\n{", str(output))
+    lines = []
+    for line in fixed.split("\n"):
+        if _LETTER_LINE.match(line):
+            continue  # discard warning messages
+        if line.startswith("{"):
+            lines.append(f'"{res_copy.pop(0)}" : {{')
+        else:
+            lines.append(line)
+    return json.loads("{" + "\n".join(lines) + "}")
+
+
+def default_runner(cmd: str, timeout_s: float) -> str:
+    """Run the CLI command, stderr ignored (execSync stdio pipe/pipe/ignore,
+    pull_jvm_stats.js:42)."""
+    out = subprocess.run(
+        cmd, shell=True, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=timeout_s, check=True,
+    )
+    return out.stdout.decode("utf-8", errors="replace")
+
+
+class JmxPoller:
+    def __init__(
+        self,
+        jvm_config: dict,
+        write_line: Callable[[str], None],
+        *,
+        logger=None,
+        runner: Callable[[str, float], str] = default_runner,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.config = jvm_config
+        self.write_line = write_line
+        self.logger = logger
+        self.runner = runner
+        self.clock = clock
+
+    def set_config(self, jvm_config: dict) -> None:
+        self.config = jvm_config
+
+    # -- command construction (pull_jvm_stats.js:38-43) ----------------------
+    def build_command(self, jvm_host: str, cmd_list: str) -> str:
+        c = self.config
+        return (
+            f"java -jar {c['clientJarFullPath']} --output-json "
+            f"--timeout={c.get('clientTimeoutMs', 2000)} "
+            f"--controller={jvm_host}:{c.get('jmxPort', 9990)} "
+            f"--user={c.get('adminUser', '')} --password={c.get('adminPass', '')} "
+            f'--connect commands="{cmd_list}"'
+        )
+
+    def stat_names_and_commands(self) -> tuple:
+        stat_names: List[str] = []
+        cmds: List[str] = []
+        for stat_name, stat_cmd in (self.config.get("statCmdMap") or {}).items():
+            stat_names.append(stat_name)
+            cmds.append(stat_cmd)
+        return stat_names, ",".join(cmds)
+
+    # -- polling -------------------------------------------------------------
+    def pull_host(self, jvm_host: str, stat_names: List[str], cmd_list: str) -> Optional[dict]:
+        try:
+            raw = self.runner(self.build_command(jvm_host, cmd_list),
+                              float(self.config.get("clientTimeoutMs", 2000)) / 1000.0 + 30.0)
+            stats = cli_to_json(stat_names, raw)
+            stats["server"] = jvm_host
+            return stats
+        except Exception:
+            # connection errors are silently skipped like the bare `return`
+            # at pull_jvm_stats.js:54-56 — a down JVM is a normal condition
+            return None
+
+    def pull_all(self, ts: Optional[float] = None) -> List[JmxEntry]:
+        ts = self.clock() * 1000.0 if ts is None else ts
+        stat_names, cmd_list = self.stat_names_and_commands()
+        entries: List[JmxEntry] = []
+        for jvm_host in self.config.get("jvmHosts", []) or []:
+            stats = self.pull_host(jvm_host, stat_names, cmd_list)
+            if stats is None:
+                continue
+            server = stats["server"]
+            if self.config.get("shortenHostname"):
+                server = re.sub(r"\..*", "", server)
+            try:
+                entry = JmxEntry.from_jmx_stats(ts, server, stats)
+            except (KeyError, IndexError, TypeError) as e:
+                if self.logger:
+                    self.logger.error(f"Malformed JMX stats from {jvm_host}: {e}")
+                continue
+            entries.append(entry)
+            self.write_line(entry.to_csv())
+        return entries
+
+    def seconds_until_next_poll(self) -> float:
+        """Second-aligned cadence: fire on the :00 of each interval
+        (pull_jvm_stats.js:145-147)."""
+        interval = int(self.config.get("pollingIntervalSeconds", 60))
+        current_sec = int(self.clock()) % 60
+        return interval - (current_sec % interval)

@@ -1,0 +1,54 @@
+"""JMX poller module process (pull_jvm_stats.js role)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..transport.memory import MemoryBroker
+from .jmx import JmxPoller
+
+
+def build(runtime) -> JmxPoller:
+    cfg = runtime.module_config
+    db_queue = runtime.qm.get_queue(runtime.config.get("dbInsertQueue", "db_insert"), "p")
+    verbose = bool(cfg.get("verboseQueueWrite"))
+    poller = JmxPoller(
+        cfg,
+        lambda line: db_queue.write_line(line, verbose),
+        logger=runtime.logger,
+    )
+    runtime.on_reload(lambda new_cfg: poller.set_config(new_cfg.get("pullJvmStats", {})))
+
+    # Second-aligned recursion; the first (immediate) tick never polls
+    # (pullAllJvmStatsRecurs(false), pull_jvm_stats.js:141-149).
+    def schedule(not_first_time: bool) -> None:
+        if runtime._stop.is_set():
+            return
+        if not_first_time:
+            try:
+                poller.pull_all()
+            except Exception as e:
+                runtime.logger.error(f"JMX poll error: {e}")
+        t = threading.Timer(poller.seconds_until_next_poll(), schedule, args=(True,))
+        t.daemon = True
+        t.start()
+
+    if cfg.get("jvmHosts") and cfg.get("clientJarFullPath"):
+        schedule(False)
+    else:
+        runtime.logger.warning("JMX polling disabled: no jvmHosts/clientJarFullPath configured")
+    return poller
+
+
+def main(config_path: Optional[str] = None, broker: Optional[MemoryBroker] = None) -> None:
+    from ..runtime.module_base import ModuleRuntime
+
+    runtime = ModuleRuntime("pullJvmStats", config_path=config_path, broker=broker)
+    build(runtime)
+    runtime.logger.info("JMX poller started")
+    runtime.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Transaction parser module process (stream_parse_transactions.js role).
+
+Tails the configured log masks (PyTailer threads or the native C++ tail
+binary), correlates entry/exit lines into TxEntry records, and produces them
+onto the ``transactions`` queue (audit records straight to ``db_insert``).
+Backpressure: a queue 'pause' event creates the shared pause file that stalls
+every tailer at the source; 'resume' deletes it (stream_parse_transactions.js:
+170-176, 834-897).
+
+``--replay <dir>`` feeds fixture/captured logs through the same parser and
+exits — the deterministic replay driver (SURVEY.md §7.2 step 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+from ..transport.memory import MemoryBroker
+from .parser import TransactionParser
+from .replay import ReplayDriver
+from .tailer import TailManager
+
+
+def server_extractor(cfg: dict):
+    """Server name from a log path, config-driven.
+
+    Order: ``serverFromPathPattern`` (regex, group 1) -> path component
+    ``serverPathComponentIndex`` (the reference's ``split('/')[2]``,
+    stream_parse_transactions.js:319) -> ``defaultServerName`` -> basename.
+    """
+    import re as _re
+
+    pattern = cfg.get("serverFromPathPattern")
+    compiled = _re.compile(pattern) if pattern else None
+    component = cfg.get("serverPathComponentIndex")
+    default = cfg.get("defaultServerName")
+
+    def extract(fp: str) -> str:
+        if compiled is not None:
+            m = compiled.search(fp)
+            if m:
+                return m.group(1)
+        if component is not None:
+            parts = fp.split("/")
+            if len(parts) > component:
+                return parts[component]
+        return default or fp.rsplit("/", 1)[-1]
+
+    return extract
+
+
+def build(runtime, *, tail: bool = True):
+    cfg = runtime.module_config
+    verbose = bool(cfg.get("verboseQueueWrite"))
+    out_queue = runtime.qm.get_queue(cfg.get("outQueue", "transactions"), "p")
+    db_queue = runtime.qm.get_queue(runtime.config.get("dbInsertQueue", "db_insert"), "p")
+
+    def on_record(tx, insert_to_db: bool) -> None:
+        # Provider records go down the pipeline; non-Provider audit records go
+        # straight to the DB queue (outputRecord, stream_parse_transactions.js:264-290).
+        if insert_to_db:
+            db_queue.write_line(tx.to_csv(), verbose)
+        else:
+            out_queue.write_line(tx.to_csv(), verbose)
+
+    parser = TransactionParser(
+        on_record, logger=runtime.logger, server_from_path=server_extractor(cfg)
+    )
+
+    manager = None
+    if tail:
+        native = cfg.get("nativeTailBinary")
+        if native and not os.path.exists(native):
+            runtime.logger.warning(f"nativeTailBinary not found, using Python tailers: {native}")
+            native = None
+
+        def on_tail_exit(path, rc):
+            # any tail death kills the parser; the manager restarts it
+            # (fail-fast, stream_parse_transactions.js:919-922)
+            runtime.logger.error(f"Tail exited (rc={rc}) for {path}; exiting parser")
+            runtime.exit(1)
+
+        manager = TailManager(
+            cfg, parser.read_line, logger=runtime.logger,
+            native_binary=native, on_tail_exit=on_tail_exit,
+        )
+        manager.start()
+        runtime.qm.on("pause", manager.pause_reads)
+        runtime.qm.on("resume", manager.resume_reads)
+        runtime.on_exit(manager.stop)
+
+    # TTL cache sweeps (expired partials emit incomplete records,
+    # stream_parse_transactions.js:213-239) + hit/miss stat logging (:329-335)
+    runtime.every(1.0, parser.sweep, name="cache-sweep")
+    interval = int(runtime.config.get("statLogIntervalInSeconds", 60))
+    runtime.every(
+        interval,
+        lambda: runtime.logger.info(f"Cache stats: {parser.cache_stats()}"),
+        name="cache-stats", align=True,
+    )
+    runtime.on_exit(parser.drain)
+    return parser, manager
+
+
+def main(config_path: Optional[str] = None, broker: Optional[MemoryBroker] = None) -> None:
+    from ..runtime.module_base import ModuleRuntime
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replay", help="replay a directory of logs then exit")
+    args, _ = ap.parse_known_args()
+
+    runtime = ModuleRuntime("streamParseTransactions", config_path=config_path, broker=broker)
+    parser, _manager = build(runtime, tail=not args.replay)
+    if args.replay:
+        driver = ReplayDriver(parser)
+        fed = driver.feed_dir(args.replay)
+        driver.finish()
+        runtime.logger.info(f"Replay complete: {fed} lines")
+        runtime.exit(0)
+    runtime.logger.info("Transaction parser started")
+    runtime.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Outward-facing host integrations: Grafana (render/annotations) and email."""
+
+from .email_sender import EmailSender, build_mime  # noqa: F401
+from .grafana import GrafanaClient  # noqa: F401

@@ -1,0 +1,100 @@
+"""Email dispatch via the local sendmail binary.
+
+Role parity with sendEmail (util_methods.js:359-396): HTML body; when an
+image path is given, the HTML gets ``<br><br><img src="cid:..."/>`` appended
+and the PNG rides as an inline related attachment. Transport is the
+``sendmail`` executable on stdin (the nodemailer sendmail-transport role),
+injectable for tests and gated on the binary existing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from email.message import EmailMessage
+from email.utils import make_msgid
+from typing import Callable, Optional
+
+
+def build_mime(
+    from_addr: str,
+    to_addrs: str,
+    subject: str,
+    html: str,
+    image_path: Optional[str] = None,
+    *,
+    clock: Callable[[], float] = time.time,
+) -> EmailMessage:
+    msg = EmailMessage()
+    msg["From"] = from_addr
+    msg["To"] = to_addrs
+    msg["Subject"] = subject
+    if image_path:
+        # Stable cid naming like `graph_<epoch ms>` (util_methods.js:375);
+        # make_msgid supplies the required uniqueness/domain part.
+        cid = make_msgid(idstring=f"graph_{int(clock() * 1000)}")
+        html = f'{html}<br><br><img src="cid:{cid[1:-1]}"/>'
+        msg.add_alternative(html, subtype="html")
+        with open(image_path, "rb") as fh:
+            msg.get_payload()[0].add_related(
+                fh.read(), maintype="image", subtype="png", cid=cid,
+                filename=os.path.basename(image_path),
+            )
+    else:
+        msg.add_alternative(html, subtype="html")
+    return msg
+
+
+class EmailSender:
+    """Callable matching the AlertsManager ``email_sender`` seam:
+    ``sender(subject, html, image_path)``."""
+
+    def __init__(
+        self,
+        from_addr: str,
+        to_addrs: str,
+        *,
+        sendmail_path: str = "/usr/sbin/sendmail",
+        logger=None,
+        transport: Optional[Callable[[EmailMessage], None]] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.from_addr = from_addr
+        self.to_addrs = to_addrs
+        self.sendmail_path = sendmail_path
+        self.logger = logger
+        self.transport = transport
+        self.clock = clock
+
+    def available(self) -> bool:
+        return self.transport is not None or bool(
+            shutil.which(self.sendmail_path) or os.path.exists(self.sendmail_path)
+        )
+
+    def __call__(self, subject: str, html: str, image_path: Optional[str] = None) -> bool:
+        msg = build_mime(self.from_addr, self.to_addrs, subject, html, image_path, clock=self.clock)
+        if self.logger:
+            self.logger.info(f"Sending email! subject={subject!r} to={self.to_addrs!r} image={image_path!r}")
+        try:
+            if self.transport is not None:
+                self.transport(msg)
+            else:
+                if not self.available():
+                    raise FileNotFoundError(self.sendmail_path)
+                # -t reads recipients from the headers; -i guards against
+                # lone-dot line termination (classic sendmail pipe flags).
+                subprocess.run(
+                    [self.sendmail_path, "-t", "-i"],
+                    input=msg.as_bytes(),
+                    check=True,
+                    timeout=30,
+                )
+            if self.logger:
+                self.logger.info("Message sent")
+            return True
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"sendmail error: {e}")
+            return False

@@ -1,0 +1,162 @@
+"""Grafana HTTP client: alert-inspector URLs, PNG renders, annotations.
+
+Role parity:
+
+- :meth:`GrafanaClient.alert_urls` — generateGrafanaURL/Params
+  (stream_process_alerts.js:153-206): one dashboard URL + one /render URL
+  covering every server/service/lag in the alert batch, with a from/to window
+  of [first alert - 5 min, last alert + 5 min], clamped so "to" stays at least
+  ``grafanaNowDelayIntervalMs`` in the past (data-ingest delay),
+  and a render height sized to the alert combinatorics
+  (servers x services x lags z-score panels + one tx panel per service).
+- :meth:`GrafanaClient.render` — renderGraph (stream_process_alerts.js:59-85):
+  GET the render URL with the bearer token, stream the PNG to
+  ``renderDir/alert_<ISO>.png``.
+- :meth:`GrafanaClient.post_annotation` — sendAnnotation
+  (apm_manager.js:224-244): POST /api/annotations with time=timeEnd=now.
+
+HTTP is injectable (``http_get``/``http_post``) so everything is testable
+without a live Grafana; the default transport is urllib.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from datetime import datetime, timezone
+from typing import Callable, List, Optional, Tuple
+
+from ..entries import EntryFactory
+
+
+def _default_http_get(url: str, headers: dict, timeout_s: float) -> bytes:
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+def _default_http_post(url: str, body: dict, headers: dict, timeout_s: float) -> bytes:
+    import urllib.request
+
+    data = json.dumps(body).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=data, headers={**headers, "Content-Type": "application/json"}, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+class GrafanaClient:
+    def __init__(
+        self,
+        grafana_config: dict,
+        *,
+        logger=None,
+        clock: Callable[[], float] = time.time,
+        http_get: Callable[[str, dict, float], bytes] = _default_http_get,
+        http_post: Callable[[str, dict, dict, float], bytes] = _default_http_post,
+    ):
+        self.config = grafana_config
+        self.logger = logger
+        self.clock = clock
+        self.http_get = http_get
+        self.http_post = http_post
+        self._factory = EntryFactory()
+
+    def set_config(self, grafana_config: dict) -> None:
+        self.config = grafana_config
+
+    # -- URL generation (stream_process_alerts.js:153-206) -------------------
+    def alert_url_params(self, alert_buffer: List[dict]) -> Tuple[str, int]:
+        """(query params, height factor) for a batch of buffered alerts."""
+        servers: List[str] = []
+        services: List[str] = []
+        lags: List = []
+        for el in alert_buffer:
+            entry = self._factory.from_csv(el["entry"], delim="&")
+            if entry is None:
+                continue
+            if entry.server not in servers:
+                servers.append(entry.server)
+            if entry.service not in services:
+                services.append(entry.service)
+            if entry.lag not in lags:
+                lags.append(entry.lag)
+
+        first = self._factory.from_csv(alert_buffer[0]["entry"], delim="&")
+        last = self._factory.from_csv(alert_buffer[-1]["entry"], delim="&")
+        now_ms = self.clock() * 1000.0
+        from_ts = int(first.timestamp - 300000)
+        to_ts = int(last.timestamp + 300000)
+        delay = float(self.config.get("grafanaNowDelayIntervalMs", 90000))
+        if now_ms - to_ts <= delay:
+            to_ts = int(now_ms - delay)
+
+        params = f"from={from_ts}&to={to_ts}"
+        for server in servers:
+            params += f"&var-server={server}"
+        for service in services:
+            params += f"&var-service={service}"
+        for lag in lags:
+            params += f"&var-lag={lag}"
+        height_factor = len(servers) * len(services) * len(lags) + len(services)
+        return params, height_factor
+
+    def alert_urls(self, alert_buffer: List[dict]) -> Tuple[str, str]:
+        """(dashboard URL, render URL) for an alert batch."""
+        params, height_factor = self.alert_url_params(alert_buffer)
+        base = self.config.get("grafanaURL", "")
+        rel = self.config.get("alertInspectorRelativeURL", "/d/alert-inspector")
+        url = f"{base}{rel}?{params}"
+        render_height = 100 + int(self.config.get("renderHeightMultiple", 750)) * height_factor
+        extra = (
+            f"&width={self.config.get('renderWidth', 1800)}&height={render_height}"
+            f"{self.config.get('renderExtraParams', '')}"
+        )
+        render_url = f"{base}/render{rel}?{params}{extra}"
+        return url, render_url
+
+    # -- render (stream_process_alerts.js:59-85) -----------------------------
+    def render(self, render_url: str) -> Optional[str]:
+        """Download the rendered PNG; returns the image path or None on error."""
+        if self.logger:
+            self.logger.info("Rendering graph...")
+        try:
+            iso = datetime.fromtimestamp(self.clock(), tz=timezone.utc).isoformat()
+            render_dir = self.config.get("renderDir", "renders")
+            os.makedirs(render_dir, exist_ok=True)
+            image_path = os.path.abspath(os.path.join(render_dir, f"alert_{iso}.png"))
+            data = self.http_get(
+                render_url,
+                {"Authorization": self.config.get("bearerToken", "")},
+                float(self.config.get("renderTimeout", 90000)) / 1000.0,
+            )
+            with open(image_path, "wb") as fh:
+                fh.write(data)
+            return image_path
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Error rendering graph! {e}")
+            return None
+
+    # -- annotations (apm_manager.js:224-244) --------------------------------
+    def post_annotation(self, text: str, tags: List[str]) -> bool:
+        now = int(self.clock() * 1000.0)
+        body = {"time": now, "timeEnd": now, "text": text, "tags": tags}
+        if self.logger:
+            self.logger.info("Submitting annotation...")
+        try:
+            self.http_post(
+                f"{self.config.get('grafanaURL', '')}/api/annotations",
+                body,
+                {"Authorization": self.config.get("bearerToken", "")},
+                10.0,
+            )
+            return True
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Annotation submission failure! {e}")
+            return False

@@ -179,7 +179,12 @@ class ModuleRuntime:
         except Exception as e:
             self.logger.error(f"qm.shutdown() error: {e}")
         self.logger.info("Exiting...")
-        sys.exit(code)
+        if threading.current_thread() is threading.main_thread():
+            sys.exit(code)
+        # sys.exit from a worker thread only kills that thread and the process
+        # would report rc=0; the fail-fast paths (tail death) need the real
+        # exit code for the supervisor's restart logic. Handlers already ran.
+        os._exit(code)
 
 
 def _rss_mb() -> float:

@@ -1,0 +1,199 @@
+"""The TPU pipeline worker process.
+
+One process replaces three reference modules — stream_calc_stats,
+stream_calc_z_score, stream_process_alerts — because the fused device step
+(:mod:`apmbackend_tpu.pipeline`) runs all three stages in a single jit over
+the whole service fleet. The process:
+
+- consumes the ``transactions`` queue,
+- feeds the :class:`PipelineDriver` (device micro-batching + 10 s ticks),
+- emits ordered raw tx, FullStat passthrough, and AlertEntry rows to the
+  ``db_insert`` queue (the reference's stream_calc_stats.js:364 heap drain,
+  stream_process_alerts.js:618 passthrough, and :628 alert rows),
+- optionally mirrors StatEntry / FullStatEntry lines onto the ``stats`` /
+  ``z_score`` queues so reference-style per-stage consumers and the dequeue
+  debug CLI keep working (the per-stage isolation seams of SURVEY.md §4),
+- runs the alert email sender with interval doubling, Grafana render attach,
+- snapshots device + alert state on an interval and on shutdown, restoring on
+  boot (§5.4 semantics),
+- honors pause/resume backpressure by cancelling/restarting consumption.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..entries import EntryFactory
+from ..integrations import EmailSender, GrafanaClient
+from ..ops.alerts import AlertsManager
+from ..pipeline import PipelineDriver
+from ..transport.memory import MemoryBroker
+
+
+class WorkerApp:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        # The consumer thread (broker pump / AMQP) feeds the driver while the
+        # resume-save timer thread flushes + snapshots it; PipelineDriver
+        # itself is single-threaded by design, so serialize here.
+        self._driver_lock = threading.RLock()
+        self._closed = False
+        config = runtime.config
+        eng_cfg = config.get("tpuEngine", {})
+        alerts_cfg = config.get("streamProcessAlerts", {})
+        stats_cfg = config.get("streamCalcStats", {})
+        logger = runtime.logger
+
+        # -- outbound queues -------------------------------------------------
+        qm = runtime.qm
+        self.db_queue = qm.get_queue(config.get("dbInsertQueue", "db_insert"), "p")
+        self.stats_queue = (
+            qm.get_queue(stats_cfg.get("outQueue", "stats"), "p")
+            if eng_cfg.get("emitStatsQueue")
+            else None
+        )
+        zcfg = config.get("streamCalcZScore", {})
+        self.zscore_queue = (
+            qm.get_queue(zcfg.get("outQueue", "z_score"), "p")
+            if eng_cfg.get("emitZScoreQueue")
+            else None
+        )
+
+        # -- alert dispatch chain --------------------------------------------
+        email_sender = None
+        if alerts_cfg.get("emailsEnabled"):
+            email_sender = EmailSender(
+                alerts_cfg.get("fromEmail", "apm@localhost"),
+                alerts_cfg.get("emailList", ""),
+                logger=logger,
+            )
+        grafana_cfg = config.get("grafana", {})
+        grafana = GrafanaClient(grafana_cfg, logger=logger) if grafana_cfg.get("grafanaURL") else None
+        self.alerts_manager = AlertsManager(
+            alerts_cfg, logger=logger, email_sender=email_sender, grafana=grafana
+        )
+
+        # -- the device pipeline ---------------------------------------------
+        self.driver = PipelineDriver(
+            config,
+            alerts_manager=self.alerts_manager,
+            on_stat=(lambda st: self.stats_queue.write_line(st.to_csv())) if self.stats_queue else None,
+            on_fullstat=self._on_fullstat,
+            on_ordered_tx=lambda tx: self.db_queue.write_line(tx.to_csv()),
+            logger=logger,
+            micro_batch_size=int(eng_cfg.get("microBatchSize", 65536)),
+        )
+
+        # -- resume ----------------------------------------------------------
+        self.engine_resume = eng_cfg.get("resumeFileFullPath")
+        self.alerts_resume = alerts_cfg.get("alertsResumeFileFullPath")
+        if self.engine_resume and self.driver.load_resume(self.engine_resume):
+            logger.info(f"Engine state resumed from {self.engine_resume}")
+        if self.alerts_resume:
+            self.alerts_manager.load_resume(self.alerts_resume)
+
+        save_s = int(stats_cfg.get("resumeFileSaveFrequencyInSeconds", 60))
+        runtime.every(save_s, self.save_state, name="resume-save")
+
+        # -- intake ----------------------------------------------------------
+        self._factory = EntryFactory()
+        in_queue_name = stats_cfg.get("inQueue", "transactions")
+        self.in_queue = qm.get_queue(in_queue_name, "c", self._consume)
+        self._consume_enabled = bool(stats_cfg.get("consumeQueue", True))
+        if self._consume_enabled:
+            self.in_queue.start_consume()
+        qm.on("pause", self.in_queue.stop_consume)
+        qm.on("resume", lambda: self.in_queue.start_consume() if self._consume_enabled else None)
+
+        # -- alert sender recursion (stream_process_alerts.js:269-333) -------
+        self._alert_timer: Optional[threading.Timer] = None
+        self._schedule_alert_send(float(alerts_cfg.get("alertCollectionIntervalInSeconds", 60)))
+
+        runtime.on_reload(self._apply_config)
+        runtime.on_exit(self.shutdown)
+
+    # -- callbacks -----------------------------------------------------------
+    def _on_fullstat(self, fs) -> None:
+        line = fs.to_csv()
+        self.db_queue.write_line(line)  # passthrough: everything lands in Postgres
+        if self.zscore_queue is not None:
+            self.zscore_queue.write_line(line)
+
+    def _consume(self, line: str) -> None:
+        entry = self._factory.from_csv(line)
+        if entry is None or entry.type != "tx":
+            self.runtime.logger.info(f"Not a transactions entry: {line[:200]}")
+            return
+        with self._driver_lock:
+            self.driver.feed(entry)
+
+    def _schedule_alert_send(self, interval_s: float) -> None:
+        def _fire():
+            try:
+                count, next_interval = self.alerts_manager.flush()
+                if count:
+                    self.runtime.logger.info(f"Sent {count} alerts; next interval {next_interval}s")
+            except Exception as e:
+                self.runtime.logger.error(f"Alert send error: {e}")
+                next_interval = interval_s
+            self._schedule_alert_send(next_interval)
+
+        if self.runtime._stop.is_set():
+            return
+        self._alert_timer = threading.Timer(interval_s, _fire)
+        self._alert_timer.daemon = True
+        self._alert_timer.start()
+
+    def _apply_config(self, new_config: dict) -> None:
+        with self._driver_lock:
+            self.driver.apply_config(new_config)
+        alerts_cfg = new_config.get("streamProcessAlerts", {})
+        consume = bool(new_config.get("streamCalcStats", {}).get("consumeQueue", True))
+        if consume != self._consume_enabled:
+            self._consume_enabled = consume
+            if consume:
+                self.in_queue.start_consume()
+            else:
+                self.in_queue.stop_consume()
+        self.alerts_manager.set_config(alerts_cfg)
+
+    # -- state ---------------------------------------------------------------
+    def save_state(self) -> None:
+        with self._driver_lock:
+            self.driver.flush()
+            if self.engine_resume:
+                self.driver.save_resume(self.engine_resume)
+        if self.alerts_resume:
+            self.alerts_manager.save_resume(self.alerts_resume)
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._alert_timer is not None:
+            self._alert_timer.cancel()
+        # final flush sends whatever is buffered (sendAlertsRecurse(0, true)
+        # on exit, stream_process_alerts.js:575)
+        try:
+            self.alerts_manager.flush()
+        except Exception as e:
+            self.runtime.logger.error(f"Final alert flush error: {e}")
+        self.save_state()
+
+
+def build(runtime) -> WorkerApp:
+    return WorkerApp(runtime)
+
+
+def main(config_path: Optional[str] = None, broker: Optional[MemoryBroker] = None) -> None:
+    from .module_base import ModuleRuntime
+
+    runtime = ModuleRuntime("tpuEngine", config_path=config_path, broker=broker)
+    build(runtime)
+    runtime.logger.info("TPU pipeline worker started")
+    runtime.run_forever()
+
+
+if __name__ == "__main__":
+    main()

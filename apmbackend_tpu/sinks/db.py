@@ -275,20 +275,20 @@ class DBWriter:
         self.add(entry.type, obj)
 
     def add(self, etype: str, obj: dict) -> None:
-        flush_now = False
         with self._lock:
-            buf = self._buffers[etype]
-            if not buf:
-                self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
-                self._wake.set()
-            if len(buf) >= self.buffer_limit:
-                flush_now = True
+            flush_now = len(self._buffers[etype]) >= self.buffer_limit
         # Reference order: flush the full buffer first, then append
         # (stream_insert_db.js:345-352).
         if flush_now:
             self.process_buffer(etype)
         with self._lock:
             self._buffers[etype].append(obj)
+            # arm whenever no deadline is pending — covers both the
+            # first-insert-into-empty-buffer case and the row that lands
+            # right after a limit-triggered flush disarmed the timer
+            if self._deadlines[etype] is None:
+                self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
+                self._wake.set()
 
     # -- flush ---------------------------------------------------------------
     def process_buffer(self, etype: str) -> bool:
@@ -362,9 +362,17 @@ class DBWriter:
             payload = {t: [self._resume_row(r) for r in b] for t, b in self._buffers.items()}
         save_resume_file(path, payload, logger=self.logger)
 
-    @staticmethod
-    def _resume_row(row: dict) -> dict:
-        return {k: _adapt(v) if isinstance(v, datetime) else v for k, v in row.items()}
+    @classmethod
+    def _resume_row(cls, value):
+        """Recursive datetime -> ISO adaptation ('al' rows nest an entry dict
+        that itself contains datetimes)."""
+        if isinstance(value, datetime):
+            return _adapt(value)
+        if isinstance(value, dict):
+            return {k: cls._resume_row(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [cls._resume_row(v) for v in value]
+        return value
 
     def load_resume(self, path: str) -> bool:
         data = load_resume_file(path, logger=self.logger)

@@ -1,0 +1,112 @@
+"""Single-process pipeline: every module wired over one in-process broker.
+
+The reference can only run as 6 processes + RabbitMQ; this mode runs the whole
+system — parser (tail or replay), TPU worker, DB sink, JMX poller — inside one
+process over the memory broker. It is the dev/bench/test topology; production
+parity mode is the supervisor + AMQP multi-process layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .ingest import parser_main
+from .ingest import jmx_main
+from .runtime.module_base import ModuleRuntime
+from .runtime.worker import WorkerApp
+from .sinks import insert_db_main
+from .transport.memory import MemoryBroker
+
+
+class StandalonePipeline:
+    def __init__(self, config_path: Optional[str] = None, config: Optional[dict] = None,
+                 *, tail: bool = True, install_signals: bool = True):
+        self.broker = MemoryBroker()
+        self.broker.start_pump_thread()
+        # the lead runtime owns signals + the config watcher; the rest share
+        # its config object and broker
+        self.lead = ModuleRuntime(
+            "tpuEngine", config_path=config_path, config=config,
+            broker=self.broker, install_signals=install_signals,
+        )
+        self.worker = WorkerApp(self.lead)
+        self.sink_rt = ModuleRuntime("streamInsertDb", config=self.lead.config,
+                                     broker=self.broker, install_signals=False)
+        self.writer = insert_db_main.build(self.sink_rt)
+        self.parser_rt = ModuleRuntime("streamParseTransactions", config=self.lead.config,
+                                       broker=self.broker, install_signals=False)
+        self.parser, self.tail_manager = parser_main.build(self.parser_rt, tail=tail)
+        self.jmx_rt = ModuleRuntime("pullJvmStats", config=self.lead.config,
+                                    broker=self.broker, install_signals=False)
+        self.jmx = jmx_main.build(self.jmx_rt)
+        self._closed = False
+        # propagate hot reloads from the lead watcher to the satellites
+        self.lead.on_reload(self._propagate_reload)
+        # a signal on the lead must also run the satellites' exit handlers
+        # (sink flush+resume, parser drain, tail stop) — registered after the
+        # WorkerApp handler so LIFO order runs satellites first
+        self.lead.on_exit(self.shutdown)
+
+    def _propagate_reload(self, new_config: dict) -> None:
+        for rt in (self.sink_rt, self.parser_rt, self.jmx_rt):
+            rt._on_config_change(new_config)
+
+    def replay(self, log_dir: str) -> int:
+        from .ingest.replay import ReplayDriver
+
+        driver = ReplayDriver(self.parser)
+        fed = driver.feed_dir(log_dir)
+        driver.finish()
+        self.drain()
+        return fed
+
+    def drain(self) -> None:
+        """Pump until quiescent, flush device + sink state (test/replay aid)."""
+        while self.broker.pump():
+            pass
+        self.worker.driver.flush()
+        while self.broker.pump():
+            pass
+        self.writer.process_all()
+
+    def run_forever(self) -> None:
+        self.lead.logger.info("Standalone pipeline running (single process, memory broker)")
+        self.lead.run_forever()
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for rt in (self.jmx_rt, self.parser_rt, self.sink_rt):
+            for handler in reversed(rt._exit_handlers):
+                try:
+                    handler()
+                except Exception as e:
+                    rt.logger.error(f"Exit handler error: {e}")
+        self.worker.shutdown()
+        self.broker.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Run the full pipeline in one process")
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--replay", help="replay a directory of logs, drain, then exit")
+    ap.add_argument("--no-tail", action="store_true")
+    args = ap.parse_args(argv)
+
+    pipe = StandalonePipeline(config_path=args.config, tail=not (args.replay or args.no_tail))
+    if args.replay:
+        fed = pipe.replay(args.replay)
+        pipe.lead.logger.info(f"Replay complete: {fed} lines")
+        pipe.shutdown()
+        return 0
+    pipe.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,2 @@
+"""Operator debug tooling: destructive queue peek (dequeue.js role) and queue
+status (qstat.sh role)."""

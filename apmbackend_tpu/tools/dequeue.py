@@ -1,0 +1,77 @@
+"""Destructive queue peek: drain-and-print a named queue (dequeue.js:19-51).
+
+Messages are consumed without requeue (the noAck drain the reference used for
+live inspection), printed one per line to stdout. Stops after ``--idle``
+seconds without a message or after ``--count`` messages.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..config import default_config, load_config
+from ..runtime.module_base import CONFIG_ENV_VAR, make_queue_manager
+
+
+def drain(qm, queue_name: str, *, count: Optional[int] = None, idle_s: float = 2.0,
+          out=sys.stdout) -> int:
+    seen = 0
+    last = time.monotonic()
+    lock = threading.Lock()
+
+    def on_line(line: str) -> None:
+        nonlocal seen, last
+        with lock:
+            seen += 1
+            last = time.monotonic()
+        out.write(line + "\n")
+
+    q = qm.get_queue(queue_name, "c", on_line)
+    q.start_consume()
+    try:
+        while True:
+            with lock:
+                done = (count is not None and seen >= count) or (
+                    time.monotonic() - last > idle_s
+                )
+            if done:
+                break
+            time.sleep(0.05)
+    finally:
+        q.stop_consume()
+    return seen
+
+
+def main(argv=None) -> int:
+    import os
+
+    ap = argparse.ArgumentParser(description="Drain and print a queue (destructive)")
+    ap.add_argument("queue_name")
+    ap.add_argument("--config", default=os.environ.get(CONFIG_ENV_VAR))
+    ap.add_argument("--count", type=int, default=None, help="stop after N messages")
+    ap.add_argument("--idle", type=float, default=2.0, help="stop after this many idle seconds")
+    args = ap.parse_args(argv)
+
+    config = load_config(args.config) if args.config else default_config()
+    if config.get("brokerBackend", "memory") == "memory":
+        print(
+            "warning: memory broker is process-local — this fresh process cannot "
+            "see a running pipeline's queues; switch brokerBackend to amqp for "
+            "cross-process inspection",
+            file=sys.stderr,
+        )
+    qm = make_queue_manager(config)
+    try:
+        seen = drain(qm, args.queue_name, count=args.count, idle_s=args.idle)
+        print(f"--- drained {seen} messages from {args.queue_name}", file=sys.stderr)
+    finally:
+        qm.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

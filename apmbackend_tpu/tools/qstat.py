@@ -1,0 +1,80 @@
+"""Queue status: name, depth, memory (qstat.sh:2-5 role).
+
+For the AMQP backend this passively declares each configured queue to read its
+message count; for an in-process memory broker it reads depths directly (the
+path the standalone pipeline and tests use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Tuple
+
+
+def known_queue_names(config: dict) -> List[str]:
+    names = {config.get("dbInsertQueue", "db_insert")}
+    for section in ("streamParseTransactions", "streamCalcStats", "streamCalcZScore"):
+        sec = config.get(section, {})
+        for key in ("inQueue", "outQueue"):
+            if sec.get(key):
+                names.add(sec[key])
+    return sorted(names)
+
+
+def memory_broker_stats(broker) -> List[Tuple[str, int, float]]:
+    return [
+        (name, broker.queue_depth(name), broker.queue_memory_bytes(name) / (1024.0 * 1024.0))
+        for name in broker.queue_names()
+    ]
+
+
+def amqp_stats(connection_string: str, names: List[str]) -> List[Tuple[str, int, float]]:  # pragma: no cover - live broker
+    import pika  # type: ignore
+
+    params = pika.URLParameters(connection_string)
+    conn = pika.BlockingConnection(params)
+    ch = conn.channel()
+    rows = []
+    for name in names:
+        try:
+            ok = ch.queue_declare(queue=name, durable=True, passive=True)
+            rows.append((name, ok.method.message_count, float("nan")))
+        except Exception:
+            ch = conn.channel()  # passive declare on a missing queue closes the channel
+            rows.append((name, -1, float("nan")))
+    conn.close()
+    return rows
+
+
+def format_rows(rows: List[Tuple[str, int, float]]) -> str:
+    lines = [f"{'queue':<20} {'messages':>10} {'memory MB':>10}"]
+    for name, depth, mb in rows:
+        mb_s = f"{mb:.2f}" if mb == mb else "-"
+        lines.append(f"{name:<20} {depth:>10} {mb_s:>10}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import os
+
+    from ..config import default_config, load_config
+    from ..runtime.module_base import CONFIG_ENV_VAR
+
+    ap = argparse.ArgumentParser(description="Show queue depth/memory")
+    ap.add_argument("--config", default=os.environ.get(CONFIG_ENV_VAR))
+    args = ap.parse_args(argv)
+    config = load_config(args.config) if args.config else default_config()
+    if config.get("brokerBackend") == "amqp":
+        rows = amqp_stats(config.get("amqpConnectionString", "amqp://localhost:5672"),
+                          known_queue_names(config))
+    else:
+        print("memory broker is process-local; run qstat inside the pipeline process "
+              "or switch brokerBackend to amqp", file=sys.stderr)
+        rows = [(n, 0, 0.0) for n in known_queue_names(config)]
+    print(format_rows(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

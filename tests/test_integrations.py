@@ -1,0 +1,171 @@
+"""Grafana URL/render/annotation + email MIME (stream_process_alerts.js:59-206,
+apm_manager.js:224-244, util_methods.js:359-396 roles)."""
+
+import email
+import math
+
+from apmbackend_tpu.entries import FullStatEntry
+from apmbackend_tpu.integrations import EmailSender, GrafanaClient, build_mime
+from apmbackend_tpu.ops.alerts import AlertsManager
+
+GRAFANA_CFG = {
+    "grafanaURL": "http://grafana.example:3000",
+    "alertInspectorRelativeURL": "/d/alert-inspector",
+    "grafanaNowDelayIntervalMs": 90000,
+    "bearerToken": "Bearer tok",
+    "renderDir": "renders",
+    "renderWidth": 1800,
+    "renderHeightMultiple": 750,
+    "renderExtraParams": "&autofitpanels",
+    "renderTimeout": 90000,
+}
+
+
+def fs_entry(ts=1700000000000, server="srv1", service="svc", lag=360):
+    return FullStatEntry(
+        ts, server, service, 2.5, lag,
+        100.0, 90.0, 80.0, 110.0, 0,
+        120.0, 100.0, 90.0, 130.0, 1,
+        200.0, 150.0, 100.0, 220.0, 1,
+    )
+
+
+def buffered(entry, cause="average and per75 UB exceeded"):
+    return {
+        "alertTimestamp": entry.timestamp + 1000,
+        "entryTimestamp": entry.timestamp,
+        "server": entry.server,
+        "service": entry.service,
+        "cause": cause,
+        "entry": entry.to_csv().replace("|", "&"),
+    }
+
+
+def test_alert_urls_window_and_vars():
+    # now far in the future => no delay clamping
+    clock = lambda: (1700000000000 + 10**9) / 1000.0
+    g = GrafanaClient(GRAFANA_CFG, clock=clock)
+    buf = [
+        buffered(fs_entry(ts=1700000000000, server="a", service="s1", lag=360)),
+        buffered(fs_entry(ts=1700000600000, server="b", service="s2", lag=8640)),
+    ]
+    url, render_url = g.alert_urls(buf)
+    assert url.startswith("http://grafana.example:3000/d/alert-inspector?")
+    assert "from=1699999700000" in url  # first - 5 min
+    assert "to=1700000900000" in url  # last + 5 min
+    assert "&var-server=a&var-server=b" in url
+    assert "&var-service=s1&var-service=s2" in url
+    assert "&var-lag=360&var-lag=8640" in url
+    # height factor: 2*2*2 + 2 services = 10 -> 100 + 750*10 = 7600
+    assert "&width=1800&height=7600&autofitpanels" in render_url
+    assert render_url.startswith("http://grafana.example:3000/render/d/alert-inspector?")
+
+
+def test_alert_urls_now_delay_clamp():
+    ts = 1700000000000
+    clock = lambda: (ts + 301000) / 1000.0  # "to" would be within the delay window
+    g = GrafanaClient(GRAFANA_CFG, clock=clock)
+    url, _ = g.alert_urls([buffered(fs_entry(ts=ts))])
+    assert f"to={ts + 301000 - 90000}" in url
+
+
+def test_render_writes_png(tmp_path):
+    cfg = dict(GRAFANA_CFG, renderDir=str(tmp_path / "renders"))
+    calls = []
+
+    def fake_get(url, headers, timeout_s):
+        calls.append((url, headers, timeout_s))
+        return b"\x89PNG fake"
+
+    g = GrafanaClient(cfg, http_get=fake_get, clock=lambda: 1700000000.0)
+    path = g.render("http://grafana.example:3000/render/d/x?a=1")
+    assert path and path.endswith(".png")
+    assert open(path, "rb").read() == b"\x89PNG fake"
+    assert calls[0][1] == {"Authorization": "Bearer tok"}
+    assert calls[0][2] == 90.0
+
+
+def test_render_failure_returns_none(tmp_path):
+    cfg = dict(GRAFANA_CFG, renderDir=str(tmp_path))
+
+    def boom(url, headers, timeout_s):
+        raise OSError("no route")
+
+    g = GrafanaClient(cfg, http_get=boom)
+    assert g.render("http://x/render") is None
+
+
+def test_post_annotation():
+    posts = []
+
+    def fake_post(url, body, headers, timeout_s):
+        posts.append((url, body, headers))
+        return b"{}"
+
+    g = GrafanaClient(GRAFANA_CFG, http_post=fake_post, clock=lambda: 1700.0)
+    assert g.post_annotation("restarting module", ["maintenance"])
+    url, body, headers = posts[0]
+    assert url == "http://grafana.example:3000/api/annotations"
+    assert body == {"time": 1700000, "timeEnd": 1700000, "text": "restarting module", "tags": ["maintenance"]}
+
+
+def test_build_mime_inline_image(tmp_path):
+    img = tmp_path / "g.png"
+    img.write_bytes(b"\x89PNG data")
+    msg = build_mime("apm@x.com", "oncall@x.com", "APM Alerts Triggered!", "<p>hi</p>", str(img))
+    raw = msg.as_bytes()
+    parsed = email.message_from_bytes(raw)
+    assert parsed["Subject"] == "APM Alerts Triggered!"
+    parts = list(parsed.walk())
+    types = [p.get_content_type() for p in parts]
+    assert "text/html" in types and "image/png" in types
+    html_part = next(p for p in parts if p.get_content_type() == "text/html")
+    html = html_part.get_payload(decode=True).decode()
+    img_part = next(p for p in parts if p.get_content_type() == "image/png")
+    cid = img_part["Content-ID"].strip("<>")
+    assert f'<img src="cid:{cid}"/>' in html
+
+
+def test_build_mime_without_image():
+    msg = build_mime("a@x", "b@x", "s", "<p>text</p>")
+    assert "img src" not in msg.as_string()
+
+
+def test_email_sender_transport_seam():
+    sent = []
+    sender = EmailSender("a@x", "b@x", transport=sent.append)
+    assert sender.available()
+    assert sender("subj", "<p>x</p>") is True
+    assert sent[0]["To"] == "b@x"
+
+
+def test_email_sender_missing_binary():
+    sender = EmailSender("a@x", "b@x", sendmail_path="/nonexistent/sendmail")
+    assert not sender.available()
+    assert sender("subj", "<p>x</p>") is False
+
+
+def test_alerts_manager_full_dispatch_with_grafana(tmp_path):
+    """AlertsManager.flush wired to the real GrafanaClient + EmailSender seams."""
+    sent_msgs = []
+    cfg = {
+        "emailsEnabled": True,
+        "alertCollectionIntervalInSeconds": 60,
+        "increaseCollectionIntervalAfterAlert": True,
+        "maxCollectionIntervalInSeconds": 960,
+        "perServiceAlertCooldownInMinutes": 15,
+    }
+    g = GrafanaClient(
+        dict(GRAFANA_CFG, renderDir=str(tmp_path)),
+        http_get=lambda u, h, t: b"\x89PNG!",
+        clock=lambda: 1700001000.0,
+    )
+    sender = EmailSender("apm@x.com", "oncall@x.com", transport=sent_msgs.append)
+    mgr = AlertsManager(cfg, email_sender=sender, grafana=g, clock=lambda: 1700000500.0)
+    alert = mgr.process_trigger(fs_entry(), 1 << 4)
+    assert alert is not None
+    mgr.add_to_buffer(alert)
+    count, next_interval = mgr.flush()
+    assert count == 1 and next_interval == 120
+    parsed = email.message_from_bytes(sent_msgs[0].as_bytes())
+    assert any(p.get_content_type() == "image/png" for p in parsed.walk())

@@ -1,0 +1,143 @@
+"""JMX poller: CLI blob parsing, entry emission, scheduling (pull_jvm_stats.js role)."""
+
+import json
+
+from apmbackend_tpu.entries import EntryFactory
+from apmbackend_tpu.ingest.jmx import JmxPoller, cli_to_json
+
+# Shaped like real jboss-cli --output-json output: one bare JSON blob per
+# command, free-text warnings interleaved, no separators between blobs.
+CLI_OUTPUT = """Picked up JAVA_TOOL_OPTIONS: -Dfile.encoding=UTF8
+{
+    "outcome" : "success",
+    "result" : {
+        "ActiveCount" : 10,
+        "AvailableCount" : 8,
+        "InUseCount" : 2
+    }
+}
+{
+    "outcome" : "success",
+    "result" : {
+        "used" : 1000,
+        "committed" : 2000,
+        "max" : 4000
+    }
+}
+{
+    "outcome" : "success",
+    "result" : {
+        "used" : 100,
+        "committed" : 200,
+        "max" : 400
+    }
+}
+{
+    "outcome" : "success",
+    "result" : 1.5
+}
+{
+    "outcome" : "success",
+    "result" : 12345
+}
+{
+    "outcome" : "success",
+    "result" : {
+        "thread-count" : 77,
+        "daemon-thread-count" : 33
+    }
+}
+{
+    "outcome" : "success",
+    "result" : [{
+        "result" : {
+            "pool-available-count" : 5,
+            "pool-current-size" : 3,
+            "pool-max-size" : 10
+        }
+    }]
+}"""
+
+NAMES = ["ds", "heap", "meta", "sysload", "classcnt", "threading", "bean"]
+
+
+def poller_config(**kw):
+    cfg = {
+        "clientJarFullPath": "/opt/jboss-cli-client.jar",
+        "jvmHosts": ["jvm1.example.com", "jvm2.example.com"],
+        "shortenHostname": True,
+        "adminUser": "admin",
+        "adminPass": "pw",
+        "jmxPort": 8390,
+        "clientTimeoutMs": 2000,
+        "pollingIntervalSeconds": 60,
+        "statCmdMap": {n: f"/cmd/{n}" for n in NAMES},
+    }
+    cfg.update(kw)
+    return cfg
+
+
+def test_cli_to_json_labels_blobs_in_order():
+    stats = cli_to_json(NAMES, CLI_OUTPUT)
+    assert stats["ds"]["result"]["InUseCount"] == 2
+    assert stats["heap"]["result"]["max"] == 4000
+    assert stats["sysload"]["result"] == 1.5
+    assert stats["threading"]["result"]["thread-count"] == 77
+    assert stats["bean"]["result"][0]["result"]["pool-max-size"] == 10
+
+
+def test_cli_to_json_discards_warning_lines():
+    out = "WARNING: something\n" + json.dumps({"result": 1}, indent=1)
+    assert cli_to_json(["x"], out) == {"x": {"result": 1}}
+
+
+def test_pull_all_emits_entries_and_shortens_hostnames():
+    lines = []
+    commands = []
+
+    def runner(cmd, timeout_s):
+        commands.append(cmd)
+        return CLI_OUTPUT
+
+    p = JmxPoller(poller_config(), lines.append, runner=runner, clock=lambda: 1700000000.0)
+    entries = p.pull_all()
+    assert len(entries) == 2
+    assert entries[0].server == "jvm1"  # shortened
+    assert entries[0].thread_cnt == 77
+    assert entries[0].sys_load == 1.5
+    # wire roundtrip through the shared factory
+    rt = EntryFactory().from_csv(lines[0])
+    assert rt.type == "jx" and rt.bean_pool_max_size == 10
+    # command construction parity
+    assert "--controller=jvm1.example.com:8390" in commands[0]
+    assert '--connect commands="/cmd/ds,/cmd/heap' in commands[0]
+    assert "--user=admin --password=pw" in commands[0]
+
+
+def test_pull_all_skips_down_hosts():
+    def runner(cmd, timeout_s):
+        if "jvm1" in cmd:
+            raise RuntimeError("connection refused")
+        return CLI_OUTPUT
+
+    p = JmxPoller(poller_config(), lambda l: None, runner=runner, clock=lambda: 1700000000.0)
+    entries = p.pull_all()
+    assert [e.server for e in entries] == ["jvm2"]
+
+
+def test_no_hostname_shortening_when_disabled():
+    p = JmxPoller(
+        poller_config(shortenHostname=False, jvmHosts=["jvm1.example.com"]),
+        lambda l: None,
+        runner=lambda c, t: CLI_OUTPUT,
+        clock=lambda: 1700000000.0,
+    )
+    assert p.pull_all()[0].server == "jvm1.example.com"
+
+
+def test_second_aligned_schedule():
+    at_13s = 1699999980.0 + 13  # :13 of the minute
+    p = JmxPoller(poller_config(pollingIntervalSeconds=60), lambda l: None, clock=lambda: at_13s)
+    assert p.seconds_until_next_poll() == 47
+    p2 = JmxPoller(poller_config(pollingIntervalSeconds=15), lambda l: None, clock=lambda: at_13s)
+    assert p2.seconds_until_next_poll() == 2  # 13 % 15 = 13 -> 2s to :15

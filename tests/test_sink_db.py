@@ -122,6 +122,37 @@ def test_resume_roundtrip(tmp_path):
     assert isinstance(endts, str) and endts.endswith("Z")
 
 
+def test_deadline_rearms_after_limit_flush():
+    # The row appended right after a limit-triggered flush must still get a
+    # timeout flush (trickling traffic after a burst).
+    w, ex, clock = make_writer(limit=2, max_ms=5000)
+    for i in range(3):
+        w.add_entry(tx(i))  # third add flushes [0,1], buffers [2]
+    assert ex.batches == [("tx", 2)]
+    clock.t += 5.1
+    assert w.process_due() == ["tx"]
+    assert ex.batches == [("tx", 2), ("tx", 1)]
+
+
+def test_alert_row_resume_roundtrip(tmp_path):
+    # 'al' rows nest an entry dict with datetimes: resume must serialize them
+    fs = FullStatEntry(
+        1700000000000, "s", "svc", 2.5, 360,
+        100.0, 90.0, 80.0, 110.0, 0,
+        120.0, 100.0, 90.0, 130.0, 0,
+        200.0, 150.0, 100.0, 220.0, 1,
+    )
+    al = AlertEntry(1700000001000, 1700000000000, "s", "svc", "cause", fs.to_csv())
+    path = str(tmp_path / "al.resume")
+    w, _, _ = make_writer(limit=100)
+    w.add_entry(al)
+    w.save_resume(path)
+    w2, ex2, _ = make_writer(limit=100)
+    assert w2.load_resume(path)
+    w2.process_all()
+    assert ("alerts", 1) in ex2.batches
+
+
 def test_load_resume_missing(tmp_path):
     w, _, _ = make_writer()
     assert not w.load_resume(str(tmp_path / "nope.resume"))
