@@ -7,6 +7,9 @@ Commands map to the reference's process/tool set:
 - ``insertdb``    DB sink
 - ``jmx``         JMX poller
 - ``standalone``  whole pipeline in one process (memory broker)
+- ``manager``     supervisor process (apm_manager.js)
+- ``controller``  start|stop|restart the manager (controller.sh)
+- ``pidstats``    'MEM_MiB SWAP_MiB' for a PID (pid_stats.py)
 - ``dequeue``     destructive queue peek (dequeue.js)
 - ``qstat``       queue depth/memory (qstat.sh)
 """
@@ -38,6 +41,18 @@ def main() -> int:
         m()
     elif cmd == "standalone":
         from .standalone import main as m
+
+        return m(argv)
+    elif cmd == "manager":
+        from .manager.manager import main as m
+
+        m()
+    elif cmd == "controller":
+        from .manager.controller import main as m
+
+        return m(argv)
+    elif cmd == "pidstats":
+        from .manager.pid_stats import main as m
 
         return m(argv)
     elif cmd == "dequeue":

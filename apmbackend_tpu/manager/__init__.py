@@ -1,0 +1,3 @@
+"""Supervisor / control plane (apm_manager.js + controller.sh + pid_stats.py roles)."""
+
+from .pid_stats import pid_exists, pids_matching_cmdline, pss_swap_mb  # noqa: F401

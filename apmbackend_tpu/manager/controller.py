@@ -1,0 +1,115 @@
+"""start/stop/restart of the manager process (controller.sh role).
+
+``start`` spawns the manager detached with output to ``<logDir>/manager.start.log``
+and records its PID in a pidfile; ``stop`` is SIGTERM with a SIGKILL
+escalation after a grace period (controller.sh:38-67); ``restart`` is both.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from ..config import default_config, load_config
+from .pid_stats import pid_exists, pids_matching_cmdline
+
+_MANAGER_PATTERN = r"-m\s+apmbackend_tpu\.manager\.manager(\s|$)"
+
+
+def _pidfile(config: dict) -> str:
+    return os.path.join(config.get("appDirectory", "."), "state", "apm_manager.pid")
+
+
+def read_pid(config: dict) -> Optional[int]:
+    try:
+        with open(_pidfile(config)) as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def start(config: dict, config_path: Optional[str]) -> int:
+    pid = read_pid(config)
+    if pid is not None and pid_exists(pid):
+        print(f"Manager already running (PID {pid})", file=sys.stderr)
+        return 1
+    # Pidfile-less manager (started by hand, or stale state dir): a second
+    # supervisor would fight the first over the same children.
+    rogue = pids_matching_cmdline(_MANAGER_PATTERN)
+    if rogue:
+        print(f"Manager already running without a pidfile (PID {rogue[0]}); "
+              f"stop it first or remove it manually", file=sys.stderr)
+        return 1
+    log_dir = config.get("logDir", "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    out = open(os.path.join(log_dir, "manager.start.log"), "a")
+    env = dict(os.environ)
+    if config_path:
+        env["APM_CONFIG"] = os.path.abspath(config_path)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "apmbackend_tpu.manager.manager"],
+        stdin=subprocess.DEVNULL, stdout=out, stderr=out,
+        start_new_session=True, env=env,
+    )
+    out.close()
+    pidfile = _pidfile(config)
+    os.makedirs(os.path.dirname(pidfile), exist_ok=True)
+    with open(pidfile, "w") as fh:
+        fh.write(str(proc.pid))
+    print(f"Manager started (PID {proc.pid})")
+    return 0
+
+
+def stop(config: dict, *, grace_s: float = 10.0) -> int:
+    pid = read_pid(config)
+    if pid is None or not pid_exists(pid):
+        print("Manager not running", file=sys.stderr)
+        return 1
+    os.kill(pid, signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if not pid_exists(pid):
+            break
+        time.sleep(0.2)
+    if pid_exists(pid):
+        # kill -9 escalation (controller.sh:49-60)
+        print(f"Manager did not stop after SIGTERM; escalating to SIGKILL (PID {pid})", file=sys.stderr)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    try:
+        os.unlink(_pidfile(config))
+    except OSError:
+        pass
+    print("Manager stopped")
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="start|stop|restart the APM manager")
+    ap.add_argument("action", choices=["start", "stop", "restart", "status"])
+    ap.add_argument("--config", default=os.environ.get("APM_CONFIG"))
+    args = ap.parse_args(argv)
+    config = load_config(args.config) if args.config else default_config()
+    if args.action == "start":
+        return start(config, args.config)
+    if args.action == "stop":
+        return stop(config)
+    if args.action == "restart":
+        stop(config)
+        return start(config, args.config)
+    pid = read_pid(config)
+    alive = pid is not None and pid_exists(pid)
+    print(f"Manager {'running (PID ' + str(pid) + ')' if alive else 'not running'}")
+    return 0 if alive else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

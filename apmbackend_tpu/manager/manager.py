@@ -1,0 +1,519 @@
+"""The supervisor process (apm_manager.js role).
+
+Forks every configured module as a detached child (stdout/stderr to
+``<name>.start.log``), restarts on exit with crash-loop damping, polls each
+child's PSS/swap and requests GC (SIGUSR1) over threshold, watches disk space,
+queue depth/memory and the broker's liveness, prunes old logs, batches its own
+operational alerts into emails with interval doubling, and posts Grafana
+``maintenance`` annotations around restarts.
+
+Differences from the reference, by design:
+
+- children are ``python -m <module>`` (moduleSettings[].module), matched for
+  stale-PID cleanup by cmdline regex instead of ps output parsing;
+- ``requestGC`` rides SIGUSR1 (ModuleRuntime installs the handler) instead of
+  a Node IPC channel (apm_manager.js:505-509 -> util_methods.js:463-467);
+- broker supervision is backend-aware: for AMQP it shells to rabbitmqctl like
+  the reference (gated on the binary existing); the in-process memory broker
+  needs no supervision.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..integrations import EmailSender, GrafanaClient
+
+
+class ManagerAlerts:
+    """Operational alert batching with interval doubling
+    (apm_manager.js:42-132). Buffers plain strings, emails them joined."""
+
+    MAX_BUFFERED = 1000  # drop-oldest cap: alerts accrue forever when emails
+    # are disabled (every inspection cycle can add), so an unbounded list
+    # would leak in a long-lived supervisor
+
+    def __init__(self, manager_config: dict, *, email_sender=None, logger=None):
+        self.config = manager_config
+        self.email_sender = email_sender
+        self.logger = logger
+        self.buffer: List[str] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._stopped = False
+
+    def set_config(self, manager_config: dict) -> None:
+        self.config = manager_config
+
+    def add(self, message: str) -> None:
+        if self.logger:
+            self.logger.warning(f"Manager alert: {message}")
+        with self._lock:
+            self.buffer.append(message)
+            if len(self.buffer) > self.MAX_BUFFERED:
+                del self.buffer[0]
+                self.dropped += 1
+
+    def send_email(self, subject: str, body: str) -> None:
+        """Immediate send (sendManagerEmail role), gated on emailsEnabled."""
+        if self.email_sender is not None and self.config.get("emailsEnabled"):
+            self.email_sender(subject, body.replace("\n", "<br>"), None)
+
+    def flush(self, interval_s: Optional[float] = None) -> tuple:
+        base = float(self.config.get("alertCollectionIntervalInSeconds", 60))
+        if interval_s is None:
+            interval_s = base
+        can_send = self.email_sender is not None and bool(self.config.get("emailsEnabled"))
+        with self._lock:
+            if not self.buffer or not can_send:
+                return 0, base
+            # take the batch atomically so an add() racing the (slow) send
+            # is never wiped by the post-send clear
+            batch, self.buffer = self.buffer, []
+            dropped, self.dropped = self.dropped, 0
+        count = len(batch)
+        if self.config.get("increaseCollectionIntervalAfterAlert") and interval_s < float(
+            self.config.get("maxCollectionIntervalInSeconds", 3840)
+        ):
+            interval_s *= 2
+        if dropped:
+            batch.insert(0, f"({dropped} older alerts dropped at the {self.MAX_BUFFERED}-entry cap)")
+        html = "<br>\n".join(batch)
+        self.email_sender("APM manager alerts", html, None)
+        return count, interval_s
+
+    def start(self) -> None:
+        """Recursion with per-flush interval (startAlertSender role)."""
+
+        def _fire(interval_s: float):
+            if self._stopped:
+                return
+            try:
+                _count, next_interval = self.flush(interval_s)
+            except Exception as e:
+                if self.logger:
+                    self.logger.error(f"Manager alert flush error: {e}")
+                next_interval = interval_s
+            self._timer = threading.Timer(next_interval, _fire, args=(next_interval,))
+            self._timer.daemon = True
+            self._timer.start()
+
+        base = float(self.config.get("alertCollectionIntervalInSeconds", 60))
+        self._timer = threading.Timer(base, _fire, args=(base,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()
+
+
+class ModuleProc:
+    """One supervised child module (Module class role, apm_manager.js:246-357)."""
+
+    def __init__(
+        self,
+        module_setting: dict,
+        *,
+        log_dir: str,
+        config_path: Optional[str],
+        logger=None,
+        on_exit_alert: Optional[Callable[[str, str], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        python: str = sys.executable,
+        extra_env: Optional[dict] = None,
+    ):
+        self.module = module_setting["module"]  # e.g. "apmbackend_tpu.runtime.worker"
+        self.setting = module_setting
+        self.log_dir = log_dir
+        self.config_path = config_path
+        self.logger = logger
+        self.on_exit_alert = on_exit_alert
+        self.clock = clock
+        self.python = python
+        self.extra_env = extra_env or {}
+        self.proc: Optional[subprocess.Popen] = None
+        self.last_start_time: float = 0.0
+        self.restart_pending_until: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return self.module.rsplit(".", 1)[-1]
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def cmdline_pattern(self) -> str:
+        return rf"-m\s+{self.module.replace('.', r'\.')}(\s|$)"
+
+    def kill_existing_pids(self) -> int:
+        """Stale-PID cleanup before forking (killExistingPIDs role)."""
+        from .pid_stats import pid_exists, pids_matching_cmdline
+
+        killed = 0
+        for pid in pids_matching_cmdline(self.cmdline_pattern()):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+                if self.logger:
+                    self.logger.warning(f"Process PID: {pid} has been killed intentionally ({self.module})")
+            except OSError as e:
+                if self.logger:
+                    self.logger.error(f"Could not kill pid: {pid} Error: {e}")
+        if killed:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and any(
+                pid_exists(p) for p in pids_matching_cmdline(self.cmdline_pattern())
+            ):
+                time.sleep(0.1)
+            for pid in pids_matching_cmdline(self.cmdline_pattern()):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        return killed
+
+    def start_process(self) -> None:
+        os.makedirs(self.log_dir, exist_ok=True)
+        out_path = os.path.join(self.log_dir, f"{self.name}.start.log")
+        # append: a restart must not truncate the crash output that caused it
+        out_fd = open(out_path, "a")
+        self.last_start_time = self.clock()
+        env = dict(os.environ, **self.extra_env)
+        if self.config_path:
+            env["APM_CONFIG"] = self.config_path
+        self.proc = subprocess.Popen(
+            [self.python, "-m", self.module],
+            stdin=subprocess.DEVNULL,
+            stdout=out_fd,
+            stderr=out_fd,
+            start_new_session=True,  # detached (fork {detached: true} role)
+            env=env,
+        )
+        out_fd.close()
+        if self.logger:
+            self.logger.info(f"Child process started via PID: {self.proc.pid} ({self.module})")
+
+    def poll_exit(self) -> Optional[int]:
+        """Non-blocking: return the exit code if the child exited, else None."""
+        if self.proc is None:
+            return None
+        return self.proc.poll()
+
+    def handle_exit(self, code: int) -> None:
+        """Crash-loop damping: exited <5 s after start => wait 60 s before the
+        restart, else 1 s (childExitCB, apm_manager.js:303-327). Non-blocking:
+        the restart fires once the damping window elapses (see tick())."""
+        if self.on_exit_alert:
+            self.on_exit_alert(
+                "APM manager error",
+                f"Child module exited: code:{code} module: {self.module}",
+            )
+        now = self.clock()
+        delay = 60.0 if (now - self.last_start_time) < 5.0 else 1.0
+        if self.logger and delay > 1.0:
+            self.logger.warning(
+                "Time since last restart is under 5 seconds, something is likely "
+                "wrong with the module (not a one-off kill); damping restart 60s"
+            )
+        self.proc = None
+        self.restart_pending_until = now + delay
+
+    def tick(self) -> Optional[str]:
+        """Periodic state machine step; returns an event string when something
+        happened ('exited', 'restarted')."""
+        if self.proc is not None:
+            code = self.poll_exit()
+            if code is not None:
+                self.handle_exit(code)
+                return "exited"
+            return None
+        if self.restart_pending_until and self.clock() >= self.restart_pending_until:
+            self.restart_pending_until = 0.0
+            self.start_process()
+            return "restarted"
+        return None
+
+    def request_gc(self) -> None:
+        if self.pid is not None and hasattr(signal, "SIGUSR1"):
+            try:
+                os.kill(self.pid, signal.SIGUSR1)
+            except OSError:
+                pass
+
+    def stop(self, *, kill_timeout_s: float = 10.0) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=kill_timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+        self.proc = None
+
+
+class ManagerApp:
+    """The supervisor main loop, wired onto a ModuleRuntime for config/logging."""
+
+    def __init__(self, runtime, *, spawn_children: bool = True):
+        self.runtime = runtime
+        config = runtime.config
+        self.mconfig = runtime.module_config
+        logger = runtime.logger
+
+        email_sender = None
+        if self.mconfig.get("emailsEnabled"):
+            email_sender = EmailSender(
+                self.mconfig.get("fromEmail", "apm@localhost"),
+                self.mconfig.get("emailList", ""),
+                logger=logger,
+            )
+        grafana_cfg = config.get("grafana", {})
+        self.grafana = GrafanaClient(grafana_cfg, logger=logger) if grafana_cfg.get("grafanaURL") else None
+        self.alerts = ManagerAlerts(self.mconfig, email_sender=email_sender, logger=logger)
+
+        self.modules: List[ModuleProc] = [
+            ModuleProc(
+                ms,
+                log_dir=config.get("logDir", "logs"),
+                config_path=runtime.config_path,
+                logger=logger,
+                on_exit_alert=self._on_child_exit_alert,
+            )
+            for ms in self.mconfig.get("moduleSettings", [])
+        ]
+
+        if spawn_children:
+            self.annotate("Restarting all modules")
+            for mod in self.modules:
+                mod.kill_existing_pids()
+            for mod in self.modules:
+                mod.start_process()
+
+        self.alerts.start()
+        freq = int(self.mconfig.get("inspectionFrequencySeconds", 60))
+        runtime.every(freq, self.inspect_all, name="monitor", align=True)
+        runtime.every(12 * 3600, self.cleanup_logs, name="log-gc")
+        runtime.every(1.0, self.tick_modules, name="module-ticker")
+        runtime.on_reload(self._apply_config)
+        runtime.on_exit(self.shutdown)
+
+    # -- callbacks -----------------------------------------------------------
+    def _on_child_exit_alert(self, subject: str, body: str) -> None:
+        self.annotate(body)
+        self.alerts.send_email(subject, body)
+        self.alerts.add(body)
+
+    def annotate(self, text: str) -> None:
+        if self.grafana is not None:
+            self.grafana.post_annotation(text, ["maintenance"])
+
+    def _apply_config(self, new_config: dict) -> None:
+        self.mconfig = new_config.get("applicationManager", {})
+        self.alerts.set_config(self.mconfig)
+        # emailsEnabled may be switched on at runtime: build the sender the
+        # startup path skipped (and refresh addresses on change)
+        if self.mconfig.get("emailsEnabled"):
+            self.alerts.email_sender = EmailSender(
+                self.mconfig.get("fromEmail", "apm@localhost"),
+                self.mconfig.get("emailList", ""),
+                logger=self.runtime.logger,
+            )
+
+    # -- module supervision ---------------------------------------------------
+    def tick_modules(self) -> None:
+        for mod in self.modules:
+            event = mod.tick()
+            if event == "restarted":
+                self.alerts.send_email(
+                    "APM manager alert", f"Process restarted via startProcess: {mod.module}"
+                )
+
+    def module_setting(self, mod: ModuleProc, name: str):
+        """Per-module override falling back to the manager default
+        (getModuleSetting, apm_manager.js:455-464)."""
+        if name in mod.setting:
+            return mod.setting[name]
+        return self.mconfig.get(name)
+
+    def inspect_modules(self) -> None:
+        from .pid_stats import pid_exists, pss_swap_mb
+
+        for mod in self.modules:
+            if mod.pid is None:
+                continue  # restart already pending via tick()
+            if not pid_exists(mod.pid):
+                mod.tick()  # reap + schedule restart
+                continue
+            mem, swap = pss_swap_mb(mod.pid)
+            if mem is None:
+                continue
+            trigger_gc = False
+            mem_thr_raw = self.module_setting(mod, "moduleMemoryAlertThreshold")
+            mem_thr = 350.0 if mem_thr_raw is None else float(mem_thr_raw)
+            if mem > mem_thr:
+                self.alerts.add(
+                    f"Child module exceeded the memory threshold - Module: {mod.module} "
+                    f"Threshold(Mb): {mem_thr} MemoryUsed(Mb): {mem:.1f}"
+                )
+                trigger_gc = True
+            swap_thr_raw = self.module_setting(mod, "moduleSwapAlertThreshold")
+            swap_thr = 200.0 if swap_thr_raw is None else float(swap_thr_raw)
+            if swap > swap_thr:
+                self.alerts.add(
+                    f"Child module exceeded the swap threshold - Module: {mod.module} "
+                    f"Threshold(Mb): {swap_thr} SwapUsed(Mb): {swap:.1f}"
+                )
+                trigger_gc = True
+            if trigger_gc:
+                self.runtime.logger.info(f"Sending garbage collection request to module: {mod.module}")
+                mod.request_gc()
+
+    # -- host monitors --------------------------------------------------------
+    def inspect_disk_space(self) -> None:
+        mount = self.mconfig.get("diskInspectionMount") or self.runtime.config.get("appDirectory", ".")
+        try:
+            usage = shutil.disk_usage(os.path.abspath(mount))
+        except OSError as e:
+            self.alerts.add(f"Could not inspect mount disk usage: {e}")
+            return
+        gb = 1024.0 ** 3
+        avail_gb = usage.free / gb
+        percent = 100.0 * usage.used / usage.total if usage.total else 0.0
+        if avail_gb <= float(self.mconfig.get("diskSpaceGBAvailableThreshold", 100)):
+            self.alerts.add(
+                f"Available disk space is low on mount: {mount} - "
+                f"Available: {avail_gb:.1f} GB, PercentUsed: {percent:.0f}%"
+            )
+        if percent > float(self.mconfig.get("diskSpacePercentageUsedThreshold", 80)):
+            self.alerts.add(
+                f"Disk space percentage used is high on mount: {mount} - "
+                f"Available: {avail_gb:.1f} GB, PercentUsed: {percent:.0f}%"
+            )
+
+    def inspect_queues(self) -> None:
+        """Depth/memory thresholds over every queue (apm_manager.js:429-453)."""
+        rows = self._queue_rows()
+        if rows is None:
+            return
+        msg_thr = int(self.mconfig.get("queueMessageAlertThreshold", 1000000))
+        mem_thr = float(self.mconfig.get("queueMemoryAlertThreshold", 150))
+        for name, count, mem_mb in rows:
+            if count > msg_thr:
+                self.alerts.add(
+                    f"Queue exceeded the message count threshold - Queue: {name} "
+                    f"Threshold: {msg_thr} MessageCount: {count}"
+                )
+            if mem_mb == mem_mb and mem_mb > mem_thr:
+                self.alerts.add(
+                    f"Queue exceeded the memory threshold - Queue: {name} "
+                    f"Threshold: {mem_thr} MemoryUsed(Mb): {mem_mb:.1f}"
+                )
+
+    def _queue_rows(self):  # pragma: no cover - requires rabbitmqctl
+        if self.runtime.config.get("brokerBackend") != "amqp":
+            return None
+        ctl = os.path.join(self.mconfig.get("rabbitSbinPath", ""), "rabbitmqctl")
+        if not (shutil.which(ctl) or os.path.exists(ctl)):
+            return None
+        try:
+            out = subprocess.run(
+                [ctl, "list_queues", "--quiet", "--no-table-headers", "name",
+                 "messages_ram", "messages_persistent", "memory"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=30, check=True,
+            ).stdout.decode()
+        except Exception as e:
+            self.alerts.add(f"Could not inspect queues via rabbit controller: {e}")
+            return None
+        rows = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 4:
+                rows.append((parts[0], int(parts[1]) + int(parts[2]), int(parts[3]) / 1024.0 / 1024.0))
+        return rows
+
+    def broker_is_running(self) -> Optional[bool]:  # pragma: no cover - live broker
+        if self.runtime.config.get("brokerBackend") != "amqp":
+            return None
+        ctl = os.path.join(self.mconfig.get("rabbitSbinPath", ""), "rabbitmqctl")
+        if not (shutil.which(ctl) or os.path.exists(ctl)):
+            return None
+        try:
+            subprocess.run([ctl, "status"], stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL, timeout=30, check=True)
+            return True
+        except Exception:
+            return False
+
+    def start_broker(self) -> None:  # pragma: no cover - live broker
+        server = os.path.join(self.mconfig.get("rabbitSbinPath", ""), "rabbitmq-server")
+        try:
+            subprocess.run([server, "-detached"], timeout=60, check=True)
+        except Exception as e:
+            self.alerts.add(f"Could not start RabbitMQ: {e}")
+
+    def inspect_all(self) -> None:
+        running = self.broker_is_running()
+        if running is False:
+            self.alerts.add("RabbitMQ is down, attempting to restart it.")
+            self.start_broker()
+        self.inspect_disk_space()
+        self.inspect_queues()
+        self.inspect_modules()
+
+    # -- log retention (apm_manager.js:532-571) -------------------------------
+    def cleanup_logs(self) -> int:
+        log_dir = self.runtime.config.get("logDir", "logs")
+        days = float(self.mconfig.get("appLogRetentionDays", 7))
+        cutoff = time.time() - days * 86400
+        removed = 0
+        try:
+            names = os.listdir(log_dir)
+        except OSError:
+            return 0
+        for name in names:
+            path = os.path.join(log_dir, name)
+            try:
+                if os.path.isfile(path) and os.path.getmtime(path) < cutoff:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                continue
+        if removed:
+            self.runtime.logger.info(f"Removed {removed} logs older than {days} days")
+        return removed
+
+    # -- lifecycle ------------------------------------------------------------
+    def shutdown(self, *, stop_children: Optional[bool] = None) -> None:
+        self.alerts.stop()
+        if stop_children is None:
+            # Reference parity: controller.sh stop kills only the manager and
+            # the next start reaps stale module PIDs (apm_manager.js:624).
+            # Opt into full teardown with stopChildrenOnShutdown.
+            stop_children = bool(self.mconfig.get("stopChildrenOnShutdown", False))
+        if stop_children:
+            for mod in self.modules:
+                mod.stop()
+
+
+def main(config_path: Optional[str] = None) -> None:
+    from ..runtime.module_base import ModuleRuntime
+
+    runtime = ModuleRuntime("applicationManager", config_path=config_path)
+    ManagerApp(runtime)
+    runtime.logger.info("APM manager started")
+    runtime.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Per-PID memory accounting from /proc (pid_stats.py / ps_mem role).
+
+The reference vendors ps_mem.py and shells out per PID for "RSS MiB SWAP MiB"
+(config/apm_config.json:52, apm_manager.js:359-370). Here the same numbers are
+read in-process from ``/proc/<pid>/smaps_rollup`` (kernel >= 4.14; one file,
+no per-mapping walk) with a ``statm`` fallback; PSS is used when available so
+shared pages are attributed fairly, like ps_mem does. A CLI mode prints the
+same two-number format for interop:
+
+    python -m apmbackend_tpu.manager.pid_stats -p <PID>
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import List, Optional, Tuple
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def pss_swap_mb(pid: int) -> Tuple[Optional[float], Optional[float]]:
+    """(memory MiB, swap MiB) for a PID, or (None, None) when unreadable."""
+    try:
+        with open(f"/proc/{pid}/smaps_rollup") as fh:
+            text = fh.read()
+        mem_kb = swap_kb = 0.0
+        for line in text.splitlines():
+            if line.startswith("Pss:"):
+                mem_kb = float(line.split()[1])
+            elif line.startswith("SwapPss:"):
+                swap_kb = float(line.split()[1])
+            elif line.startswith("Swap:") and swap_kb == 0.0:
+                swap_kb = float(line.split()[1])
+        return mem_kb / 1024.0, swap_kb / 1024.0
+    except OSError:
+        pass
+    try:  # fallback: RSS from statm (no PSS, no swap)
+        with open(f"/proc/{pid}/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * _PAGE / (1024.0 * 1024.0), 0.0
+    except (OSError, ValueError, IndexError):
+        return None, None
+
+
+def pid_exists(pid: int) -> bool:
+    """Liveness probe (process.kill(pid, 0) analog, apm_manager.js:466-473)."""
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def pids_matching_cmdline(pattern: str, *, exclude_self: bool = True) -> List[int]:
+    """PIDs whose /proc cmdline matches ``pattern`` (regex) — the stale-PID
+    lookup (lookupPidsByRelativeScriptPath, apm_manager.js:188-196) without
+    shelling out to ps."""
+    rx = re.compile(pattern)
+    out: List[int] = []
+    me = os.getpid()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if exclude_self and pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmdline = fh.read().replace(b"\x00", b" ").decode("utf-8", "replace")
+        except OSError:
+            continue
+        if rx.search(cmdline):
+            out.append(pid)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Print 'MEM_MiB SWAP_MiB' for a PID")
+    ap.add_argument("-p", "--pid", type=int, required=True)
+    ap.add_argument("-S", "--swap", action="store_true", help="accepted for interop")
+    ap.add_argument("-q", "--quiet", action="store_true", help="accepted for interop")
+    ap.add_argument("-m", "--mib", action="store_true", help="accepted for interop")
+    args = ap.parse_args(argv)
+    mem, swap = pss_swap_mb(args.pid)
+    if mem is None:
+        return 1
+    print(f"{mem:.2f} MiB {swap:.2f} MiB")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

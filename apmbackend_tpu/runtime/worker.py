@@ -149,6 +149,14 @@ class WorkerApp:
         with self._driver_lock:
             self.driver.apply_config(new_config)
         alerts_cfg = new_config.get("streamProcessAlerts", {})
+        # emailsEnabled switched on at runtime needs the sender the startup
+        # path skipped (and address changes should take effect)
+        if alerts_cfg.get("emailsEnabled"):
+            self.alerts_manager.email_sender = EmailSender(
+                alerts_cfg.get("fromEmail", "apm@localhost"),
+                alerts_cfg.get("emailList", ""),
+                logger=self.runtime.logger,
+            )
         consume = bool(new_config.get("streamCalcStats", {}).get("consumeQueue", True))
         if consume != self._consume_enabled:
             self._consume_enabled = consume
