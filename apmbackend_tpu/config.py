@@ -387,5 +387,11 @@ _DEFAULT_CONFIG: dict = {
         # 'z_score' queues for per-stage inspection and interop (SURVEY.md §4)
         "emitStatsQueue": False,
         "emitZScoreQueue": False,
+        # Multi-window EWMA/seasonal baselining channels beside the lag
+        # windows (no reference equivalent; SURVEY.md §7.2 step 10). Keys are
+        # uppercase like streamCalcZScore.defaults. SEASON_SLOTS=24 +
+        # SLOT_INTERVALS=360 keeps one baseline per UTC hour-of-day at the
+        # stock 10 s cadence; CHANNEL_ID is the (negative) wire 'lag'.
+        "ewmaChannels": [],
     },
 }

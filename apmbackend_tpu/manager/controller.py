@@ -15,9 +15,12 @@ import time
 from typing import Optional
 
 from ..config import default_config, load_config
+from .manager import cmdline_pattern_for
 from .pid_stats import pid_exists, pids_matching_cmdline
 
-_MANAGER_PATTERN = r"-m\s+apmbackend_tpu\.manager\.manager(\s|$)"
+# matches both `-m apmbackend_tpu.manager.manager` and the CLI dispatcher
+# form `-m apmbackend_tpu manager`
+_MANAGER_PATTERN = cmdline_pattern_for("apmbackend_tpu.manager.manager")
 
 
 def _pidfile(config: dict) -> str:

@@ -21,6 +21,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import signal
 import subprocess
@@ -30,6 +31,26 @@ import time
 from typing import Callable, List, Optional
 
 from ..integrations import EmailSender, GrafanaClient
+
+# The CLI dispatcher (`python -m apmbackend_tpu <cmd>`) runs the same modules
+# with a different /proc cmdline than `python -m <dotted.module>`; stale-PID
+# matching must catch both or two supervisors can fight over children.
+_DISPATCH_ALIASES = {
+    "apmbackend_tpu.runtime.worker": "worker",
+    "apmbackend_tpu.ingest.parser_main": "parser",
+    "apmbackend_tpu.sinks.insert_db_main": "insertdb",
+    "apmbackend_tpu.ingest.jmx_main": "jmx",
+    "apmbackend_tpu.manager.manager": "manager",
+}
+
+
+def cmdline_pattern_for(module: str) -> str:
+    """Regex matching both launch forms of a module process."""
+    pats = [rf"-m\s+{re.escape(module)}(\s|$)"]
+    alias = _DISPATCH_ALIASES.get(module)
+    if alias:
+        pats.append(rf"-m\s+apmbackend_tpu\s+{alias}(\s|$)")
+    return "|".join(f"(?:{p})" for p in pats)
 
 
 class ManagerAlerts:
@@ -80,10 +101,12 @@ class ManagerAlerts:
             batch, self.buffer = self.buffer, []
             dropped, self.dropped = self.dropped, 0
         count = len(batch)
-        if self.config.get("increaseCollectionIntervalAfterAlert") and interval_s < float(
-            self.config.get("maxCollectionIntervalInSeconds", 3840)
-        ):
-            interval_s *= 2
+        if self.config.get("increaseCollectionIntervalAfterAlert"):
+            # clamp: doubling from a non-power-of-two base must not overshoot
+            # the configured cap
+            interval_s = min(
+                interval_s * 2, float(self.config.get("maxCollectionIntervalInSeconds", 3840))
+            )
         if dropped:
             batch.insert(0, f"({dropped} older alerts dropped at the {self.MAX_BUFFERED}-entry cap)")
         html = "<br>\n".join(batch)
@@ -154,7 +177,7 @@ class ModuleProc:
         return self.proc.pid if self.proc is not None else None
 
     def cmdline_pattern(self) -> str:
-        return rf"-m\s+{self.module.replace('.', r'\.')}(\s|$)"
+        return cmdline_pattern_for(self.module)
 
     def kill_existing_pids(self) -> int:
         """Stale-PID cleanup before forking (killExistingPIDs role)."""

@@ -187,10 +187,12 @@ class AlertsManager:
             self.current_interval_s = base
             return 0, base
         count = len(self.alert_buffer)
-        if self.config.get("increaseCollectionIntervalAfterAlert") and interval_s < float(
-            self.config.get("maxCollectionIntervalInSeconds", 960)
-        ):
-            interval_s *= 2
+        if self.config.get("increaseCollectionIntervalAfterAlert"):
+            # clamp: doubling from a non-power-of-two base must not overshoot
+            # the configured cap
+            interval_s = min(
+                interval_s * 2, float(self.config.get("maxCollectionIntervalInSeconds", 960))
+            )
         html = self.format_alerts_html()
         image_path = None
         if self.grafana is not None:

@@ -1,0 +1,195 @@
+"""EWMA + seasonal baselining channels (multi-window extension, SURVEY.md §7.2
+step 10; BASELINE.json configs[4]).
+
+The reference's only baselining algorithm is the fixed-lag smoothed z-score
+(stream_calc_z_score.js:66-104). These channels add the classic EWMA control
+chart and seasonal (time-of-day / day-of-week) baselines as *additional lag
+channels* beside the lag windows, sharing the engine's tick cadence, alert
+rule ladder, and emission shapes — but with O(1) state per key instead of an
+O(lag) ring:
+
+- state is ``mean/var [S, 3, K]`` + ``count [S, K]`` where ``K`` is the number
+  of season slots. ``K = 1`` is a plain EWMA channel; ``K = 24`` with
+  ``slot_intervals = 360`` (10 s cadence) keeps one baseline per hour-of-day;
+  ``K = 168`` per hour-of-week. Memory: a 24 h seasonal channel costs
+  ``24 × 3`` floats/row vs the 8640-lag window's ``3 × 8640`` — ~360× less.
+- update is the exponentially weighted moving mean/variance recursion
+  (incremental form of West 1979): ``delta = x - mean``,
+  ``mean += alpha·delta``, ``var = (1 - alpha)·(var + alpha·delta²)``; the
+  first observation of a slot seeds ``mean = x, var = 0``.
+- signal semantics mirror the z-score channel's quirks so the downstream alert
+  ladder treats the channels identically: warm-up gating on per-slot update
+  count (the lag-length analog), zero variance -> std undefined -> no bounds
+  and no signal, NaN input -> no signal and no state update.
+
+Influence damping carries over from the reference (stream_calc_z_score.js:96-97):
+a signalling value enters the recursion damped as ``infl·x + (1-infl)·mean``,
+preventing an anomaly from inflating the EWMA variance and masking its own
+successors.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+N_METRICS = 3  # average, per75, per95 (in that order on axis 1)
+
+
+class EwmaSpec(NamedTuple):
+    """Static per-channel settings (hashable: part of the jitted EngineConfig).
+
+    ``channel_id`` is the wire identifier emitted in the FullStatEntry ``lag``
+    field for this channel (negative by convention, so dashboards can
+    distinguish EWMA/seasonal rows from true lag windows).
+    """
+
+    alpha: float  # smoothing factor in (0, 1]
+    threshold: float  # signal at |x - mean| > threshold * std
+    warmup: int  # min per-slot updates before signalling
+    season_slots: int = 1  # K; 1 = plain EWMA
+    slot_intervals: int = 1  # bucket labels per season slot
+    channel_id: int = -1
+    suppressed: bool = False  # like suppressedLags for this channel
+    # influence damping, same semantic as stream_calc_z_score.js:96-97: a
+    # signalling value enters the recursion as infl·x + (1-infl)·mean, so an
+    # anomaly can't immediately inflate the EWMA variance and mask itself
+    # (the classic EWMA control-chart weakness). 1.0 = no damping.
+    influence: float = 1.0
+
+
+class EwmaState(NamedTuple):
+    mean: jnp.ndarray  # [S, 3, K]
+    var: jnp.ndarray  # [S, 3, K]
+    count: jnp.ndarray  # [S, K] int32 per-slot update count
+
+
+class EwmaResult(NamedTuple):
+    # each [S, 3] on the metric axis, matching ZScoreResult shapes
+    window_avg: jnp.ndarray  # NaN = undefined (cold slot)
+    lower_bound: jnp.ndarray
+    upper_bound: jnp.ndarray
+    signal: jnp.ndarray  # int32 in {-1, 0, 1}
+
+
+def init_state(capacity: int, spec: EwmaSpec, dtype=jnp.float32) -> EwmaState:
+    S, K = capacity, spec.season_slots
+    return EwmaState(
+        mean=jnp.full((S, N_METRICS, K), jnp.nan, dtype),
+        var=jnp.zeros((S, N_METRICS, K), dtype),
+        count=jnp.zeros((S, K), jnp.int32),
+    )
+
+
+def slot_for_label(label, spec: EwmaSpec):
+    """Season slot owning a bucket label: (label // slot_intervals) % K.
+
+    Labels are 10 s-granular epoch buckets (stream_calc_stats.js:89-96), so
+    with the stock cadence ``slot_intervals = 360`` gives hour-of-day slots
+    when ``K = 24`` (epoch hour 0 is slot 0 = 00:00 UTC).
+    """
+    return (jnp.asarray(label, jnp.int32) // spec.slot_intervals) % spec.season_slots
+
+
+def step(
+    state: EwmaState,
+    spec: EwmaSpec,
+    new_values: jnp.ndarray,  # [S, 3]: this tick's average/per75/per95 per row
+    label,  # int32 scalar: the tick's bucket label (selects the season slot)
+) -> Tuple[EwmaResult, EwmaState]:
+    k = slot_for_label(label, spec)
+    mean_k = state.mean[:, :, k]  # [S, 3]
+    var_k = state.var[:, :, k]
+    cnt_k = state.count[:, k]  # [S]
+
+    warm = cnt_k >= spec.warmup  # [S]
+    has_avg = warm[:, None] & ~jnp.isnan(mean_k)
+    has_std = has_avg & (var_k > 0)  # zero variance -> undefined, like zscore
+    std = jnp.where(has_std, jnp.sqrt(var_k), jnp.nan)
+
+    lb = jnp.where(has_std, mean_k - spec.threshold * std, jnp.nan)
+    ub = jnp.where(has_std, mean_k + spec.threshold * std, jnp.nan)
+
+    new_ok = ~jnp.isnan(new_values)
+    exceeds = has_std & new_ok & (jnp.abs(new_values - mean_k) > spec.threshold * std)
+    signal = jnp.where(exceeds, jnp.where(new_values > mean_k, 1, -1), 0).astype(jnp.int32)
+
+    # EWMA mean/var update (skip NaN inputs; first observation seeds the slot).
+    # Signalling values are influence-damped before entering the recursion.
+    pushed = jnp.where(exceeds, spec.influence * new_values + (1.0 - spec.influence) * mean_k, new_values)
+    seeded = ~jnp.isnan(mean_k)
+    delta = jnp.where(new_ok & seeded, pushed - mean_k, 0)
+    incr = spec.alpha * delta
+    upd_mean = jnp.where(new_ok, jnp.where(seeded, mean_k + incr, new_values), mean_k)
+    # seeding resets var to 0 (not just mean): a NaN var — e.g. rows grown
+    # past a resume snapshot's capacity — must not poison the recursion forever
+    upd_var = jnp.where(
+        new_ok,
+        jnp.where(seeded, (1.0 - spec.alpha) * (var_k + delta * incr), 0.0),
+        var_k,
+    )
+
+    dtype = state.mean.dtype
+    new_mean = state.mean.at[:, :, k].set(upd_mean.astype(dtype))
+    new_var = state.var.at[:, :, k].set(upd_var.astype(dtype))
+    # per-slot count advances when any metric updated (all 3 share the tick)
+    any_ok = jnp.any(new_ok, axis=1)
+    new_count = state.count.at[:, k].add(jnp.where(any_ok, 1, 0).astype(jnp.int32))
+
+    result = EwmaResult(
+        window_avg=jnp.where(has_avg, mean_k, jnp.nan).astype(dtype),
+        lower_bound=lb.astype(dtype),
+        upper_bound=ub.astype(dtype),
+        signal=signal,
+    )
+    return result, EwmaState(new_mean, new_var, new_count)
+
+
+def grow_state(state: EwmaState, new_capacity: int) -> EwmaState:
+    S_old = state.count.shape[0]
+    if new_capacity < S_old:
+        raise ValueError("cannot shrink")
+    pad = new_capacity - S_old
+    return EwmaState(
+        mean=jnp.pad(state.mean, ((0, pad), (0, 0), (0, 0)), constant_values=jnp.nan),
+        var=jnp.pad(state.var, ((0, pad), (0, 0), (0, 0))),
+        count=jnp.pad(state.count, ((0, pad), (0, 0))),
+    )
+
+
+def specs_from_config(eng_config: dict) -> Tuple[EwmaSpec, ...]:
+    """Parse ``tpuEngine.ewmaChannels`` into EwmaSpec tuples.
+
+    Config shape (keys uppercase like the z-score defaults block,
+    apm_config.json:136-145):
+
+        "ewmaChannels": [
+          {"ALPHA": 0.05, "THRESHOLD": 3.0, "WARMUP": 60},
+          {"ALPHA": 0.2, "THRESHOLD": 3.0, "WARMUP": 3,
+           "SEASON_SLOTS": 24, "SLOT_INTERVALS": 360, "CHANNEL_ID": -24}
+        ]
+    """
+    out = []
+    seen = set()
+    for i, d in enumerate(eng_config.get("ewmaChannels", []) or []):
+        spec = EwmaSpec(
+            alpha=float(d["ALPHA"]),
+            threshold=float(d["THRESHOLD"]),
+            warmup=int(d.get("WARMUP", 1)),
+            season_slots=int(d.get("SEASON_SLOTS", 1)),
+            slot_intervals=int(d.get("SLOT_INTERVALS", 1)),
+            channel_id=int(d.get("CHANNEL_ID", -(i + 1))),
+            suppressed=bool(d.get("SUPPRESSED", False)),
+            influence=float(d.get("INFLUENCE", 1.0)),
+        )
+        # channel_id is the wire 'lag' and the resume-snapshot key: it must be
+        # negative (so it can't collide with a real lag window) and unique
+        # (a collision would silently merge two channels' resume state)
+        if spec.channel_id >= 0:
+            raise ValueError(f"ewmaChannels[{i}]: CHANNEL_ID must be negative, got {spec.channel_id}")
+        if spec.channel_id in seen:
+            raise ValueError(f"ewmaChannels[{i}]: duplicate CHANNEL_ID {spec.channel_id}")
+        seen.add(spec.channel_id)
+        out.append(spec)
+    return tuple(out)

@@ -32,6 +32,7 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from ..ops.ewma import EwmaState
 from ..ops.stats import StatsState
 from ..ops.zscore import ZScoreState
 from ..pipeline import (
@@ -51,9 +52,9 @@ class FleetRollup(NamedTuple):
 
     total_tx: jnp.ndarray  # scalar int: window tx count across the fleet
     mean_elapsed: jnp.ndarray  # scalar: global mean of per-service averages
-    signals_high: jnp.ndarray  # [n_lags] int: services signalling +1 (avg metric)
-    signals_low: jnp.ndarray  # [n_lags] int: services signalling -1
-    alerts: jnp.ndarray  # [n_lags] int: alert triggers this tick
+    signals_high: jnp.ndarray  # [n_lags + n_ewma] int: services signalling +1 (avg metric)
+    signals_low: jnp.ndarray  # [n_lags + n_ewma] int: services signalling -1
+    alerts: jnp.ndarray  # [n_lags + n_ewma] int: alert triggers this tick
 
 
 def _local_tick_with_rollup(cfg: EngineConfig):
@@ -65,14 +66,17 @@ def _local_tick_with_rollup(cfg: EngineConfig):
         s = jax.lax.psum(jnp.sum(jnp.where(defined, avg, 0)), SERVICE_AXIS)
         n = jax.lax.psum(jnp.sum(defined), SERVICE_AXIS)
         mean_elapsed = jnp.where(n > 0, s / jnp.maximum(n, 1), jnp.nan)
+        # lag windows first, then EWMA/seasonal channels (axis order matches
+        # cfg.lags + cfg.ewma)
+        chans = list(emission.lags) + list(emission.ewma)
         sig_hi = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.signal[:, 0] == 1), SERVICE_AXIS) for l in emission.lags]
+            [jax.lax.psum(jnp.sum(l.signal[:, 0] == 1), SERVICE_AXIS) for l in chans]
         )
         sig_lo = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.signal[:, 0] == -1), SERVICE_AXIS) for l in emission.lags]
+            [jax.lax.psum(jnp.sum(l.signal[:, 0] == -1), SERVICE_AXIS) for l in chans]
         )
         alerts = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.trigger), SERVICE_AXIS) for l in emission.lags]
+            [jax.lax.psum(jnp.sum(l.trigger), SERVICE_AXIS) for l in chans]
         )
         rollup = FleetRollup(total_tx, mean_elapsed, sig_hi, sig_lo, alerts)
         return emission, rollup, new_state
@@ -88,6 +92,8 @@ def _state_specs(cfg: EngineConfig) -> EngineState:
         stats=StatsState(latest_bucket=P(), counts=_ROW, sums=_ROW, samples=_ROW, nsamples=_ROW),
         zscores=tuple(ZScoreState(values=_ROW, fill=_ROW, pos=_ROW) for _ in cfg.lags),
         alert_counters=tuple(_ROW for _ in cfg.lags),
+        ewmas=tuple(EwmaState(mean=_ROW, var=_ROW, count=_ROW) for _ in cfg.ewma),
+        ewma_counters=tuple(_ROW for _ in cfg.ewma),
     )
 
 
@@ -108,6 +114,7 @@ def _emission_specs(cfg: EngineConfig) -> TickEmission:
     return TickEmission(
         tpm=_ROW, average=_ROW, count=_ROW, overflowed=_ROW,
         lags=tuple(lag_spec for _ in cfg.lags),
+        ewma=tuple(lag_spec for _ in cfg.ewma),
     )
 
 

@@ -32,6 +32,7 @@ import numpy as np
 
 from .entries import FullStatEntry, StatEntry, TxEntry
 from .ops import alerts as dalerts
+from .ops import ewma as dewma
 from .ops import stats as dstats
 from .ops import zscore as dzscore
 from .ops.registry import CapacityExceeded, ServiceRegistry
@@ -48,6 +49,9 @@ class EngineConfig(NamedTuple):
     lags: Tuple[LagSpec, ...]
     alert_rules: Tuple[dalerts.AlertRuleConfig, ...]  # one per lag
     quantize: bool = True
+    # multi-window extension (SURVEY.md §7.2 step 10): EWMA/seasonal channels
+    ewma: Tuple[dewma.EwmaSpec, ...] = ()
+    ewma_rules: Tuple[dalerts.AlertRuleConfig, ...] = ()  # one per channel
 
     @property
     def capacity(self) -> int:
@@ -58,6 +62,8 @@ class EngineState(NamedTuple):
     stats: dstats.StatsState
     zscores: Tuple[dzscore.ZScoreState, ...]  # one per lag
     alert_counters: Tuple[jnp.ndarray, ...]  # [S] int32 per lag
+    ewmas: Tuple[dewma.EwmaState, ...] = ()  # one per EWMA channel
+    ewma_counters: Tuple[jnp.ndarray, ...] = ()  # [S] int32 per channel
 
 
 class EngineParams(NamedTuple):
@@ -85,6 +91,7 @@ class TickEmission(NamedTuple):
     count: jnp.ndarray  # [S] int32
     overflowed: jnp.ndarray  # [S] bool
     lags: Tuple[LagEmission, ...]
+    ewma: Tuple[LagEmission, ...] = ()  # one per EWMA/seasonal channel
 
 
 def engine_init(cfg: EngineConfig) -> EngineState:
@@ -96,6 +103,8 @@ def engine_init(cfg: EngineConfig) -> EngineState:
             for spec in cfg.lags
         ),
         alert_counters=tuple(jnp.zeros((S,), jnp.int32) for _ in cfg.lags),
+        ewmas=tuple(dewma.init_state(S, spec, cfg.stats.dtype) for spec in cfg.ewma),
+        ewma_counters=tuple(jnp.zeros((S,), jnp.int32) for _ in cfg.ewma),
     )
 
 
@@ -139,8 +148,40 @@ def engine_tick(
         new_zstates.append(zstate)
         new_counters.append(ares.counters)
 
-    emission = TickEmission(tpm, new_values, res.count, res.overflowed, tuple(lag_emissions))
-    return emission, EngineState(stats_state, tuple(new_zstates), tuple(new_counters))
+    # EWMA/seasonal channels: same inputs and alert ladder, O(1) state. The
+    # season slot is keyed by the *edge* label — the time the emitted stats
+    # actually describe (latest - buffer - 1, stream_calc_stats.js:356) — not
+    # the raw tick label.
+    edge_label = jnp.asarray(new_label, jnp.int32) - (cfg.stats.buffer_sz + 1)
+    ewma_emissions = []
+    new_estates = []
+    new_ecounters = []
+    for i, espec in enumerate(cfg.ewma):
+        eres, estate = dewma.step(state.ewmas[i], espec, new_values, edge_label)
+        ares = dalerts.eval_rules(
+            state.ewma_counters[i],
+            cfg.ewma_rules[i],
+            avg, p75, tpm,
+            eres.signal[:, 0], eres.signal[:, 1],
+            params.hard_max_ms, params.suppressed,
+        )
+        ewma_emissions.append(
+            LagEmission(
+                eres.window_avg, eres.lower_bound, eres.upper_bound, eres.signal,
+                ares.trigger, ares.cause_bits,
+            )
+        )
+        new_estates.append(estate)
+        new_ecounters.append(ares.counters)
+
+    emission = TickEmission(
+        tpm, new_values, res.count, res.overflowed,
+        tuple(lag_emissions), tuple(ewma_emissions),
+    )
+    return emission, EngineState(
+        stats_state, tuple(new_zstates), tuple(new_counters),
+        tuple(new_estates), tuple(new_ecounters),
+    )
 
 
 def engine_ingest(state: EngineState, cfg: EngineConfig, rows, labels, elapsed, valid) -> EngineState:
@@ -172,18 +213,23 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
         LagSpec(int(d["LAG"]), int(d["LAG"]) in suppressed_lags)
         for d in zcfg.get("defaults", [])
     )
-    rules = tuple(
-        dalerts.AlertRuleConfig(
+    def rule_for(suppressed: bool) -> dalerts.AlertRuleConfig:
+        return dalerts.AlertRuleConfig(
             hard_min_ms=float(acfg.get("hardMinMsAlertThreshold", 200)),
             hard_min_tpm=float(acfg.get("hardMinTpmAlertThreshold", 1.0)),
             alert_on_both_only=bool(acfg.get("alertOnBothOnly", True)),
             window_sz=int(acfg.get("rollingAlertWindowSizeInIntervals", 60)),
             required_bad=int(acfg.get("requiredNumberBadIntervalsInAlertWindowToTrigger", 45)),
-            lag_suppressed=spec.suppressed,
+            lag_suppressed=suppressed,
         )
-        for spec in lags
+
+    rules = tuple(rule_for(spec.suppressed) for spec in lags)
+    ewma_specs = dewma.specs_from_config(eng)
+    ewma_rules = tuple(rule_for(spec.suppressed) for spec in ewma_specs)
+    return EngineConfig(
+        stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True,
+        ewma=ewma_specs, ewma_rules=ewma_rules,
     )
-    return EngineConfig(stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True)
 
 
 def make_demo_engine(
@@ -192,12 +238,14 @@ def make_demo_engine(
     lag_settings: Sequence[Tuple[int, float, float]],
     *,
     hard_max_ms: float = 10000.0,
+    ewma_channels: Sequence[dict] = (),
 ) -> Tuple[EngineConfig, EngineState, EngineParams]:
     """(cfg, fresh state, uniform params) for benches/dryruns/tests.
 
-    ``lag_settings`` is [(lag, threshold, influence), ...]. Single source for
-    the engine-setup boilerplate shared by bench.py, __graft_entry__.py and
-    the sharding tests.
+    ``lag_settings`` is [(lag, threshold, influence), ...]; ``ewma_channels``
+    is a list of tpuEngine.ewmaChannels dicts (uppercase keys). Single source
+    for the engine-setup boilerplate shared by bench.py, __graft_entry__.py
+    and the sharding tests.
     """
     from .config import default_config
 
@@ -208,6 +256,8 @@ def make_demo_engine(
     ]
     cfg_tree["tpuEngine"]["serviceCapacity"] = capacity
     cfg_tree["tpuEngine"]["samplesPerBucket"] = samples_per_bucket
+    if ewma_channels:
+        cfg_tree["tpuEngine"]["ewmaChannels"] = list(ewma_channels)
     cfg = build_engine_config(cfg_tree, capacity)
     state = engine_init(cfg)
     S = cfg.capacity
@@ -305,11 +355,12 @@ class PipelineDriver:
             zc = dzscore.ZScoreConfig(self.cfg.capacity, spec.lag, self.cfg.stats.dtype)
             zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
             zstates.append(zs)
-        counters = tuple(
-            jnp.pad(c, (0, new_capacity - self.cfg.capacity)) for c in self.state.alert_counters
-        )
+        pad_n = new_capacity - self.cfg.capacity
+        counters = tuple(jnp.pad(c, (0, pad_n)) for c in self.state.alert_counters)
+        estates = tuple(dewma.grow_state(e, new_capacity) for e in self.state.ewmas)
+        ecounters = tuple(jnp.pad(c, (0, pad_n)) for c in self.state.ewma_counters)
         self.cfg = self.cfg._replace(stats=stats_cfg)
-        self.state = EngineState(stats_state, tuple(zstates), counters)
+        self.state = EngineState(stats_state, tuple(zstates), counters, estates, ecounters)
         self._refresh_params()
 
     def _row_for(self, server: str, service: str) -> int:
@@ -391,8 +442,11 @@ class PipelineDriver:
                               float(metrics[row, 0]), float(metrics[row, 1]), float(metrics[row, 2]))
                 )
 
-        for i, spec in enumerate(self.cfg.lags):
-            lag_em = emission.lags[i]
+        # lag windows + EWMA/seasonal channels share the emission path; EWMA
+        # channels ride the FullStatEntry wire with lag = channel_id (<0)
+        channels = [(spec.lag, em) for spec, em in zip(self.cfg.lags, emission.lags)]
+        channels += [(spec.channel_id, em) for spec, em in zip(self.cfg.ewma, emission.ewma)]
+        for chan_id, lag_em in channels:
             need_fs = self.on_fullstat is not None
             need_alert = (self.on_alert is not None or self.alerts_manager is not None)
             if not (need_fs or need_alert):
@@ -409,7 +463,7 @@ class PipelineDriver:
                     continue
                 server, service = self.registry.key_of(row)
                 fs = FullStatEntry(
-                    edge_ts, server, service, float(tpm[row]), spec.lag,
+                    edge_ts, server, service, float(tpm[row]), chan_id,
                     float(metrics[row, 0]), float(wavg[row, 0]), float(lb[row, 0]), float(ub[row, 0]), int(sig[row, 0]),
                     float(metrics[row, 1]), float(wavg[row, 1]), float(lb[row, 1]), float(ub[row, 1]), int(sig[row, 1]),
                     float(metrics[row, 2]), float(wavg[row, 2]), float(lb[row, 2]), float(ub[row, 2]), int(sig[row, 2]),
@@ -443,6 +497,16 @@ class PipelineDriver:
             arrays[f"z{spec.lag}_fill"] = np.asarray(z.fill)
             arrays[f"z{spec.lag}_pos"] = np.asarray(z.pos)
             arrays[f"z{spec.lag}_counters"] = np.asarray(self.state.alert_counters[i])
+        for i, espec in enumerate(self.cfg.ewma):
+            e = self.state.ewmas[i]
+            # key includes the slot count so a SEASON_SLOTS config change
+            # invalidates the snapshot (like lag in the z{lag}_* keys) instead
+            # of resuming wrong-shaped baselines
+            ek = f"e{espec.channel_id}x{espec.season_slots}"
+            arrays[f"{ek}_mean"] = np.asarray(e.mean)
+            arrays[f"{ek}_var"] = np.asarray(e.var)
+            arrays[f"{ek}_count"] = np.asarray(e.count)
+            arrays[f"{ek}_counters"] = np.asarray(self.state.ewma_counters[i])
         keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
         import tempfile
 
@@ -470,6 +534,9 @@ class PipelineDriver:
             required = ["latest_bucket", "counts", "sums", "samples", "nsamples"]
             for spec in self.cfg.lags:
                 required += [f"z{spec.lag}_{f}" for f in ("values", "fill", "pos", "counters")]
+            for espec in self.cfg.ewma:
+                ek = f"e{espec.channel_id}x{espec.season_slots}"
+                required += [f"{ek}_{f}" for f in ("mean", "var", "count", "counters")]
             missing = [name for name in required if name not in data]
             if missing:
                 raise KeyError(missing[0])
@@ -508,7 +575,20 @@ class PipelineDriver:
                 )
             )
             counters.append(jnp.asarray(pad_rows(data[f"z{spec.lag}_counters"])))
-        self.state = EngineState(stats_state, tuple(zstates), tuple(counters))
+        estates, ecounters = [], []
+        for espec in self.cfg.ewma:
+            ek = f"e{espec.channel_id}x{espec.season_slots}"
+            estates.append(
+                dewma.EwmaState(
+                    mean=jnp.asarray(pad_rows(data[f"{ek}_mean"])),
+                    var=jnp.asarray(pad_rows(data[f"{ek}_var"])),
+                    count=jnp.asarray(pad_rows(data[f"{ek}_count"])),
+                )
+            )
+            ecounters.append(jnp.asarray(pad_rows(data[f"{ek}_counters"])))
+        self.state = EngineState(
+            stats_state, tuple(zstates), tuple(counters), tuple(estates), tuple(ecounters)
+        )
         self._latest_label = int(data["latest_bucket"])
         self._refresh_params()
         return True

@@ -1,0 +1,263 @@
+"""EWMA/seasonal baselining channels vs a float64 numpy oracle, plus engine
+integration (multi-window extension, BASELINE.json configs[4])."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apmbackend_tpu.ops import ewma as de
+from apmbackend_tpu.pipeline import (
+    PipelineDriver,
+    engine_ingest,
+    engine_tick,
+    make_demo_engine,
+)
+
+
+class OracleEwma:
+    """Scalar float64 EWMA mean/var recursion, one (slot,) baseline."""
+
+    def __init__(self, alpha, threshold, warmup, season_slots=1, slot_intervals=1, influence=1.0):
+        self.alpha = alpha
+        self.threshold = threshold
+        self.warmup = warmup
+        self.K = season_slots
+        self.slot_intervals = slot_intervals
+        self.influence = influence
+        self.mean = [float("nan")] * season_slots
+        self.var = [0.0] * season_slots
+        self.count = [0] * season_slots
+
+    def step(self, x, label):
+        k = (label // self.slot_intervals) % self.K
+        mean, var, cnt = self.mean[k], self.var[k], self.count[k]
+        warm = cnt >= self.warmup
+        has_avg = warm and not math.isnan(mean)
+        has_std = has_avg and var > 0
+        std = math.sqrt(var) if has_std else float("nan")
+        lb = mean - self.threshold * std if has_std else float("nan")
+        ub = mean + self.threshold * std if has_std else float("nan")
+        signal = 0
+        if has_std and not math.isnan(x) and abs(x - mean) > self.threshold * std:
+            signal = 1 if x > mean else -1
+        if not math.isnan(x):
+            pushed = self.influence * x + (1 - self.influence) * mean if signal else x
+            if math.isnan(mean):
+                self.mean[k] = x
+            else:
+                delta = pushed - mean
+                incr = self.alpha * delta
+                self.mean[k] = mean + incr
+                self.var[k] = (1 - self.alpha) * (var + delta * incr)
+            self.count[k] = cnt + 1
+        return {"avg": mean if has_avg else float("nan"), "lb": lb, "ub": ub, "signal": signal}
+
+
+def same(a, b):
+    if isinstance(a, float) and math.isnan(a):
+        return isinstance(b, float) and math.isnan(b)
+    return a == pytest.approx(b, rel=1e-9, abs=1e-9)
+
+
+def drive(spec, series, labels):
+    """series: [T] floats fed to rows 0 (the other rows get NaN)."""
+    S = 4
+    state = de.init_state(S, spec, jnp.float64)
+    step = jax.jit(de.step, static_argnums=1)
+    out = []
+    for x, label in zip(series, labels):
+        nv = np.full((S, 3), np.nan)
+        nv[0] = (x, x + 1, x + 2)  # 3 parallel series per row
+        res, state = step(state, spec, jnp.asarray(nv), jnp.int32(label))
+        out.append(res)
+    return out
+
+
+@pytest.mark.parametrize("alpha", [1.0, 0.3, 0.05])
+def test_plain_ewma_matches_oracle(alpha):
+    rng = np.random.RandomState(7)
+    series = list(200 + 40 * rng.rand(120))
+    series[50] = 900.0  # spike
+    series[80] = float("nan")  # missing tick
+    labels = list(range(1000, 1000 + len(series)))
+    spec = de.EwmaSpec(alpha=alpha, threshold=3.0, warmup=10)
+    oracle = OracleEwma(alpha, 3.0, 10)
+    results = drive(spec, series, labels)
+    for t, (x, label) in enumerate(zip(series, labels)):
+        g = oracle.step(x, label)
+        d = results[t]
+        assert same(g["avg"], float(d.window_avg[0, 0])), f"t={t} avg"
+        assert same(g["lb"], float(d.lower_bound[0, 0])), f"t={t} lb"
+        assert same(g["ub"], float(d.upper_bound[0, 0])), f"t={t} ub"
+        assert g["signal"] == int(d.signal[0, 0]), f"t={t} signal"
+
+
+def test_influence_damping_sustains_signals():
+    """With influence < 1 a sustained regression keeps signalling (the anomaly
+    can't inflate its own baseline); matches the oracle exactly."""
+    rng = np.random.RandomState(11)
+    series = list(250 + 2 * rng.rand(40)) + [3000.0] * 10
+    labels = list(range(len(series)))
+    spec = de.EwmaSpec(alpha=0.3, threshold=3.0, warmup=5, influence=0.1)
+    oracle = OracleEwma(0.3, 3.0, 5, influence=0.1)
+    results = drive(spec, series, labels)
+    signals = []
+    for t, (x, label) in enumerate(zip(series, labels)):
+        g = oracle.step(x, label)
+        d = results[t]
+        assert same(g["avg"], float(d.window_avg[0, 0])), f"t={t} avg"
+        assert g["signal"] == int(d.signal[0, 0]), f"t={t} signal"
+        signals.append(g["signal"])
+    assert all(s == 1 for s in signals[-10:])  # every regressed tick signals
+
+
+def test_warmup_gates_signals():
+    spec = de.EwmaSpec(alpha=0.5, threshold=1.0, warmup=50)
+    series = [100.0, 200.0, 100.0, 200.0] * 10  # wild swings but cold
+    results = drive(spec, series, range(len(series)))
+    for d in results:
+        assert int(d.signal[0, 0]) == 0
+        assert math.isnan(float(d.window_avg[0, 0]))
+
+
+def test_zero_variance_no_signal():
+    spec = de.EwmaSpec(alpha=0.5, threshold=1.0, warmup=2)
+    # constant series keeps var == 0 -> std undefined -> never signals,
+    # matching the z-score channel's zero-variance quirk
+    series = [100.0] * 10 + [500.0]
+    results = drive(spec, series, range(len(series)))
+    assert int(results[-1].signal[0, 0]) == 0
+    assert math.isnan(float(results[-1].upper_bound[0, 0]))
+
+
+def test_nan_input_freezes_state():
+    spec = de.EwmaSpec(alpha=0.5, threshold=3.0, warmup=1)
+    S = 2
+    state = de.init_state(S, spec, jnp.float64)
+    nv = np.full((S, 3), 100.0)
+    _, state1 = de.step(state, spec, jnp.asarray(nv), jnp.int32(0))
+    nan_nv = np.full((S, 3), np.nan)
+    _, state2 = de.step(state1, spec, jnp.asarray(nan_nv), jnp.int32(1))
+    np.testing.assert_array_equal(np.asarray(state1.mean), np.asarray(state2.mean))
+    np.testing.assert_array_equal(np.asarray(state1.count), np.asarray(state2.count))
+
+
+def test_seasonal_slots_are_independent():
+    # 2 slots alternating: even labels see ~100, odd labels see ~500; a 500 on
+    # an even label must signal against slot-0's baseline
+    spec = de.EwmaSpec(alpha=0.3, threshold=3.0, warmup=3, season_slots=2, slot_intervals=1)
+    oracle = OracleEwma(0.3, 3.0, 3, season_slots=2, slot_intervals=1)
+    rng = np.random.RandomState(3)
+    series, labels = [], []
+    for t in range(60):
+        base = 100.0 if t % 2 == 0 else 500.0
+        series.append(base + rng.rand() * 5)
+        labels.append(t)
+    series.append(500.0)  # anomaly: slot-1 value arriving on slot 0
+    labels.append(60)
+    results = drive(spec, series, labels)
+    for t, (x, label) in enumerate(zip(series, labels)):
+        g = oracle.step(x, label)
+        assert g["signal"] == int(results[t].signal[0, 0]), f"t={t}"
+    assert int(results[-1].signal[0, 0]) == 1  # flagged vs slot-0 baseline
+
+
+def test_engine_integration_ewma_channel_alerts():
+    """End-to-end: engine with an EWMA channel raises a device-side trigger."""
+    chan = {"ALPHA": 0.3, "THRESHOLD": 2.0, "WARMUP": 3, "CHANNEL_ID": -1}
+    cfg, state, params = make_demo_engine(
+        8, 16, [(4, 20.0, 0.1)], ewma_channels=[chan]
+    )
+    # loosen the alert window so a single bad interval triggers
+    rule = cfg.ewma_rules[0]._replace(window_sz=1, required_bad=1)
+    cfg = cfg._replace(ewma_rules=(rule,))
+    tick = jax.jit(engine_tick, static_argnums=1)
+    ingest = jax.jit(engine_ingest, static_argnums=1)
+
+    label = 17_000_000
+    rng = np.random.RandomState(0)
+    em = None
+    for t in range(40):
+        label += 1
+        em, state = tick(state, cfg, jnp.int32(label), params)
+        B = 64
+        # steady ~250 ms, then a 10x regression in the last ticks
+        ms = 250.0 if t < 30 else 2500.0
+        rows = np.zeros(B, np.int32)
+        labels = np.full(B, label, np.int32)
+        elaps = (ms + 5 * rng.rand(B)).astype(np.float64)
+        state = ingest(state, cfg, rows, labels, elaps, np.ones(B, bool))
+    assert len(em.ewma) == 1
+    assert bool(em.ewma[0].trigger[0])
+    assert int(em.ewma[0].signal[0, 0]) == 1
+
+
+def test_driver_resume_roundtrip_with_ewma(tmp_path):
+    from apmbackend_tpu.config import default_config
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["serviceCapacity"] = 8
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = 8
+    cfg_tree["tpuEngine"]["ewmaChannels"] = [
+        {"ALPHA": 0.5, "THRESHOLD": 3.0, "WARMUP": 2, "SEASON_SLOTS": 4,
+         "SLOT_INTERVALS": 2, "CHANNEL_ID": -4}
+    ]
+    cfg_tree["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0}]
+    from apmbackend_tpu.entries import TxEntry
+
+    d1 = PipelineDriver(cfg_tree, capacity=8)
+    ts = 170_000_000_0000
+    for t in range(12):
+        for k in range(3):
+            tx = TxEntry("s1", f"svc{k}", f"L{t}-{k}", "A", ts - 150, float(ts), 150.0, "Y")
+            d1.feed(tx)
+        ts += 10_000
+    path = str(tmp_path / "resume.npz")
+    d1.save_resume(path)
+
+    d2 = PipelineDriver(cfg_tree, capacity=8)
+    assert d2.load_resume(path)
+    np.testing.assert_array_equal(
+        np.asarray(d1.state.ewmas[0].count), np.asarray(d2.state.ewmas[0].count)
+    )
+    np.testing.assert_allclose(
+        np.asarray(d1.state.ewmas[0].mean), np.asarray(d2.state.ewmas[0].mean)
+    )
+    assert np.asarray(d2.state.ewmas[0].count).sum() > 0  # state actually moved
+
+
+def test_sharded_tick_with_ewma_channels():
+    """EWMA channels ride the shard_map step (state specs cover them)."""
+    from apmbackend_tpu.parallel import make_mesh, make_sharded_tick, shard_rows
+
+    n = 8
+    chan = {"ALPHA": 0.5, "THRESHOLD": 3.0, "WARMUP": 1, "CHANNEL_ID": -1}
+    cfg, state, params = make_demo_engine(8 * n, 8, [(4, 20.0, 0.1)], ewma_channels=[chan])
+    mesh = make_mesh(n)
+    tick = make_sharded_tick(mesh, cfg)
+    state = shard_rows(state, mesh)
+    params = shard_rows(params, mesh)
+    em, rollup, state = tick(state, jnp.int32(17_000_001), params)
+    assert len(em.ewma) == 1
+    assert em.ewma[0].signal.shape == (8 * n, 3)
+
+
+def test_nan_var_recovers_on_seed():
+    """Rows grown past a resume snapshot (var padded NaN) must become live
+    again once a value seeds them — NaN var must not poison the recursion."""
+    spec = de.EwmaSpec(alpha=0.5, threshold=1.0, warmup=2)
+    state = de.EwmaState(
+        mean=jnp.full((1, 3, 1), jnp.nan, jnp.float64),
+        var=jnp.full((1, 3, 1), jnp.nan, jnp.float64),  # poisoned pad
+        count=jnp.zeros((1, 1), jnp.int32),
+    )
+    vals = [100.0, 110.0, 90.0, 105.0, 500.0]
+    res = None
+    for t, v in enumerate(vals):
+        nv = np.full((1, 3), v)
+        res, state = de.step(state, spec, jnp.asarray(nv), jnp.int32(t))
+    assert not math.isnan(float(state.var[0, 0, 0]))
+    assert int(res.signal[0, 0]) == 1  # the spike is detected

@@ -195,3 +195,37 @@ def test_module_setting_override(tmp_path):
     assert app.module_setting(mod, "moduleMemoryAlertThreshold") == 700
     mod2 = ModuleProc({"module": "y"}, log_dir=str(tmp_path), config_path=None)
     assert app.module_setting(mod2, "moduleMemoryAlertThreshold") == 350
+
+
+def test_manager_alerts_interval_never_overshoots_cap():
+    """Doubling from a base that doesn't power-of-two into the cap must clamp
+    at the cap, not sail past it (60 -> 120 -> 240 -> 300, never 480)."""
+    cfg = {
+        "emailsEnabled": True,
+        "alertCollectionIntervalInSeconds": 60,
+        "increaseCollectionIntervalAfterAlert": True,
+        "maxCollectionIntervalInSeconds": 300,
+    }
+    alerts = ManagerAlerts(cfg, email_sender=lambda s, h, i: None)
+    interval = 60.0
+    seen = []
+    for _ in range(6):
+        alerts.add("x")
+        _, interval = alerts.flush(interval)
+        seen.append(interval)
+    assert seen == [120, 240, 300, 300, 300, 300]
+
+
+def test_cmdline_pattern_matches_both_launch_forms():
+    import re
+
+    from apmbackend_tpu.manager.manager import cmdline_pattern_for
+
+    pat = cmdline_pattern_for("apmbackend_tpu.manager.manager")
+    assert re.search(pat, "python -m apmbackend_tpu.manager.manager")
+    assert re.search(pat, "python -m apmbackend_tpu manager")
+    assert not re.search(pat, "python -m apmbackend_tpu worker")
+    assert not re.search(pat, "python -m apmbackend_tpuXmanager")
+    wpat = cmdline_pattern_for("apmbackend_tpu.runtime.worker")
+    assert re.search(wpat, "python -m apmbackend_tpu worker --foo")
+    assert not re.search(wpat, "python -m apmbackend_tpu manager")
