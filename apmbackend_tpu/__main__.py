@@ -14,7 +14,25 @@ Commands map to the reference's process/tool set:
 - ``qstat``       queue depth/memory (qstat.sh)
 """
 
+import importlib
 import sys
+
+# command -> (dotted module exposing main(), main takes argv?). This is THE
+# table: the supervisor's stale-PID matching derives its dispatcher aliases
+# from it (manager.cmdline_pattern_for), so both launch forms of a module stay
+# recognizable without a second hand-maintained mapping.
+COMMANDS = {
+    "worker": ("apmbackend_tpu.runtime.worker", False),
+    "parser": ("apmbackend_tpu.ingest.parser_main", False),
+    "insertdb": ("apmbackend_tpu.sinks.insert_db_main", False),
+    "jmx": ("apmbackend_tpu.ingest.jmx_main", False),
+    "standalone": ("apmbackend_tpu.standalone", True),
+    "manager": ("apmbackend_tpu.manager.manager", False),
+    "controller": ("apmbackend_tpu.manager.controller", True),
+    "pidstats": ("apmbackend_tpu.manager.pid_stats", True),
+    "dequeue": ("apmbackend_tpu.tools.dequeue", True),
+    "qstat": ("apmbackend_tpu.tools.qstat", True),
+}
 
 
 def main() -> int:
@@ -22,51 +40,15 @@ def main() -> int:
         print(__doc__, file=sys.stderr)
         return 2
     cmd, argv = sys.argv[1], sys.argv[2:]
-    sys.argv = [f"apmbackend_tpu {cmd}"] + argv
-    if cmd == "worker":
-        from .runtime.worker import main as m
-
-        m()
-    elif cmd == "parser":
-        from .ingest.parser_main import main as m
-
-        m()
-    elif cmd == "insertdb":
-        from .sinks.insert_db_main import main as m
-
-        m()
-    elif cmd == "jmx":
-        from .ingest.jmx_main import main as m
-
-        m()
-    elif cmd == "standalone":
-        from .standalone import main as m
-
-        return m(argv)
-    elif cmd == "manager":
-        from .manager.manager import main as m
-
-        m()
-    elif cmd == "controller":
-        from .manager.controller import main as m
-
-        return m(argv)
-    elif cmd == "pidstats":
-        from .manager.pid_stats import main as m
-
-        return m(argv)
-    elif cmd == "dequeue":
-        from .tools.dequeue import main as m
-
-        return m(argv)
-    elif cmd == "qstat":
-        from .tools.qstat import main as m
-
-        return m(argv)
-    else:
+    entry = COMMANDS.get(cmd)
+    if entry is None:
         print(f"Unknown command: {cmd}\n{__doc__}", file=sys.stderr)
         return 2
-    return 0
+    sys.argv = [f"apmbackend_tpu {cmd}"] + argv
+    module_path, takes_argv = entry
+    m = importlib.import_module(module_path).main
+    result = m(argv) if takes_argv else m()
+    return 0 if result is None else int(result)
 
 
 if __name__ == "__main__":

@@ -358,6 +358,16 @@ _DEFAULT_CONFIG: dict = {
             "threading": "/core-service=platform-mbean/type=threading :read-resource",
             "bean": "/deployment=App.ear/subdeployment=*/subsystem=ejb3/stateless-session-bean=MainBean :read-resource(recursive=true,include-runtime=true)",
         },
+        # device multivariate anomaly detector over the poll stream (a TPU
+        # capability beyond the reference; ops/multivariate.py). Absent block
+        # or "enabled": false disables; an empty {} means enabled-with-defaults.
+        "multivariateDetector": {
+            "enabled": False,
+            "alpha": 0.05,  # EW smoothing factor for mean/covariance
+            "threshold": 3.0,  # signal at normalized Mahalanobis > threshold
+            "warmup": 10,  # polls before a host may signal
+            "influence": 0.25,  # damping for signalling samples (1 = none)
+        },
     },
     "grafana": {
         "grafanaURL": "",

@@ -9,6 +9,27 @@ from ..transport.memory import MemoryBroker
 from .jmx import JmxPoller
 
 
+def _make_detector(cfg: dict, logger):
+    """Optional device multivariate detector over the poll stream — a new
+    capability beyond the reference (which only persists JMX rows). Enabled by
+    ``pullJvmStats.multivariateDetector`` config block."""
+    mv_cfg = cfg.get("multivariateDetector")
+    # an empty {} block means "enabled with defaults" — only an absent block
+    # or an explicit enabled=false disables
+    if mv_cfg is None or not mv_cfg.get("enabled", True):
+        return None
+    from ..ops import multivariate as mv
+
+    spec = mv.MvSpec(
+        n_features=mv.JMX_FEATURE_COUNT,
+        alpha=float(mv_cfg.get("alpha", 0.05)),
+        threshold=float(mv_cfg.get("threshold", 3.0)),
+        warmup=int(mv_cfg.get("warmup", 10)),
+        influence=float(mv_cfg.get("influence", 0.25)),
+    )
+    return mv.MvDriver(spec, logger=logger)
+
+
 def build(runtime) -> JmxPoller:
     cfg = runtime.module_config
     db_queue = runtime.qm.get_queue(runtime.config.get("dbInsertQueue", "db_insert"), "p")
@@ -18,7 +39,24 @@ def build(runtime) -> JmxPoller:
         lambda line: db_queue.write_line(line, verbose),
         logger=runtime.logger,
     )
-    runtime.on_reload(lambda new_cfg: poller.set_config(new_cfg.get("pullJvmStats", {})))
+    # detector holder so hot reload can swap/disable it (a spec change rebuilds
+    # the detector — its EW baselines restart, like the z-score stale-lag purge
+    # on reload, stream_calc_z_score.js:370-371)
+    det = {"driver": _make_detector(cfg, runtime.logger), "block": cfg.get("multivariateDetector")}
+
+    def on_reload(new_cfg: dict) -> None:
+        block = new_cfg.get("pullJvmStats", {})
+        poller.set_config(block)
+        mv_block = block.get("multivariateDetector")
+        if mv_block != det["block"]:
+            det["block"] = mv_block
+            det["driver"] = _make_detector(block, runtime.logger)
+            runtime.logger.warning(
+                "multivariateDetector config changed: detector "
+                + ("rebuilt (baselines reset)" if det["driver"] else "disabled")
+            )
+
+    runtime.on_reload(on_reload)
 
     # Second-aligned recursion; the first (immediate) tick never polls
     # (pullAllJvmStatsRecurs(false), pull_jvm_stats.js:141-149).
@@ -27,7 +65,16 @@ def build(runtime) -> JmxPoller:
             return
         if not_first_time:
             try:
-                poller.pull_all()
+                entries = poller.pull_all()
+                detector = det["driver"]
+                if detector is not None and entries:
+                    for verdict in detector.feed(entries):
+                        if verdict["signal"]:
+                            runtime.logger.warning(
+                                "JMX multivariate anomaly on "
+                                f"{verdict['server']}: score={verdict['score']:.2f} "
+                                f"over {verdict['observed']} metrics"
+                            )
             except Exception as e:
                 runtime.logger.error(f"JMX poll error: {e}")
         t = threading.Timer(poller.seconds_until_next_poll(), schedule, args=(True,))

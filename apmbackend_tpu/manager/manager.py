@@ -34,14 +34,16 @@ from ..integrations import EmailSender, GrafanaClient
 
 # The CLI dispatcher (`python -m apmbackend_tpu <cmd>`) runs the same modules
 # with a different /proc cmdline than `python -m <dotted.module>`; stale-PID
-# matching must catch both or two supervisors can fight over children.
-_DISPATCH_ALIASES = {
-    "apmbackend_tpu.runtime.worker": "worker",
-    "apmbackend_tpu.ingest.parser_main": "parser",
-    "apmbackend_tpu.sinks.insert_db_main": "insertdb",
-    "apmbackend_tpu.ingest.jmx_main": "jmx",
-    "apmbackend_tpu.manager.manager": "manager",
-}
+# matching must catch both or two supervisors can fight over children. The
+# alias map is derived from the dispatcher's own command table so the two
+# cannot drift.
+def _dispatch_aliases() -> dict:
+    from apmbackend_tpu.__main__ import COMMANDS
+
+    return {module: cmd for cmd, (module, _takes_argv) in COMMANDS.items()}
+
+
+_DISPATCH_ALIASES = _dispatch_aliases()
 
 
 def cmdline_pattern_for(module: str) -> str:

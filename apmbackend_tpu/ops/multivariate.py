@@ -1,0 +1,257 @@
+"""Device multivariate anomaly detector for JMX / machine-health vectors
+(BASELINE.json configs[2]: "JMX + datasource + VM-CPU multivariate batch").
+
+The reference only *persists* JMX samples (pull_jvm_stats.js -> stream_insert_db
+-> Grafana eyeballs); it has no detector over them. This module closes that gap
+the TPU way: every poll the fleet's per-host metric vectors form one ``[H, M]``
+batch, and a single jitted step updates an exponentially weighted mean vector
+and covariance matrix per host and scores the new sample by normalized
+Mahalanobis distance — the multivariate generalization of the per-metric
+smoothed z-score (stream_calc_z_score.js:66-104):
+
+- state: ``mean [H, M]``, ``cov [H, M, M]``, ``count [H]``. EW recursion
+  (incremental West 1979, matching ops/ewma.py): ``delta = x - mean``,
+  ``mean += alpha*delta``, ``cov = (1-alpha)*(cov + alpha*outer(delta, delta))``.
+- score: ``sqrt(d' (C + ridge*diag(C) + eps*I)^-1 d / m)`` over the ``m``
+  observed dims — the *relative* ridge keeps the score invariant to per-metric
+  units (heap bytes vs sysload), and dividing by ``m`` makes one threshold work
+  across hosts reporting different metric subsets. Under normality
+  ``m*score^2 ~ chi2(m)``, so ``threshold=3`` is roughly a per-dim 3-sigma gate.
+- quirk parity with the z-score channel: warm-up gating on update count (the
+  lag-length analog, stream_calc_z_score.js:75), NaN dims are masked (a down
+  collector must not poison the baseline), and signalling samples enter the
+  recursion influence-damped (stream_calc_z_score.js:96-97) so an anomaly
+  cannot inflate its own covariance and mask successors.
+
+Host-side, :class:`MvDriver` keeps the server->row registry and turns
+:class:`~apmbackend_tpu.entries.JmxEntry` batches into device calls.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..entries import JmxEntry
+
+
+class MvSpec(NamedTuple):
+    """Static detector settings (hashable, part of the jitted closure)."""
+
+    n_features: int
+    alpha: float = 0.05  # EW smoothing factor
+    threshold: float = 3.0  # signal at normalized Mahalanobis > threshold
+    warmup: int = 10  # min updates before signalling
+    ridge: float = 0.05  # relative diagonal regularization
+    eps: float = 1e-9  # absolute regularization floor
+    influence: float = 0.25  # damping for signalling samples (1 = none)
+    # a dim only *scores* while its EW std exceeds std_floor_frac*(|mean|+1):
+    # a long-constant metric's variance decays to ~0, and without this gate the
+    # next +-1 blip would divide by eps and signal unconditionally. Collapsed
+    # dims still *update* (so the baseline tracks and variance can recover) —
+    # the univariate channels have the same semantics (zero variance -> std
+    # undefined -> no signal, ops/ewma.py; stream_calc_z_score.js:66-104).
+    std_floor_frac: float = 1e-4
+
+
+class MvState(NamedTuple):
+    mean: jnp.ndarray  # [H, M] (NaN = dim not yet seeded)
+    cov: jnp.ndarray  # [H, M, M]
+    count: jnp.ndarray  # [H] int32
+
+
+class MvResult(NamedTuple):
+    score: jnp.ndarray  # [H] normalized Mahalanobis distance (NaN = cold)
+    signal: jnp.ndarray  # [H] int32 {0, 1}
+    observed: jnp.ndarray  # [H] int32: dims observed this step
+
+
+def init_state(capacity: int, spec: MvSpec, dtype=jnp.float32) -> MvState:
+    H, M = capacity, spec.n_features
+    return MvState(
+        mean=jnp.full((H, M), jnp.nan, dtype),
+        cov=jnp.zeros((H, M, M), dtype),
+        count=jnp.zeros((H,), jnp.int32),
+    )
+
+
+def step(
+    state: MvState, spec: MvSpec, x: jnp.ndarray, valid: jnp.ndarray
+) -> Tuple[MvResult, MvState]:
+    """One poll for the whole fleet: x [H, M] float (NaN = missing),
+    valid [H] bool (False = host not polled this round; state untouched)."""
+    M = spec.n_features
+    dtype = state.mean.dtype
+    x = jnp.asarray(x, dtype)
+    valid = jnp.asarray(valid, bool)
+
+    seeded = ~jnp.isnan(state.mean)  # [H, M] per-dim
+    obs = valid[:, None] & ~jnp.isnan(x)  # [H, M]
+    live = obs & seeded  # dims that update the baseline this step
+    diag = jnp.diagonal(state.cov, axis1=1, axis2=2)  # [H, M]
+    var_floor = jnp.square(spec.std_floor_frac * (jnp.abs(jnp.where(seeded, state.mean, 0.0)) + 1.0))
+    scorable = live & (diag > var_floor)  # dims that enter the score
+    m_obs = jnp.sum(scorable, axis=1)  # [H]
+
+    d = jnp.where(scorable, x - state.mean, 0.0)  # [H, M]
+    reg = spec.ridge * diag + spec.eps
+    # unobserved/unseeded dims get an identity row/col so the solve stays
+    # well-posed without influencing observed dims (their d is already 0)
+    eye = jnp.eye(M, dtype=dtype)
+    mask2d = scorable[:, :, None] & scorable[:, None, :]
+    C = jnp.where(mask2d, state.cov, 0.0) + eye[None] * jnp.where(scorable, reg, 1.0)[:, :, None]
+    y = jnp.linalg.solve(C, d[:, :, None])[:, :, 0]  # [H, M]
+    maha2 = jnp.sum(d * y, axis=1)  # [H]
+
+    warm = (state.count >= spec.warmup) & (m_obs > 0)
+    score = jnp.where(warm, jnp.sqrt(jnp.maximum(maha2, 0.0) / jnp.maximum(m_obs, 1)), jnp.nan)
+    signal = jnp.where(warm & (score > spec.threshold), 1, 0).astype(jnp.int32)
+
+    # EW update. Signalling samples are influence-damped; dims seen for the
+    # first time seed mean=x (cov row/col stays 0 until a second sample).
+    damped = jnp.where(
+        (signal == 1)[:, None] & live,
+        spec.influence * x + (1.0 - spec.influence) * state.mean,
+        x,
+    )
+    delta = jnp.where(live, damped - state.mean, 0.0)  # [H, M]
+    new_mean = jnp.where(live, state.mean + spec.alpha * delta, state.mean)
+    new_mean = jnp.where(obs & ~seeded, x, new_mean)  # seed fresh dims
+    outer = delta[:, :, None] * delta[:, None, :]
+    upd = (1.0 - spec.alpha) * (state.cov + spec.alpha * outer)
+    # only covariance entries whose BOTH dims were observed update — a missing
+    # collector must not decay unrelated baselines (EWMA NaN-skip parity)
+    live2d = live[:, :, None] & live[:, None, :]
+    new_cov = jnp.where(live2d, upd, state.cov)
+    new_count = state.count + jnp.any(obs, axis=1).astype(jnp.int32)
+
+    return (
+        MvResult(score.astype(dtype), signal, m_obs.astype(jnp.int32)),
+        MvState(new_mean.astype(dtype), new_cov.astype(dtype), new_count),
+    )
+
+
+def grow_state(state: MvState, new_capacity: int) -> MvState:
+    H_old = state.count.shape[0]
+    if new_capacity < H_old:
+        raise ValueError("cannot shrink")
+    pad = new_capacity - H_old
+    return MvState(
+        mean=jnp.pad(state.mean, ((0, pad), (0, 0)), constant_values=jnp.nan),
+        cov=jnp.pad(state.cov, ((0, pad), (0, 0), (0, 0))),
+        count=jnp.pad(state.count, (0, pad)),
+    )
+
+
+# -- JMX feature map ---------------------------------------------------------
+
+def _frac(used: float, cap: float) -> float:
+    if math.isnan(used) or math.isnan(cap) or cap <= 0:
+        return float("nan")
+    return used / cap
+
+
+def jmx_features(e: JmxEntry) -> np.ndarray:
+    """JmxEntry -> stationary-ish feature vector (ratios where a capacity
+    exists, raw where not). Order is the wire contract for resume snapshots."""
+    return np.array(
+        [
+            e.ds_in_use_nodes,
+            e.ds_active_nodes,
+            _frac(e.ds_in_use_nodes, e.ds_available_nodes),
+            _frac(e.heap_used, e.heap_max),
+            _frac(e.heap_committed, e.heap_max),
+            _frac(e.meta_used, e.meta_max if not math.isnan(e.meta_max) and e.meta_max > 0 else e.meta_committed),
+            e.sys_load,
+            e.class_cnt,
+            e.thread_cnt,
+            e.daemon_thread_cnt,
+            _frac(
+                e.bean_pool_current_size - e.bean_pool_available_count
+                if not math.isnan(e.bean_pool_current_size)
+                else float("nan"),
+                e.bean_pool_max_size,
+            ),
+        ],
+        dtype=np.float64,
+    )
+
+
+JMX_FEATURE_COUNT = 11
+
+
+class MvDriver:
+    """Host loop: JmxEntry batches -> device step; server->row registry with
+    growth-by-recompile (same pattern as pipeline.PipelineDriver)."""
+
+    def __init__(
+        self,
+        spec: Optional[MvSpec] = None,
+        *,
+        capacity: int = 8,
+        dtype=jnp.float32,
+        logger=None,
+    ):
+        self.spec = spec or MvSpec(n_features=JMX_FEATURE_COUNT)
+        self.capacity = capacity
+        self.dtype = dtype
+        self.logger = logger
+        self.rows: dict = {}
+        self.state = init_state(capacity, self.spec, dtype)
+        self._step = jax.jit(step, static_argnums=1)
+
+    def _row_for(self, server: str) -> int:
+        row = self.rows.get(server)
+        if row is None:
+            if len(self.rows) >= self.capacity:
+                self._grow()
+            row = len(self.rows)
+            self.rows[server] = row
+        return row
+
+    def _grow(self) -> None:
+        new_capacity = self.capacity * 2
+        if self.logger:
+            self.logger.warning(
+                f"Growing JMX host capacity {self.capacity} -> {new_capacity} (recompile)"
+            )
+        self.state = grow_state(self.state, new_capacity)
+        self.capacity = new_capacity
+
+    def feed(self, entries: Sequence[JmxEntry]) -> List[dict]:
+        """One poll round. Returns [{server, score, signal, observed}] for
+        hosts present in this batch (NaN score while warming up)."""
+        if not entries:
+            return []
+        for e in entries:  # resolve rows first: growth must precede the step
+            self._row_for(e.server)
+        H, M = self.capacity, self.spec.n_features
+        x = np.full((H, M), np.nan, np.float64)
+        valid = np.zeros((H,), bool)
+        for e in entries:
+            row = self.rows[e.server]
+            x[row] = jmx_features(e)
+            valid[row] = True
+        res, self.state = self._step(self.state, self.spec, x.astype(self._np_dtype()), valid)
+        score = np.asarray(res.score)
+        signal = np.asarray(res.signal)
+        observed = np.asarray(res.observed)
+        out = []
+        for e in entries:
+            row = self.rows[e.server]
+            out.append(
+                {
+                    "server": e.server,
+                    "score": float(score[row]),
+                    "signal": int(signal[row]),
+                    "observed": int(observed[row]),
+                }
+            )
+        return out
+
+    def _np_dtype(self):
+        return np.float64 if self.dtype == jnp.float64 else np.float32

@@ -499,10 +499,11 @@ class PipelineDriver:
             arrays[f"z{spec.lag}_counters"] = np.asarray(self.state.alert_counters[i])
         for i, espec in enumerate(self.cfg.ewma):
             e = self.state.ewmas[i]
-            # key includes the slot count so a SEASON_SLOTS config change
-            # invalidates the snapshot (like lag in the z{lag}_* keys) instead
-            # of resuming wrong-shaped baselines
-            ek = f"e{espec.channel_id}x{espec.season_slots}"
+            # key includes the slot count AND slot width so a SEASON_SLOTS or
+            # SLOT_INTERVALS config change invalidates the snapshot (like lag
+            # in the z{lag}_* keys) instead of resuming baselines under a
+            # wrong-shaped or wrong-meaning slot mapping
+            ek = f"e{espec.channel_id}x{espec.season_slots}x{espec.slot_intervals}"
             arrays[f"{ek}_mean"] = np.asarray(e.mean)
             arrays[f"{ek}_var"] = np.asarray(e.var)
             arrays[f"{ek}_count"] = np.asarray(e.count)
@@ -535,7 +536,7 @@ class PipelineDriver:
             for spec in self.cfg.lags:
                 required += [f"z{spec.lag}_{f}" for f in ("values", "fill", "pos", "counters")]
             for espec in self.cfg.ewma:
-                ek = f"e{espec.channel_id}x{espec.season_slots}"
+                ek = f"e{espec.channel_id}x{espec.season_slots}x{espec.slot_intervals}"
                 required += [f"{ek}_{f}" for f in ("mean", "var", "count", "counters")]
             missing = [name for name in required if name not in data]
             if missing:
@@ -577,7 +578,7 @@ class PipelineDriver:
             counters.append(jnp.asarray(pad_rows(data[f"z{spec.lag}_counters"])))
         estates, ecounters = [], []
         for espec in self.cfg.ewma:
-            ek = f"e{espec.channel_id}x{espec.season_slots}"
+            ek = f"e{espec.channel_id}x{espec.season_slots}x{espec.slot_intervals}"
             estates.append(
                 dewma.EwmaState(
                     mean=jnp.asarray(pad_rows(data[f"{ek}_mean"])),

@@ -1,0 +1,199 @@
+"""Device multivariate JMX detector (ops/multivariate.py).
+
+The reference has no JMX detector (pull_jvm_stats.js only persists samples);
+these tests pin the new capability's contract: EW mean/cov recursion,
+normalized Mahalanobis scoring, warm-up gating, NaN masking, influence
+damping, growth, and the JmxEntry feature map.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apmbackend_tpu.entries import JmxEntry
+from apmbackend_tpu.ops import multivariate as mv
+
+
+def make_spec(**kw):
+    defaults = dict(n_features=3, alpha=0.2, threshold=3.0, warmup=5, influence=1.0)
+    defaults.update(kw)
+    return mv.MvSpec(**defaults)
+
+
+def run_steps(spec, xs, capacity=2):
+    state = mv.init_state(capacity, spec, jnp.float64)
+    results = []
+    for x in xs:
+        x = np.asarray(x, np.float64)
+        if x.ndim == 1:
+            x = np.tile(x[None, :], (capacity, 1))
+        res, state = mv.step(state, spec, x, np.ones(capacity, bool))
+        results.append(res)
+    return results, state
+
+
+class TestStep:
+    def test_warmup_gates_score(self):
+        spec = make_spec(warmup=5)
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(3) for _ in range(7)]
+        results, _ = run_steps(spec, xs)
+        for res in results[:5]:
+            assert math.isnan(float(res.score[0]))
+            assert int(res.signal[0]) == 0
+        assert not math.isnan(float(results[5].score[0]))
+
+    def test_inlier_scores_low_outlier_scores_high(self):
+        spec = make_spec(warmup=10, threshold=3.0, alpha=0.1)
+        rng = np.random.RandomState(1)
+        xs = [100 + rng.randn(3) for _ in range(60)]
+        results, state = run_steps(spec, xs)
+        warm_scores = [float(r.score[0]) for r in results[15:]]
+        assert max(warm_scores) < 3.0  # in-distribution stays quiet
+        res, state = mv.step(
+            state, spec, np.tile(np.array([200.0, 200.0, 200.0]), (2, 1)), np.ones(2, bool)
+        )
+        assert float(res.score[0]) > 3.0
+        assert int(res.signal[0]) == 1
+
+    def test_correlation_aware(self):
+        # two strongly correlated dims; a sample that breaks the correlation
+        # but stays within marginal ranges must outscore one that follows it
+        spec = make_spec(n_features=2, warmup=10, alpha=0.05, threshold=3.0)
+        rng = np.random.RandomState(2)
+        xs = []
+        for _ in range(200):
+            a = rng.randn()
+            xs.append(np.array([a, a + 0.01 * rng.randn()]))
+        _, state = run_steps(spec, xs, capacity=1)
+        aligned, s1 = mv.step(state, spec, np.array([[1.5, 1.5]]), np.ones(1, bool))
+        broken, s2 = mv.step(state, spec, np.array([[1.5, -1.5]]), np.ones(1, bool))
+        assert float(broken.score[0]) > float(aligned.score[0]) * 5
+
+    def test_nan_dims_masked(self):
+        spec = make_spec(warmup=3, alpha=0.2)
+        rng = np.random.RandomState(3)
+        xs = [10 + rng.randn(3) for _ in range(10)]
+        _, state = run_steps(spec, xs, capacity=1)
+        mean_before = np.asarray(state.mean).copy()
+        x = np.array([[10.0, np.nan, np.nan]])
+        res, state = mv.step(state, spec, x, np.ones(1, bool))
+        assert int(res.observed[0]) == 1
+        assert not math.isnan(float(res.score[0]))
+        # unobserved dims untouched
+        np.testing.assert_allclose(np.asarray(state.mean)[0, 1:], mean_before[0, 1:])
+
+    def test_invalid_row_untouched(self):
+        spec = make_spec(warmup=1)
+        state = mv.init_state(2, spec, jnp.float64)
+        x = np.tile(np.arange(3.0)[None, :], (2, 1))
+        res, state = mv.step(state, spec, x, np.array([True, False]))
+        assert int(state.count[0]) == 1
+        assert int(state.count[1]) == 0
+        assert np.all(np.isnan(np.asarray(state.mean)[1]))
+
+    def test_first_sample_seeds_mean(self):
+        spec = make_spec(warmup=1)
+        state = mv.init_state(1, spec, jnp.float64)
+        x = np.array([[5.0, 6.0, 7.0]])
+        _, state = mv.step(state, spec, x, np.ones(1, bool))
+        np.testing.assert_allclose(np.asarray(state.mean)[0], [5.0, 6.0, 7.0])
+
+    def test_influence_damps_anomaly_update(self):
+        rng = np.random.RandomState(4)
+        xs = [50 + rng.randn(3) for _ in range(40)]
+        outlier = np.array([500.0, 500.0, 500.0])
+        spec_full = make_spec(warmup=5, influence=1.0, alpha=0.2)
+        spec_damped = spec_full._replace(influence=0.0)
+        _, s_full = run_steps(spec_full, xs + [outlier], capacity=1)
+        _, s_damped = run_steps(spec_damped, xs + [outlier], capacity=1)
+        drift_full = abs(float(s_full.mean[0, 0]) - 50.0)
+        drift_damped = abs(float(s_damped.mean[0, 0]) - 50.0)
+        assert drift_damped < drift_full / 10
+
+    def test_constant_dim_does_not_false_alarm(self):
+        # a metric constant for 100 polls collapses its EW variance; the next
+        # +-1 blip must NOT divide by the eps floor and signal (std-floor gate,
+        # zero-variance parity with ops/ewma.py has_std)
+        spec = make_spec(warmup=5, alpha=0.05)
+        rng = np.random.RandomState(6)
+        xs = [np.array([30000.0, 200 + rng.randn(), 1.5 + 0.1 * rng.randn()]) for _ in range(100)]
+        _, state = run_steps(spec, xs, capacity=1)
+        res, state = mv.step(
+            state, spec, np.array([[30001.0, 200.0, 1.5]]), np.ones(1, bool)
+        )
+        assert int(res.signal[0]) == 0
+        assert float(res.score[0]) < 3.0
+        # the collapsed dim is excluded from scoring but still tracks: its
+        # mean moves toward the new value and variance re-inflates
+        assert float(state.mean[0, 0]) > 30000.0
+        assert float(state.cov[0, 0, 0]) > 0.0
+
+    def test_grow_state(self):
+        spec = make_spec(warmup=1)
+        _, state = run_steps(spec, [np.ones(3)], capacity=2)
+        grown = mv.grow_state(state, 4)
+        assert grown.mean.shape == (4, 3)
+        assert grown.cov.shape == (4, 3, 3)
+        assert np.all(np.isnan(np.asarray(grown.mean)[2:]))
+        with pytest.raises(ValueError):
+            mv.grow_state(state, 1)
+
+
+def make_entry(**kw):
+    base = dict(
+        timestamp=1.7e12, server="jvm1",
+        ds_in_use_nodes=5, ds_active_nodes=10, ds_available_nodes=20,
+        heap_used=4e9, heap_committed=6e9, heap_max=8e9,
+        meta_used=2e8, meta_committed=3e8, meta_max=4e8,
+        sys_load=1.5, class_cnt=30000, thread_cnt=200, daemon_thread_cnt=150,
+        bean_pool_available_count=90, bean_pool_current_size=100, bean_pool_max_size=128,
+    )
+    base.update(kw)
+    return JmxEntry(**base)
+
+
+class TestJmxFeatures:
+    def test_shape_and_ratios(self):
+        f = mv.jmx_features(make_entry())
+        assert f.shape == (mv.JMX_FEATURE_COUNT,)
+        assert f[2] == pytest.approx(5 / 20)  # ds utilization
+        assert f[3] == pytest.approx(0.5)  # heap fraction
+        assert f[10] == pytest.approx(10 / 128)  # bean pool in-use fraction
+
+    def test_missing_capacity_is_nan(self):
+        f = mv.jmx_features(make_entry(heap_max=float("nan")))
+        assert math.isnan(f[3]) and math.isnan(f[4])
+        f2 = mv.jmx_features(make_entry(heap_max=0))
+        assert math.isnan(f2[3])
+
+
+class TestMvDriver:
+    def test_feed_registry_and_growth(self):
+        d = mv.MvDriver(make_spec(n_features=mv.JMX_FEATURE_COUNT, warmup=2), capacity=2)
+        servers = [f"jvm{i}" for i in range(5)]  # forces growth past 2 -> 8
+        for tick in range(4):
+            out = d.feed([make_entry(server=s, sys_load=1.0 + 0.01 * tick) for s in servers])
+            assert [o["server"] for o in out] == servers
+        assert d.capacity == 8
+        assert len(d.rows) == 5
+        assert all(not math.isnan(o["score"]) for o in out)
+        assert all(o["signal"] == 0 for o in out)
+
+    def test_detects_fleet_outlier(self):
+        d = mv.MvDriver(
+            make_spec(n_features=mv.JMX_FEATURE_COUNT, warmup=5, alpha=0.1, threshold=3.0),
+            capacity=2,
+        )
+        rng = np.random.RandomState(5)
+        for _ in range(30):
+            d.feed([make_entry(sys_load=1.5 + 0.05 * rng.randn(),
+                               thread_cnt=200 + rng.randint(-3, 4))])
+        out = d.feed([make_entry(sys_load=30.0, thread_cnt=900)])
+        assert out[0]["signal"] == 1
+
+    def test_empty_feed(self):
+        d = mv.MvDriver(make_spec(n_features=mv.JMX_FEATURE_COUNT))
+        assert d.feed([]) == []
