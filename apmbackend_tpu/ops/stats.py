@@ -44,6 +44,11 @@ class StatsConfig(NamedTuple):
     interval_len_s: int = 10  # intervalLengthInSeconds
     samples_per_bucket: int = 128  # CAP
     dtype: jnp.dtype = jnp.float32
+    # percentile implementation: "auto" (Pallas selection kernel on TPU+f32,
+    # sort elsewhere), "sort" (XLA per-row sort), or "pallas" (force the
+    # kernel; interpret-mode off-TPU). Both are exact — see
+    # ops/pallas_kernels.py for the equivalence argument.
+    percentile_impl: str = "auto"
 
     @property
     def num_keep(self) -> int:
@@ -164,23 +169,28 @@ def _advance(state: StatsState, cfg: StatsConfig, new_label: jnp.ndarray) -> Sta
     return StatsState(new_label.astype(jnp.int32), counts, sums, samples, nsamples)
 
 
-def reference_percentile_sorted(sorted_vals: jnp.ndarray, n: jnp.ndarray, p: int) -> jnp.ndarray:
-    """Vectorized util_methods.js:112-142 over ``[..., K]`` ascending-sorted
-
-    arrays (NaN tail) with ``n`` valid entries per row, integer-exact index
-    math: index = p*n/100 - 1; integral -> arr[index]; else mean of arr[ceil]
-    and arr[ceil+1] unless ceil is the last element."""
+def percentile_rank(n: jnp.ndarray, p: int):
+    """The reference's percentile index math (util_methods.js:112-142) as
+    (1-indexed rank, take_pair): value = take_pair ? mean(v[rank], v[rank+1])
+    : v[rank]. Integer-exact; the single source shared by the sort path below
+    and the Pallas selection kernel (ops/pallas_kernels.py)."""
     pn = p * n  # int32
     is_int = (pn % 100) == 0
     idx_exact = pn // 100 - 1
     idx_ceil = (pn - 1) // 100  # ceil(pn/100 - 1) for non-integral pn/100
-
     last = n - 1
     idx1 = jnp.where(is_int | (n == 1), jnp.maximum(idx_exact, 0), idx_ceil)
     take_pair = (~is_int) & (n > 1) & (idx_ceil != last)
-    idx1 = jnp.clip(idx1, 0, sorted_vals.shape[-1] - 1)
-    idx2 = jnp.clip(jnp.where(take_pair, idx1 + 1, idx1), 0, sorted_vals.shape[-1] - 1)
+    return (idx1 + 1).astype(jnp.int32), take_pair
 
+
+def reference_percentile_sorted(sorted_vals: jnp.ndarray, n: jnp.ndarray, p: int) -> jnp.ndarray:
+    """Vectorized util_methods.js:112-142 over ``[..., K]`` ascending-sorted
+    arrays (NaN tail) with ``n`` valid entries per row: value at the
+    :func:`percentile_rank` rank, averaged with its successor on take_pair."""
+    rank, take_pair = percentile_rank(n, p)
+    idx1 = jnp.clip(rank - 1, 0, sorted_vals.shape[-1] - 1)
+    idx2 = jnp.clip(jnp.where(take_pair, idx1 + 1, idx1), 0, sorted_vals.shape[-1] - 1)
     v1 = jnp.take_along_axis(sorted_vals, idx1[..., None], axis=-1)[..., 0]
     v2 = jnp.take_along_axis(sorted_vals, idx2[..., None], axis=-1)[..., 0]
     out = jnp.where(take_pair, (v1 + v2) / 2.0, v1)
@@ -230,9 +240,28 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
     overflowed = stored < cnt
 
     window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
-    sorted_samples = jnp.sort(window_samples, axis=-1)  # NaN sorts to the end
-    per75 = reference_percentile_sorted(sorted_samples, stored, 75)
-    per95 = reference_percentile_sorted(sorted_samples, stored, 95)
+    impl = cfg.percentile_impl
+    if impl == "auto":
+        impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and cfg.dtype == jnp.float32
+            else "sort"
+        )
+    if impl == "pallas":
+        if cfg.dtype == jnp.float64:
+            # the kernel is f32-only; a silent downcast would break the f64
+            # reference-parity mode (auto never picks pallas for f64)
+            raise ValueError("percentile_impl='pallas' requires float32 (got float64)")
+        from .pallas_kernels import window_percentiles
+
+        per75, per95 = window_percentiles(
+            window_samples, stored, (75, 95),
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        sorted_samples = jnp.sort(window_samples, axis=-1)  # NaN sorts to the end
+        per75 = reference_percentile_sorted(sorted_samples, stored, 75)
+        per95 = reference_percentile_sorted(sorted_samples, stored, 95)
 
     tpm = cnt / (cfg.window_sz * cfg.interval_len_s / 60.0)  # stream_calc_stats.js:186
 

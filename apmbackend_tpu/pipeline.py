@@ -207,6 +207,7 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
         interval_len_s=int(calc.get("intervalLengthInSeconds", 10)),
         samples_per_bucket=int(eng.get("samplesPerBucket", 128)),
         dtype=dtype,
+        percentile_impl=str(eng.get("percentileImpl", "auto")),
     )
     suppressed_lags = {int(x) for x in acfg.get("suppressedLags", [])}
     lags = tuple(
