@@ -72,7 +72,17 @@ def build(runtime, *, tail: bool = True):
     manager = None
     if tail:
         native = cfg.get("nativeTailBinary")
-        if native and not os.path.exists(native):
+        if native == "auto":
+            # build the in-repo C++ tailer (native/tailer.cpp) on demand;
+            # falls back to Python threads when no toolchain is available
+            from ..native import tail_binary_path
+
+            native = tail_binary_path()
+            if native is None:
+                runtime.logger.warning(
+                    "nativeTailBinary=auto but native build unavailable; using Python tailers"
+                )
+        elif native and not os.path.exists(native):
             runtime.logger.warning(f"nativeTailBinary not found, using Python tailers: {native}")
             native = None
 

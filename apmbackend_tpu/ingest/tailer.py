@@ -95,14 +95,15 @@ class PyTailer:
             buf = ""
             inode = None
             while not self._stop.is_set():
-                if self.pause_file is not None and self.pause_file.exists():
-                    # hold position while paused (perl_tail.pl:36-41)
-                    time.sleep(self.poll_interval_s)
-                    continue
                 if fh is None:
+                    # open BEFORE honoring pause so the EOF anchor is
+                    # established at startup — lines written while paused must
+                    # be delivered after resume, not skipped
                     try:
                         fh = open(self.file_path, "r", encoding="utf-8", errors="replace")
                     except FileNotFoundError:
+                        # a file that appears later is all new content
+                        self.from_start = True
                         time.sleep(self.poll_interval_s)
                         continue
                     if not self.from_start:
@@ -112,6 +113,10 @@ class PyTailer:
                         inode = os.fstat(fh.fileno()).st_ino
                     except OSError:
                         inode = None
+                if self.pause_file is not None and self.pause_file.exists():
+                    # hold position while paused (perl_tail.pl:36-41)
+                    time.sleep(self.poll_interval_s)
+                    continue
                 try:
                     st = os.stat(self.file_path)
                     size, cur_inode = st.st_size, st.st_ino

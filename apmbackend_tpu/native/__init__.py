@@ -1,0 +1,151 @@
+"""Native (C++) runtime components + ctypes bindings.
+
+Sources live in ``native/`` at the repo root; this package builds them on
+demand with ``make`` (g++, no external deps) and exposes:
+
+- :func:`tail_binary_path` — the ``apm_tail`` per-file tailer binary
+  (perl_tail.pl role), consumed by ingest.tailer.NativeTailer/TailManager.
+- :class:`LineRing` — lock-free SPSC byte ring (native/ring.cpp): the
+  bounded host buffer between producers and the device step loop, with
+  full-ring push failure as the backpressure signal (queue.js:250-256 role).
+
+Everything degrades gracefully: with no compiler available the build
+functions return None and callers fall back to the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "native")
+_BUILD_LOCK = threading.Lock()
+_BUILD_RESULT: dict = {}
+
+
+def native_source_dir() -> str:
+    return os.path.abspath(_NATIVE_DIR)
+
+
+def ensure_built(*, quiet: bool = True, timeout_s: float = 45.0) -> Optional[str]:
+    """Run ``make`` in native/ once per process; returns the build dir or
+    None when the toolchain/sources are unavailable."""
+    with _BUILD_LOCK:
+        if "dir" in _BUILD_RESULT:
+            return _BUILD_RESULT["dir"]
+        src = native_source_dir()
+        result: Optional[str] = None
+        if os.path.isfile(os.path.join(src, "Makefile")):
+            try:
+                proc = subprocess.run(
+                    ["make", "-C", src],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    timeout=timeout_s,
+                )
+                if proc.returncode == 0:
+                    result = os.path.join(src, "build")
+                elif not quiet:
+                    raise RuntimeError(f"native build failed:\n{proc.stdout.decode()}")
+            except (OSError, subprocess.TimeoutExpired):
+                if not quiet:
+                    raise
+        _BUILD_RESULT["dir"] = result
+        return result
+
+
+def tail_binary_path() -> Optional[str]:
+    """Path to the apm_tail binary, building if needed; None if unavailable."""
+    build = ensure_built()
+    if build is None:
+        return None
+    path = os.path.join(build, "apm_tail")
+    return path if os.access(path, os.X_OK) else None
+
+
+_ring_lib = None
+
+
+def _load_ring_lib():
+    global _ring_lib
+    if _ring_lib is not None:
+        return _ring_lib
+    build = ensure_built()
+    if build is None:
+        return None
+    so = os.path.join(build, "libapmring.so")
+    if not os.path.isfile(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.apmring_create.restype = ctypes.c_void_p
+    lib.apmring_create.argtypes = [ctypes.c_uint64]
+    lib.apmring_destroy.argtypes = [ctypes.c_void_p]
+    lib.apmring_capacity.restype = ctypes.c_uint64
+    lib.apmring_capacity.argtypes = [ctypes.c_void_p]
+    lib.apmring_used.restype = ctypes.c_uint64
+    lib.apmring_used.argtypes = [ctypes.c_void_p]
+    lib.apmring_dropped.restype = ctypes.c_uint64
+    lib.apmring_dropped.argtypes = [ctypes.c_void_p]
+    lib.apmring_push.restype = ctypes.c_int
+    lib.apmring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.apmring_pop.restype = ctypes.c_int64
+    lib.apmring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    _ring_lib = lib
+    return lib
+
+
+class LineRing:
+    """SPSC byte-record ring over libapmring. One pushing thread, one popping
+    thread; ``push`` returning False = ring full = raise backpressure."""
+
+    def __init__(self, capacity_bytes: int = 1 << 22, *, max_record: int = 1 << 16):
+        lib = _load_ring_lib()
+        if lib is None:
+            raise RuntimeError("native ring unavailable (no toolchain?)")
+        self._lib = lib
+        self._ring = lib.apmring_create(ctypes.c_uint64(capacity_bytes))
+        if not self._ring:
+            raise MemoryError("apmring_create failed")
+        self._buf = ctypes.create_string_buffer(max_record)
+
+    def push(self, data: bytes) -> bool:
+        return bool(self._lib.apmring_push(self._ring, data, len(data)))
+
+    def pop(self) -> Optional[bytes]:
+        """One record, or None when empty. The pop-side buffer grows to fit
+        oversized records (SPSC: only the popping thread touches it)."""
+        n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
+        if n == 0:
+            return None
+        if n < 0:  # record larger than our buffer: grow and retry
+            self._buf = ctypes.create_string_buffer(int(-n))
+            n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
+            if n <= 0:
+                return None
+        return self._buf.raw[:n]
+
+    @property
+    def used_bytes(self) -> int:
+        return int(self._lib.apmring_used(self._ring))
+
+    @property
+    def dropped(self) -> int:
+        return int(self._lib.apmring_dropped(self._ring))
+
+    @property
+    def capacity(self) -> int:
+        return int(self._lib.apmring_capacity(self._ring))
+
+    def close(self) -> None:
+        if self._ring:
+            self._lib.apmring_destroy(self._ring)
+            self._ring = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

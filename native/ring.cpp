@@ -1,0 +1,143 @@
+// libapmring — lock-free SPSC byte ring for the host ingest path.
+//
+// Role: the bounded, double-buffer-friendly host ring that feeds parsed
+// records to the device step loop (SURVEY.md §7.3 "async dispatch +
+// double-buffered host ring") and stands in for the reference's
+// producer-side AMQP buffer + pause/drain contract (queue.js:245-263): a
+// full ring returns false from push — the producer's cue to raise the pause
+// file — and drains from the consumer side, after which pushes succeed again
+// (the 'drain' -> resume analog).
+//
+// Design: single-producer / single-consumer, C++11 acquire/release atomics,
+// no locks, no syscalls on the hot path. Records are length-prefixed
+// (u32 LE) byte blobs, contiguous in the ring; a record that would straddle
+// the wrap point is preceded by a SKIP sentinel so every record is
+// contiguous (memcpy-able straight into a parser/numpy buffer).
+//
+// C ABI for ctypes (apmbackend_tpu/native/ring.py). All functions are
+// thread-compatible under the SPSC contract: exactly one pushing thread,
+// exactly one popping thread.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+constexpr uint32_t kSkip = 0xFFFFFFFFu;  // wrap sentinel in the length slot
+
+struct Ring {
+    char* buf;
+    uint64_t capacity;  // bytes, power of two not required
+    alignas(64) std::atomic<uint64_t> head;  // consumer position (bytes, monotonic)
+    alignas(64) std::atomic<uint64_t> tail;  // producer position (bytes, monotonic)
+    alignas(64) std::atomic<uint64_t> dropped;  // failed pushes (observability)
+};
+
+inline uint64_t offset_of(const Ring* r, uint64_t pos) { return pos % r->capacity; }
+
+}  // namespace
+
+extern "C" {
+
+Ring* apmring_create(uint64_t capacity_bytes) {
+    if (capacity_bytes < 64) return nullptr;
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->buf = static_cast<char*>(malloc(capacity_bytes));
+    if (!r->buf) {
+        delete r;
+        return nullptr;
+    }
+    r->capacity = capacity_bytes;
+    r->head.store(0, std::memory_order_relaxed);
+    r->tail.store(0, std::memory_order_relaxed);
+    r->dropped.store(0, std::memory_order_relaxed);
+    return r;
+}
+
+void apmring_destroy(Ring* r) {
+    if (!r) return;
+    free(r->buf);
+    delete r;
+}
+
+uint64_t apmring_capacity(const Ring* r) { return r->capacity; }
+
+// Bytes currently queued (records + framing). Approximate under concurrency.
+uint64_t apmring_used(const Ring* r) {
+    return r->tail.load(std::memory_order_acquire) - r->head.load(std::memory_order_acquire);
+}
+
+uint64_t apmring_dropped(const Ring* r) { return r->dropped.load(std::memory_order_relaxed); }
+
+// Push one record. Returns 1 on success, 0 if the ring is full (caller
+// should pause the source — the queue.js:250-256 'pause' analog).
+int apmring_push(Ring* r, const void* data, uint32_t len) {
+    const uint64_t need = 4u + (uint64_t)len;
+    const uint64_t tail = r->tail.load(std::memory_order_relaxed);
+    const uint64_t head = r->head.load(std::memory_order_acquire);
+    uint64_t off = offset_of(r, tail);
+    uint64_t to_end = r->capacity - off;
+
+    uint64_t framed = need;
+    bool skip = false;
+    if (to_end < 4) {
+        // not even room for a length slot before the wrap: implicit skip
+        framed = to_end + need;
+        skip = true;
+    } else if (to_end < need) {
+        // length slot fits but payload would straddle: SKIP sentinel + wrap
+        framed = to_end + need;
+        skip = true;
+    }
+    if (framed > r->capacity - (tail - head)) {
+        r->dropped.fetch_add(1, std::memory_order_relaxed);
+        return 0;
+    }
+    uint64_t wpos = tail;
+    if (skip) {
+        if (to_end >= 4) {
+            memcpy(r->buf + off, &kSkip, 4);
+        }
+        // bytes between off and capacity are dead; consumer skips via sentinel
+        // (or via the <4 remainder rule)
+        wpos = tail + to_end;
+        off = 0;
+    }
+    memcpy(r->buf + off, &len, 4);
+    memcpy(r->buf + off + 4, data, len);
+    r->tail.store(wpos + need, std::memory_order_release);
+    return 1;
+}
+
+// Pop one record into out (max_len bytes). Returns the record length,
+// 0 if the ring is empty, or -(needed) if out is too small (record stays).
+int64_t apmring_pop(Ring* r, void* out, uint32_t max_len) {
+    uint64_t head = r->head.load(std::memory_order_relaxed);
+    const uint64_t tail = r->tail.load(std::memory_order_acquire);
+    if (head == tail) return 0;
+    uint64_t off = offset_of(r, head);
+    uint64_t to_end = r->capacity - off;
+    if (to_end < 4) {  // implicit wrap (producer couldn't fit a length slot)
+        head += to_end;
+        off = 0;
+    } else {
+        uint32_t len_or_skip;
+        memcpy(&len_or_skip, r->buf + off, 4);
+        if (len_or_skip == kSkip) {  // explicit wrap sentinel
+            head += to_end;
+            off = 0;
+        }
+    }
+    uint32_t len;
+    memcpy(&len, r->buf + off, 4);
+    if (len > max_len) return -(int64_t)len;
+    memcpy(out, r->buf + off + 4, len);
+    r->head.store(head + 4u + len, std::memory_order_release);
+    return (int64_t)len;
+}
+
+}  // extern "C"

@@ -1,0 +1,173 @@
+// apm_tail — robust single-file tailer (perl_tail.pl role).
+//
+// Usage: apm_tail <file> <pause_file> [--from-start] [--poll-ms N]
+//
+// Follows appends to <file> and prints complete lines to stdout. Contract
+// (mirrors the reference's patched File::Tail, perl_tail.pl:25-41, and the
+// Python PyTailer in apmbackend_tpu/ingest/tailer.py):
+//  - start at EOF unless --from-start;
+//  - while <pause_file> exists, spin-sleep holding the read position — the
+//    pause file IS the cross-process backpressure signal
+//    (stream_parse_transactions.js:834-897);
+//  - on truncation (size < pos) or inode swap (rename rotation), drain the
+//    old handle, then reopen the new file from the start. Works on network
+//    mounts: decisions are made from pathname stat size first, inode only as
+//    a secondary rotation hint (the reference removed File::Tail's inode
+//    checks for NFS; we keep a conservative version: inode change matters
+//    only when the pathname stat succeeds);
+//  - a vanished file is not fatal (wait for it to reappear);
+//  - exit 0 on SIGTERM/SIGINT, nonzero on unrecoverable I/O errors.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <csignal>
+#include <ctime>
+#include <string>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+
+void on_signal(int) { g_stop = 1; }
+
+struct Tail {
+    std::string path;
+    std::string pause_path;
+    int fd = -1;
+    off_t pos = 0;
+    ino_t inode = 0;
+    bool from_start = false;
+    int poll_ms = 200;
+    std::string carry;  // partial line across reads
+
+    bool paused() const { return ::access(pause_path.c_str(), F_OK) == 0; }
+
+    void sleep_poll() const {
+        struct timespec ts;
+        ts.tv_sec = poll_ms / 1000;
+        ts.tv_nsec = (long)(poll_ms % 1000) * 1000000L;
+        nanosleep(&ts, nullptr);
+    }
+
+    bool open_file() {
+        fd = ::open(path.c_str(), O_RDONLY);
+        if (fd < 0) return false;
+        struct stat st;
+        if (fstat(fd, &st) != 0) {
+            ::close(fd);
+            fd = -1;
+            return false;
+        }
+        inode = st.st_ino;
+        pos = from_start ? 0 : st.st_size;
+        if (lseek(fd, pos, SEEK_SET) < 0) {
+            ::close(fd);
+            fd = -1;
+            return false;
+        }
+        return true;
+    }
+
+    // read everything currently available from fd; emit complete lines
+    void drain() {
+        char buf[65536];  // maxbuf parity: 100 KB-ish chunks (perl_tail.pl:25-32)
+        for (;;) {
+            ssize_t n = ::read(fd, buf, sizeof(buf));
+            if (n < 0) {
+                if (errno == EINTR) continue;
+                return;  // treat as temporarily unreadable
+            }
+            if (n == 0) return;
+            pos += n;
+            size_t start = 0;
+            for (ssize_t i = 0; i < n; i++) {
+                if (buf[i] == '\n') {
+                    carry.append(buf + start, (size_t)i - start);
+                    fwrite(carry.data(), 1, carry.size(), stdout);
+                    fputc('\n', stdout);
+                    carry.clear();
+                    start = (size_t)i + 1;
+                }
+            }
+            carry.append(buf + start, (size_t)n - start);
+        }
+    }
+
+    int run() {
+        while (!g_stop) {
+            if (fd < 0) {
+                // open BEFORE honoring pause so the EOF anchor is established
+                // at startup — lines written while paused must be delivered
+                // after resume, not skipped
+                if (!open_file()) {
+                    // the file doesn't exist yet: when it appears it is all
+                    // new content, so read it from the beginning
+                    from_start = true;
+                    sleep_poll();
+                    continue;
+                }
+            }
+            if (paused()) {  // hold position (perl_tail.pl:36-41)
+                sleep_poll();
+                continue;
+            }
+            struct stat st;
+            bool have_path_stat = (::stat(path.c_str(), &st) == 0);
+            if (have_path_stat && (st.st_size < pos || st.st_ino != inode)) {
+                drain();  // rescue anything written pre-rotation
+                ::close(fd);
+                fd = -1;
+                from_start = true;  // replacement file: read from beginning
+                continue;
+            }
+            off_t before = pos;
+            drain();
+            fflush(stdout);
+            if (pos == before) sleep_poll();
+        }
+        if (fd >= 0) {
+            // final drain so a fast writer's last lines aren't lost on stop
+            drain();
+            if (!carry.empty()) {
+                fwrite(carry.data(), 1, carry.size(), stdout);
+                fputc('\n', stdout);
+            }
+            fflush(stdout);
+            ::close(fd);
+        }
+        return 0;
+    }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr, "usage: %s <file> <pause_file> [--from-start] [--poll-ms N]\n", argv[0]);
+        return 2;
+    }
+    Tail t;
+    t.path = argv[1];
+    t.pause_path = argv[2];
+    for (int i = 3; i < argc; i++) {
+        if (strcmp(argv[i], "--from-start") == 0) {
+            t.from_start = true;
+        } else if (strcmp(argv[i], "--poll-ms") == 0 && i + 1 < argc) {
+            t.poll_ms = atoi(argv[++i]);
+            if (t.poll_ms < 1) t.poll_ms = 1;
+        } else {
+            fprintf(stderr, "unknown arg: %s\n", argv[i]);
+            return 2;
+        }
+    }
+    signal(SIGTERM, on_signal);
+    signal(SIGINT, on_signal);
+    signal(SIGPIPE, SIG_DFL);  // die when the consumer goes away (fail-fast)
+    return t.run();
+}

@@ -101,3 +101,37 @@ def test_rename_rotation_reopens(tmp_path):
         fh.write("after-rotate\n")
     assert wait_until(lambda: "after-rotate" in lines)
     t.stop()
+
+
+def test_paused_at_start_still_anchors_eof(tmp_path):
+    # pause exists before the tailer starts: the file must still be opened
+    # (EOF anchor established) so lines written during the pause are
+    # delivered on resume, not skipped
+    p = tmp_path / "pre.log"
+    p.write_text("pre-existing\n")
+    pause = PauseFile(str(tmp_path / "PAUSE"))
+    pause.create()
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), pause, poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.15)
+    with open(p, "a") as fh:
+        fh.write("during-pause\n")
+    time.sleep(0.15)
+    assert lines == []
+    pause.delete()
+    assert wait_until(lambda: lines == ["during-pause"]), lines
+    t.stop()
+
+
+def test_late_appearing_file_read_from_start(tmp_path):
+    # the file does not exist when the tail starts; when it appears it is all
+    # new content and must be read from the beginning
+    p = tmp_path / "late.log"
+    lines = []
+    t = PyTailer(str(p), lambda f, l: lines.append(l), poll_interval_s=0.02)
+    t.start()
+    time.sleep(0.15)
+    p.write_text("l1\nl2\n")
+    assert wait_until(lambda: lines == ["l1", "l2"]), lines
+    t.stop()
