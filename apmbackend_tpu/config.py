@@ -266,7 +266,10 @@ _DEFAULT_CONFIG: dict = {
         "defaultServerName": None,
         # optional path to the native C++ tail binary (native/apm_tail);
         # Python tailer threads are used when absent
-        "nativeTailBinary": None,
+        # per-file tail process: "auto" builds native/tailer.cpp via make and
+        # spawns it per file (perl_tail.pl role); an explicit path uses that
+        # binary; None uses in-process Python tail threads
+        "nativeTailBinary": "auto",
     },
     "streamCalcStats": {
         "logFilePrefix": "stream_calc_stats",
@@ -365,8 +368,12 @@ _DEFAULT_CONFIG: dict = {
             "enabled": False,
             "alpha": 0.05,  # EW smoothing factor for mean/covariance
             "threshold": 3.0,  # signal at normalized Mahalanobis > threshold
-            "warmup": 10,  # polls before a host may signal
+            "warmup": 22,  # polls before a host may signal; keep >= 2x feature count
             "influence": 0.25,  # damping for signalling samples (1 = none)
+            # baseline snapshot (None disables); avoids a full re-warmup
+            # (~warmup polls of blindness) on every module restart
+            "resumeFileFullPath": None,
+            "resumeFileSaveFrequencyInSeconds": 60,
         },
     },
     "grafana": {

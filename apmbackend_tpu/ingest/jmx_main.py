@@ -24,7 +24,7 @@ def _make_detector(cfg: dict, logger):
         n_features=mv.JMX_FEATURE_COUNT,
         alpha=float(mv_cfg.get("alpha", 0.05)),
         threshold=float(mv_cfg.get("threshold", 3.0)),
-        warmup=int(mv_cfg.get("warmup", 10)),
+        warmup=int(mv_cfg.get("warmup", 2 * mv.JMX_FEATURE_COUNT)),
         influence=float(mv_cfg.get("influence", 0.25)),
     )
     return mv.MvDriver(spec, logger=logger)
@@ -43,6 +43,23 @@ def build(runtime) -> JmxPoller:
     # the detector — its EW baselines restart, like the z-score stale-lag purge
     # on reload, stream_calc_z_score.js:370-371)
     det = {"driver": _make_detector(cfg, runtime.logger), "block": cfg.get("multivariateDetector")}
+
+    # -- detector resume (§5.4 parity: periodic snapshot + load on boot) -----
+    mv_block = cfg.get("multivariateDetector") or {}
+    resume_path = mv_block.get("resumeFileFullPath")
+    if det["driver"] is not None and resume_path:
+        if det["driver"].load_resume(resume_path):
+            runtime.logger.info(f"JMX detector baselines resumed from {resume_path}")
+
+        def save_detector():
+            if det["driver"] is not None:
+                det["driver"].save_resume(resume_path)
+
+        runtime.every(
+            int(mv_block.get("resumeFileSaveFrequencyInSeconds", 60)),
+            save_detector, name="jmx-detector-resume",
+        )
+        runtime.on_exit(save_detector)
 
     def on_reload(new_cfg: dict) -> None:
         block = new_cfg.get("pullJvmStats", {})

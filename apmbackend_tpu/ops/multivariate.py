@@ -13,10 +13,13 @@ smoothed z-score (stream_calc_z_score.js:66-104):
   (incremental West 1979, matching ops/ewma.py): ``delta = x - mean``,
   ``mean += alpha*delta``, ``cov = (1-alpha)*(cov + alpha*outer(delta, delta))``.
 - score: ``sqrt(d' (C + ridge*diag(C) + eps*I)^-1 d / m)`` over the ``m``
-  observed dims — the *relative* ridge keeps the score invariant to per-metric
-  units (heap bytes vs sysload), and dividing by ``m`` makes one threshold work
-  across hosts reporting different metric subsets. Under normality
-  ``m*score^2 ~ chi2(m)``, so ``threshold=3`` is roughly a per-dim 3-sigma gate.
+  observed dims, where ``C`` is the covariance bias-corrected by
+  ``1/(1-(1-alpha)^n)`` (the EW estimate converges from below; uncorrected it
+  over-signals right after warmup). The *relative* ridge keeps the score
+  invariant to per-metric units (heap bytes vs sysload), and dividing by ``m``
+  makes one threshold work across hosts reporting different metric subsets.
+  Under normality ``m*score^2 ~ chi2(m)``, so ``threshold=3`` is roughly a
+  per-dim 3-sigma gate.
 - quirk parity with the z-score channel: warm-up gating on update count (the
   lag-length analog, stream_calc_z_score.js:75), NaN dims are masked (a down
   collector must not poison the baseline), and signalling samples enter the
@@ -45,7 +48,11 @@ class MvSpec(NamedTuple):
     n_features: int
     alpha: float = 0.05  # EW smoothing factor
     threshold: float = 3.0  # signal at normalized Mahalanobis > threshold
-    warmup: int = 10  # min updates before signalling
+    # min updates before signalling. A covariance over M dims needs well over
+    # M samples to be full-rank and stable — keep warmup >= ~2*n_features (the
+    # reference's analog waits for the FULL lag window before signalling,
+    # stream_calc_z_score.js:75)
+    warmup: int = 24
     ridge: float = 0.05  # relative diagonal regularization
     eps: float = 1e-9  # absolute regularization floor
     influence: float = 0.25  # damping for signalling samples (1 = none)
@@ -92,7 +99,13 @@ def step(
     seeded = ~jnp.isnan(state.mean)  # [H, M] per-dim
     obs = valid[:, None] & ~jnp.isnan(x)  # [H, M]
     live = obs & seeded  # dims that update the baseline this step
-    diag = jnp.diagonal(state.cov, axis1=1, axis2=2)  # [H, M]
+    # EW covariance starts at 0 and converges from below (var after n updates
+    # ~ (1-(1-alpha)^n)*sigma^2), which inflates early Mahalanobis scores and
+    # over-signals right after warmup. Score against the bias-corrected
+    # covariance (Adam-style 1/(1-(1-alpha)^n)); state keeps the raw EW form.
+    bias = 1.0 - (1.0 - spec.alpha) ** jnp.maximum(state.count, 1).astype(dtype)  # [H]
+    cov_c = state.cov / bias[:, None, None]
+    diag = jnp.diagonal(cov_c, axis1=1, axis2=2)  # [H, M]
     var_floor = jnp.square(spec.std_floor_frac * (jnp.abs(jnp.where(seeded, state.mean, 0.0)) + 1.0))
     scorable = live & (diag > var_floor)  # dims that enter the score
     m_obs = jnp.sum(scorable, axis=1)  # [H]
@@ -103,7 +116,7 @@ def step(
     # well-posed without influencing observed dims (their d is already 0)
     eye = jnp.eye(M, dtype=dtype)
     mask2d = scorable[:, :, None] & scorable[:, None, :]
-    C = jnp.where(mask2d, state.cov, 0.0) + eye[None] * jnp.where(scorable, reg, 1.0)[:, :, None]
+    C = jnp.where(mask2d, cov_c, 0.0) + eye[None] * jnp.where(scorable, reg, 1.0)[:, :, None]
     y = jnp.linalg.solve(C, d[:, :, None])[:, :, 0]  # [H, M]
     maha2 = jnp.sum(d * y, axis=1)  # [H]
 
@@ -255,3 +268,73 @@ class MvDriver:
 
     def _np_dtype(self):
         return np.float64 if self.dtype == jnp.float64 else np.float32
+
+    # -- checkpoint / resume (§5.4 parity with the engine's resume files) ----
+    def save_resume(self, path: str) -> None:
+        """Atomic snapshot of baselines + host registry (tmp + rename)."""
+        import os
+        import tempfile
+
+        arrays = {
+            "mean": np.asarray(self.state.mean),
+            "cov": np.asarray(self.state.cov),
+            "count": np.asarray(self.state.count),
+            # spec fields that change the meaning/shape of the state: a
+            # mismatch on load invalidates the snapshot
+            "spec": np.array([self.spec.n_features, self.spec.alpha], np.float64),
+            "servers": np.array(
+                sorted(self.rows, key=self.rows.get), dtype=object
+            ),
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                np.savez_compressed(fh, **arrays)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def load_resume(self, path: str) -> bool:
+        """Restore baselines; a corrupt/mismatched snapshot means start
+        fresh (False), never a crash or half-mutated driver."""
+        import os
+
+        if not os.path.exists(path):
+            return False
+        try:
+            with np.load(path, allow_pickle=True) as npz:
+                data = {name: npz[name] for name in npz.files}
+            n_features, alpha = data["spec"]
+            if int(n_features) != self.spec.n_features or float(alpha) != self.spec.alpha:
+                raise ValueError("spec mismatch")
+            servers = [str(s) for s in data["servers"].tolist()]
+            mean, cov, count = data["mean"], data["cov"], data["count"]
+            if mean.shape[1] != self.spec.n_features or len(servers) > mean.shape[0]:
+                raise ValueError("shape mismatch")
+        except Exception:
+            if self.logger:
+                self.logger.error(f"Could not load JMX detector snapshot (starting fresh): {path}")
+            return False
+        while len(servers) > self.capacity:
+            self._grow()
+        H = self.capacity
+
+        def pad(a):
+            if a.shape[0] < H:
+                width = [(0, H - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+                fill = np.nan if np.issubdtype(a.dtype, np.floating) else 0
+                return np.pad(a, width, constant_values=fill)
+            return a[:H]
+
+        self.rows = {s: i for i, s in enumerate(servers)}
+        dt = self._np_dtype()
+        self.state = MvState(
+            mean=jnp.asarray(pad(mean).astype(dt)),
+            cov=jnp.asarray(pad(cov).astype(dt)),
+            count=jnp.asarray(pad(count).astype(np.int32)),
+        )
+        return True

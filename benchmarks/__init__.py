@@ -1,0 +1,26 @@
+"""Runnable benchmarks — one per BASELINE.json config.
+
+Each module exposes ``run(quick=False, **overrides) -> dict`` returning the
+standard result line ``{"metric", "value", "unit", "vs_baseline", "details"}``.
+``python -m benchmarks.run --all`` executes the suite; ``bench.py`` at the repo
+root stays the driver-facing headline benchmark (a superset of `rolling` at
+production scale).
+
+| name        | BASELINE.json configs[i] |
+|-------------|--------------------------|
+| replay      | 0: WildFly log replay -> parser -> z-score (1 JVM) |
+| rolling     | 1: multi-service rolling baseline (100 services) |
+| jmx         | 2: JMX + datasource + VM-CPU multivariate batch |
+| podshard    | 3: pod-sharded 10k-service z-score, ICI-allreduced baselines |
+| multiwindow | 4: multi-window seasonal/EWMA baselining + alert eval on device |
+"""
+
+from . import bench_jmx, bench_multiwindow, bench_podshard, bench_replay, bench_rolling
+
+REGISTRY = {
+    "replay": bench_replay.run,
+    "rolling": bench_rolling.run,
+    "jmx": bench_jmx.run,
+    "podshard": bench_podshard.run,
+    "multiwindow": bench_multiwindow.run,
+}

@@ -1,0 +1,72 @@
+"""BASELINE.json configs[2]: JMX + datasource + VM-CPU multivariate batch.
+
+The pull_jvm_stats feed scaled to a fleet: per-host JMX feature vectors
+(datasource pool, heap/metaspace fractions, sysload, class/thread counts,
+bean pool) scored by the device multivariate detector (EW mean/covariance +
+Mahalanobis, ops/multivariate.py) as one [hosts, features] batch per poll.
+Reports hosts scored per second; the anchor is the reference's poll rate
+(2 hosts / 60 s — pull_jvm_stats.js + config/apm_config.json:239,245 — and it
+computes no detection at all).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .common import REFERENCE_JMX_HOST_RATE, latency_stats_ms, result
+
+
+def run(quick: bool = False, *, hosts: int = 1024, polls: int = 50) -> dict:
+    import jax
+
+    from apmbackend_tpu.ops import multivariate as mv
+
+    if quick:
+        hosts, polls = 16, 5
+
+    spec = mv.MvSpec(n_features=mv.JMX_FEATURE_COUNT, alpha=0.05, threshold=3.0,
+                     warmup=2 * mv.JMX_FEATURE_COUNT)
+    state = mv.init_state(hosts, spec)
+    step = jax.jit(mv.step, static_argnums=1)
+
+    rng = np.random.RandomState(0)
+    base = 100 + 50 * rng.rand(hosts, spec.n_features)
+
+    def batch():
+        return (base + rng.randn(hosts, spec.n_features)).astype(np.float32)
+
+    valid = np.ones(hosts, bool)
+    for _ in range(spec.warmup + 4):  # past detector warmup + compile
+        res, state = step(state, spec, batch(), valid)
+    jax.block_until_ready(res.score)
+
+    lat = []
+    signals = 0
+    t_start = time.perf_counter()
+    for _ in range(polls):
+        t0 = time.perf_counter()
+        res, state = step(state, spec, batch(), valid)
+        signals += int(np.asarray(res.signal).sum())
+        lat.append(time.perf_counter() - t0)
+    wall = time.perf_counter() - t_start
+
+    hosts_per_sec = hosts * polls / sum(lat)
+    return result(
+        "jmx_multivariate_throughput",
+        hosts_per_sec,
+        "hosts/sec",
+        REFERENCE_JMX_HOST_RATE,
+        {
+            "config": "BASELINE.json configs[2]",
+            "device": str(jax.devices()[0]),
+            "hosts": hosts,
+            "features": spec.n_features,
+            "polls": polls,
+            "false_signals": signals,
+            "poll_latency": latency_stats_ms(lat),
+            "wall_s": round(wall, 3),
+            "anchor": "reference polls 2 hosts/60s with no detector",
+        },
+    )

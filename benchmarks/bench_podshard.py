@@ -1,0 +1,100 @@
+"""BASELINE.json configs[3]: pod-sharded 10k-service z-score detection.
+
+The full fused step shard_mapped over a service-axis mesh of every visible
+device, with fleet rollup baselines all-reduced over ICI (jax.lax.psum).
+10,240 service rows (10k padded to the mesh), lags 360 + 8640. Reports fleet
+metrics/sec against the whole-pod north star (1M metrics/sec). On a single
+chip the mesh is 1 wide and this degenerates to the headline bench; under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` it exercises the real
+8-way sharded program.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .common import POD_NORTH_STAR, latency_stats_ms, result
+
+
+def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_per_shard: int = 2048) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from apmbackend_tpu.parallel import (
+        make_mesh,
+        make_sharded_ingest,
+        make_sharded_tick,
+        route_batch,
+        shard_rows,
+    )
+    from apmbackend_tpu.pipeline import make_demo_engine
+
+    n_dev = len(jax.devices())
+    if quick:
+        services, ticks, batch_per_shard = 16 * n_dev, 4, 64
+
+    capacity = ((services + n_dev - 1) // n_dev) * n_dev
+    lags = [(4, 20.0, 0.1), (8, 15.0, 0.0)] if quick else [(360, 20.0, 0.1), (8640, 15.0, 0.0)]
+    cfg, state, params = make_demo_engine(capacity, 32 if quick else 64, lags)
+    mesh = make_mesh(n_dev)
+    tick = make_sharded_tick(mesh, cfg)
+    ingest = make_sharded_ingest(mesh, cfg)
+    state = shard_rows(state, mesh)
+    params = shard_rows(params, mesh)
+
+    rng = np.random.RandomState(0)
+    label = 170_000_000
+    B = batch_per_shard * n_dev
+
+    def routed(lbl):
+        rows = rng.randint(0, services, B).astype(np.int32)
+        elaps = (200 + 50 * rng.rand(B)).astype(np.float32)
+        r, l, e, v, _dropped = route_batch(
+            rows, np.full(B, lbl, np.int32), elaps, np.ones(B, bool),
+            capacity=capacity, n_shards=n_dev, batch_per_shard=batch_per_shard,
+        )
+        return r, l, e, v
+
+    for _ in range(3):  # warmup/compile
+        label += 1
+        em, rollup, state = tick(state, jnp.int32(label), params)
+        jax.block_until_ready(em.tpm)
+        state = ingest(state, *routed(label))
+    jax.block_until_ready(state.stats.counts)
+
+    lat = []
+    t_start = time.perf_counter()
+    for _ in range(ticks):
+        label += 1
+        t0 = time.perf_counter()
+        em, rollup, state = tick(state, jnp.int32(label), params)
+        # fleet view must reach the host: rollup + trigger masks
+        _ = int(rollup.total_tx)
+        _ = [np.asarray(l.trigger) for l in em.lags]
+        lat.append(time.perf_counter() - t0)
+        state = ingest(state, *routed(label))
+    jax.block_until_ready(state.stats.counts)
+    wall = time.perf_counter() - t_start
+
+    metrics_per_tick = capacity * 3 * len(cfg.lags)
+    throughput = metrics_per_tick * ticks / sum(lat)
+    return result(
+        "podshard_fleet_throughput",
+        throughput,
+        "metrics/sec",
+        POD_NORTH_STAR,
+        {
+            "config": "BASELINE.json configs[3]",
+            "devices": n_dev,
+            "device0": str(jax.devices()[0]),
+            "services": services,
+            "capacity": capacity,
+            "lags": [spec.lag for spec in cfg.lags],
+            "ticks": ticks,
+            "tick_latency": latency_stats_ms(lat),
+            "wall_s": round(wall, 3),
+            "note": "ICI-allreduced FleetRollup fetched to host every tick",
+        },
+    )

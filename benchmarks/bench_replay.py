@@ -1,0 +1,80 @@
+"""BASELINE.json configs[0]: WildFly log replay -> parser -> z-score (1 JVM).
+
+End-to-end host+device slice: synthetic WildFly fixture logs (SOAP-correlated
+EJB timings, standard CommonTiming pairs, audit trails) are replayed through
+the transaction parser into the fused device pipeline (stats -> z-score ->
+alert eval). Reports transactions/sec through the WHOLE path; the anchor is
+the reference's observed prod record rate (~76 records/sec,
+stream_insert_db.js:3-4).
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+
+from .common import REFERENCE_FULLSTAT_RATE, result
+
+
+def run(quick: bool = False, *, n_transactions: int = 20000, n_services: int = 24) -> dict:
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.ingest.parser import TransactionParser
+    from apmbackend_tpu.ingest.replay import ReplayDriver, write_fixture_logs
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    if quick:
+        n_transactions, n_services = 300, 4
+
+    services = tuple(f"svc{i:03d}" for i in range(n_services - 1)) + ("Provider[risk]",)
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 64
+    cfg["tpuEngine"]["samplesPerBucket"] = 64
+
+    stats_seen = [0]
+    fullstats_seen = [0]
+    driver = PipelineDriver(
+        cfg,
+        on_stat=lambda s: stats_seen.__setitem__(0, stats_seen[0] + 1),
+        on_fullstat=lambda f: fullstats_seen.__setitem__(0, fullstats_seen[0] + 1),
+        micro_batch_size=4096,
+    )
+    tx_count = [0]
+
+    def on_record(tx, insert_to_db):
+        # Provider/audit rows go only to db in the reference split
+        # (stream_parse_transactions design notes: outQueue vs dbQueue)
+        tx_count[0] += 1
+        if not insert_to_db:
+            driver.feed(tx)
+
+    parser = TransactionParser(on_record)
+    replay = ReplayDriver(parser)
+
+    with tempfile.TemporaryDirectory() as d:
+        paths = write_fixture_logs(
+            d, n_transactions=n_transactions, services=services, seed=7
+        )
+        t0 = time.perf_counter()
+        lines = replay.feed_dir(d)
+        replay.finish()
+        driver.flush()
+        elapsed = time.perf_counter() - t0
+
+    tx_per_sec = tx_count[0] / elapsed
+    return result(
+        "replay_end_to_end_throughput",
+        tx_per_sec,
+        "tx/sec",
+        REFERENCE_FULLSTAT_RATE,
+        {
+            "config": "BASELINE.json configs[0]",
+            "lines": lines,
+            "lines_per_sec": round(lines / elapsed, 1),
+            "transactions": tx_count[0],
+            "stat_entries": stats_seen[0],
+            "fullstat_entries": fullstats_seen[0],
+            "log_files": len(paths),
+            "wall_s": round(elapsed, 3),
+            "anchor": "reference prod record rate ~76/s (stream_insert_db.js:3-4)",
+        },
+    )

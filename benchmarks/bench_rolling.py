@@ -1,0 +1,76 @@
+"""BASELINE.json configs[1]: multi-service rolling baseline, 100 services.
+
+The stream_calc_stats role at the reference's real key scale: 100 services'
+elapsed-time buckets ingested per 10 s interval, windowed TPM/avg/p75/p95 plus
+one-lag z-score baselining per tick. Reports metrics/sec/chip against the
+per-chip north star.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .common import PER_CHIP_NORTH_STAR, latency_stats_ms, result
+
+
+def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tick: int = 4096) -> dict:
+    import jax
+
+    from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
+
+    if quick:
+        ticks, tx_per_tick = 5, 256
+
+    capacity = 128  # 100 live rows padded to the power-of-two tier
+    cfg, state, params = make_demo_engine(capacity, 64, [(360, 20.0, 0.1)])
+    tick = jax.jit(engine_tick, static_argnums=1)
+    ingest = jax.jit(engine_ingest, static_argnums=1)
+
+    rng = np.random.RandomState(0)
+    label = 170_000_000
+
+    def batch(lbl):
+        rows = rng.randint(0, services, tx_per_tick).astype(np.int32)
+        labels = np.full(tx_per_tick, lbl, np.int32)
+        elaps = (200 + 50 * rng.rand(tx_per_tick)).astype(np.float32)
+        return rows, labels, elaps, np.ones(tx_per_tick, bool)
+
+    for _ in range(3):  # warmup/compile
+        label += 1
+        em, state = tick(state, cfg, label, params)
+        jax.block_until_ready(em.tpm)
+        state = ingest(state, cfg, *batch(label))
+    jax.block_until_ready(state.stats.counts)
+
+    lat = []
+    t_start = time.perf_counter()
+    for _ in range(ticks):
+        label += 1
+        t0 = time.perf_counter()
+        em, state = tick(state, cfg, label, params)
+        jax.block_until_ready(em.lags[0].trigger)
+        lat.append(time.perf_counter() - t0)
+        state = ingest(state, cfg, *batch(label))
+    jax.block_until_ready(state.stats.counts)
+    wall = time.perf_counter() - t_start
+
+    metrics_per_tick = capacity * 3 * len(cfg.lags)
+    throughput = metrics_per_tick * ticks / sum(lat)
+    return result(
+        "rolling_baseline_throughput",
+        throughput,
+        "metrics/sec/chip",
+        PER_CHIP_NORTH_STAR,
+        {
+            "config": "BASELINE.json configs[1]",
+            "device": str(jax.devices()[0]),
+            "services": services,
+            "capacity": capacity,
+            "ticks": ticks,
+            "tx_per_tick": tx_per_tick,
+            "tick_latency": latency_stats_ms(lat),
+            "wall_s": round(wall, 3),
+        },
+    )

@@ -1,0 +1,38 @@
+"""Benchmark suite runner: ``python -m benchmarks.run [--config NAME] [--all]``.
+
+Prints one JSON result line per benchmark (same schema as bench.py). Use
+``--quick`` for a smoke-sized pass (CI / CPU).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    from . import REGISTRY
+
+    ap = argparse.ArgumentParser(prog="benchmarks.run")
+    ap.add_argument("--config", choices=sorted(REGISTRY), action="append",
+                    help="benchmark(s) to run (default: --all)")
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--quick", action="store_true", help="smoke-sized shapes")
+    args = ap.parse_args(argv)
+
+    names = args.config or sorted(REGISTRY)
+    failed = 0
+    for name in names:
+        try:
+            res = REGISTRY[name](quick=args.quick)
+            print(json.dumps(res), flush=True)
+        except Exception as e:  # one failing bench must not hide the others
+            failed += 1
+            print(json.dumps({"metric": name, "error": f"{type(e).__name__}: {e}"}),
+                  file=sys.stderr, flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

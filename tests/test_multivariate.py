@@ -113,6 +113,25 @@ class TestStep:
         drift_damped = abs(float(s_damped.mean[0, 0]) - 50.0)
         assert drift_damped < drift_full / 10
 
+    def test_bias_corrected_early_scores(self):
+        # right after a short warmup the EW covariance is far below the true
+        # variance; bias correction must keep iid-noise scores below the
+        # threshold instead of mass false-signaling
+        spec = make_spec(n_features=8, warmup=16, alpha=0.05, threshold=3.0)
+        rng = np.random.RandomState(7)
+        state = mv.init_state(16, spec, jnp.float64)
+        signals = 0
+        scored = 0
+        for _ in range(24):
+            x = 100 + rng.randn(16, 8)
+            res, state = mv.step(state, spec, x, np.ones(16, bool))
+            sig = np.asarray(res.signal)
+            score = np.asarray(res.score)
+            signals += int(sig.sum())
+            scored += int(np.sum(~np.isnan(score)))
+        assert scored > 0
+        assert signals <= scored * 0.05  # ~zero false positives on iid noise
+
     def test_constant_dim_does_not_false_alarm(self):
         # a metric constant for 100 polls collapses its EW variance; the next
         # +-1 blip must NOT divide by the eps floor and signal (std-floor gate,
@@ -197,3 +216,39 @@ class TestMvDriver:
     def test_empty_feed(self):
         d = mv.MvDriver(make_spec(n_features=mv.JMX_FEATURE_COUNT))
         assert d.feed([]) == []
+
+    def test_resume_roundtrip(self, tmp_path):
+        spec = make_spec(n_features=mv.JMX_FEATURE_COUNT, warmup=3, alpha=0.1)
+        d = mv.MvDriver(spec, capacity=2)
+        rng = np.random.RandomState(8)
+        for _ in range(6):
+            d.feed([make_entry(server=s, sys_load=1.5 + 0.1 * rng.randn())
+                    for s in ("jvm1", "jvm2", "jvm3")])
+        path = str(tmp_path / "mv.npz")
+        d.save_resume(path)
+
+        d2 = mv.MvDriver(spec, capacity=2)
+        assert d2.load_resume(path)
+        assert d2.rows == d.rows
+        np.testing.assert_allclose(np.asarray(d2.state.mean), np.asarray(d.state.mean))
+        np.testing.assert_allclose(np.asarray(d2.state.cov), np.asarray(d.state.cov))
+        # resumed driver keeps scoring without re-warmup
+        out = d2.feed([make_entry(server="jvm1")])
+        assert not math.isnan(out[0]["score"])
+
+    def test_resume_spec_mismatch_starts_fresh(self, tmp_path):
+        spec = make_spec(n_features=mv.JMX_FEATURE_COUNT, warmup=2)
+        d = mv.MvDriver(spec, capacity=2)
+        d.feed([make_entry()])
+        path = str(tmp_path / "mv.npz")
+        d.save_resume(path)
+        other = mv.MvDriver(spec._replace(alpha=0.5), capacity=2)
+        assert not other.load_resume(path)
+        assert other.rows == {}
+
+    def test_resume_corrupt_file_starts_fresh(self, tmp_path):
+        path = tmp_path / "mv.npz"
+        path.write_bytes(b"not a zip")
+        d = mv.MvDriver(make_spec(n_features=mv.JMX_FEATURE_COUNT))
+        assert not d.load_resume(str(path))
+        assert not d.load_resume(str(tmp_path / "missing.npz"))
