@@ -6,3 +6,25 @@ from .sharded import (  # noqa: F401
     make_sharded_tick,
     route_batch,
 )
+from .window_sharded import (  # noqa: F401
+    WINDOW_AXIS,
+    make_mesh2d,
+    make_window_sharded_step,
+    shard_zstate,
+)
+
+__all__ = [
+    "SERVICE_AXIS", "WINDOW_AXIS", "FleetRollup", "ShardedCheckpointer",
+    "local_config", "make_mesh", "make_mesh2d", "make_sharded_ingest",
+    "make_sharded_tick", "make_window_sharded_step", "padded_capacity",
+    "replicated", "route_batch", "row_sharding", "shard_rows", "shard_zstate",
+]
+
+
+def __getattr__(name):
+    # orbax import is heavy; load the checkpointer lazily
+    if name == "ShardedCheckpointer":
+        from .checkpoint import ShardedCheckpointer
+
+        return ShardedCheckpointer
+    raise AttributeError(name)

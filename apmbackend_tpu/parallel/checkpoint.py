@@ -1,0 +1,147 @@
+"""Sharded engine checkpointing (pod-scale resume files, SURVEY.md §7.2 step 9).
+
+The single-chip driver snapshots device state to one .npz (pipeline.py
+save_resume — the reference's JSON resume-file semantics, §5.4). At pod scale
+that means gathering every shard to one host; instead this module checkpoints
+the sharded EngineState directly with orbax: each host writes only its
+addressable shards, restore re-places arrays onto the mesh without a gather,
+and the service registry + engine shape metadata ride along so a snapshot is
+self-describing and refuses to resume onto an incompatible config (the same
+contract as the z{lag}/e{channel} key checks in load_resume).
+
+Retention follows the reference's overwrite-in-place resume files: keep the
+last ``keep`` checkpoints (default 2 — current + one fallback against a crash
+mid-save; orbax writes atomically via tmp+rename anyway).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding
+
+from ..pipeline import EngineConfig, EngineState
+from .sharded import _state_specs
+
+
+def _shape_signature(cfg: EngineConfig) -> dict:
+    """The config facts a snapshot must agree on to be resumable."""
+    return {
+        "capacity": cfg.capacity,
+        "num_buckets": cfg.stats.num_buckets,
+        "samples_per_bucket": cfg.stats.samples_per_bucket,
+        "lags": [spec.lag for spec in cfg.lags],
+        "ewma": [
+            [spec.channel_id, spec.season_slots, spec.slot_intervals]
+            for spec in cfg.ewma
+        ],
+        "dtype": str(np.dtype(cfg.stats.dtype)),
+    }
+
+
+class ShardedCheckpointer:
+    """Save/restore a sharded EngineState + registry keys under ``directory``."""
+
+    def __init__(self, directory: str, *, keep: int = 2):
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+
+    def save(
+        self,
+        step: int,
+        state: EngineState,
+        cfg: EngineConfig,
+        registry_keys: Tuple[Tuple[str, str], ...],
+    ) -> None:
+        meta = {
+            "signature": _shape_signature(cfg),
+            "registry": ["\x00".join(k) for k in registry_keys],
+        }
+        # async: the write overlaps the driver's tick/ingest loop; orbax
+        # finalizes the previous save on the next save(), and wait()/close()
+        # (and restore/latest_step) synchronize explicitly
+        self.manager.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state._asdict()),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        self.manager.wait_until_finished()
+        return self.manager.latest_step()
+
+    def restore(
+        self, cfg: EngineConfig, mesh: Optional[Mesh] = None
+    ) -> Optional[Tuple[EngineState, Tuple[Tuple[str, str], ...], int]]:
+        """Restore the newest restorable compatible snapshot placed on
+        ``mesh`` (single-device when None). Falls back to older retained
+        steps when the newest is unreadable (the point of keep>1). Returns
+        None when nothing works — the caller starts fresh, never crashes
+        (load_resume contract)."""
+        self.manager.wait_until_finished()
+        template = _template_state(cfg, mesh)
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            try:
+                meta = self.manager.restore(
+                    step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+                )["meta"]
+                if meta["signature"] != _shape_signature(cfg):
+                    continue
+                restored = self.manager.restore(
+                    step,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(template._asdict())
+                    ),
+                )["state"]
+                state = EngineState(**restored)
+            except Exception:
+                continue
+            registry = tuple(tuple(k.split("\x00", 1)) for k in meta["registry"])
+            return state, registry, step
+        return None
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+def _template_state(cfg: EngineConfig, mesh: Optional[Mesh]) -> EngineState:
+    """Abstract arrays with target shardings for StandardRestore (no
+    allocation: eval_shape)."""
+    from ..pipeline import engine_init
+
+    abstract = jax.eval_shape(lambda: engine_init(cfg))
+    leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    if mesh is None:
+        # explicit single-device placement: without it orbax re-applies the
+        # sharding recorded in the snapshot, which cannot reconstruct on a
+        # smaller topology (pod snapshot -> 1-device debug resume would fail)
+        from jax.sharding import SingleDeviceSharding
+
+        dev = jax.devices()[0]
+        out = [
+            jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=SingleDeviceSharding(dev))
+            for x in leaves
+        ]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # pair each abstract leaf with its PartitionSpec; specs' P nodes are
+    # tuples (sub-pytrees), so flatten them up to the state's structure
+    spec_leaves = treedef.flatten_up_to(_state_specs(cfg))
+    out = [
+        jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=NamedSharding(mesh, spec))
+        for x, spec in zip(leaves, spec_leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,175 @@
+"""Sequence (window-axis) sharding for extreme lag windows — the context-
+parallelism mode.
+
+The production layout shards the service axis only: at stock scale a whole
+8640-step (24 h) window fits per chip, so sequence sharding is unnecessary
+(SURVEY.md §5.7). But the lag window IS this system's sequence dimension, and
+for extreme windows (multi-week lags, or huge per-service capacity squeezing
+HBM) the z-score ring ``[S, 3, L]`` itself must split. This module shards it
+over a 2-D ``(services, window)`` mesh:
+
+- every window shard holds an ``L/W``-slice of each ring;
+- the window statistics become two small ICI all-reduces per step
+  (``psum(count, sum)`` -> mean, then ``psum(sum((x-mean)^2))`` -> var) —
+  the reference's two-pass mean/std (util_methods.js:10-50) computed
+  collectively. Results match the single-chip path to reduction-order
+  rounding (the psum tree sums shard partials in a different order than one
+  flat sum; last-ulp differences are inherent), which a one-pass sum/sumsq
+  trick would degrade much further;
+- the influence-damping lookup of the last pushed value and the ring write
+  each touch exactly one owner shard, selected by masked psum / masked store;
+- ``fill``/``pos`` counters are replicated across window shards and advance
+  identically everywhere.
+
+This is the all-reduce flavor of sequence parallelism (a ring/all-to-all
+exchange is unnecessary because the reduction is a plain sum over the
+sequence axis — no attention-style pairwise interaction exists).
+Parity-tested against ops.zscore.step on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.zscore import N_METRICS, ZScoreConfig, ZScoreResult, ZScoreState
+from .mesh import SERVICE_AXIS
+
+WINDOW_AXIS = "window"
+
+
+def make_mesh2d(n_service_shards: int, n_window_shards: int) -> Mesh:
+    devices = jax.devices()
+    need = n_service_shards * n_window_shards
+    if need > len(devices):
+        raise ValueError(
+            f"Requested a {n_service_shards}x{n_window_shards} mesh but only "
+            f"{len(devices)} JAX device(s) are visible"
+        )
+    grid = np.array(devices[:need]).reshape(n_service_shards, n_window_shards)
+    return Mesh(grid, (SERVICE_AXIS, WINDOW_AXIS))
+
+
+def shard_zstate(state: ZScoreState, mesh: Mesh) -> ZScoreState:
+    """Place values [S, 3, L] on (services, window); counters on services."""
+    from jax.sharding import NamedSharding
+
+    return ZScoreState(
+        values=jax.device_put(state.values, NamedSharding(mesh, P(SERVICE_AXIS, None, WINDOW_AXIS))),
+        fill=jax.device_put(state.fill, NamedSharding(mesh, P(SERVICE_AXIS))),
+        pos=jax.device_put(state.pos, NamedSharding(mesh, P(SERVICE_AXIS))),
+    )
+
+
+def _local_step(cfg: ZScoreConfig, n_window_shards: int):
+    """The per-shard body; cfg.lag is the GLOBAL lag length."""
+    L = cfg.lag
+    if L % n_window_shards != 0:
+        raise ValueError(f"lag {L} not divisible by window shards {n_window_shards}")
+    L_loc = L // n_window_shards
+
+    def fn(state: ZScoreState, new_values, threshold, influence):
+        widx = jax.lax.axis_index(WINDOW_AXIS)
+        vals = state.values  # [S_loc, 3, L_loc]
+        fill, pos = state.fill, state.pos
+        full = fill >= L
+
+        # two-pass mean/std over the sharded window (reference parity)
+        valid = ~jnp.isnan(vals)
+        cnt = jax.lax.psum(jnp.sum(valid, axis=-1), WINDOW_AXIS)  # [S, 3]
+        total = jax.lax.psum(jnp.sum(jnp.where(valid, vals, 0), axis=-1), WINDOW_AXIS)
+        has_avg = (cnt > 0) & full[:, None]
+        mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
+        diff = jnp.where(valid, vals - mean[..., None], 0)
+        var_sum = jax.lax.psum(jnp.sum(diff * diff, axis=-1), WINDOW_AXIS)
+        var = jnp.where(has_avg, var_sum / jnp.maximum(cnt, 1), jnp.nan)
+        has_std = has_avg & (var > 0)
+        std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
+
+        thr = threshold[:, None]
+        lb = jnp.where(has_std, mean - thr * std, jnp.nan)
+        ub = jnp.where(has_std, mean + thr * std, jnp.nan)
+        new_ok = ~jnp.isnan(new_values)
+        exceeds = has_std & new_ok & (jnp.abs(new_values - mean) > thr * std)
+        signal = jnp.where(exceeds, jnp.where(new_values > mean, 1, -1), 0).astype(jnp.int32)
+
+        # last pushed value lives on exactly one window shard: masked psum
+        last_idx = jnp.where(full, (pos - 1) % L, jnp.maximum(fill - 1, 0))  # [S] global
+        owner = (last_idx // L_loc) == widx  # [S]
+        lidx = last_idx % L_loc
+        lv = jnp.take_along_axis(
+            vals, lidx[:, None, None].repeat(N_METRICS, 1), axis=-1
+        )[..., 0]  # [S, 3]
+        lv_nan = jnp.isnan(lv)
+        last_val = jax.lax.psum(
+            jnp.where(owner[:, None] & ~lv_nan, lv, 0), WINDOW_AXIS
+        )
+        last_nan = (
+            jax.lax.psum(jnp.where(owner[:, None], lv_nan.astype(jnp.int32), 0), WINDOW_AXIS) > 0
+        )
+        can_damp = exceeds & ~last_nan & (fill > 0)[:, None]
+        infl = influence[:, None]
+        pushed = jnp.where(can_damp, infl * new_values + (1 - infl) * last_val, new_values)
+
+        # ring write: one owner shard stores; everyone advances counters
+        wglobal = jnp.where(full, pos, fill)  # [S]
+        owner_w = (wglobal // L_loc) == widx
+        lw = wglobal % L_loc
+        written = jax.vmap(lambda v, i, p: v.at[:, i].set(p))(
+            vals, lw, pushed.astype(cfg.dtype)
+        )
+        new_vals = jnp.where(owner_w[:, None, None], written, vals)
+        new_fill = jnp.minimum(fill + 1, L)
+        new_pos = jnp.where(full, (pos + 1) % L, pos)
+
+        result = ZScoreResult(
+            window_avg=mean.astype(cfg.dtype),
+            lower_bound=lb.astype(cfg.dtype),
+            upper_bound=ub.astype(cfg.dtype),
+            signal=signal,
+        )
+        return result, ZScoreState(new_vals, new_fill, new_pos)
+
+    return fn
+
+
+def make_window_sharded_step(mesh: Mesh, cfg: ZScoreConfig):
+    """jit(shard_map(z-score step)) over a (services, window) mesh.
+
+    ``cfg`` carries GLOBAL capacity and lag; both must divide by their mesh
+    axis. Inputs/outputs: state as placed by :func:`shard_zstate`; per-row
+    vectors (new_values, threshold, influence) sharded on services.
+    """
+    n_s = mesh.shape[SERVICE_AXIS]
+    n_w = mesh.shape[WINDOW_AXIS]
+    if cfg.capacity % n_s != 0:
+        raise ValueError(f"capacity {cfg.capacity} not divisible by service shards {n_s}")
+    local_cfg = cfg._replace(capacity=cfg.capacity // n_s)
+    fn = _local_step(local_cfg, n_w)
+
+    state_spec = ZScoreState(
+        values=P(SERVICE_AXIS, None, WINDOW_AXIS),
+        fill=P(SERVICE_AXIS),
+        pos=P(SERVICE_AXIS),
+    )
+    row2 = P(SERVICE_AXIS, None)
+    row = P(SERVICE_AXIS)
+    result_spec = ZScoreResult(
+        window_avg=row2, lower_bound=row2, upper_bound=row2, signal=row2
+    )
+    mapped = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(state_spec, row2, row, row),
+        out_specs=(result_spec, state_spec),
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,104 @@
+"""Window-axis (sequence-parallel) z-score sharding vs the single-device op.
+
+A (services x window) mesh over the virtual 8-CPU platform must reproduce
+ops.zscore.step: means/bounds to reduction-order rounding (a psum over shard
+partials sums in a different order than one flat sum — last-ulp differences
+are inherent to floating point), and signals, ring contents, and counters
+exactly — across enough steps to cover fill-up, full-ring rotation, and
+signalling regimes. Ring contents are compared to the same tight tolerance:
+XLA may contract the damping expression to an FMA in one program and not the
+other, so even bit-identical inputs can round differently in the last ulp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apmbackend_tpu.ops import zscore as z
+from apmbackend_tpu.parallel.window_sharded import (
+    WINDOW_AXIS,
+    make_mesh2d,
+    make_window_sharded_step,
+    shard_zstate,
+)
+
+S, LAG = 8, 16
+DTYPE = jnp.float64
+
+
+def series(rng, t):
+    """Mostly-steady series with occasional NaN and occasional spikes."""
+    x = 100 + rng.randn(S, 3)
+    if t % 7 == 3:
+        x[rng.randint(0, S)] = np.nan
+    if t > LAG and t % 11 == 5:
+        x[rng.randint(0, S)] *= 3  # spike -> signal + influence damping
+    return x.astype(np.float64)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8), (4, 2)])
+def test_parity_with_single_device(mesh_shape):
+    n_s, n_w = mesh_shape
+    cfg = z.ZScoreConfig(S, LAG, DTYPE)
+    mesh = make_mesh2d(n_s, n_w)
+    step_sharded = make_window_sharded_step(mesh, cfg)
+
+    ref_state = z.init_state(cfg)
+    sh_state = shard_zstate(z.init_state(cfg), mesh)
+
+    thr = jnp.asarray(np.linspace(2.0, 4.0, S), DTYPE)
+    infl = jnp.asarray(np.linspace(0.0, 1.0, S), DTYPE)
+    rng = np.random.RandomState(42)
+
+    for t in range(2 * LAG + 9):
+        x = jnp.asarray(series(rng, t))
+        ref_res, ref_state = z.step(ref_state, cfg, x, thr, infl)
+        sh_res, sh_state = step_sharded(sh_state, x, thr, infl)
+        for field in ("window_avg", "lower_bound", "upper_bound"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(ref_res, field)),
+                np.asarray(getattr(sh_res, field)),
+                rtol=1e-12, atol=0,
+                err_msg=f"{field} diverged at step {t}",
+            )
+        np.testing.assert_array_equal(
+            np.asarray(ref_res.signal), np.asarray(sh_res.signal), err_msg=f"signal @ {t}"
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref_state.values), np.asarray(sh_state.values),
+            rtol=1e-12, atol=0, err_msg=f"ring @ {t}",
+        )
+        np.testing.assert_array_equal(np.asarray(ref_state.fill), np.asarray(sh_state.fill))
+        np.testing.assert_array_equal(np.asarray(ref_state.pos), np.asarray(sh_state.pos))
+
+
+def test_signals_fire_through_sharded_path():
+    cfg = z.ZScoreConfig(S, LAG, DTYPE)
+    mesh = make_mesh2d(2, 4)
+    step_sharded = make_window_sharded_step(mesh, cfg)
+    state = shard_zstate(z.init_state(cfg), mesh)
+    # threshold 6: plain randn never exceeds in this window, the x2 spike always does
+    thr = jnp.full(S, 6.0, DTYPE)
+    infl = jnp.full(S, 0.1, DTYPE)
+    rng = np.random.RandomState(0)
+    for _ in range(LAG + 2):
+        x = jnp.asarray(200 + rng.randn(S, 3))
+        res, state = step_sharded(state, x, thr, infl)
+    assert not np.any(np.asarray(res.signal))
+    res, state = step_sharded(state, jnp.asarray(np.full((S, 3), 400.0)), thr, infl)
+    assert np.all(np.asarray(res.signal) == 1)
+
+
+def test_lag_not_divisible_raises():
+    cfg = z.ZScoreConfig(S, 10, DTYPE)  # 10 % 4 != 0
+    mesh = make_mesh2d(2, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        make_window_sharded_step(mesh, cfg)
+
+
+def test_capacity_not_divisible_raises():
+    cfg = z.ZScoreConfig(9, LAG, DTYPE)
+    mesh = make_mesh2d(2, 4)
+    with pytest.raises(ValueError, match="capacity"):
+        make_window_sharded_step(mesh, cfg)
