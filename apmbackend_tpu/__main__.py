@@ -12,6 +12,7 @@ Commands map to the reference's process/tool set:
 - ``pidstats``    'MEM_MiB SWAP_MiB' for a PID (pid_stats.py)
 - ``dequeue``     destructive queue peek (dequeue.js)
 - ``qstat``       queue depth/memory (qstat.sh)
+- ``backup``      timestamped source/config backups (backup.sh)
 """
 
 import importlib
@@ -32,6 +33,7 @@ COMMANDS = {
     "pidstats": ("apmbackend_tpu.manager.pid_stats", True),
     "dequeue": ("apmbackend_tpu.tools.dequeue", True),
     "qstat": ("apmbackend_tpu.tools.qstat", True),
+    "backup": ("apmbackend_tpu.tools.backup", True),
 }
 
 

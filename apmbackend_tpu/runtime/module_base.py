@@ -98,6 +98,14 @@ class ModuleRuntime:
             self.watcher.start()
         if install_signals:
             self._install_signals()
+        # profiling harness (§5.1 parity): SIGUSR2 heap snapshot, MemoryError
+        # auto-dump, optional JAX profiler server on module_config.profilerPort
+        from ..utils.profiling import Profiling
+
+        prof_cfg = dict(self.module_config)
+        prof_cfg.setdefault("heapSnapshotDir", log_dir or "logs")
+        self.profiling = Profiling(prefix, prof_cfg, logger=self.logger)
+        self.profiling.install(install_signal=install_signals)
 
     # -- config hot reload (§5.6) --------------------------------------------
     def on_reload(self, handler: Callable[[dict], None]) -> None:

@@ -1,0 +1,84 @@
+"""Timestamped source/config backups (backup.sh role).
+
+The reference kept an hourly-stamped copy of every ``*.js`` in ``js_bkups/``
+as a poor man's VCS (backup.sh:8-10). Same affordance, generalized: copy the
+configured globs into ``<backup_dir>/<YYYYMMDD_HH>/`` (one folder per hour —
+re-running within the hour overwrites, matching the reference's
+``date +%Y%m%d_%H`` stamp), with a ``--prune-days`` retention sweep.
+
+CLI: ``python -m apmbackend_tpu backup [--dir DIR] [--glob G ...]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import os
+import shutil
+import time
+from typing import List, Optional, Sequence
+
+DEFAULT_GLOBS = ("*.py", "apmbackend_tpu/**/*.py", "native/*.cpp", "native/Makefile", "config/*.json")
+
+
+def stamp(now: Optional[float] = None) -> str:
+    return time.strftime("%Y%m%d_%H", time.localtime(now))
+
+
+def run_backup(
+    backup_dir: str,
+    globs: Sequence[str] = DEFAULT_GLOBS,
+    *,
+    root: str = ".",
+    now: Optional[float] = None,
+) -> List[str]:
+    """Copy every glob match (relative paths preserved) into the stamped
+    folder; returns the copied destination paths."""
+    dest_root = os.path.join(backup_dir, stamp(now))
+    copied = []
+    for pattern in globs:
+        for src in globlib.glob(os.path.join(root, pattern), recursive=True):
+            if not os.path.isfile(src):
+                continue
+            rel = os.path.relpath(src, root)
+            dest = os.path.join(dest_root, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            shutil.copy2(src, dest)
+            copied.append(dest)
+    return copied
+
+
+def prune(backup_dir: str, *, days: float, now: Optional[float] = None) -> List[str]:
+    """Delete stamped folders older than ``days`` (mtime-based, like the
+    manager's log GC, apm_manager.js:532-566)."""
+    if not os.path.isdir(backup_dir):
+        return []
+    cutoff = (now if now is not None else time.time()) - days * 86400
+    removed = []
+    for entry in os.listdir(backup_dir):
+        path = os.path.join(backup_dir, entry)
+        if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    return removed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu backup", description=__doc__)
+    ap.add_argument("--dir", default="backups", help="backup root (default: backups/)")
+    ap.add_argument("--glob", action="append", help="glob(s) to back up (repeatable)")
+    ap.add_argument("--root", default=".", help="tree the globs resolve against")
+    ap.add_argument("--prune-days", type=float, help="also delete stamped folders older than N days")
+    args = ap.parse_args(argv)
+    copied = run_backup(args.dir, args.glob or DEFAULT_GLOBS, root=args.root)
+    print(f"Backed up {len(copied)} files to {os.path.join(args.dir, stamp())}")
+    if args.prune_days is not None:
+        removed = prune(args.dir, days=args.prune_days)
+        print(f"Pruned {len(removed)} old backup folder(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""Backup tool (tools/backup.py — backup.sh parity): hourly-stamped copies,
+re-run overwrite within the hour, retention pruning, CLI."""
+
+import os
+import time
+
+from apmbackend_tpu.tools import backup
+
+
+def make_tree(root):
+    (root / "a.py").write_text("A")
+    (root / "pkg").mkdir()
+    (root / "pkg" / "b.py").write_text("B")
+    (root / "skip.txt").write_text("no")
+
+
+def test_backup_copies_matching_globs(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    make_tree(src)
+    dest = tmp_path / "bk"
+    copied = backup.run_backup(str(dest), ("*.py", "pkg/*.py"), root=str(src), now=0)
+    assert len(copied) == 2
+    stamped = dest / backup.stamp(0)
+    assert (stamped / "a.py").read_text() == "A"
+    assert (stamped / "pkg" / "b.py").read_text() == "B"
+    assert not (stamped / "skip.txt").exists()
+
+
+def test_rerun_same_hour_overwrites(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    make_tree(src)
+    dest = tmp_path / "bk"
+    backup.run_backup(str(dest), ("*.py",), root=str(src), now=0)
+    (src / "a.py").write_text("A2")
+    backup.run_backup(str(dest), ("*.py",), root=str(src), now=60)  # same hour
+    assert (dest / backup.stamp(0) / "a.py").read_text() == "A2"
+    assert len(os.listdir(dest)) == 1
+
+
+def test_prune_removes_old_folders(tmp_path):
+    dest = tmp_path / "bk"
+    old = dest / "20200101_00"
+    new = dest / "20990101_00"
+    old.mkdir(parents=True)
+    new.mkdir(parents=True)
+    past = time.time() - 10 * 86400
+    os.utime(old, (past, past))
+    removed = backup.prune(str(dest), days=7)
+    assert [os.path.basename(p) for p in removed] == ["20200101_00"]
+    assert new.exists() and not old.exists()
+
+
+def test_cli(tmp_path, capsys, monkeypatch):
+    src = tmp_path / "src"
+    src.mkdir()
+    make_tree(src)
+    rc = backup.main(["--dir", str(tmp_path / "bk"), "--glob", "*.py",
+                      "--root", str(src), "--prune-days", "7"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Backed up 1 files" in out
