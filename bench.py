@@ -12,17 +12,31 @@ Also measured (reported in the details): p50 end-to-end detection latency —
 wall time from a tick boundary (data complete) to the alert-trigger mask
 being available on the host, plus ingest throughput in tx/sec.
 
+Self-defense: the default interpreter environment dials the TPU relay at
+startup and backend init can hang for minutes or fail UNAVAILABLE.  The
+launcher therefore runs the measurement in a worker subprocess with a
+backend-init watchdog, retries once on UNAVAILABLE, and falls back to a
+scrubbed-env CPU run if the TPU never comes up.  On ANY outcome it prints
+exactly one single-line JSON object to stdout and exits 0 — never a
+traceback.
+
 Run: python bench.py [--capacity 8192] [--ticks 30] [--batch 16384]
 """
 
 import argparse
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
 
-import numpy as np
+INIT_TIMEOUT_S = float(os.environ.get("APM_BENCH_INIT_TIMEOUT", "75"))
+RUN_TIMEOUT_S = float(os.environ.get("APM_BENCH_RUN_TIMEOUT", "480"))
+READY_SENTINEL = "BENCH_BACKEND_READY"
 
 
-def main() -> None:
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=8192)
     ap.add_argument("--ticks", type=int, default=30)
@@ -30,21 +44,31 @@ def main() -> None:
     ap.add_argument("--samples-per-bucket", type=int, default=64)
     ap.add_argument("--lags", type=int, nargs="+", default=[360, 8640])
     ap.add_argument("--warmup", type=int, default=3)
-    args = ap.parse_args()
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
+
+
+# ---------------------------------------------------------------- worker ----
+
+def run_worker(args) -> None:
+    """The measurement body. Assumes it owns the process; prints one JSON line."""
+    import numpy as np
 
     import jax
-    import jax.numpy as jnp
 
     from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
 
     device = jax.devices()[0]
+    # Tell the launcher's watchdog that backend init survived.
+    print(f"{READY_SENTINEL} {device.platform}", file=sys.stderr, flush=True)
+
     cfg, state, params = make_demo_engine(
         args.capacity, args.samples_per_bucket, [(lag, 20.0, 0.1) for lag in args.lags]
     )
     S = cfg.capacity
 
-    tick = jax.jit(engine_tick, static_argnums=1)
-    ingest = jax.jit(engine_ingest, static_argnums=1)
+    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
     B = args.batch
@@ -69,6 +93,7 @@ def main() -> None:
     # measured loop
     tick_latencies = []
     ingest_times = []
+    overflow_row_ticks = 0
     t_start = time.perf_counter()
     for i in range(args.ticks):
         label += 1
@@ -79,6 +104,7 @@ def main() -> None:
         np.asarray(em.tpm)
         t1 = time.perf_counter()
         tick_latencies.append(t1 - t0)
+        overflow_row_ticks += int(np.asarray(em.overflowed).sum())  # untimed: telemetry
         batch = make_batch(label)
         t2 = time.perf_counter()
         state = ingest(state, cfg, *batch)
@@ -92,6 +118,11 @@ def main() -> None:
     p50_ms = float(np.percentile(np.array(tick_latencies) * 1000, 50))
     ingest_tx_s = B * args.ticks / sum(ingest_times)
 
+    # host intake fast path: CSV decode + registry routing + device scatter at
+    # steady state (within one 10 s interval), through PipelineDriver's
+    # feed_csv_batch — the boundary the reference crosses per-message
+    host_intake_tx_s = _measure_host_intake()
+
     result = {
         "metric": "zscore_baselining_throughput",
         "value": round(throughput, 1),
@@ -99,6 +130,7 @@ def main() -> None:
         "vs_baseline": round(throughput / 125000.0, 3),
         "details": {
             "device": str(device),
+            "platform": device.platform,
             "services": S,
             "lags": [spec.lag for spec in cfg.lags],
             "metrics_per_tick": metrics_per_tick,
@@ -106,11 +138,212 @@ def main() -> None:
             "p50_detection_latency_ms": round(p50_ms, 3),
             "p95_detection_latency_ms": round(float(np.percentile(np.array(tick_latencies) * 1000, 95)), 3),
             "ingest_tx_per_sec": round(ingest_tx_s, 1),
+            "host_intake_tx_per_sec": round(host_intake_tx_s, 1),
+            "overflow_row_ticks": overflow_row_ticks,
             "wall_s": round(total, 3),
             "north_star": "1M metrics/sec on v5e-8 => 125k/sec/chip; <100ms p50 detection",
         },
     }
     print(json.dumps(result))
+
+
+def _measure_host_intake(capacity: int = 1024, per_batch: int = 50000, batches: int = 4) -> float:
+    """tx/sec through PipelineDriver.feed_csv_batch (decode -> rows -> scatter)."""
+    import numpy as np
+
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = capacity
+    cfg["tpuEngine"]["samplesPerBucket"] = 64
+    rng = np.random.RandomState(0)
+    base = 170_000_000
+
+    def make_lines(label, n):
+        rows = rng.randint(0, capacity - 24, n)
+        elaps = rng.randint(50, 900, n)
+        return [
+            f"tx|jvm{r % 8}|S:svc{r:04d}|l{i}|1|{label * 10000 - e}|{label * 10000 + i % 9999}|{e}|Y"
+            for i, (r, e) in enumerate(zip(rows, elaps))
+        ]
+
+    drv = PipelineDriver(cfg, micro_batch_size=16384, on_ordered_csv=lambda line: None)
+    drv.feed_csv_batch(make_lines(base, 16384))  # compile ingest
+    drv.feed_csv_batch(make_lines(base + 1, 16384))  # compile tick
+    work = [make_lines(base + 1, per_batch) for _ in range(batches)]
+    n = 0
+    t0 = time.perf_counter()
+    for lines in work:
+        n += drv.feed_csv_batch(lines)
+    return n / (time.perf_counter() - t0)
+
+
+# -------------------------------------------------------------- launcher ----
+
+class _Attempt:
+    """One worker subprocess run with a two-phase (init, run) watchdog."""
+
+    def __init__(self, name: str, env: dict):
+        self.name = name
+        self.env = env
+        self.stderr_tail: list[str] = []
+        self.stdout_lines: list[str] = []
+        self.ready = threading.Event()
+        self.json_line: str | None = None
+        self.outcome = "unknown"
+
+    def _drain_stderr(self, pipe) -> None:
+        for line in pipe:
+            if READY_SENTINEL in line:
+                self.ready.set()
+            self.stderr_tail.append(line)
+            if len(self.stderr_tail) > 80:
+                del self.stderr_tail[: len(self.stderr_tail) - 80]
+            sys.stderr.write(line)
+        pipe.close()
+
+    def _drain_stdout(self, pipe) -> None:
+        for line in pipe:
+            self.stdout_lines.append(line)
+        pipe.close()
+
+    def run(self) -> bool:
+        cmd = [sys.executable, "-u", os.path.abspath(__file__), "--_worker"] + [
+            a for a in sys.argv[1:] if a != "--_worker"
+        ]
+        proc = subprocess.Popen(
+            cmd, cwd=os.path.dirname(os.path.abspath(__file__)), env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, bufsize=1,
+        )
+        # both pipes are drained by threads (never communicate(): it would
+        # race the drain threads on the same fds -> EBADF)
+        t_err = threading.Thread(target=self._drain_stderr, args=(proc.stderr,), daemon=True)
+        t_out = threading.Thread(target=self._drain_stdout, args=(proc.stdout,), daemon=True)
+        t_err.start()
+        t_out.start()
+        deadline = time.monotonic() + INIT_TIMEOUT_S
+        extended = False
+        killed_reason = None
+        while True:
+            if proc.poll() is not None:
+                break
+            if not extended and self.ready.is_set():
+                deadline = time.monotonic() + RUN_TIMEOUT_S
+                extended = True
+            if time.monotonic() > deadline:
+                killed_reason = "init_timeout" if not extended else "run_timeout"
+                proc.kill()
+                break
+            time.sleep(0.25)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        t_out.join(timeout=5)
+        t_err.join(timeout=5)
+        stdout = "".join(self.stdout_lines)
+        for line in reversed((stdout or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                    if isinstance(obj, dict) and "metric" in obj:
+                        self.json_line = line
+                        break
+                except json.JSONDecodeError:
+                    continue
+        if killed_reason:
+            self.outcome = killed_reason
+        elif proc.returncode != 0:
+            self.outcome = f"rc={proc.returncode}"
+        elif self.json_line is None:
+            self.outcome = "no_json"
+        else:
+            self.outcome = "ok"
+        return self.outcome == "ok"
+
+    def tail(self, n_chars: int = 800) -> str:
+        return "".join(self.stderr_tail)[-n_chars:]
+
+
+def _scrubbed_cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)  # drops the TPU-relay sitecustomize hook
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def run_launcher(args) -> None:
+    attempts = []
+
+    def try_one(name, env):
+        att = _Attempt(name, env)
+        print(f"bench launcher: attempt '{name}'...", file=sys.stderr, flush=True)
+        att.run()
+        attempts.append(att)
+        print(f"bench launcher: attempt '{name}' -> {att.outcome}", file=sys.stderr, flush=True)
+        return att
+
+    winner = None
+    if os.environ.get("APM_BENCH_NO_TPU") or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        att = try_one("cpu", _scrubbed_cpu_env())
+        winner = att if att.outcome == "ok" else None
+    else:
+        att = try_one("tpu", dict(os.environ))
+        if att.outcome == "ok":
+            winner = att
+        else:
+            # Retry only a *fast* UNAVAILABLE (flaky tunnel); an init hang
+            # would just hang again, so fall straight back to CPU.
+            if att.outcome.startswith("rc=") and "UNAVAILABLE" in att.tail(4000):
+                att = try_one("tpu-retry", dict(os.environ))
+                if att.outcome == "ok":
+                    winner = att
+            if winner is None:
+                att = try_one("cpu-fallback", _scrubbed_cpu_env())
+                if att.outcome == "ok":
+                    winner = att
+    if winner is not None:
+        obj = json.loads(winner.json_line)
+        details = obj.setdefault("details", {})
+        details["bench_attempts"] = [f"{a.name}:{a.outcome}" for a in attempts]
+        if winner.name.startswith("cpu") and len(attempts) > 1:
+            details["tpu_error_tail"] = attempts[0].tail(400)
+        print(json.dumps(obj))
+        return
+    diag = {
+        "metric": "zscore_baselining_throughput",
+        "value": 0.0,
+        "unit": "metrics/sec/chip",
+        "vs_baseline": 0.0,
+        "details": {
+            "error": "all bench attempts failed",
+            "bench_attempts": [f"{a.name}:{a.outcome}" for a in attempts],
+            "last_stderr_tail": attempts[-1].tail(600) if attempts else "",
+        },
+    }
+    print(json.dumps(diag))
+
+
+def main() -> None:
+    args = parse_args()
+    if args._worker:
+        run_worker(args)
+        return
+    try:
+        run_launcher(args)
+    except Exception as e:  # never leak a traceback to stdout
+        print(json.dumps({
+            "metric": "zscore_baselining_throughput",
+            "value": 0.0,
+            "unit": "metrics/sec/chip",
+            "vs_baseline": 0.0,
+            "details": {"error": f"launcher crashed: {type(e).__name__}: {e}"},
+        }))
+    sys.exit(0)
 
 
 if __name__ == "__main__":
