@@ -219,6 +219,10 @@ class NativeTailer:
                 self._proc.wait(timeout=2.0)
             except subprocess.TimeoutExpired:
                 self._proc.kill()
+                try:  # reap: a killed child must not linger as a zombie
+                    self._proc.wait(timeout=2.0)
+                except subprocess.TimeoutExpired:
+                    pass
         if self._thread:
             self._thread.join(timeout=2.0)
 

@@ -31,6 +31,7 @@ import time
 from typing import Callable, List, Optional
 
 from ..integrations import EmailSender, GrafanaClient
+from ..utils.counters import capped_append
 
 # The CLI dispatcher (`python -m apmbackend_tpu <cmd>`) runs the same modules
 # with a different /proc cmdline than `python -m <dotted.module>`; stale-PID
@@ -80,10 +81,7 @@ class ManagerAlerts:
         if self.logger:
             self.logger.warning(f"Manager alert: {message}")
         with self._lock:
-            self.buffer.append(message)
-            if len(self.buffer) > self.MAX_BUFFERED:
-                del self.buffer[0]
-                self.dropped += 1
+            self.dropped += capped_append(self.buffer, message, self.MAX_BUFFERED)
 
     def send_email(self, subject: str, body: str) -> None:
         """Immediate send (sendManagerEmail role), gated on emailsEnabled."""

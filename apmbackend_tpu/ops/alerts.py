@@ -26,6 +26,7 @@ from typing import List, NamedTuple, Optional, Tuple
 import jax.numpy as jnp
 
 from ..entries import AlertEntry, EntryFactory, FullStatEntry
+from ..utils.counters import capped_append
 from ..utils.resume import load_resume_file, save_resume_file
 
 # cause bits, in the reference's evaluation (and string join) order
@@ -133,6 +134,7 @@ class AlertsManager:
         self.alerts: dict = {}  # service -> alert dict (cooldown state)
         self.alert_buffer: List[dict] = []
         self.current_interval_s: Optional[float] = None
+        self.dropped_alerts = 0  # drop-oldest evictions while dispatch is unavailable
 
     def set_config(self, alerts_config: dict) -> None:
         self.config = alerts_config
@@ -156,8 +158,13 @@ class AlertsManager:
         self.alerts[entry.service] = {"alertTimestamp": alert.alert_timestamp}
         return alert
 
+    MAX_BUFFERED = 1000  # drop-oldest cap: with emails disabled (the shipped
+    # default) flush() retains the buffer, so without a cap alert dicts would
+    # accumulate without bound and persist into the resume file
+
     def add_to_buffer(self, alert: AlertEntry) -> None:
-        self.alert_buffer.append(
+        self.dropped_alerts += capped_append(
+            self.alert_buffer,
             {
                 "alertTimestamp": alert.alert_timestamp,
                 "entryTimestamp": alert.entry_timestamp,
@@ -165,8 +172,14 @@ class AlertsManager:
                 "service": alert.service,
                 "cause": alert.cause,
                 "entry": alert.entry,
-            }
+            },
+            self.MAX_BUFFERED,
         )
+        if self.dropped_alerts and self.logger and self.dropped_alerts % 100 == 1:
+            self.logger.warning(
+                f"Alert buffer at {self.MAX_BUFFERED}-entry cap; "
+                f"{self.dropped_alerts} oldest alerts dropped so far"
+            )
 
     # -- batched send with interval doubling (:269-333) ----------------------
     def flush(self, interval_s: Optional[float] = None) -> Tuple[int, float]:

@@ -21,9 +21,16 @@ Exactness notes (SURVEY.md §7.3):
   using the reference's index formula (util_methods.js:112-142) evaluated in
   *integer* arithmetic — provably equal to the reference's float64 index math
   for p in {75, 95} and realistic n.
-- Each (row, bucket) stores at most CAP samples; if a bucket overflows,
-  percentiles are computed over the first CAP samples (counts/averages stay
-  exact). ``overflowed`` in the tick output reports when this happened.
+- Each (row, bucket) stores at most CAP samples. Below CAP the stored set is
+  every sample and percentiles are exact. Beyond CAP, reservoir sampling
+  (Algorithm R) keeps a uniform random CAP-subset of ALL arrivals, so the
+  percentile is an unbiased estimate with error O(1/sqrt(CAP)) in rank —
+  bounded, unlike first-CAP truncation which is arbitrarily biased toward
+  early arrivals. The reservoir's randomness is a deterministic hash of
+  (row, bucket label, arrival index), so replay and resume reproduce the
+  same reservoir bit-for-bit. ``overflowed`` in the tick output reports
+  rows whose window percentile used a reservoir (counts/averages stay
+  exact regardless).
 - ``average`` is sum/count like the reference; NaN where the window is empty
   (the reference's ``undefined``).
 """
@@ -118,6 +125,29 @@ def _batch_cumcount(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer over uint32: the deterministic per-arrival hash
+    driving reservoir replacement (full avalanche, wraps mod 2^32)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def _keep_last(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """True for the last (in arrival order) valid occurrence of each key.
+
+    XLA scatter leaves duplicate-index write order undefined; masking all but
+    the final writer per target keeps ingest deterministic (replay parity).
+    """
+    B = keys.shape[0]
+    big = jnp.where(valid, keys, jnp.iinfo(jnp.int32).max)
+    perm = jnp.argsort(big, stable=True)
+    sk = big[perm]
+    is_last = jnp.concatenate([sk[:-1] != sk[1:], jnp.ones((1,), bool)])
+    return jnp.zeros((B,), bool).at[perm].set(is_last) & valid
+
+
 def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) -> StatsState:
     """Scatter a micro-batch into the bucket ring.
 
@@ -143,9 +173,29 @@ def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) ->
 
     key = srows * NB + slots
     cum = _batch_cumcount(key, valid)
-    pos = state.nsamples[srows, slots] + cum
+    # arrival index among ALL arrivals ever seen by this (row, bucket) —
+    # state.counts counts every valid arrival, including reservoir-dropped ones
+    t = state.counts[srows, slots] + cum
+    # Reservoir sampling (Algorithm R): arrivals 0..CAP-1 fill slots in order;
+    # arrival t >= CAP replaces slot j = hash(row, label, t) % (t+1) iff
+    # j < CAP (probability CAP/(t+1)), keeping the stored set a uniform sample
+    # of all t+1 arrivals. The hash is deterministic in (row, label, t) so
+    # replay/resume reproduce the reservoir bit-for-bit.
+    h = _mix32(
+        srows.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        ^ labels.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+        ^ t.astype(jnp.uint32)
+    )
+    j = (h % (t.astype(jnp.uint32) + 1)).astype(jnp.int32)
+    pos = jnp.where(t < CAP, t, jnp.where(j < CAP, j, CAP))
     ok = valid & (pos < CAP)
     pos = jnp.where(ok, pos, CAP)  # CAP is out of bounds -> dropped
+    # dedupe within-batch writes to the same (row, slot, pos): keep the latest
+    # arrival. wkey stays in int32 while S*NB*(CAP+1) < 2^31 (~450k rows at
+    # stock NB=37, CAP=128) — far above serviceCapacity scales.
+    wkey = key * (CAP + 1) + pos
+    ok = ok & _keep_last(wkey, ok)
+    pos = jnp.where(ok, pos, CAP)
     samples = state.samples.at[srows, slots, pos].set(
         jnp.where(ok, elapsed, jnp.nan), mode="drop"
     )
@@ -242,11 +292,13 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
     window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
     impl = cfg.percentile_impl
     if impl == "auto":
-        impl = (
-            "pallas"
-            if jax.default_backend() == "tpu" and cfg.dtype == jnp.float32
-            else "sort"
-        )
+        # The selection kernel is exact and parity-tested in interpret mode,
+        # but has NOT yet been timed/proven on real TPU hardware, so "auto"
+        # plays it safe with the XLA sort path on every backend. Run
+        # benchmarks/bench_pallas.py on a TPU for the parity+timing proof,
+        # then opt in with percentile_impl="pallas" (config
+        # tpuEngine.percentileImpl) if it wins.
+        impl = "sort"
     if impl == "pallas":
         if cfg.dtype == jnp.float64:
             # the kernel is f32-only; a silent downcast would break the f64

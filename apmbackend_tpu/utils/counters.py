@@ -83,3 +83,18 @@ class DBStats:
             self.rec_ins_counter, self.ins_elap_total_ms = 0, 0.0
         avg = (total / cnt) if cnt else 0.0
         return f"DB> inserted: {cnt} - total ms: {total:.1f} - avg ms/rec: {avg:.3f}"
+
+
+def capped_append(buffer: list, item, cap: int) -> int:
+    """Append with a drop-oldest cap; returns 1 when the oldest was evicted.
+
+    The single eviction policy shared by every long-lived alert buffer
+    (service alerts in ops/alerts.py, operational alerts in
+    manager/manager.py): unbounded buffers leak in processes whose dispatch
+    path is disabled. Caller holds any lock it needs.
+    """
+    buffer.append(item)
+    if len(buffer) > cap:
+        del buffer[0]
+        return 1
+    return 0

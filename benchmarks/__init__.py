@@ -13,9 +13,11 @@ production scale).
 | jmx         | 2: JMX + datasource + VM-CPU multivariate batch |
 | podshard    | 3: pod-sharded 10k-service z-score, ICI-allreduced baselines |
 | multiwindow | 4: multi-window seasonal/EWMA baselining + alert eval on device |
+| pallas      | (extra) selection-kernel hardware proof: parity + timing vs XLA sort |
 """
 
-from . import bench_jmx, bench_multiwindow, bench_podshard, bench_replay, bench_rolling
+from . import (bench_jmx, bench_multiwindow, bench_pallas, bench_podshard,
+               bench_replay, bench_rolling)
 
 REGISTRY = {
     "replay": bench_replay.run,
@@ -23,4 +25,5 @@ REGISTRY = {
     "jmx": bench_jmx.run,
     "podshard": bench_podshard.run,
     "multiwindow": bench_multiwindow.run,
+    "pallas": bench_pallas.run,
 }

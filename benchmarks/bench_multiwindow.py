@@ -35,8 +35,8 @@ def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_ti
     cfg, state, params = make_demo_engine(
         capacity, 32 if quick else 64, lags, ewma_channels=EWMA_CHANNELS
     )
-    tick = jax.jit(engine_tick, static_argnums=1)
-    ingest = jax.jit(engine_ingest, static_argnums=1)
+    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
     label = 170_000_000

@@ -48,13 +48,17 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     label = 170_000_000
     B = batch_per_shard * n_dev
 
+    route_times: list = []
+
     def routed(lbl):
         rows = rng.randint(0, services, B).astype(np.int32)
         elaps = (200 + 50 * rng.rand(B)).astype(np.float32)
+        t0 = time.perf_counter()
         r, l, e, v, _dropped = route_batch(
             rows, np.full(B, lbl, np.int32), elaps, np.ones(B, bool),
             capacity=capacity, n_shards=n_dev, batch_per_shard=batch_per_shard,
         )
+        route_times.append(time.perf_counter() - t0)
         return r, l, e, v
 
     for _ in range(3):  # warmup/compile
@@ -94,6 +98,9 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
             "lags": [spec.lag for spec in cfg.lags],
             "ticks": ticks,
             "tick_latency": latency_stats_ms(lat),
+            # host-side DCN scatter layout rate (vectorized route_batch);
+            # north star: >=1M records/s so routing never gates the pod
+            "route_records_per_sec": round(B * len(route_times) / max(sum(route_times), 1e-9), 1),
             "wall_s": round(wall, 3),
             "note": "ICI-allreduced FleetRollup fetched to host every tick",
         },

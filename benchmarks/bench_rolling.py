@@ -25,8 +25,8 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tic
 
     capacity = 128  # 100 live rows padded to the power-of-two tier
     cfg, state, params = make_demo_engine(capacity, 64, [(360, 20.0, 0.1)])
-    tick = jax.jit(engine_tick, static_argnums=1)
-    ingest = jax.jit(engine_ingest, static_argnums=1)
+    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
     label = 170_000_000

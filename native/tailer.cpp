@@ -30,6 +30,10 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#ifdef __linux__
+#include <sys/prctl.h>
+#endif
+
 namespace {
 
 volatile sig_atomic_t g_stop = 0;
@@ -169,5 +173,13 @@ int main(int argc, char** argv) {
     signal(SIGTERM, on_signal);
     signal(SIGINT, on_signal);
     signal(SIGPIPE, SIG_DFL);  // die when the consumer goes away (fail-fast)
+#ifdef __linux__
+    // A quiet tailed file means no writes, so SIGPIPE alone can leave this
+    // process running forever after the spawning worker dies.  Ask the kernel
+    // to deliver SIGTERM when the parent exits; if the parent died before the
+    // request latched, exit now (we were reparented already).
+    prctl(PR_SET_PDEATHSIG, SIGTERM);
+    if (getppid() == 1) return 0;
+#endif
     return t.run();
 }

@@ -254,3 +254,26 @@ def test_flush_skips_corrupted_buffer_entry():
     sent, _ = mgr.flush(60)
     assert sent == 2 and len(emails) == 1  # no crash; good row still in the email
     assert "svcA" in emails[0][1]
+
+
+def test_buffer_drop_oldest_cap_when_emails_disabled():
+    """With dispatch unavailable (the shipped default) the buffer must not
+    grow without bound: drop-oldest at MAX_BUFFERED, counting evictions."""
+    now = [1_700_000_000.0]
+    emails = []
+    mgr = manager(lambda: now[0], emails)
+    mgr.config["emailsEnabled"] = False
+    mgr.config["perServiceAlertCooldownInMinutes"] = 0
+    cap = da.AlertsManager.MAX_BUFFERED
+    for i in range(cap + 25):
+        now[0] += 1
+        alert = mgr.process_trigger(make_fs(f"svc{i}"), da.CAUSE_BOTH_UB)
+        assert alert is not None
+        mgr.add_to_buffer(alert)
+        mgr.flush(60)  # emails off: retains (capped), never sends
+    assert len(mgr.alert_buffer) == cap
+    assert mgr.dropped_alerts == 25
+    assert not emails
+    # the oldest 25 were evicted; the newest survive
+    assert mgr.alert_buffer[0]["service"] == "svc25"
+    assert mgr.alert_buffer[-1]["service"] == f"svc{cap + 24}"

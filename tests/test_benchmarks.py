@@ -17,14 +17,19 @@ REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline", "details"}
 def test_bench_quick(name):
     res = REGISTRY[name](quick=True)
     assert REQUIRED_KEYS <= set(res)
-    assert res["value"] > 0
-    assert res["vs_baseline"] > 0
+    if name == "pallas":
+        # off-TPU the kernel bench verifies parity but reports speedup 0
+        # (timing needs hardware); the parity check raising IS the test
+        assert res["details"]["parity"] == "exact"
+    else:
+        assert res["value"] > 0
+        assert res["vs_baseline"] > 0
     json.dumps(res)  # must be JSON-serializable (the wire contract)
 
 
 def test_registry_covers_all_five_configs():
-    assert len(REGISTRY) == 5
-    assert set(REGISTRY) == {"replay", "rolling", "jmx", "podshard", "multiwindow"}
+    # the five BASELINE.json configs plus the pallas hardware-proof extra
+    assert set(REGISTRY) == {"replay", "rolling", "jmx", "podshard", "multiwindow", "pallas"}
 
 
 def test_runner_cli(capsys):

@@ -30,6 +30,34 @@ def built():
     return path
 
 
+def _apm_tail_children():
+    """PIDs of live apm_tail processes whose parent is this test process."""
+    me = os.getpid()
+    found = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as fh:
+                stat = fh.read()
+            comm = stat[stat.index("(") + 1 : stat.rindex(")")]
+            ppid = int(stat[stat.rindex(")") + 2 :].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if ppid == me and "apm_tail" in comm:
+            found.append(int(pid))
+    return found
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_tail_children():
+    """Every test must reap every apm_tail it spawned (round-1 leak regression)."""
+    yield
+    assert wait_for(lambda: not _apm_tail_children(), timeout=5.0), (
+        f"leaked apm_tail children: {_apm_tail_children()}"
+    )
+
+
 def wait_for(predicate, timeout=8.0, interval=0.02):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -131,6 +159,52 @@ class TestApmTail:
             assert wait_for(lambda: t.lines == ["l1"]), t.lines
         finally:
             t.stop()
+
+    def test_child_dies_with_parent(self, built, tmp_path):
+        """apm_tail must not outlive the worker that spawned it (PDEATHSIG):
+        the round-1 leak was an orphan surviving a dead parent on a quiet
+        file, where SIGPIPE never fires because nothing is ever written."""
+        import sys
+
+        log = tmp_path / "orphan.log"
+        log.write_text("")
+        script = (
+            "import os, subprocess, sys\n"
+            f"p = subprocess.Popen([{tail_binary_path()!r}, {str(log)!r}, "
+            f"{str(tmp_path / 'pause')!r}], stdout=subprocess.DEVNULL)\n"
+            "print(p.pid, flush=True)\n"
+            "os._exit(0)\n"  # die without stopping the tail
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True, timeout=10
+        )
+        pid = int(out.stdout.strip())
+
+        def gone():
+            try:
+                os.kill(pid, 0)
+                return False
+            except ProcessLookupError:
+                return True
+            except PermissionError:
+                return False
+
+        assert wait_for(gone, timeout=5.0), f"orphan apm_tail {pid} survived its parent"
+
+    def test_stop_reaps_child(self, built, tmp_path):
+        from apmbackend_tpu.ingest.tailer import NativeTailer
+
+        log = tmp_path / "reap.log"
+        log.write_text("")
+        t = NativeTailer(
+            tail_binary_path(), str(log), str(tmp_path / "pause"), lambda f, line: None
+        )
+        t.start()
+        assert wait_for(lambda: t._proc is not None and t._proc.poll() is None, timeout=5.0)
+        child = t._proc.pid
+        t.stop()
+        assert t._proc.returncode is not None  # reaped, not abandoned
+        assert child not in _apm_tail_children()
 
     def test_native_tailer_class_integration(self, built, tmp_path):
         from apmbackend_tpu.ingest.tailer import NativeTailer
