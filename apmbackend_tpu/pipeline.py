@@ -296,7 +296,10 @@ class PipelineDriver:
         on_stat: Optional[Callable[[StatEntry], None]] = None,
         on_fullstat: Optional[Callable[[FullStatEntry], None]] = None,
         on_ordered_tx: Optional[Callable[[TxEntry], None]] = None,
+        on_ordered_csv: Optional[Callable[[str], None]] = None,
         on_alert: Optional[Callable] = None,
+        on_overflow: Optional[Callable[[int, int], None]] = None,
+        on_fullstat_csv: Optional[Callable[[List[str]], None]] = None,
         logger=None,
         micro_batch_size: int = 8192,
     ):
@@ -307,9 +310,35 @@ class PipelineDriver:
         self.alerts_manager = alerts_manager
         self.on_stat = on_stat
         self.on_fullstat = on_fullstat
+        if on_ordered_tx is not None and on_ordered_csv is not None:
+            raise ValueError(
+                "on_ordered_tx and on_ordered_csv are mutually exclusive "
+                "(one ordered-tx drain per driver); pick the object heap or "
+                "the raw-line fast path"
+            )
         self.on_ordered_tx = on_ordered_tx
+        # fast-path variant of on_ordered_tx: receives the RAW tx CSV line at
+        # the tick-boundary drain, end_ts-ordered, without TxEntry objects or
+        # per-entry heap pushes. Served by feed_csv_batch only (feed() keeps
+        # the object heap); producers emit normalized to_csv() lines so the
+        # raw line is the same wire bytes the object path would re-serialize.
+        self.on_ordered_csv = on_ordered_csv
+        self._tx_backlog: List[Tuple[float, str]] = []  # (end_ts, raw line)
         self.on_alert = on_alert
+        self.on_overflow = on_overflow
+        # bulk wire-format emission: receives the tick's FullStat CSV lines
+        # for one channel as a list, built without per-row dataclass objects
+        # (byte-identical to [fs.to_csv() for fs in ...]); the fast path for
+        # queue-writing consumers at 10k-row fleets
+        self.on_fullstat_csv = on_fullstat_csv
         self.logger = logger
+        # percentile-reservoir overflow telemetry (ops/stats.py reservoir):
+        # rows whose window percentile was estimated from a uniform CAP-sample
+        # rather than computed exactly — bounded error, but worth alerting on
+        # so operators can raise samplesPerBucket if it is chronic
+        self.overflow_rows_total = 0
+        self.overflow_ticks = 0
+        self._overflow_last_logged_tick = -1000
         self.micro_batch_size = micro_batch_size
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
@@ -317,8 +346,8 @@ class PipelineDriver:
         self._refresh_params()
         # jax.jit memoizes per static EngineConfig, so growth (a new cfg)
         # recompiles automatically through these two callables
-        self._tick = jax.jit(engine_tick, static_argnums=1)
-        self._ingest = jax.jit(engine_ingest, static_argnums=1)
+        self._tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+        self._ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     # -- params / growth -----------------------------------------------------
     def _refresh_params(self) -> None:
@@ -387,13 +416,135 @@ class PipelineDriver:
             self._latest_label = label
         row = self._row_for(tx.server, tx.service)
         self._pending.append((row, label, float(tx.elapsed)))
-        self.heap.push(tx)
+        if self.on_ordered_tx is not None:
+            self.heap.push(tx)
+        elif self.on_ordered_csv is not None:  # mixed callers: feed() must
+            # serve the CSV drain too, not only feed_csv_batch
+            self._tx_backlog.append((float(tx.end_ts), tx.to_csv()))
         if len(self._pending) >= self.micro_batch_size:
             self._flush_pending()
 
     def feed_batch(self, txs: Sequence[TxEntry]) -> None:
         for tx in txs:
             self.feed(tx)
+
+    def feed_csv_batch(self, lines: Sequence[str]) -> int:
+        """Bulk host fast path: decode ``tx|...`` pipe-CSV lines with numpy
+        split/astype and ingest them as arrays, skipping TxEntry objects, the
+        per-entry heap push, and the per-tuple pending list entirely.
+
+        Emissions are identical to feeding line-by-line: arrival order is
+        kept, and ticks fire exactly where feed() would fire them — before
+        each entry whose label exceeds every label seen so far (the
+        stats-before-addData event order, stream_calc_stats.js:348-370).
+        Entries between two ticks are scattered as one array batch. Returns
+        the number of transactions ingested. Falls back to the object path
+        when an ordered-tx consumer needs the heap.
+        """
+        if self.on_ordered_tx is not None:
+            from .entries import EntryFactory
+
+            fac = EntryFactory()
+            n = 0
+            for line in lines:
+                entry = fac.from_csv(line)
+                if entry is not None and entry.type == "tx":
+                    self.feed(entry)
+                    n += 1
+                elif self.logger:
+                    self.logger.info(f"Not a transactions entry: {line[:200]}")
+            return n
+
+        good = []
+        good_lines: List[str] = []
+        n_bad = 0
+        for line in lines:
+            p = line.split("|")
+            if len(p) == 9 and p[0] == "tx":
+                good.append(p)
+                good_lines.append(line)
+            else:
+                n_bad += 1
+        if n_bad and self.logger:
+            self.logger.info(f"Skipped {n_bad} non-tx/malformed lines in batch")
+        if not good:
+            return 0
+        fields = np.array(good, dtype=object)  # [N, 9] strings
+        try:
+            end_ts = fields[:, 6].astype(np.float64)
+            elaps = fields[:, 7].astype(np.float64)
+        except (ValueError, TypeError):  # rare malformed numerics: slow decode
+            from .entries import js_parse_int
+
+            end_ts = np.array([js_parse_int(x) for x in fields[:, 6]], np.float64)
+            elaps = np.array([js_parse_int(x) for x in fields[:, 7]], np.float64)
+        end_ts = np.trunc(end_ts)  # TxEntry applies js_parse_int (int truncation)
+        elaps = np.trunc(elaps)
+        ok = ~np.isnan(end_ts)
+        n_nan = int(len(end_ts) - ok.sum())
+        if n_nan:
+            if self.logger:
+                self.logger.error(f"NaN bucket labels in batch: {n_nan} lines dropped")
+            fields, end_ts, elaps = fields[ok], end_ts[ok], elaps[ok]
+            good_lines = [gl for gl, o in zip(good_lines, ok) if o]
+            if len(fields) == 0:
+                return 0
+        labels = (end_ts.astype(np.int64) // 10000).astype(np.int32)
+        keys = np.array(
+            [s + "\x00" + v for s, v in zip(fields[:, 1], fields[:, 2])]
+        )
+
+        def resolve_rows(lo: int, hi: int) -> np.ndarray:
+            # registry rows for one segment: each unique (server, service)
+            # resolved once. Per-SEGMENT (not per-batch) so a tick only ever
+            # sees services registered by entries processed before it — the
+            # same registry growth order as feed()
+            uk, inv = np.unique(keys[lo:hi], return_inverse=True)
+            rowmap = np.fromiter(
+                (self._row_for(*k.split("\x00", 1)) for k in uk), np.int32, len(uk)
+            )
+            return rowmap[inv]
+
+        self._flush_pending()  # interleaved feed() entries must not reorder
+        # tick exactly where feed() would: before each entry whose label
+        # exceeds every label seen so far (running max over arrival order)
+        running_max = np.maximum.accumulate(labels)
+        prior = np.concatenate([[self._latest_label], running_max[:-1]])
+        tick_points = np.nonzero(running_max > prior)[0]
+        track_ordered = self.on_ordered_csv is not None
+        ets_list = end_ts.tolist() if track_ordered else None
+        idx = 0
+        for i in tick_points:
+            i = int(i)
+            if i > idx:
+                if track_ordered:
+                    self._tx_backlog.extend(zip(ets_list[idx:i], good_lines[idx:i]))
+                self._ingest_arrays(resolve_rows(idx, i), labels[idx:i], elaps[idx:i])
+                idx = i
+            label = int(labels[i])
+            self._run_tick(label)
+            self._latest_label = label
+        if track_ordered:
+            self._tx_backlog.extend(zip(ets_list[idx:], good_lines[idx:]))
+        self._ingest_arrays(resolve_rows(idx, len(labels)), labels[idx:], elaps[idx:])
+        return len(labels)
+
+    def _ingest_arrays(self, rows: np.ndarray, labels: np.ndarray, elaps: np.ndarray) -> None:
+        """Scatter pre-decoded arrays in micro_batch_size chunks (one fixed
+        batch shape => the same compiled ingest program as the object path)."""
+        B = self.micro_batch_size
+        dtype = self._np_dtype()
+        for i in range(0, len(rows), B):
+            m = min(B, len(rows) - i)
+            r = np.zeros(B, np.int32)
+            l = np.zeros(B, np.int32)
+            e = np.zeros(B, dtype)
+            v = np.zeros(B, bool)
+            r[:m] = rows[i : i + m]
+            l[:m] = labels[i : i + m]
+            e[:m] = elaps[i : i + m]
+            v[:m] = True
+            self.state = self._ingest(self.state, self.cfg, r, l, e, v)
 
     def flush(self) -> None:
         self._flush_pending()
@@ -428,12 +579,38 @@ class PipelineDriver:
                 self.on_ordered_tx(tx)
         else:
             self.heap.pop_all_leq(edge_ts)
+        # fast-path drain: due raw lines, end_ts-sorted (stable: arrival order
+        # within equal timestamps), one C-speed sort per tick instead of
+        # per-entry heap pushes
+        if self.on_ordered_csv is not None and self._tx_backlog:
+            due = [p for p in self._tx_backlog if p[0] <= edge_ts]
+            if due:
+                self._tx_backlog = [p for p in self._tx_backlog if p[0] > edge_ts]
+                due.sort(key=lambda p: p[0])
+                for _ts, line in due:
+                    self.on_ordered_csv(line)
 
         count = self.registry.count
         if count == 0:
             return
         tpm = np.asarray(emission.tpm[:count])
         metrics = np.asarray(emission.average[:count])  # [count, 3]
+
+        n_overflowed = int(np.asarray(emission.overflowed[:count]).sum())
+        if n_overflowed:
+            self.overflow_rows_total += n_overflowed
+            self.overflow_ticks += 1
+            if self.on_overflow is not None:
+                self.on_overflow(new_label, n_overflowed)
+            if self.logger and self.overflow_ticks - self._overflow_last_logged_tick >= 30:
+                self._overflow_last_logged_tick = self.overflow_ticks
+                self.logger.warning(
+                    f"Percentile reservoir overflow: {n_overflowed} rows this tick "
+                    f"({self.overflow_rows_total} row-ticks total) exceeded "
+                    f"samplesPerBucket={self.cfg.stats.samples_per_bucket}; percentiles "
+                    f"for those rows are reservoir estimates (bounded error). Raise "
+                    f"tpuEngine.samplesPerBucket to restore exactness."
+                )
 
         if self.on_stat is not None:
             for row in range(count):
@@ -447,10 +624,11 @@ class PipelineDriver:
         # channels ride the FullStatEntry wire with lag = channel_id (<0)
         channels = [(spec.lag, em) for spec, em in zip(self.cfg.lags, emission.lags)]
         channels += [(spec.channel_id, em) for spec, em in zip(self.cfg.ewma, emission.ewma)]
+        need_fs = self.on_fullstat is not None
+        need_csv = self.on_fullstat_csv is not None
+        need_alert = self.on_alert is not None or self.alerts_manager is not None
         for chan_id, lag_em in channels:
-            need_fs = self.on_fullstat is not None
-            need_alert = (self.on_alert is not None or self.alerts_manager is not None)
-            if not (need_fs or need_alert):
+            if not (need_fs or need_csv or need_alert):
                 continue
             wavg = np.asarray(lag_em.window_avg[:count])
             lb = np.asarray(lag_em.lower_bound[:count])
@@ -458,27 +636,71 @@ class PipelineDriver:
             sig = np.asarray(lag_em.signal[:count])
             trig = np.asarray(lag_em.trigger[:count])
             bits = np.asarray(lag_em.cause_bits[:count])
-            for row in range(count):
-                is_alert = need_alert and trig[row]
-                if not (need_fs or is_alert):
-                    continue
+
+            def make_fs(row: int) -> FullStatEntry:
                 server, service = self.registry.key_of(row)
-                fs = FullStatEntry(
+                return FullStatEntry(
                     edge_ts, server, service, float(tpm[row]), chan_id,
                     float(metrics[row, 0]), float(wavg[row, 0]), float(lb[row, 0]), float(ub[row, 0]), int(sig[row, 0]),
                     float(metrics[row, 1]), float(wavg[row, 1]), float(lb[row, 1]), float(ub[row, 1]), int(sig[row, 1]),
                     float(metrics[row, 2]), float(wavg[row, 2]), float(lb[row, 2]), float(ub[row, 2]), int(sig[row, 2]),
                 )
-                if need_fs:
+
+            if need_csv:
+                self.on_fullstat_csv(
+                    self._format_fullstat_lines(edge_ts, chan_id, count, tpm, metrics, wavg, lb, ub, sig)
+                )
+            if need_fs:
+                for row in range(count):
+                    fs = make_fs(row)
                     self.on_fullstat(fs)
-                if is_alert and self.alerts_manager is not None:
-                    alert = self.alerts_manager.process_trigger(fs, int(bits[row]))
-                    if alert is not None:
-                        self.alerts_manager.add_to_buffer(alert)
-                        if self.on_alert is not None:
-                            self.on_alert(alert)
-                elif is_alert and self.on_alert is not None:
-                    self.on_alert((fs, int(bits[row])))
+                    if need_alert and trig[row]:
+                        self._dispatch_alert(fs, int(bits[row]))
+            elif need_alert:
+                # alert-only fast path: build objects for triggered rows only
+                for row in np.nonzero(trig)[0]:
+                    self._dispatch_alert(make_fs(int(row)), int(bits[row]))
+
+    def _dispatch_alert(self, fs: FullStatEntry, bits: int) -> None:
+        if self.alerts_manager is not None:
+            alert = self.alerts_manager.process_trigger(fs, bits)
+            if alert is not None:
+                self.alerts_manager.add_to_buffer(alert)
+                if self.on_alert is not None:
+                    self.on_alert(alert)
+        elif self.on_alert is not None:
+            self.on_alert((fs, bits))
+
+    def _format_fullstat_lines(
+        self, edge_ts: int, chan_id, count: int, tpm, metrics, wavg, lb, ub, sig
+    ) -> List[str]:
+        """The tick's FullStat wire lines for one channel, in bulk.
+
+        Byte-identical to ``FullStatEntry(...).to_csv()`` (entries.py wire
+        quirks: nf() 1-decimal toFixed, tpm 2-decimal, bare average signal —
+        entries.js:117) without constructing 20-field dataclasses per row;
+        asserted by tests/test_pipeline.py parity."""
+        from .entries import nf
+
+        ts_s = str(int(edge_ts))
+        t = tpm.tolist()
+        m = metrics.tolist()
+        w = wavg.tolist()
+        lo = lb.tolist()
+        up = ub.tolist()
+        sg = sig.tolist()
+        key_of = self.registry.key_of
+        lines = []
+        for row in range(count):
+            server, service = key_of(row)
+            mr, wr, lr, ur, sr = m[row], w[row], lo[row], up[row], sg[row]
+            lines.append(
+                f"fs|{ts_s}|{server}|{service}|{chan_id}|{nf(t[row], 2)}|"
+                f"{nf(mr[0])}:{nf(wr[0])}:{nf(lr[0])}:{nf(ur[0])}:{sr[0]}|"
+                f"{nf(mr[1])}:{nf(wr[1])}:{nf(lr[1])}:{nf(ur[1])}:{nf(sr[1])}|"
+                f"{nf(mr[2])}:{nf(wr[2])}:{nf(lr[2])}:{nf(ur[2])}:{nf(sr[2])}"
+            )
+        return lines
 
     # -- checkpoint / resume (§5.4) ------------------------------------------
     def save_resume(self, path: str) -> None:
@@ -510,6 +732,12 @@ class PipelineDriver:
             arrays[f"{ek}_count"] = np.asarray(e.count)
             arrays[f"{ek}_counters"] = np.asarray(self.state.ewma_counters[i])
         keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
+        # pending ordered-tx records (not yet past the window edge) must
+        # survive a restart — the reference keeps its heap in the resume file
+        # (stream_calc_stats resume semantics). Stored as wire lines.
+        pending = [tx.to_csv() for tx in self.heap.items()]
+        pending += [line for _ts, line in self._tx_backlog]
+        arrays["pending_tx"] = np.array(pending, dtype=object)
         import tempfile
 
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)), suffix=".tmp")
@@ -592,5 +820,23 @@ class PipelineDriver:
             stats_state, tuple(zstates), tuple(counters), tuple(estates), tuple(ecounters)
         )
         self._latest_label = int(data["latest_bucket"])
+        self.heap = MinHeap(lambda tx: tx.end_ts)
+        self._tx_backlog = []
+        if "pending_tx" in data:  # optional: absent in older snapshots
+            from .entries import EntryFactory
+
+            fac = EntryFactory()
+            for line in data["pending_tx"].tolist():
+                if self.on_ordered_tx is not None:
+                    entry = fac.from_csv(line)
+                    if entry is not None and entry.type == "tx":
+                        self.heap.push(entry)
+                elif self.on_ordered_csv is not None:
+                    p = line.split("|")
+                    if len(p) == 9 and p[0] == "tx":
+                        try:
+                            self._tx_backlog.append((float(p[6]), line))
+                        except ValueError:
+                            pass
         self._refresh_params()
         return True

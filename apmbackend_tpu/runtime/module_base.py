@@ -52,7 +52,7 @@ def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBrok
         from ..transport.amqp import AmqpChannel
 
         conn_str = config.get("amqpConnectionString", "amqp://localhost:5672")
-        factory = lambda _qtype: AmqpChannel(conn_str)  # noqa: E731
+        factory = lambda qtype: AmqpChannel(conn_str, direction=qtype, logger=logger)  # noqa: E731
     else:
         raise ValueError(f"Unknown brokerBackend: {backend!r}")
     qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger)

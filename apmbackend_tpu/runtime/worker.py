@@ -22,6 +22,7 @@ the whole service fleet. The process:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from ..entries import EntryFactory
@@ -74,16 +75,55 @@ class WorkerApp:
             alerts_cfg, logger=logger, email_sender=email_sender, grafana=grafana
         )
 
+        # -- operational alerts (manager-alert channel for engine health) ----
+        # Chronic percentile-reservoir overflow is an operator problem (raise
+        # samplesPerBucket), not a service anomaly, so it rides the manager's
+        # batching alerter rather than the service AlertsManager.
+        from ..manager.manager import ManagerAlerts
+
+        self.ops_alerts = ManagerAlerts(
+            config.get("apmManager", {}), email_sender=email_sender, logger=logger
+        )
+        self._overflow_alerted_ticks = 0
+
         # -- the device pipeline ---------------------------------------------
         self.driver = PipelineDriver(
             config,
             alerts_manager=self.alerts_manager,
             on_stat=(lambda st: self.stats_queue.write_line(st.to_csv())) if self.stats_queue else None,
-            on_fullstat=self._on_fullstat,
-            on_ordered_tx=lambda tx: self.db_queue.write_line(tx.to_csv()),
+            on_fullstat_csv=self._on_fullstat_lines,
+            on_ordered_csv=self.db_queue.write_line,
+            on_overflow=self._on_overflow,
             logger=logger,
             micro_batch_size=int(eng_cfg.get("microBatchSize", 65536)),
         )
+
+        # -- native intake ring ----------------------------------------------
+        # The broker consumer thread pushes raw lines into the C++ SPSC ring;
+        # a dedicated device-loop thread pops micro-batches and feeds the
+        # driver via the bulk CSV path — the boundary the reference crosses
+        # with RabbitMQ deliveries into consumeMsg
+        # (stream_parse_transactions.js:902-975 fan-in scale). Ring-full
+        # blocks the broker thread briefly = natural backpressure. Disable
+        # with tpuEngine.useNativeRing=false; degrades to direct feed when
+        # the native build is unavailable.
+        self._ring = None
+        self._ring_thread: Optional[threading.Thread] = None
+        self._ring_stop = threading.Event()
+        self._ring_pushed = 0  # lines accepted by _consume (single writer thread)
+        self._ring_fed = 0  # lines handed to the driver (single device thread)
+        if eng_cfg.get("useNativeRing", True):
+            try:
+                from ..native import LineRing
+
+                self._ring = LineRing(int(eng_cfg.get("ringBytes", 1 << 22)))
+            except Exception as e:
+                logger.info(f"Native intake ring unavailable (direct feed): {e}")
+        if self._ring is not None:
+            self._ring_thread = threading.Thread(
+                target=self._ring_loop, name="device-loop", daemon=True
+            )
+            self._ring_thread.start()
 
         # -- resume ----------------------------------------------------------
         self.engine_resume = eng_cfg.get("resumeFileFullPath")
@@ -114,19 +154,104 @@ class WorkerApp:
         runtime.on_exit(self.shutdown)
 
     # -- callbacks -----------------------------------------------------------
-    def _on_fullstat(self, fs) -> None:
-        line = fs.to_csv()
-        self.db_queue.write_line(line)  # passthrough: everything lands in Postgres
-        if self.zscore_queue is not None:
-            self.zscore_queue.write_line(line)
+    def _on_fullstat_lines(self, lines) -> None:
+        db_write = self.db_queue.write_line
+        z_write = self.zscore_queue.write_line if self.zscore_queue is not None else None
+        for line in lines:
+            db_write(line)  # passthrough: everything lands in Postgres
+            if z_write is not None:
+                z_write(line)
+
+    def _on_overflow(self, label: int, n_rows: int) -> None:
+        """Percentile-reservoir overflow -> manager alert, heavily rate-limited
+        (first occurrence, then every 360 overflow ticks ~= 1h of log time)."""
+        ticks = self.driver.overflow_ticks
+        if ticks == 1 or ticks - self._overflow_alerted_ticks >= 360:
+            self._overflow_alerted_ticks = ticks
+            self.ops_alerts.add(
+                f"Percentile sample reservoir overflowed for {n_rows} services at "
+                f"bucket {label} ({self.driver.overflow_rows_total} row-ticks total): "
+                f"percentiles for hot services are reservoir estimates. Raise "
+                f"tpuEngine.samplesPerBucket to restore exactness."
+            )
 
     def _consume(self, line: str) -> None:
+        if self._ring is not None and self._ring_thread.is_alive():
+            data = line.encode("utf-8")
+            while not self._ring.push(data):
+                # ring full: block the broker delivery thread = backpressure
+                if self._ring_stop.is_set() or not self._ring_thread.is_alive():
+                    break  # loop died: fall through to the direct path
+                time.sleep(0.001)
+            else:
+                self._ring_pushed += 1
+                return
+        # ring-less (or dead-loop) fallback: the per-line object path — one
+        # from_csv + feed() is far cheaper than feed_csv_batch's numpy
+        # machinery on a single line
         entry = self._factory.from_csv(line)
         if entry is None or entry.type != "tx":
             self.runtime.logger.info(f"Not a transactions entry: {line[:200]}")
             return
         with self._driver_lock:
             self.driver.feed(entry)
+
+    def _ring_loop(self) -> None:
+        """Device-loop thread: pop micro-batches off the intake ring and feed
+        the bulk CSV path. Single popper + single pusher = the ring's SPSC
+        contract."""
+        lines: list = []
+        max_batch = 4096
+        while not self._ring_stop.is_set():
+            rec = self._ring.pop()
+            if rec is None:
+                if lines:
+                    self._feed_lines(lines)
+                    lines = []
+                else:
+                    time.sleep(0.002)
+                continue
+            lines.append(rec.decode("utf-8", "replace"))
+            if len(lines) >= max_batch:
+                self._feed_lines(lines)
+                lines = []
+        while (rec := self._ring.pop()) is not None:  # final drain on stop
+            lines.append(rec.decode("utf-8", "replace"))
+        if lines:
+            self._feed_lines(lines)
+
+    def _feed_lines(self, lines: list) -> None:
+        try:
+            with self._driver_lock:
+                self.driver.feed_csv_batch(lines)
+        except Exception:
+            # the device loop must survive a bad batch: a dead loop would
+            # wedge the broker thread against a full ring forever. The batch
+            # is lost; log loudly and keep consuming (crash-damping, like the
+            # supervisor's module restarts).
+            import traceback
+
+            self.runtime.logger.error(
+                f"Device loop: feed_csv_batch failed; {len(lines)} lines dropped:\n"
+                + traceback.format_exc()
+            )
+        finally:
+            self._ring_fed += len(lines)
+
+    @property
+    def intake_pending(self) -> bool:
+        """Lines accepted but not yet fed to the driver (ring in flight)."""
+        return self._ring is not None and self._ring_fed < self._ring_pushed
+
+    def drain_intake(self, timeout_s: float = 10.0) -> None:
+        """Block until every line pushed so far has been fed to the driver
+        (tests + orderly shutdown)."""
+        if self._ring is None:
+            return
+        target = self._ring_pushed
+        deadline = time.monotonic() + timeout_s
+        while self._ring_fed < target and time.monotonic() < deadline:
+            time.sleep(0.005)
 
     def _schedule_alert_send(self, interval_s: float) -> None:
         def _fire():
@@ -181,12 +306,30 @@ class WorkerApp:
         self._closed = True
         if self._alert_timer is not None:
             self._alert_timer.cancel()
+        if self._ring_thread is not None:
+            self.drain_intake()  # everything consumed must reach the device
+            self._ring_stop.set()
+            # a registry-growth recompile inside the loop can take tens of
+            # seconds on real TPU: wait long, and NEVER destroy the native
+            # ring under a live popper (use-after-free) — leaking it on a
+            # stuck exit is harmless, the process is going down anyway
+            self._ring_thread.join(timeout=60.0)
+            if self._ring_thread.is_alive():
+                self.runtime.logger.error(
+                    "Device loop did not exit within 60s; leaving intake ring allocated"
+                )
+            else:
+                self._ring.close()
         # final flush sends whatever is buffered (sendAlertsRecurse(0, true)
         # on exit, stream_process_alerts.js:575)
         try:
             self.alerts_manager.flush()
         except Exception as e:
             self.runtime.logger.error(f"Final alert flush error: {e}")
+        try:
+            self.ops_alerts.flush()
+        except Exception as e:
+            self.runtime.logger.error(f"Final ops-alert flush error: {e}")
         self.save_state()
 
 

@@ -62,8 +62,14 @@ class StandalonePipeline:
 
     def drain(self) -> None:
         """Pump until quiescent, flush device + sink state (test/replay aid)."""
-        while self.broker.pump():
-            pass
+        while True:
+            pumped = False
+            while self.broker.pump():
+                pumped = True
+            had_intake = self.worker.intake_pending
+            self.worker.drain_intake()  # ring feeding may enqueue more lines
+            if not pumped and not had_intake:
+                break
         self.worker.driver.flush()
         while self.broker.pump():
             pass

@@ -24,8 +24,8 @@ def make_queue_manager(config: dict, *, broker=None, logger=None) -> QueueManage
     if backend == "amqp":
         conn = config["amqpConnectionString"]
 
-        def factory(_kind: str):
-            return AmqpChannel(conn)
+        def factory(kind: str):
+            return AmqpChannel(conn, direction=kind, logger=logger)
 
         return QueueManager(factory, interval, logger=logger)
     raise ValueError(f"Unknown brokerBackend: {backend}")

@@ -1,17 +1,46 @@
-"""AMQP/RabbitMQ backend.
+"""AMQP/RabbitMQ backend with real flow-control detection.
 
 Role parity with queue.js: named durable queues on a RabbitMQ broker,
-ack-on-receipt consumption, publish backpressure. Uses ``pika`` when present;
-this environment ships without an AMQP client, so construction raises a clear
-error and the rest of the framework (which only depends on the Channel
-interface) runs on the memory backend. Wire format on the queues is identical
-(UTF-8 pipe-CSV), so a deployment with RabbitMQ interoperates with reference
-modules consuming the same queues.
+ack-on-receipt consumption, publish backpressure with a drain event. The
+reference holds one connection per direction (queue.js:73-78) and relies on
+Node's channel ``write`` return + ``drain`` event for flow control
+(queue.js:245-263, 88-106). The Python equivalents here:
+
+- **One connection per direction, each owned by a dedicated thread.** pika's
+  BlockingConnection is not thread-safe, so all protocol I/O for a direction
+  happens on that direction's thread; cross-thread requests (publish, declare,
+  consume, cancel) are marshalled through thread-safe queues/op-lists the
+  owning thread drains between ``process_data_events`` pumps.
+- **Backpressure = bounded outbound queue + broker block frames.** ``send()``
+  returns False (the Channel contract's "full" signal) when the broker has
+  sent ``connection.blocked`` (RabbitMQ's memory/disk alarm — the real-world
+  reason a publisher must stop) or when the outbound queue is full because
+  the publisher thread can't keep up. Either way the ProducerQueue buffers
+  and the process-wide pause engages.
+- **Drain.** When pressure was signalled and has cleared (not blocked, the
+  outbound queue drained to the low-water mark), registered ``on_drain``
+  callbacks fire from the publisher thread — QueueManager then retries every
+  producer buffer and emits ``resume`` once all are empty.
+- **Publisher confirms.** The publish channel runs in confirm mode; a
+  nacked/unroutable publish re-queues the line rather than losing it.
+- **Reconnect.** Either thread rebuilds its connection with exponential
+  backoff after an AMQP failure, re-declaring queues and re-installing
+  consumers (crash-only design, like the supervisor restarting a module).
+
+Wire format on the queues is identical (UTF-8 pipe-CSV), so a deployment with
+RabbitMQ interoperates with reference modules consuming the same queues.
+
+The ``pika_module`` hook exists so tests can drive the full
+pause->buffer->drain->resume stack against a faithful in-process fake broker
+(tests/fake_pika.py); production uses the real ``pika`` import.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import queue as pyqueue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .base import Channel
 
@@ -24,54 +53,258 @@ except ImportError:  # pragma: no cover
     HAVE_PIKA = False
 
 
-class AmqpChannel(Channel):  # pragma: no cover - requires live broker
-    def __init__(self, connection_string: str):
-        if not HAVE_PIKA:
+class AmqpChannel(Channel):
+    """One direction ('p' or 'c') of an AMQP link, on its own thread."""
+
+    def __init__(
+        self,
+        connection_string: str,
+        direction: str = "p",
+        *,
+        pika_module=None,
+        logger=None,
+        publish_queue_max: int = 10000,
+        drain_low_water: Optional[int] = None,
+        poll_interval_s: float = 0.05,
+        reconnect_max_backoff_s: float = 10.0,
+    ):
+        self._pika = pika_module if pika_module is not None else pika
+        if self._pika is None:
             raise RuntimeError(
                 "AMQP backend requires the 'pika' package, which is not installed. "
                 "Use brokerBackend='memory' or install pika."
             )
-        params = pika.URLParameters(connection_string)
-        self._connection = pika.BlockingConnection(params)
-        self._channel = self._connection.channel()
-        self._drain_callbacks = []
-        self._consumer_tags = {}
+        if direction not in ("p", "c"):
+            raise ValueError("direction must be 'p' or 'c'")
+        self._url = connection_string
+        self._direction = direction
+        self._logger = logger
+        self._poll_s = poll_interval_s
+        self._max_backoff_s = reconnect_max_backoff_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._queues: Set[str] = set()
+        self._drain_callbacks: List[Callable[[], None]] = []
 
+        # producer side
+        self._out: pyqueue.Queue[Tuple[str, bytes]] = pyqueue.Queue(maxsize=publish_queue_max)
+        self._low_water = publish_queue_max // 4 if drain_low_water is None else drain_low_water
+        self._blocked = False
+        self._pressure = False
+        self._pending_pub: Optional[Tuple[str, bytes]] = None  # in-flight publish
+
+        # consumer side: pending (op, args) requests + active consumers
+        self._consumer_ops: List[Tuple[str, tuple]] = []
+        self._consumers: Dict[str, Tuple[str, Callable[[bytes], None]]] = {}
+
+        target = self._publisher_loop if direction == "p" else self._consumer_loop
+        self._thread = threading.Thread(
+            target=target, name=f"amqp-{direction}", daemon=True
+        )
+        self._thread.start()
+
+    # -- Channel contract ----------------------------------------------------
     def assert_queue(self, name: str) -> None:
-        self._channel.queue_declare(queue=name, durable=True)
+        with self._lock:
+            self._queues.add(name)
 
     def send(self, name: str, payload: bytes) -> bool:
+        if self._direction != "p":
+            raise RuntimeError("send() on a consumer-direction channel")
+        if self._blocked:
+            # broker flow control (connection.blocked): refuse immediately so
+            # the ProducerQueue buffers instead of stacking the outbound queue
+            self._pressure = True
+            return False
         try:
-            self._channel.basic_publish(
-                exchange="",
-                routing_key=name,
-                body=payload,
-                properties=pika.BasicProperties(delivery_mode=2),
-            )
+            self._out.put_nowait((name, payload))
             return True
-        except pika.exceptions.AMQPError:
+        except pyqueue.Full:
+            self._pressure = True
             return False
 
     def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
-        def _on_message(ch, method, properties, body):
-            ch.basic_ack(delivery_tag=method.delivery_tag)  # ack-on-receipt
-            callback(body)
-
-        tag = self._channel.basic_consume(queue=name, on_message_callback=_on_message, consumer_tag=consumer_tag)
-        self._consumer_tags[consumer_tag] = tag
+        if self._direction != "c":
+            raise RuntimeError("consume() on a producer-direction channel")
+        with self._lock:
+            self._queues.add(name)
+            self._consumers[consumer_tag] = (name, callback)
+            self._consumer_ops.append(("consume", (name, callback, consumer_tag)))
 
     def cancel(self, consumer_tag: str) -> None:
-        self._channel.basic_cancel(consumer_tag)
+        with self._lock:
+            self._consumers.pop(consumer_tag, None)
+            self._consumer_ops.append(("cancel", (consumer_tag,)))
 
-    def on_drain(self, callback) -> None:
+    def on_drain(self, callback: Callable[[], None]) -> None:
         self._drain_callbacks.append(callback)
 
-    def close(self) -> None:
-        try:
-            self._channel.close()
-        finally:
-            self._connection.close()
+    def close(self, drain_timeout_s: float = 5.0) -> None:
+        if self._direction == "p":
+            # send() acknowledged these lines: give the publisher a bounded
+            # window to flush the outbound queue AND any in-flight pending
+            # publish before stopping (it cannot drain while the broker holds
+            # the connection blocked)
+            deadline = time.monotonic() + drain_timeout_s
+            while (self._out.qsize() > 0 or self._pending_pub is not None) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            left = self._out.qsize() + (1 if self._pending_pub is not None else 0)
+            if left and self._logger:
+                self._logger.error(
+                    f"AMQP close: {left} queued publishes not flushed within "
+                    f"{drain_timeout_s}s (broker blocked or down); they are lost"
+                )
+        self._stop.set()
+        self._thread.join(timeout=5.0)
 
-    def start_io(self) -> None:
-        """Blocking consume loop (call from a dedicated thread)."""
-        self._channel.start_consuming()
+    # -- introspection (qstat / tests) ---------------------------------------
+    @property
+    def blocked(self) -> bool:
+        return self._blocked
+
+    @property
+    def outbound_depth(self) -> int:
+        return self._out.qsize()
+
+    # -- publisher thread ----------------------------------------------------
+    def _on_blocked(self, *_args) -> None:
+        self._blocked = True
+        if self._logger:
+            self._logger.warning("AMQP broker sent connection.blocked (alarm): pausing publishes")
+
+    def _on_unblocked(self, *_args) -> None:
+        self._blocked = False
+        if self._logger:
+            self._logger.info("AMQP broker sent connection.unblocked: resuming publishes")
+
+    def _maybe_fire_drain(self) -> None:
+        if self._pressure and not self._blocked and self._out.qsize() <= self._low_water:
+            self._pressure = False
+            for cb in list(self._drain_callbacks):
+                try:
+                    cb()
+                except Exception as e:  # a retry bug must not kill the publisher
+                    if self._logger:
+                        self._logger.error(f"AMQP drain callback error: {e}")
+
+    def _connect(self):
+        conn = self._pika.BlockingConnection(self._pika.URLParameters(self._url))
+        ch = conn.channel()
+        return conn, ch
+
+    def _declare_new(self, ch, declared: Set[str]) -> None:
+        with self._lock:
+            to_declare = self._queues - declared
+        for q in sorted(to_declare):
+            ch.queue_declare(queue=q, durable=True)
+            declared.add(q)
+
+    def _publisher_loop(self) -> None:
+        backoff = 0.5
+        while not self._stop.is_set():
+            conn = None
+            try:
+                conn, ch = self._connect()
+                ch.confirm_delivery()
+                conn.add_on_connection_blocked_callback(self._on_blocked)
+                conn.add_on_connection_unblocked_callback(self._on_unblocked)
+                self._blocked = False
+                backoff = 0.5
+                declared: Set[str] = set()
+                while not self._stop.is_set():
+                    self._declare_new(ch, declared)
+                    # pump the connection: heartbeats + blocked/unblocked frames
+                    conn.process_data_events(0)
+                    if self._blocked:
+                        conn.process_data_events(self._poll_s)
+                        continue
+                    if self._pending_pub is None:
+                        try:
+                            # attribute (not a local) so close() can account
+                            # for the in-flight message across reconnects
+                            self._pending_pub = self._out.get(timeout=self._poll_s)
+                        except pyqueue.Empty:
+                            self._maybe_fire_drain()
+                            continue
+                    name, payload = self._pending_pub
+                    if name not in declared:
+                        ch.queue_declare(queue=name, durable=True)
+                        declared.add(name)
+                    ch.basic_publish(
+                        exchange="",
+                        routing_key=name,
+                        body=payload,
+                        properties=self._pika.BasicProperties(delivery_mode=2),
+                    )
+                    self._pending_pub = None
+                    self._maybe_fire_drain()
+            except Exception as e:
+                if self._stop.is_set():
+                    break
+                if self._logger:
+                    self._logger.error(f"AMQP publisher connection error (reconnecting): {e}")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self._max_backoff_s)
+            finally:
+                self._close_quietly(conn)
+
+    # -- consumer thread -----------------------------------------------------
+    def _consumer_loop(self) -> None:
+        backoff = 0.5
+        while not self._stop.is_set():
+            conn = None
+            try:
+                conn, ch = self._connect()
+                backoff = 0.5
+                declared: Set[str] = set()
+                # re-install consumers that survived a reconnect
+                with self._lock:
+                    ops = [("consume", (q, cb, tag)) for tag, (q, cb) in self._consumers.items()]
+                    self._consumer_ops = [
+                        op for op in self._consumer_ops if op[0] != "consume"
+                    ] + ops
+                while not self._stop.is_set():
+                    with self._lock:
+                        ops, self._consumer_ops = self._consumer_ops, []
+                    for op, args in ops:
+                        if op == "consume":
+                            q, cb, tag = args
+                            if q not in declared:
+                                ch.queue_declare(queue=q, durable=True)
+                                declared.add(q)
+
+                            def _on_message(mch, method, _properties, body, _cb=cb):
+                                # ack-on-receipt: at-most-once past this point
+                                # (queue.js:277-283 semantics)
+                                mch.basic_ack(delivery_tag=method.delivery_tag)
+                                _cb(body)
+
+                            ch.basic_consume(
+                                queue=q, on_message_callback=_on_message, consumer_tag=tag
+                            )
+                        else:  # cancel
+                            (tag,) = args
+                            try:
+                                ch.basic_cancel(tag)
+                            except Exception as e:
+                                if self._logger:
+                                    self._logger.error(f"AMQP basic_cancel error: {e}")
+                    conn.process_data_events(self._poll_s)
+            except Exception as e:
+                if self._stop.is_set():
+                    break
+                if self._logger:
+                    self._logger.error(f"AMQP consumer connection error (reconnecting): {e}")
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self._max_backoff_s)
+            finally:
+                self._close_quietly(conn)
+
+    @staticmethod
+    def _close_quietly(conn) -> None:
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass

@@ -82,14 +82,26 @@ class ProducerQueue(EventEmitter):
     def buffer_count(self) -> int:
         return len(self.buffer)
 
-    def _send_locked(self, line: str, verbose: bool) -> bool:
-        """Caller holds self._lock. Returns True when a pause was entered."""
+    def _send_locked(self, line: str, verbose: bool, requeue_front: bool = False) -> bool:
+        """Caller holds self._lock. Returns True when a pause was entered.
+
+        ``requeue_front`` is set by retry_buffer: a line popped from the front
+        of the buffer that the channel refuses must go BACK to the front
+        (queue.js:230-243 unshift), not the back — appending would rotate one
+        line to the end of the stream on every pressure episode.
+        """
         if self.paused:
-            self.buffer.append(line)
+            if requeue_front:
+                self.buffer.insert(0, line)
+            else:
+                self.buffer.append(line)
             return False
         ok = self.channel.send(self.queue_name, line.encode("utf-8"))
         if not ok:
-            self.buffer.append(line)
+            if requeue_front:
+                self.buffer.insert(0, line)
+            else:
+                self.buffer.append(line)
             self.paused = True
             return True
         if verbose and self.logger:
@@ -116,7 +128,7 @@ class ProducerQueue(EventEmitter):
             self.paused = False
             while self.buffer and not self.paused:
                 line = self.buffer.pop(0)
-                self._send_locked(line, False)
+                self._send_locked(line, False, requeue_front=True)
             remaining = len(self.buffer)
         if remaining and self.logger:
             self.logger.info(

@@ -1,0 +1,223 @@
+"""A faithful in-process fake of the pika API surface AmqpChannel uses.
+
+Models the RabbitMQ behaviors the backpressure stack depends on:
+
+- named durable queues holding message bodies FIFO;
+- ``connection.blocked`` / ``connection.unblocked`` frames driven by a
+  broker-wide depth alarm (RabbitMQ's memory/disk alarm analog): when total
+  queued bodies exceed ``block_at`` every connection's blocked callback
+  fires; when depth falls to ``unblock_at`` the unblocked callback fires;
+- ``basic_consume`` delivery with per-connection pumping: messages are
+  delivered inside ``process_data_events`` of the connection that registered
+  the consumer — exactly where BlockingConnection invokes callbacks;
+- ``basic_ack`` bookkeeping (delivery is ack-on-receipt upstream);
+- connection kill switch (``FakeBroker.kill_connections``) to exercise the
+  reconnect path.
+
+Usage: ``broker = FakeBroker(...); mod = make_fake_pika(broker)`` and pass
+``pika_module=mod`` to AmqpChannel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from types import SimpleNamespace
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class _FakeAMQPError(Exception):
+    pass
+
+
+class _FakeConnectionError(_FakeAMQPError):
+    pass
+
+
+class FakeBroker:
+    def __init__(self, block_at: int = 50, unblock_at: int = 10):
+        self.block_at = block_at
+        self.unblock_at = unblock_at
+        self.lock = threading.RLock()
+        self.queues: Dict[str, deque] = defaultdict(deque)
+        self.declared: set = set()
+        self.blocked = False
+        self.connections: List["FakeBlockingConnection"] = []
+        self.publish_count = 0
+        self.ack_count = 0
+        self.block_events = 0
+        self.unblock_events = 0
+
+    # -- depth alarm ---------------------------------------------------------
+    def _total_depth_locked(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def _update_alarm_locked(self) -> None:
+        depth = self._total_depth_locked()
+        if not self.blocked and depth >= self.block_at:
+            self.blocked = True
+            self.block_events += 1
+            for conn in list(self.connections):
+                conn._notify_blocked()
+        elif self.blocked and depth <= self.unblock_at:
+            self.blocked = False
+            self.unblock_events += 1
+            for conn in list(self.connections):
+                conn._notify_unblocked()
+
+    # -- broker ops ----------------------------------------------------------
+    def publish(self, routing_key: str, body: bytes) -> None:
+        with self.lock:
+            self.queues[routing_key].append(body)
+            self.publish_count += 1
+            self._update_alarm_locked()
+
+    def pop(self, queue_name: str) -> Optional[bytes]:
+        with self.lock:
+            q = self.queues.get(queue_name)
+            if not q:
+                return None
+            body = q.popleft()
+            self._update_alarm_locked()
+            return body
+
+    def depth(self, queue_name: str) -> int:
+        with self.lock:
+            return len(self.queues.get(queue_name, ()))
+
+    def kill_connections(self) -> None:
+        """Simulate a broker restart: every live connection starts raising."""
+        with self.lock:
+            for conn in list(self.connections):
+                conn._killed = True
+            self.connections.clear()
+
+
+class FakeChannel:
+    def __init__(self, conn: "FakeBlockingConnection"):
+        self._conn = conn
+        self.is_open = True
+        self._confirms = False
+
+    def _check(self) -> None:
+        if self._conn._killed or not self.is_open:
+            raise _FakeConnectionError("channel/connection closed")
+
+    def queue_declare(self, queue: str, durable: bool = False):
+        self._check()
+        with self._conn._broker.lock:
+            self._conn._broker.declared.add(queue)
+        return SimpleNamespace(method=SimpleNamespace(queue=queue))
+
+    def confirm_delivery(self) -> None:
+        self._check()
+        self._confirms = True
+
+    def basic_publish(self, exchange: str, routing_key: str, body: bytes, properties=None) -> None:
+        self._check()
+        self._conn._broker.publish(routing_key, body)
+
+    def basic_consume(self, queue: str, on_message_callback: Callable, consumer_tag: str) -> str:
+        self._check()
+        self._conn._consumers[consumer_tag] = (queue, on_message_callback, self)
+        return consumer_tag
+
+    def basic_cancel(self, consumer_tag: str) -> None:
+        self._check()
+        self._conn._consumers.pop(consumer_tag, None)
+
+    def basic_ack(self, delivery_tag=None) -> None:
+        with self._conn._broker.lock:
+            self._conn._broker.ack_count += 1
+
+    def close(self) -> None:
+        self.is_open = False
+
+
+class FakeBlockingConnection:
+    def __init__(self, params, _broker: FakeBroker = None):
+        broker = params.broker if hasattr(params, "broker") else _broker
+        self._broker = broker
+        self._killed = False
+        self.is_open = True
+        self._consumers: Dict[str, Tuple[str, Callable, FakeChannel]] = {}
+        self._blocked_cbs: List[Callable] = []
+        self._unblocked_cbs: List[Callable] = []
+        self._threadsafe_cbs: List[Callable] = []
+        self._delivery_tag = 0
+        with broker.lock:
+            broker.connections.append(self)
+            # late join while the alarm is up must still learn about it
+            if broker.blocked:
+                self._notify_blocked()
+
+    def channel(self) -> FakeChannel:
+        if self._killed:
+            raise _FakeConnectionError("connection killed")
+        return FakeChannel(self)
+
+    def add_on_connection_blocked_callback(self, cb: Callable) -> None:
+        self._blocked_cbs.append(cb)
+
+    def add_on_connection_unblocked_callback(self, cb: Callable) -> None:
+        self._unblocked_cbs.append(cb)
+
+    def add_callback_threadsafe(self, cb: Callable) -> None:
+        self._threadsafe_cbs.append(cb)
+
+    def _notify_blocked(self) -> None:
+        for cb in list(self._blocked_cbs):
+            cb(self, SimpleNamespace(method="connection.blocked"))
+
+    def _notify_unblocked(self) -> None:
+        for cb in list(self._unblocked_cbs):
+            cb(self, SimpleNamespace(method="connection.unblocked"))
+
+    def process_data_events(self, time_limit: float = 0) -> None:
+        if self._killed:
+            raise _FakeConnectionError("connection killed")
+        cbs, self._threadsafe_cbs = self._threadsafe_cbs, []
+        for cb in cbs:
+            cb()
+        delivered = 0
+        for tag, (queue_name, on_message, ch) in list(self._consumers.items()):
+            while True:
+                body = self._broker.pop(queue_name)
+                if body is None:
+                    break
+                self._delivery_tag += 1
+                method = SimpleNamespace(delivery_tag=self._delivery_tag, consumer_tag=tag)
+                on_message(ch, method, SimpleNamespace(), body)
+                delivered += 1
+        if delivered == 0 and time_limit:
+            time.sleep(min(time_limit, 0.005))
+
+    def close(self) -> None:
+        self.is_open = False
+        with self._broker.lock:
+            if self in self._broker.connections:
+                self._broker.connections.remove(self)
+
+
+def make_fake_pika(broker: FakeBroker):
+    """A module-like object exposing the pika surface AmqpChannel touches."""
+
+    def URLParameters(url: str):
+        return SimpleNamespace(url=url, broker=broker)
+
+    def BasicProperties(delivery_mode=None, **kw):
+        return SimpleNamespace(delivery_mode=delivery_mode, **kw)
+
+    exceptions = SimpleNamespace(
+        AMQPError=_FakeAMQPError,
+        AMQPConnectionError=_FakeConnectionError,
+        UnroutableError=_FakeAMQPError,
+        NackError=_FakeAMQPError,
+    )
+    return SimpleNamespace(
+        URLParameters=URLParameters,
+        BlockingConnection=FakeBlockingConnection,
+        BasicProperties=BasicProperties,
+        exceptions=exceptions,
+    )

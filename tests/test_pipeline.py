@@ -228,3 +228,120 @@ def test_resume_valid_zip_wrong_contents_starts_fresh(tmp_path):
     # driver still usable after the rejected load
     drv.feed(TxEntry("s", "x", "", "1", (BASE * 10000) - 100, BASE * 10000, 100, "N"))
     drv.flush()
+
+
+def test_overflow_surfaced_via_counters_and_callback():
+    """Reservoir overflow must be consumed, not just computed: driver counters
+    advance and the on_overflow hook fires with the affected row count."""
+    cfg = small_config(capacity=4)
+    cfg["tpuEngine"]["samplesPerBucket"] = 4
+    cfg["tpuEngine"]["dtype"] = "float32"
+    overflow_events = []
+    drv = PipelineDriver(cfg, on_overflow=lambda label, n: overflow_events.append((label, n)))
+    label = BASE
+    # 30 tx for one service in one bucket >> CAP=4
+    for j in range(30):
+        ts = label * 10000 + j
+        drv.feed(TxEntry("jvm1", "S:hot", f"l{j}", "1", ts - 100, ts, 100, "Y"))
+    # advance far enough that `label` lands inside the stats window
+    edge_label = label + drv.cfg.stats.buffer_sz + 1
+    drv.feed(TxEntry("jvm1", "S:hot", "lx", "1", edge_label * 10000 - 100, edge_label * 10000, 100, "Y"))
+    assert drv.overflow_ticks >= 1
+    assert drv.overflow_rows_total >= 1
+    assert overflow_events and overflow_events[0][1] >= 1
+
+
+def _stream_lines(rng, n_ticks=12, keys=(("jvm1", "S:a"), ("jvm1", "S:b"), ("jvm2", "S:c"))):
+    txs = make_stream(rng, n_ticks=n_ticks, keys=keys)
+    return txs, [tx.to_csv() for tx in txs]
+
+
+def test_feed_csv_batch_matches_object_path():
+    """The bulk CSV fast path must reproduce the object path exactly:
+    same FullStat emissions, same ordered-tx drain, same device state."""
+    rng = np.random.RandomState(23)
+    txs, lines = _stream_lines(rng)
+    cfg = small_config()
+
+    fs_a, ordered_a = [], []
+    drv_a = PipelineDriver(
+        cfg, on_fullstat=lambda fs: fs_a.append(fs.to_csv()),
+        on_ordered_tx=lambda tx: ordered_a.append(tx.to_csv()),
+    )
+    for tx in txs:
+        drv_a.feed(tx)
+    drv_a.flush()
+
+    fs_b, ordered_b = [], []
+    drv_b = PipelineDriver(
+        cfg, on_fullstat=lambda fs: fs_b.append(fs.to_csv()),
+        on_ordered_csv=ordered_b.append,
+    )
+    # uneven chunks exercise tick splits at arbitrary batch boundaries
+    i = 0
+    for size in (7, 64, 3, 999, 11, 10_000):
+        drv_b.feed_csv_batch(lines[i : i + size])
+        i += size
+    drv_b.feed_csv_batch(lines[i:])
+    drv_b.flush()
+
+    assert fs_b == fs_a
+    # heap drain orders by end_ts; both paths must agree on the multiset per
+    # tick and the timestamp ordering (heap ties are arbitrary, sort ties are
+    # stable) — compare end_ts-sorted
+    assert sorted(ordered_b) == sorted(ordered_a)
+    assert np.array_equal(
+        np.asarray(drv_a.state.stats.counts), np.asarray(drv_b.state.stats.counts)
+    )
+    assert np.allclose(
+        np.asarray(drv_a.state.stats.sums), np.asarray(drv_b.state.stats.sums)
+    )
+    sa = np.nan_to_num(np.asarray(drv_a.state.stats.samples), nan=-1)
+    sb = np.nan_to_num(np.asarray(drv_b.state.stats.samples), nan=-1)
+    assert np.array_equal(sa, sb)  # deterministic reservoir parity too
+
+
+def test_fullstat_csv_lines_byte_identical_to_objects():
+    rng = np.random.RandomState(31)
+    txs, lines = _stream_lines(rng, n_ticks=10)
+    cfg = small_config()
+
+    obj_lines = []
+    drv_a = PipelineDriver(cfg, on_fullstat=lambda fs: obj_lines.append(fs.to_csv()))
+    for tx in txs:
+        drv_a.feed(tx)
+
+    csv_lines = []
+    drv_b = PipelineDriver(cfg, on_fullstat_csv=csv_lines.extend)
+    drv_b.feed_csv_batch(lines)
+
+    assert csv_lines == obj_lines
+
+
+def test_feed_csv_batch_drops_malformed():
+    cfg = small_config()
+    drv = PipelineDriver(cfg)
+    n = drv.feed_csv_batch(
+        [
+            "st|1700|jvm1|S:a|1|2|3|4",  # not a tx
+            "tx|jvm1|S:a|l1|1",  # wrong arity
+            f"tx|jvm1|S:a|l1|1|{BASE * 10000 - 100}|{BASE * 10000}|100|Y",  # good
+            "tx|jvm1|S:a|l1|1|garbage|alsogarbage|100|Y",  # NaN end_ts
+        ]
+    )
+    assert n == 1
+
+
+def test_feed_csv_batch_heap_skipped_without_consumer():
+    """No ordered-tx consumer => neither the heap nor the backlog grow."""
+    rng = np.random.RandomState(5)
+    txs, lines = _stream_lines(rng, n_ticks=6)
+    cfg = small_config()
+    drv = PipelineDriver(cfg)
+    drv.feed_csv_batch(lines)
+    assert drv.heap.size() == 0
+    assert drv._tx_backlog == []
+    drv2 = PipelineDriver(cfg)
+    for tx in txs:
+        drv2.feed(tx)
+    assert drv2.heap.size() == 0

@@ -49,7 +49,8 @@ def test_replay_to_database(tmp_path):
     # newer than the last 10 s tick edge stay pending (and persist via the
     # stats resume snapshot, like the reference's heap-in-resume-file)
     assert n_tx >= 80
-    pending = pipe.worker.driver.heap.size()
+    drv = pipe.worker.driver
+    pending = drv.heap.size() + len(drv._tx_backlog)
     assert pending > 0
     # z-score passthrough rows (2 lags x services x ticks) land in stats
     n_fs = conn.execute("SELECT COUNT(*) FROM stats").fetchone()[0]
