@@ -51,10 +51,11 @@ class StatsConfig(NamedTuple):
     interval_len_s: int = 10  # intervalLengthInSeconds
     samples_per_bucket: int = 128  # CAP
     dtype: jnp.dtype = jnp.float32
-    # percentile implementation: "auto" (Pallas selection kernel on TPU+f32,
-    # sort elsewhere), "sort" (XLA per-row sort), or "pallas" (force the
-    # kernel; interpret-mode off-TPU). Both are exact — see
-    # ops/pallas_kernels.py for the equivalence argument.
+    # percentile implementation — ALL exact:
+    #   "auto"   -> "topk" (jax.lax.top_k over the top quarter of each row)
+    #   "sort"   -> XLA per-row full sort + reference index math
+    #   "pallas" -> bit-binary-search selection kernel (opt-in until proven
+    #               on real TPU hardware; interpret-mode off-TPU)
     percentile_impl: str = "auto"
 
     @property
@@ -158,6 +159,15 @@ def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) ->
     stream_calc_stats.js:348-370).
     """
     NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
+    # the reservoir dedupe key below composes (row, slot, pos) in int32; this
+    # is a static shape property, so enforce it at trace time rather than
+    # letting a grown fleet silently wrap the key space
+    if cfg.capacity * NB * (CAP + 1) > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"capacity {cfg.capacity} x num_buckets {NB} x (samples_per_bucket+1) "
+            f"{CAP + 1} exceeds the int32 dedupe-key space (~450k rows at stock "
+            f"window sizes); shard the fleet across devices instead"
+        )
     rows = jnp.asarray(rows, jnp.int32)
     labels = jnp.asarray(labels, jnp.int32)
     elapsed = jnp.asarray(elapsed, cfg.dtype)
@@ -191,8 +201,8 @@ def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) ->
     ok = valid & (pos < CAP)
     pos = jnp.where(ok, pos, CAP)  # CAP is out of bounds -> dropped
     # dedupe within-batch writes to the same (row, slot, pos): keep the latest
-    # arrival. wkey stays in int32 while S*NB*(CAP+1) < 2^31 (~450k rows at
-    # stock NB=37, CAP=128) — far above serviceCapacity scales.
+    # arrival. wkey staying inside int32 is enforced by the trace-time check
+    # at the top of this function.
     wkey = key * (CAP + 1) + pos
     ok = ok & _keep_last(wkey, ok)
     pos = jnp.where(ok, pos, CAP)
@@ -232,6 +242,37 @@ def percentile_rank(n: jnp.ndarray, p: int):
     idx1 = jnp.where(is_int | (n == 1), jnp.maximum(idx_exact, 0), idx_ceil)
     take_pair = (~is_int) & (n > 1) & (idx_ceil != last)
     return (idx1 + 1).astype(jnp.int32), take_pair
+
+
+def topk_percentiles(window: jnp.ndarray, n: jnp.ndarray, ps) -> tuple:
+    """Exact reference percentiles via ``jax.lax.top_k`` instead of a full sort.
+
+    For p >= 75 both the rank element and its interpolation neighbor always
+    sit within the top ``0.25n + 1 <= N//4 + 2`` values of the row: the r-th
+    smallest of n (1-indexed, a[r-1] ascending) is d[n-r] in descending
+    order, and r >= ceil(p*n/100) - 1 >= 0.75n - 1 bounds n-r. top_k is
+    O(N log k) and maps far better onto the TPU than the O(N log^2 N)
+    bitonic sort of the whole window; the result is the exact order
+    statistic, not an approximation (property-tested against the sort path).
+    NaN = empty slots (sorted past +inf by the sort path) become -inf here so
+    they fall OUT of the top-k window instead.
+    """
+    if min(ps) < 75:  # the k bound above assumes p >= 75
+        raise ValueError(f"topk percentile path requires p >= 75, got {ps}")
+    N = window.shape[-1]
+    k = min(N, N // 4 + 2)
+    neg = jnp.where(jnp.isnan(window), -jnp.inf, window)
+    top = jax.lax.top_k(neg, k)[0]  # [..., k] descending
+    outs = []
+    for p in ps:
+        rank, take_pair = percentile_rank(n, p)
+        idx1 = jnp.clip(n - rank, 0, k - 1)  # d[n-r] == a[r-1]
+        idx2 = jnp.clip(jnp.where(take_pair, n - rank - 1, idx1), 0, k - 1)
+        v1 = jnp.take_along_axis(top, idx1[..., None], axis=-1)[..., 0]
+        v2 = jnp.take_along_axis(top, idx2[..., None], axis=-1)[..., 0]
+        out = jnp.where(take_pair, (v1 + v2) / 2.0, v1)
+        outs.append(jnp.where(n > 0, out, jnp.nan))
+    return tuple(outs)
 
 
 def reference_percentile_sorted(sorted_vals: jnp.ndarray, n: jnp.ndarray, p: int) -> jnp.ndarray:
@@ -292,14 +333,15 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
     window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
     impl = cfg.percentile_impl
     if impl == "auto":
-        # The selection kernel is exact and parity-tested in interpret mode,
-        # but has NOT yet been timed/proven on real TPU hardware, so "auto"
-        # plays it safe with the XLA sort path on every backend. Run
-        # benchmarks/bench_pallas.py on a TPU for the parity+timing proof,
-        # then opt in with percentile_impl="pallas" (config
-        # tpuEngine.percentileImpl) if it wins.
-        impl = "sort"
-    if impl == "pallas":
+        # top_k: exact (pure XLA semantics, no hardware-specific kernel to
+        # prove), and only touches the top quarter of each row instead of
+        # sorting the whole window. The Pallas selection kernel stays opt-in
+        # ("pallas") until benchmarks/bench_pallas.py has proven it on real
+        # TPU hardware; "sort" remains as the reference-shaped fallback.
+        impl = "topk"
+    if impl == "topk":
+        per75, per95 = topk_percentiles(window_samples, stored, (75, 95))
+    elif impl == "pallas":
         if cfg.dtype == jnp.float64:
             # the kernel is f32-only; a silent downcast would break the f64
             # reference-parity mode (auto never picks pallas for f64)
@@ -334,6 +376,12 @@ def grow_state(state: StatsState, cfg: StatsConfig, new_capacity: int) -> Tuple[
     S_old = state.counts.shape[0]
     if new_capacity < S_old:
         raise ValueError("cannot shrink")
+    if new_capacity * cfg.num_buckets * (cfg.samples_per_bucket + 1) > np.iinfo(np.int32).max:
+        raise ValueError(
+            f"growing to {new_capacity} rows would overflow the int32 reservoir "
+            f"dedupe-key space (~450k rows at stock window sizes); shard the "
+            f"fleet across devices instead"
+        )
     pad = new_capacity - S_old
     new_cfg = cfg._replace(capacity=new_capacity)
     return StatsState(

@@ -72,8 +72,17 @@ def step(
     new_values: jnp.ndarray,  # [S, 3]: this tick's average/per75/per95 per row
     threshold: jnp.ndarray,  # [S]
     influence: jnp.ndarray,  # [S]
+    active=None,  # [S] bool: rows that exist in the registry (None = all)
 ) -> Tuple[ZScoreResult, ZScoreState]:
+    """``active`` gates the warm-up: the reference creates a key's rolling
+    lists at the key's FIRST StatEntry, so a service first seen mid-run waits
+    a full lag window before signalling. Without the mask every dense row
+    accrues ``fill`` from engine start and a late-registered service would
+    open its warm-up gate up to ``lag`` ticks early (z-score bounds over a
+    near-empty window — false alerts on fresh deploys)."""
     S, L = cfg.capacity, cfg.lag
+    if active is None:
+        active = jnp.ones((S,), bool)
     vals = state.values  # [S, 3, L]
     fill = state.fill  # [S]
     full = fill >= L  # [S] — signal eligibility (raw length incl. NaN pushes)
@@ -84,9 +93,20 @@ def step(
     has_avg = (cnt > 0) & full[:, None]
     mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
 
+    # Degenerate (all-equal) windows are resolved EXACTLY, not by float luck:
+    # whether sum(x*k)/k reproduces x depends on the value and the summation
+    # order (the reference's linear JS reduce and XLA's tree reduction can
+    # disagree), which would turn "zero variance -> no signal"
+    # (util_methods.js:44-48, the documented intent) into a coin flip with
+    # std ~ 1e-13 signalling on any deviation. max==min is order-independent.
+    vmax = jnp.max(jnp.where(valid, vals, -jnp.inf), axis=-1)
+    vmin = jnp.min(jnp.where(valid, vals, jnp.inf), axis=-1)
+    all_equal = has_avg & (vmax == vmin)
+    mean = jnp.where(all_equal, vmax, mean)
+
     diff = jnp.where(valid, vals - mean[..., None], 0)
     var = jnp.where(has_avg, jnp.sum(diff * diff, axis=-1) / jnp.maximum(cnt, 1), jnp.nan)
-    has_std = has_avg & (var > 0)  # var==0 -> std undefined (the quirk)
+    has_std = has_avg & ~all_equal & (var > 0)  # var==0 -> std undefined (the quirk)
     std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
 
     thr = threshold[:, None]
@@ -107,11 +127,14 @@ def step(
     pushed = jnp.where(can_damp, infl * new_values + (1 - infl) * last_val, new_values)
 
     # shift-at-lag semantics: write slot = pos when full (overwriting the
-    # oldest), else fill (append); fill grows to L then stays
+    # oldest), else fill (append); fill grows to L then stays. Inactive rows
+    # (not yet in the registry) do not push: their history starts at
+    # registration, like the reference's per-key list creation.
     write_idx = jnp.where(full, state.pos, fill)  # [S]
     new_vals = jax.vmap(lambda v, i, p: v.at[:, i].set(p))(vals, write_idx, pushed.astype(cfg.dtype))
-    new_fill = jnp.minimum(fill + 1, L)
-    new_pos = jnp.where(full, (state.pos + 1) % L, state.pos)
+    new_vals = jnp.where(active[:, None, None], new_vals, vals)
+    new_fill = jnp.where(active, jnp.minimum(fill + 1, L), fill)
+    new_pos = jnp.where(full & active, (state.pos + 1) % L, state.pos)
 
     result = ZScoreResult(
         window_avg=mean.astype(cfg.dtype),

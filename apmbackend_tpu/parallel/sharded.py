@@ -103,6 +103,7 @@ def _params_specs(cfg: EngineConfig) -> EngineParams:
         influences=tuple(_ROW for _ in cfg.lags),
         hard_max_ms=_ROW,
         suppressed=_ROW,
+        active=_ROW,
     )
 
 

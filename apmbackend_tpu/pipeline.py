@@ -74,6 +74,10 @@ class EngineParams(NamedTuple):
     influences: Tuple[jnp.ndarray, ...]  # [S] per lag
     hard_max_ms: jnp.ndarray  # [S]
     suppressed: jnp.ndarray  # [S] bool
+    # rows that exist in the registry: gates the z-score warm-up so a
+    # service first seen mid-run waits a full lag window (reference per-key
+    # list-creation semantics). None = treat every row as active.
+    active: Optional[jnp.ndarray] = None  # [S] bool
 
 
 class LagEmission(NamedTuple):
@@ -130,7 +134,8 @@ def engine_tick(
     for i, spec in enumerate(cfg.lags):
         zcfg = dzscore.ZScoreConfig(cfg.capacity, spec.lag, cfg.stats.dtype)
         zres, zstate = dzscore.step(
-            state.zscores[i], zcfg, new_values, params.thresholds[i], params.influences[i]
+            state.zscores[i], zcfg, new_values,
+            params.thresholds[i], params.influences[i], params.active,
         )
         ares = dalerts.eval_rules(
             state.alert_counters[i],
@@ -271,6 +276,7 @@ def make_demo_engine(
         ),
         hard_max_ms=jnp.full(S, hard_max_ms, cfg.stats.dtype),
         suppressed=jnp.zeros(S, bool),
+        active=jnp.ones(S, bool),  # demo fleets are fully populated
     )
     return cfg, state, params
 
@@ -362,7 +368,9 @@ class PipelineDriver:
             influences=tuple(jnp.asarray(zparams[l]["influence"]) for l in lag_values),
             hard_max_ms=jnp.asarray(aparams["hard_max_ms"]),
             suppressed=jnp.asarray(aparams["suppressed"]),
+            active=jnp.asarray(np.arange(self.cfg.capacity) < self.registry.count),
         )
+        self._params_registry_count = self.registry.count
 
     def apply_config(self, apm_config: dict) -> None:
         """Hot-reload hook: re-derive per-row params (thresholds, overrides,
@@ -497,18 +505,25 @@ class PipelineDriver:
         def resolve_rows(lo: int, hi: int) -> np.ndarray:
             # registry rows for one segment: each unique (server, service)
             # resolved once. Per-SEGMENT (not per-batch) so a tick only ever
-            # sees services registered by entries processed before it — the
-            # same registry growth order as feed()
-            uk, inv = np.unique(keys[lo:hi], return_inverse=True)
-            rowmap = np.fromiter(
-                (self._row_for(*k.split("\x00", 1)) for k in uk), np.int32, len(uk)
+            # sees services registered by entries processed before it, and
+            # new keys register in FIRST-APPEARANCE order (np.unique sorts,
+            # which would permute emission row order vs feed())
+            uk, first_idx, inv = np.unique(
+                keys[lo:hi], return_index=True, return_inverse=True
             )
+            rowmap = np.empty(len(uk), np.int32)
+            for j in np.argsort(first_idx, kind="stable"):
+                rowmap[j] = self._row_for(*uk[j].split("\x00", 1))
             return rowmap[inv]
 
         self._flush_pending()  # interleaved feed() entries must not reorder
         # tick exactly where feed() would: before each entry whose label
-        # exceeds every label seen so far (running max over arrival order)
-        running_max = np.maximum.accumulate(labels)
+        # exceeds every label seen so far — INCLUDING the pre-batch latest.
+        # Without the floor, a batch that is internally increasing but wholly
+        # below the resumed latest (stale backfill after a restart) would
+        # tick backward and regress the label mirror (caught by the soak
+        # test's mid-run kill/restore).
+        running_max = np.maximum(np.maximum.accumulate(labels), self._latest_label)
         prior = np.concatenate([[self._latest_label], running_max[:-1]])
         tick_points = np.nonzero(running_max > prior)[0]
         track_ordered = self.on_ordered_csv is not None
@@ -570,6 +585,10 @@ class PipelineDriver:
 
     # -- tick ----------------------------------------------------------------
     def _run_tick(self, new_label: int) -> None:
+        if self.registry.count != self._params_registry_count:
+            # newly registered services activate (z-score warm-up starts) at
+            # the next tick boundary — the reference's per-key list creation
+            self._refresh_params()
         emission, self.state = self._tick(self.state, self.cfg, new_label, self.params)
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
 

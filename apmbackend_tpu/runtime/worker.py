@@ -112,6 +112,18 @@ class WorkerApp:
         self._ring_stop = threading.Event()
         self._ring_pushed = 0  # lines accepted by _consume (single writer thread)
         self._ring_fed = 0  # lines handed to the driver (single device thread)
+        # ring-full escape hatch: the broker delivery thread must not block
+        # unboundedly (an AMQP consumer that stops pumping past the heartbeat
+        # timeout gets its connection dropped). After a bounded spin, lines
+        # overflow into this capped FIFO, drained by the device loop ahead of
+        # newer ring entries; beyond the cap, drop-oldest + count.
+        import collections
+
+        self._overflow: collections.deque = collections.deque()
+        self._overflow_lock = threading.Lock()
+        self._overflow_max = int(eng_cfg.get("intakeOverflowMaxLines", 200_000))
+        self.intake_dropped = 0
+        self._ring_spin_s = float(eng_cfg.get("ringFullMaxBlockSeconds", 2.0))
         if eng_cfg.get("useNativeRing", True):
             try:
                 from ..native import LineRing
@@ -177,11 +189,21 @@ class WorkerApp:
 
     def _consume(self, line: str) -> None:
         if self._ring is not None and self._ring_thread.is_alive():
+            # FIFO: while older overflow lines are pending, new lines must
+            # queue behind them, not jump into the ring
+            if self._overflow:
+                self._enqueue_overflow(line)
+                return
             data = line.encode("utf-8")
+            deadline = time.monotonic() + self._ring_spin_s
             while not self._ring.push(data):
-                # ring full: block the broker delivery thread = backpressure
+                # ring full: brief blocking = backpressure; bounded so an
+                # AMQP delivery callback keeps servicing heartbeats
                 if self._ring_stop.is_set() or not self._ring_thread.is_alive():
                     break  # loop died: fall through to the direct path
+                if time.monotonic() > deadline:
+                    self._enqueue_overflow(line)
+                    return
                 time.sleep(0.001)
             else:
                 self._ring_pushed += 1
@@ -196,10 +218,30 @@ class WorkerApp:
         with self._driver_lock:
             self.driver.feed(entry)
 
+    def _enqueue_overflow(self, line: str) -> None:
+        with self._overflow_lock:
+            self._overflow.append(line)
+            if len(self._overflow) > self._overflow_max:
+                self._overflow.popleft()
+                self.intake_dropped += 1
+                if self.intake_dropped % 10_000 == 1:
+                    self.runtime.logger.error(
+                        f"Intake overflow past {self._overflow_max} lines while the "
+                        f"device loop is stalled: {self.intake_dropped} oldest lines dropped"
+                    )
+        self._ring_pushed += 1
+
+    def _drain_overflow_locked_pop(self, max_batch: int) -> list:
+        with self._overflow_lock:
+            n = min(len(self._overflow), max_batch)
+            return [self._overflow.popleft() for _ in range(n)]
+
     def _ring_loop(self) -> None:
         """Device-loop thread: pop micro-batches off the intake ring and feed
         the bulk CSV path. Single popper + single pusher = the ring's SPSC
-        contract."""
+        contract. Overflowed lines (ring-full escape hatch) are older than
+        anything pushed after them, so they drain once the ring is empty and
+        block newer pushes until gone (FIFO preserved)."""
         lines: list = []
         max_batch = 4096
         while not self._ring_stop.is_set():
@@ -208,6 +250,10 @@ class WorkerApp:
                 if lines:
                     self._feed_lines(lines)
                     lines = []
+                elif self._overflow:
+                    batch = self._drain_overflow_locked_pop(max_batch)
+                    if batch:
+                        self._feed_lines(batch)
                 else:
                     time.sleep(0.002)
                 continue
@@ -219,6 +265,9 @@ class WorkerApp:
             lines.append(rec.decode("utf-8", "replace"))
         if lines:
             self._feed_lines(lines)
+        tail = self._drain_overflow_locked_pop(self._overflow_max)
+        if tail:
+            self._feed_lines(tail)
 
     def _feed_lines(self, lines: list) -> None:
         try:

@@ -101,6 +101,15 @@ class GoldenZScore:
         if len(lst) >= self.lag:
             avg = js_average(lst)
             std = js_standard_deviation(lst)
+            # degenerate all-equal windows: zero variance exactly (the
+            # reference's documented intent, util_methods.js:44-48) — the raw
+            # float path makes this value-dependent luck (linear summation
+            # can leave std ~ 1e-13 and signal on any deviation); the device
+            # resolves it exactly via max==min, and so does the oracle
+            vals = [v for v in lst if v is not None and not math.isnan(v)]
+            if vals and min(vals) == max(vals):
+                avg = vals[0]
+                std = None
             if (avg is not None) and (std is not None):
                 lb = avg - self.threshold * std
                 ub = avg + self.threshold * std

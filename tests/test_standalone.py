@@ -99,3 +99,20 @@ def test_stats_queue_mirroring(tmp_path):
     assert seen > 0
     assert out.getvalue().startswith("st|")
     pipe.shutdown()
+
+
+def test_tiny_ring_overflow_path_no_loss(tmp_path):
+    """A ring far too small for the stream forces the bounded-spin overflow
+    path (the AMQP-heartbeat protection): every line must still reach the
+    driver, in order, with zero drops while under the overflow cap."""
+    logs = tmp_path / "fixture_logs"
+    write_fixture_logs(str(logs), n_transactions=120, seed=17)
+    cfg = small_config(tmp_path, ringBytes=1 << 12, ringFullMaxBlockSeconds=0.0)
+    pipe = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    assert pipe.worker._ring is not None
+    fed = pipe.replay(str(logs))
+    assert fed > 0
+    assert pipe.worker.intake_dropped == 0
+    assert pipe.worker._ring_fed == pipe.worker._ring_pushed
+    assert pipe.worker.driver.registry.count > 0
+    pipe.shutdown()

@@ -310,3 +310,57 @@ def test_reservoir_batched_equals_sequential_above_cap():
     sa = np.nan_to_num(np.asarray(st_a.samples), nan=-1)
     sb = np.nan_to_num(np.asarray(st_b.samples), nan=-1)
     assert np.array_equal(sa, sb)
+
+
+def test_topk_percentiles_exact_vs_sort():
+    """topk path must be bit-identical to sort + reference index math across
+    fill levels, duplicates, singletons, empties, and both dtypes."""
+    rng = np.random.RandomState(17)
+    for dtype in (np.float32, np.float64):
+        S, N = 64, 31 * 8
+        window = np.full((S, N), np.nan, dtype)
+        counts = rng.randint(0, N + 1, S).astype(np.int32)
+        counts[0], counts[1], counts[2], counts[3] = 0, 1, 2, N
+        for s in range(S):
+            vals = rng.randint(1, 500, counts[s]).astype(dtype)  # many ties
+            window[s, : counts[s]] = vals
+        w = jnp.asarray(window)
+        n = jnp.asarray(counts)
+        srt = jnp.sort(w, axis=-1)
+        for p in (75, 95):
+            want = np.asarray(dstats.reference_percentile_sorted(srt, n, p))
+            got = np.asarray(dstats.topk_percentiles(w, n, (p,))[0])
+            same = (want == got) | (np.isnan(want) & np.isnan(got))
+            assert same.all(), (dtype, p, np.nonzero(~same), want[~same], got[~same])
+
+
+def test_topk_rejects_low_percentile():
+    w = jnp.zeros((2, 8))
+    n = jnp.array([4, 4], jnp.int32)
+    with pytest.raises(ValueError):
+        dstats.topk_percentiles(w, n, (50, 95))
+
+
+def test_tick_auto_topk_matches_sort_impl():
+    """Full tick through auto (=topk) vs explicit sort: identical emissions."""
+    cfg_t = make_cfg(capacity=8, cap=16, dtype=jnp.float32)
+    cfg_s = cfg_t._replace(percentile_impl="sort")
+    rng = np.random.RandomState(29)
+    label = BASE_LABEL
+    st_t, st_s = dstats.init_state(cfg_t), dstats.init_state(cfg_s)
+    _, st_t = dstats.tick(st_t, cfg_t, label)
+    _, st_s = dstats.tick(st_s, cfg_s, label)
+    for k in range(10):
+        n = 40
+        rows = rng.randint(0, 8, n).astype(np.int32)
+        elaps = rng.randint(1, 900, n).astype(np.float32)
+        labs = np.full(n, label + k, np.int32)
+        ok = np.ones(n, bool)
+        st_t = dstats.ingest(st_t, cfg_t, rows, labs, elaps, ok)
+        st_s = dstats.ingest(st_s, cfg_s, rows, labs, elaps, ok)
+        res_t, st_t = dstats.tick(st_t, cfg_t, label + k + 1)
+        res_s, st_s = dstats.tick(st_s, cfg_s, label + k + 1)
+        for f in ("tpm", "average", "per75", "per95"):
+            a, b = np.asarray(getattr(res_t, f)), np.asarray(getattr(res_s, f))
+            same = (a == b) | (np.isnan(a) & np.isnan(b))
+            assert same.all(), (f, k)
