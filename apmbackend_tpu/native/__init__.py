@@ -110,13 +110,22 @@ class LineRing:
         if not self._ring:
             raise MemoryError("apmring_create failed")
         self._buf = ctypes.create_string_buffer(max_record)
+        # guards stats accessors against close(): an interval-stats timer can
+        # overlap shutdown, and apmring_* dereference the handle blindly.
+        # push/pop stay lock-free (the SPSC hot path); their threads' lifetime
+        # is managed by the owner (worker joins the popper before close)
+        self._close_lock = threading.Lock()
 
     def push(self, data: bytes) -> bool:
+        if not self._ring:
+            return False
         return bool(self._lib.apmring_push(self._ring, data, len(data)))
 
     def pop(self) -> Optional[bytes]:
         """One record, or None when empty. The pop-side buffer grows to fit
         oversized records (SPSC: only the popping thread touches it)."""
+        if not self._ring:
+            return None
         n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
         if n == 0:
             return None
@@ -127,22 +136,29 @@ class LineRing:
                 return None
         return self._buf.raw[:n]
 
+    def _stat(self, fn) -> int:
+        with self._close_lock:
+            if not self._ring:
+                return 0
+            return int(fn(self._ring))
+
     @property
     def used_bytes(self) -> int:
-        return int(self._lib.apmring_used(self._ring))
+        return self._stat(self._lib.apmring_used)
 
     @property
     def dropped(self) -> int:
-        return int(self._lib.apmring_dropped(self._ring))
+        return self._stat(self._lib.apmring_dropped)
 
     @property
     def capacity(self) -> int:
-        return int(self._lib.apmring_capacity(self._ring))
+        return self._stat(self._lib.apmring_capacity)
 
     def close(self) -> None:
-        if self._ring:
-            self._lib.apmring_destroy(self._ring)
-            self._ring = None
+        with self._close_lock:
+            if self._ring:
+                self._lib.apmring_destroy(self._ring)
+                self._ring = None
 
     def __del__(self):  # pragma: no cover - GC timing
         try:

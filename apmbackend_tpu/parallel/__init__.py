@@ -6,6 +6,14 @@ from .sharded import (  # noqa: F401
     make_sharded_tick,
     route_batch,
 )
+from .multihost import (  # noqa: F401
+    HostShardPlan,
+    build_send_blocks,
+    host_shard_plan,
+    init_distributed,
+    make_exchange_ingest,
+    place_global,
+)
 from .window_sharded import (  # noqa: F401
     WINDOW_AXIS,
     make_mesh2d,
@@ -14,9 +22,11 @@ from .window_sharded import (  # noqa: F401
 )
 
 __all__ = [
-    "SERVICE_AXIS", "WINDOW_AXIS", "FleetRollup", "ShardedCheckpointer",
-    "local_config", "make_mesh", "make_mesh2d", "make_sharded_ingest",
-    "make_sharded_tick", "make_window_sharded_step", "padded_capacity",
+    "SERVICE_AXIS", "WINDOW_AXIS", "FleetRollup", "HostShardPlan",
+    "ShardedCheckpointer", "build_send_blocks", "host_shard_plan",
+    "init_distributed", "local_config", "make_exchange_ingest", "make_mesh",
+    "make_mesh2d", "make_sharded_ingest", "make_sharded_tick",
+    "make_window_sharded_step", "padded_capacity", "place_global",
     "replicated", "route_batch", "row_sharding", "shard_rows", "shard_zstate",
 ]
 

@@ -148,6 +148,12 @@ class WorkerApp:
         save_s = int(stats_cfg.get("resumeFileSaveFrequencyInSeconds", 60))
         runtime.every(save_s, self.save_state, name="resume-save")
 
+        # interval-aligned intake counters, same style as QueueStats/DBStats
+        # lines (§5.5 observability): the first place a wedged device loop or
+        # chronic overflow shows up
+        stat_s = int(config.get("statLogIntervalInSeconds", 60))
+        runtime.every(stat_s, self._log_intake_stats, name="intake-stats")
+
         # -- intake ----------------------------------------------------------
         self._factory = EntryFactory()
         in_queue_name = stats_cfg.get("inQueue", "transactions")
@@ -173,6 +179,16 @@ class WorkerApp:
             db_write(line)  # passthrough: everything lands in Postgres
             if z_write is not None:
                 z_write(line)
+
+    def _log_intake_stats(self) -> None:
+        if self._ring is None:
+            return
+        self.runtime.logger.info(
+            f"INTAKE> pushed: {self._ring_pushed} - fed: {self._ring_fed} - "
+            f"ring bytes: {self._ring.used_bytes} - overflow: {len(self._overflow)} - "
+            f"dropped: {self.intake_dropped} - reservoir row-ticks: "
+            f"{self.driver.overflow_rows_total}"
+        )
 
     def _on_overflow(self, label: int, n_rows: int) -> None:
         """Percentile-reservoir overflow -> manager alert, heavily rate-limited
