@@ -13,6 +13,7 @@ Commands map to the reference's process/tool set:
 - ``dequeue``     destructive queue peek (dequeue.js)
 - ``qstat``       queue depth/memory (qstat.sh)
 - ``backup``      timestamped source/config backups (backup.sh)
+- ``config``      print the full default config as commented JSON
 """
 
 import importlib
@@ -34,6 +35,7 @@ COMMANDS = {
     "dequeue": ("apmbackend_tpu.tools.dequeue", True),
     "qstat": ("apmbackend_tpu.tools.qstat", True),
     "backup": ("apmbackend_tpu.tools.backup", True),
+    "config": ("apmbackend_tpu.config", True),
 }
 
 

@@ -412,3 +412,28 @@ _DEFAULT_CONFIG: dict = {
         "ewmaChannels": [],
     },
 }
+
+
+def main(argv=None) -> int:
+    """``python -m apmbackend_tpu config [path]``: print (or write) the full
+    default config as ``//``-commented JSON — the starting point a reference
+    deployment edits, schema-compatible with its apm_config.json."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu config")
+    ap.add_argument("path", nargs="?", help="write here instead of stdout")
+    args = ap.parse_args(argv)
+    text = (
+        "// apmbackend_tpu configuration (apm_config.json schema).\n"
+        "// JSON with //-comment lines; hot-reloaded with debounce while the\n"
+        "// pipeline runs. TPU-engine settings live under \"tpuEngine\".\n"
+        + json.dumps(default_config(), indent=2)
+        + "\n"
+    )
+    if args.path:
+        with open(args.path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0

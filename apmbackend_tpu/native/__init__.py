@@ -110,31 +110,34 @@ class LineRing:
         if not self._ring:
             raise MemoryError("apmring_create failed")
         self._buf = ctypes.create_string_buffer(max_record)
-        # guards stats accessors against close(): an interval-stats timer can
-        # overlap shutdown, and apmring_* dereference the handle blindly.
-        # push/pop stay lock-free (the SPSC hot path); their threads' lifetime
-        # is managed by the owner (worker joins the popper before close)
+        # guards every native call against close(): an interval-stats timer
+        # or an in-flight broker delivery can overlap shutdown, and apmring_*
+        # dereference the handle blindly. Uncontended lock cost (~tens of ns)
+        # is noise next to the ctypes call itself; contention only exists at
+        # shutdown.
         self._close_lock = threading.Lock()
 
     def push(self, data: bytes) -> bool:
-        if not self._ring:
-            return False
-        return bool(self._lib.apmring_push(self._ring, data, len(data)))
+        with self._close_lock:
+            if not self._ring:
+                return False
+            return bool(self._lib.apmring_push(self._ring, data, len(data)))
 
     def pop(self) -> Optional[bytes]:
         """One record, or None when empty. The pop-side buffer grows to fit
         oversized records (SPSC: only the popping thread touches it)."""
-        if not self._ring:
-            return None
-        n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
-        if n == 0:
-            return None
-        if n < 0:  # record larger than our buffer: grow and retry
-            self._buf = ctypes.create_string_buffer(int(-n))
-            n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
-            if n <= 0:
+        with self._close_lock:
+            if not self._ring:
                 return None
-        return self._buf.raw[:n]
+            n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
+            if n == 0:
+                return None
+            if n < 0:  # record larger than our buffer: grow and retry
+                self._buf = ctypes.create_string_buffer(int(-n))
+                n = self._lib.apmring_pop(self._ring, self._buf, len(self._buf))
+                if n <= 0:
+                    return None
+            return self._buf.raw[:n]
 
     def _stat(self, fn) -> int:
         with self._close_lock:

@@ -170,13 +170,19 @@ class ModuleRuntime:
         except KeyboardInterrupt:
             self.exit()
 
+    def stop_timers(self) -> None:
+        """Stop the interval timers and the config watcher WITHOUT running
+        exit handlers or exiting the process — for embedders (standalone
+        pipeline, tests) that tear runtimes down in-process."""
+        self._stop.set()
+        if self.watcher is not None:
+            self.watcher.stop()
+
     def exit(self, code: int = 0) -> None:
         if self._exiting:
             return
         self._exiting = True
-        self._stop.set()
-        if self.watcher is not None:
-            self.watcher.stop()
+        self.stop_timers()
         for handler in reversed(self._exit_handlers):
             try:
                 handler()

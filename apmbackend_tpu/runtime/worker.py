@@ -82,8 +82,14 @@ class WorkerApp:
         from ..manager.manager import ManagerAlerts
 
         self.ops_alerts = ManagerAlerts(
-            config.get("apmManager", {}), email_sender=email_sender, logger=logger
+            config.get("applicationManager", {}), email_sender=email_sender, logger=logger
         )
+        self._ops_alerts_started = False
+        if email_sender is not None:
+            # periodic batched dispatch (interval doubling); without a sender
+            # the buffer just accrues under its cap until shutdown flush
+            self.ops_alerts.start()
+            self._ops_alerts_started = True
         self._overflow_alerted_ticks = 0
 
         # -- the device pipeline ---------------------------------------------
@@ -342,11 +348,19 @@ class WorkerApp:
         # emailsEnabled switched on at runtime needs the sender the startup
         # path skipped (and address changes should take effect)
         if alerts_cfg.get("emailsEnabled"):
-            self.alerts_manager.email_sender = EmailSender(
+            sender = EmailSender(
                 alerts_cfg.get("fromEmail", "apm@localhost"),
                 alerts_cfg.get("emailList", ""),
                 logger=self.runtime.logger,
             )
+            self.alerts_manager.email_sender = sender
+            # hot-enabling emails must also arm the operational alerter
+            if self.ops_alerts.email_sender is None:
+                self.ops_alerts.email_sender = sender
+            if not self._ops_alerts_started:
+                self.ops_alerts.start()
+                self._ops_alerts_started = True
+        self.ops_alerts.set_config(new_config.get("applicationManager", {}))
         consume = bool(new_config.get("streamCalcStats", {}).get("consumeQueue", True))
         if consume != self._consume_enabled:
             self._consume_enabled = consume
@@ -391,6 +405,7 @@ class WorkerApp:
             self.alerts_manager.flush()
         except Exception as e:
             self.runtime.logger.error(f"Final alert flush error: {e}")
+        self.ops_alerts.stop()
         try:
             self.ops_alerts.flush()
         except Exception as e:

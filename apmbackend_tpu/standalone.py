@@ -83,6 +83,12 @@ class StandalonePipeline:
         if self._closed:
             return
         self._closed = True
+        # stop every runtime's timer threads (resume-save, intake-stats,
+        # alert senders) and config watchers FIRST: runtime.exit() is a
+        # process-exit path, so without this the daemon timers would keep
+        # firing into torn-down state (closed log handlers, removed tmp dirs)
+        for rt in (self.jmx_rt, self.parser_rt, self.sink_rt, self.lead):
+            rt.stop_timers()
         for rt in (self.jmx_rt, self.parser_rt, self.sink_rt):
             for handler in reversed(rt._exit_handlers):
                 try:

@@ -108,3 +108,15 @@ def test_default_config_shape():
     # mutation of one copy must not leak into the next
     cfg["streamCalcStats"]["intervalLengthInSeconds"] = 99
     assert default_config()["streamCalcStats"]["intervalLengthInSeconds"] == 10
+
+
+def test_config_dump_cli_roundtrips(tmp_path):
+    """`python -m apmbackend_tpu config <path>` writes commented JSON that
+    load_config parses back to the exact default tree."""
+    from apmbackend_tpu.config import default_config, load_config, main
+
+    out = tmp_path / "apm_config.json"
+    assert main([str(out)]) == 0
+    loaded = load_config(str(out))
+    loaded.pop("apmConfigFilePath", None)  # injected by load_config
+    assert loaded == default_config()
