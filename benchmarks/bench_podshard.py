@@ -82,6 +82,37 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     jax.block_until_ready(state.stats.counts)
     wall = time.perf_counter() - t_start
 
+    # the multi-host ingest fabric: route -> publish -> all_to_all -> scatter
+    # (every record could have been ingested by any host; the collective is
+    # the DCN/ICI replacement for a host-side broker hop)
+    from apmbackend_tpu.parallel import (
+        build_send_blocks,
+        host_shard_plan,
+        make_exchange_ingest,
+        place_global,
+    )
+
+    plan = host_shard_plan(mesh, capacity)
+    exchange = make_exchange_ingest(mesh, cfg)
+    ex_rows = rng.randint(0, services, B).astype(np.int32)
+    ex_elaps = (200 + 50 * rng.rand(B)).astype(np.float32)
+    blocks, _dropped = build_send_blocks(
+        plan, ex_rows, np.full(B, label, np.int32), ex_elaps, np.ones(B, bool),
+        capacity=capacity, batch_per_shard=batch_per_shard,
+    )
+    state = exchange(state, *place_global(mesh, blocks))  # compile
+    jax.block_until_ready(state.stats.counts)
+    ex_reps = 3 if quick else 10
+    t0 = time.perf_counter()
+    for _ in range(ex_reps):
+        blocks, _dropped = build_send_blocks(
+            plan, ex_rows, np.full(B, label, np.int32), ex_elaps, np.ones(B, bool),
+            capacity=capacity, batch_per_shard=batch_per_shard,
+        )
+        state = exchange(state, *place_global(mesh, blocks))
+    jax.block_until_ready(state.stats.counts)
+    exchange_tx_s = B * ex_reps / (time.perf_counter() - t0)
+
     metrics_per_tick = capacity * 3 * len(cfg.lags)
     throughput = metrics_per_tick * ticks / sum(lat)
     return result(
@@ -101,6 +132,8 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
             # host-side DCN scatter layout rate (vectorized route_batch);
             # north star: >=1M records/s so routing never gates the pod
             "route_records_per_sec": round(B * len(route_times) / max(sum(route_times), 1e-9), 1),
+            # all-to-all host-batch exchange incl. host-side routing/placement
+            "exchange_ingest_tx_per_sec": round(exchange_tx_s, 1),
             "wall_s": round(wall, 3),
             "note": "ICI-allreduced FleetRollup fetched to host every tick",
         },
