@@ -20,6 +20,7 @@ doubling (:269-333), HTML table formatting, Grafana render URL, email dispatch
 from __future__ import annotations
 
 import math
+import threading
 import time
 from typing import List, NamedTuple, Optional, Tuple
 
@@ -123,6 +124,14 @@ class AlertsManager:
     State mirrors the reference AlertsManager (stream_process_alerts.js:89-482):
     ``alerts`` maps service -> last AlertEntry (cooldown anchor), ``alert_buffer``
     holds unsent alerts; both persist via resume files.
+
+    Thread-safety: the device-loop thread appends triggers while the alert
+    timer flushes and the resume-save timer serializes, so ``alerts`` and
+    ``alert_buffer`` are guarded by an internal lock (the reference is
+    single-threaded per process and needs none). The email/render round-trip
+    happens OUTSIDE the lock over a snapshot; sent entries are removed after
+    success so a failed send retains them, and concurrent appends during the
+    send are preserved.
     """
 
     def __init__(self, alerts_config: dict, *, logger=None, email_sender=None, grafana=None, clock=time.time):
@@ -135,6 +144,7 @@ class AlertsManager:
         self.alert_buffer: List[dict] = []
         self.current_interval_s: Optional[float] = None
         self.dropped_alerts = 0  # drop-oldest evictions while dispatch is unavailable
+        self._lock = threading.RLock()
 
     def set_config(self, alerts_config: dict) -> None:
         self.config = alerts_config
@@ -149,13 +159,14 @@ class AlertsManager:
             now_ms, entry.timestamp, entry.server, entry.service,
             cause_string(cause_bits), entry.to_csv(),
         )
-        prior = self.alerts.get(entry.service)
-        if prior is not None:
-            interval_s = (alert.alert_timestamp - prior["alertTimestamp"]) / 1000.0
-            cooldown_s = self.config.get("perServiceAlertCooldownInMinutes", 15) * 60
-            if interval_s <= cooldown_s:
-                return None
-        self.alerts[entry.service] = {"alertTimestamp": alert.alert_timestamp}
+        with self._lock:
+            prior = self.alerts.get(entry.service)
+            if prior is not None:
+                interval_s = (alert.alert_timestamp - prior["alertTimestamp"]) / 1000.0
+                cooldown_s = self.config.get("perServiceAlertCooldownInMinutes", 15) * 60
+                if interval_s <= cooldown_s:
+                    return None
+            self.alerts[entry.service] = {"alertTimestamp": alert.alert_timestamp}
         return alert
 
     MAX_BUFFERED = 1000  # drop-oldest cap: with emails disabled (the shipped
@@ -163,18 +174,19 @@ class AlertsManager:
     # accumulate without bound and persist into the resume file
 
     def add_to_buffer(self, alert: AlertEntry) -> None:
-        self.dropped_alerts += capped_append(
-            self.alert_buffer,
-            {
-                "alertTimestamp": alert.alert_timestamp,
-                "entryTimestamp": alert.entry_timestamp,
-                "server": alert.server,
-                "service": alert.service,
-                "cause": alert.cause,
-                "entry": alert.entry,
-            },
-            self.MAX_BUFFERED,
-        )
+        with self._lock:
+            self.dropped_alerts += capped_append(
+                self.alert_buffer,
+                {
+                    "alertTimestamp": alert.alert_timestamp,
+                    "entryTimestamp": alert.entry_timestamp,
+                    "server": alert.server,
+                    "service": alert.service,
+                    "cause": alert.cause,
+                    "entry": alert.entry,
+                },
+                self.MAX_BUFFERED,
+            )
         if self.dropped_alerts and self.logger and self.dropped_alerts % 100 == 1:
             self.logger.warning(
                 f"Alert buffer at {self.MAX_BUFFERED}-entry cap; "
@@ -196,31 +208,36 @@ class AlertsManager:
         # stream_process_alerts.js:273); otherwise the buffer is retained so
         # alerts are not lost, and the interval resets to base.
         can_send = self.email_sender is not None and bool(self.config.get("emailsEnabled"))
-        if not self.alert_buffer or not can_send:
-            self.current_interval_s = base
-            return 0, base
-        count = len(self.alert_buffer)
+        with self._lock:
+            if not self.alert_buffer or not can_send:
+                self.current_interval_s = base
+                return 0, base
+            batch = list(self.alert_buffer)  # snapshot: render/send unlocked
+        count = len(batch)
         if self.config.get("increaseCollectionIntervalAfterAlert"):
             # clamp: doubling from a non-power-of-two base must not overshoot
             # the configured cap
             interval_s = min(
                 interval_s * 2, float(self.config.get("maxCollectionIntervalInSeconds", 960))
             )
-        html = self.format_alerts_html()
+        html = self.format_alerts_html(batch)
         image_path = None
         if self.grafana is not None:
             try:
-                _url, render_url = self.grafana.alert_urls(self.alert_buffer)
+                _url, render_url = self.grafana.alert_urls(batch)
                 image_path = self.grafana.render(render_url)
             except Exception as e:  # render failure falls back to plain email
                 if self.logger:
                     self.logger.error(f"Error while trying to render graph: {e}")
         self.email_sender("APM Alerts Triggered!", html, image_path)
-        self.alert_buffer = []
+        with self._lock:
+            # a failed send (exception above) retains the batch; appends that
+            # landed during the send survive the removal of the sent prefix
+            del self.alert_buffer[:count]
         self.current_interval_s = interval_s
         return count, interval_s
 
-    def format_alerts_html(self) -> str:
+    def format_alerts_html(self, batch: Optional[List[dict]] = None) -> str:
         """Two-row-per-alert HTML table (:208-267)."""
         css = (
             '<style type="text/css" media="all"> table { border-collapse: collapse; }'
@@ -237,7 +254,10 @@ class AlertsManager:
         )
         rows = []
         fac = EntryFactory()
-        for el in self.alert_buffer:
+        if batch is None:
+            with self._lock:
+                batch = list(self.alert_buffer)
+        for el in batch:
             en = fac.from_csv(el["entry"], delim="&")
             if en is None:  # corrupted resume data must not poison the flush path
                 if self.logger:
@@ -259,10 +279,13 @@ class AlertsManager:
 
     # -- resume (:111-142) ---------------------------------------------------
     def save_resume(self, path: str, quiet: bool = True) -> None:
-        save_resume_file(path, {"alerts": self.alerts, "alertBuffer": self.alert_buffer}, logger=self.logger, quiet=quiet)
+        with self._lock:  # snapshot: the device loop appends concurrently
+            payload = {"alerts": dict(self.alerts), "alertBuffer": list(self.alert_buffer)}
+        save_resume_file(path, payload, logger=self.logger, quiet=quiet)
 
     def load_resume(self, path: str) -> None:
         data = load_resume_file(path, logger=self.logger)
         if data:
-            self.alerts = data.get("alerts", {}) or {}
-            self.alert_buffer = data.get("alertBuffer", []) or []
+            with self._lock:
+                self.alerts = data.get("alerts", {}) or {}
+                self.alert_buffer = data.get("alertBuffer", []) or []
