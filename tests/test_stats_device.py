@@ -364,3 +364,29 @@ def test_tick_auto_topk_matches_sort_impl():
             a, b = np.asarray(getattr(res_t, f)), np.asarray(getattr(res_s, f))
             same = (a == b) | (np.isnan(a) & np.isnan(b))
             assert same.all(), (f, k)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_fuzz_random_stream_vs_oracle(seed):
+    """Property fuzz: randomized streams with out-of-order arrivals, label
+    jumps, bursts, and within-batch duplicates must match the float64 oracle
+    on every emitted window (counts exact; percentiles exact below CAP)."""
+    rng = np.random.RandomState(seed)
+    cfg = make_cfg(capacity=6, cap=256)  # CAP high: stays in exact mode
+    keys = [(f"s{i % 3}", f"svc{i}") for i in range(6)]
+    events = []
+    label = BASE_LABEL
+    for _ in range(500):
+        r = rng.rand()
+        if r < 0.25:
+            label += 1
+        elif r < 0.30:
+            label += int(rng.randint(2, 9))  # jump (gap clears stale slots)
+        srv, svc = keys[rng.randint(len(keys))]
+        # out-of-order: sometimes stamp into an older (still-live) bucket
+        lbl = label - int(rng.randint(0, 5)) if rng.rand() < 0.2 else label
+        ts = lbl * 10000 + int(rng.randint(0, 9999))
+        events.append((srv, svc, ts, int(rng.randint(1, 5000))))
+    g, d = drive_both(events, cfg)
+    assert len(d) > 50
+    assert_rows_match(g, d)
