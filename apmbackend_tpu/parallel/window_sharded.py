@@ -9,13 +9,15 @@ HBM) the z-score ring ``[S, 3, L]`` itself must split. This module shards it
 over a 2-D ``(services, window)`` mesh:
 
 - every window shard holds an ``L/W``-slice of each ring;
-- the window statistics become two small ICI all-reduces per step
-  (``psum(count, sum)`` -> mean, then ``psum(sum((x-mean)^2))`` -> var) —
-  the reference's two-pass mean/std (util_methods.js:10-50) computed
-  collectively. Results match the single-chip path to reduction-order
-  rounding (the psum tree sums shard partials in a different order than one
-  flat sum; last-ulp differences are inherent), which a one-pass sum/sumsq
-  trick would degrade much further;
+- the window statistics become two rounds of small ICI all-reduces per step:
+  one fused local pass produces (count, sum, min, max) partials which cross
+  the wire together (psum/psum/pmin/pmax over [S, 3] scalars), then the var
+  partial needs one more psum after the mean broadcast — the reference's
+  two-pass mean/std (util_methods.js:10-50) computed collectively. Results
+  match the single-chip path to reduction-order rounding (the psum tree sums
+  shard partials in a different order than one flat sum; last-ulp
+  differences are inherent), which a one-pass sum/sumsq trick would degrade
+  much further;
 - the influence-damping lookup of the last pushed value and the ring write
   each touch exactly one owner shard, selected by masked psum / masked store;
 - ``fill``/``pos`` counters are replicated across window shards and advance
@@ -24,7 +26,10 @@ over a 2-D ``(services, window)`` mesh:
 This is the all-reduce flavor of sequence parallelism (a ring/all-to-all
 exchange is unnecessary because the reduction is a plain sum over the
 sequence axis — no attention-style pairwise interaction exists).
-Parity-tested against ops.zscore.step on the virtual CPU mesh.
+Parity-tested against ops.zscore.step on the virtual CPU mesh, including the
+exact degenerate-window (all-equal -> no std) semantics via pmin/pmax.
+Assumes a fully-populated fleet (no per-row ``active`` gate): shard the rows
+you have, not a padded registry.
 """
 
 from __future__ import annotations
@@ -83,16 +88,37 @@ def _local_step(cfg: ZScoreConfig, n_window_shards: int):
         fill, pos = state.fill, state.pos
         full = fill >= L
 
-        # two-pass mean/std over the sharded window (reference parity)
+        # two-pass mean/std over the sharded window (reference parity); the
+        # local partials come from ONE fused variadic reduce over the shard
+        # slice (same trick as ops.zscore.step — this module serves the rings
+        # too big for one chip, the most bandwidth-bound case of all)
         valid = ~jnp.isnan(vals)
-        cnt = jax.lax.psum(jnp.sum(valid, axis=-1), WINDOW_AXIS)  # [S, 3]
-        total = jax.lax.psum(jnp.sum(jnp.where(valid, vals, 0), axis=-1), WINDOW_AXIS)
+        dt = vals.dtype
+        cnt_l, total_l, vmin_l, vmax_l = jax.lax.reduce(
+            (
+                valid.astype(jnp.int32),
+                jnp.where(valid, vals, 0),
+                jnp.where(valid, vals, jnp.inf),
+                jnp.where(valid, vals, -jnp.inf),
+            ),
+            (jnp.int32(0), jnp.array(0, dt), jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)),
+            lambda a, b: (a[0] + b[0], a[1] + b[1], jnp.minimum(a[2], b[2]), jnp.maximum(a[3], b[3])),
+            [2],
+        )
+        cnt = jax.lax.psum(cnt_l, WINDOW_AXIS)  # [S, 3]
+        total = jax.lax.psum(total_l, WINDOW_AXIS)
         has_avg = (cnt > 0) & full[:, None]
         mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
+        # degenerate (all-equal) windows resolved exactly, matching
+        # ops.zscore.step: pmax/pmin over the fused local partials
+        vmax = jax.lax.pmax(vmax_l, WINDOW_AXIS)
+        vmin = jax.lax.pmin(vmin_l, WINDOW_AXIS)
+        all_equal = has_avg & (vmax == vmin)
+        mean = jnp.where(all_equal, vmax, mean)
         diff = jnp.where(valid, vals - mean[..., None], 0)
         var_sum = jax.lax.psum(jnp.sum(diff * diff, axis=-1), WINDOW_AXIS)
         var = jnp.where(has_avg, var_sum / jnp.maximum(cnt, 1), jnp.nan)
-        has_std = has_avg & (var > 0)
+        has_std = has_avg & ~all_equal & (var > 0)
         std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
 
         thr = threshold[:, None]

@@ -102,3 +102,27 @@ def test_capacity_not_divisible_raises():
     mesh = make_mesh2d(2, 4)
     with pytest.raises(ValueError, match="capacity"):
         make_window_sharded_step(mesh, cfg)
+
+
+def test_degenerate_all_equal_window_parity():
+    """All-equal windows must resolve exactly on the sharded path too: no std,
+    no signal, mean == the value — same as ops.zscore.step (pmin/pmax)."""
+    cfg = z.ZScoreConfig(S, LAG, DTYPE)
+    mesh = make_mesh2d(2, 4)
+    step_sharded = make_window_sharded_step(mesh, cfg)
+    st_a = z.init_state(cfg)
+    st_b = shard_zstate(z.init_state(cfg), mesh)
+    thr = jnp.full(S, 2.0, DTYPE)
+    infl = jnp.full(S, 0.1, DTYPE)
+    const = jnp.full((S, 3), 515.3, DTYPE)  # a value whose k-sum does NOT
+    # reproduce itself under linear summation (the FP-luck case)
+    for t in range(LAG):
+        _ra, st_a = z.step(st_a, cfg, const, thr, infl)
+        _rb, st_b = step_sharded(st_b, const, thr, infl)
+    probe = const.at[:, 0].add(200.0)  # big deviation: would signal iff std defined
+    ra, _ = z.step(st_a, cfg, probe, thr, infl)
+    rb, _ = step_sharded(st_b, probe, thr, infl)
+    assert np.array_equal(np.asarray(ra.signal), np.asarray(rb.signal))
+    assert int(np.asarray(rb.signal).sum()) == 0  # all-equal -> no std -> no signal
+    assert np.allclose(np.asarray(rb.window_avg), 515.3)
+    assert np.all(np.isnan(np.asarray(rb.lower_bound)))
