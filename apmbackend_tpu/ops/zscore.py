@@ -58,6 +58,28 @@ def init_state(cfg: ZScoreConfig) -> ZScoreState:
     )
 
 
+def fused_window_partials(vals: jnp.ndarray, valid: jnp.ndarray):
+    """(count, sum, min, max) over the last axis in ONE variadic lax.reduce.
+
+    A single pass over the ``[..., L]`` ring instead of four (3.2x measured on
+    the bandwidth-bound CPU path; reduction fusion matters on TPU HBM too).
+    Shared by the single-chip step and the window-sharded local step so the
+    two paths cannot drift.
+    """
+    dt = vals.dtype
+    return jax.lax.reduce(
+        (
+            valid.astype(jnp.int32),
+            jnp.where(valid, vals, 0),
+            jnp.where(valid, vals, jnp.inf),
+            jnp.where(valid, vals, -jnp.inf),
+        ),
+        (jnp.int32(0), jnp.array(0, dt), jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)),
+        lambda a, b: (a[0] + b[0], a[1] + b[1], jnp.minimum(a[2], b[2]), jnp.maximum(a[3], b[3])),
+        [vals.ndim - 1],
+    )
+
+
 class ZScoreResult(NamedTuple):
     # each [S, 3] on the metric axis (average, per75, per95)
     window_avg: jnp.ndarray  # NaN = undefined
@@ -88,21 +110,7 @@ def step(
     full = fill >= L  # [S] — signal eligibility (raw length incl. NaN pushes)
 
     valid = ~jnp.isnan(vals)  # [S, 3, L]
-    # one variadic reduction computes count/sum/min/max together — a single
-    # pass over the [S, 3, L] ring instead of four (3.2x measured on the
-    # bandwidth-bound CPU path; reduction fusion matters on TPU HBM too)
-    dt = vals.dtype
-    cnt, total, vmin, vmax = jax.lax.reduce(
-        (
-            valid.astype(jnp.int32),
-            jnp.where(valid, vals, 0),
-            jnp.where(valid, vals, jnp.inf),
-            jnp.where(valid, vals, -jnp.inf),
-        ),
-        (jnp.int32(0), jnp.array(0, dt), jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)),
-        lambda a, b: (a[0] + b[0], a[1] + b[1], jnp.minimum(a[2], b[2]), jnp.maximum(a[3], b[3])),
-        [2],
-    )
+    cnt, total, vmin, vmax = fused_window_partials(vals, valid)
     has_avg = (cnt > 0) & full[:, None]
     mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
 

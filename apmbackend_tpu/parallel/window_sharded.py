@@ -9,11 +9,11 @@ HBM) the z-score ring ``[S, 3, L]`` itself must split. This module shards it
 over a 2-D ``(services, window)`` mesh:
 
 - every window shard holds an ``L/W``-slice of each ring;
-- the window statistics become two rounds of small ICI all-reduces per step:
-  one fused local pass produces (count, sum, min, max) partials which cross
-  the wire together (psum/psum/pmin/pmax over [S, 3] scalars), then the var
-  partial needs one more psum after the mean broadcast — the reference's
-  two-pass mean/std (util_methods.js:10-50) computed collectively. Results
+- the window statistics take five small collectives per step over [S, 3]
+  partials — psum(count), psum(sum), pmin, pmax from one fused local pass,
+  then psum(var partial) after the mean broadcast (sum/min/max cannot share
+  one all-reduce combiner) — the reference's two-pass mean/std
+  (util_methods.js:10-50) computed collectively. Results
   match the single-chip path to reduction-order rounding (the psum tree sums
   shard partials in a different order than one flat sum; last-ulp
   differences are inherent), which a one-pass sum/sumsq trick would degrade
@@ -46,7 +46,13 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from ..ops.zscore import N_METRICS, ZScoreConfig, ZScoreResult, ZScoreState
+from ..ops.zscore import (
+    N_METRICS,
+    ZScoreConfig,
+    ZScoreResult,
+    ZScoreState,
+    fused_window_partials,
+)
 from .mesh import SERVICE_AXIS
 
 WINDOW_AXIS = "window"
@@ -93,18 +99,7 @@ def _local_step(cfg: ZScoreConfig, n_window_shards: int):
         # slice (same trick as ops.zscore.step — this module serves the rings
         # too big for one chip, the most bandwidth-bound case of all)
         valid = ~jnp.isnan(vals)
-        dt = vals.dtype
-        cnt_l, total_l, vmin_l, vmax_l = jax.lax.reduce(
-            (
-                valid.astype(jnp.int32),
-                jnp.where(valid, vals, 0),
-                jnp.where(valid, vals, jnp.inf),
-                jnp.where(valid, vals, -jnp.inf),
-            ),
-            (jnp.int32(0), jnp.array(0, dt), jnp.array(jnp.inf, dt), jnp.array(-jnp.inf, dt)),
-            lambda a, b: (a[0] + b[0], a[1] + b[1], jnp.minimum(a[2], b[2]), jnp.maximum(a[3], b[3])),
-            [2],
-        )
+        cnt_l, total_l, vmin_l, vmax_l = fused_window_partials(vals, valid)
         cnt = jax.lax.psum(cnt_l, WINDOW_AXIS)  # [S, 3]
         total = jax.lax.psum(total_l, WINDOW_AXIS)
         has_avg = (cnt > 0) & full[:, None]

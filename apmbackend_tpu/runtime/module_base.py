@@ -171,12 +171,17 @@ class ModuleRuntime:
             self.exit()
 
     def stop_timers(self) -> None:
-        """Stop the interval timers and the config watcher WITHOUT running
-        exit handlers or exiting the process — for embedders (standalone
-        pipeline, tests) that tear runtimes down in-process."""
+        """Stop the interval timers, the queue-stats logger, and the config
+        watcher WITHOUT running exit handlers or exiting the process — for
+        embedders (standalone pipeline, tests) that tear runtimes down
+        in-process."""
         self._stop.set()
         if self.watcher is not None:
             self.watcher.stop()
+        try:  # QueueStats runs its own timer thread, not a runtime.every one
+            self.qm.queue_stats.stop()
+        except Exception:
+            pass
 
     def exit(self, code: int = 0) -> None:
         if self._exiting:
