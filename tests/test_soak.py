@@ -222,3 +222,48 @@ def test_soak_72_file_fan_in_with_mid_run_kill(tmp_path):
             n_signals += abs(z[metric]["signal"])
     # the soak must actually exercise the detector, not just warm-up NaNs
     assert n_signals > 0, "no z-score signals fired over the whole soak"
+
+
+def test_soak_lite_with_ewma_channels_and_resume(tmp_path):
+    """Reduced fan-in soak with EWMA/seasonal channels live: the channel wire
+    path (negative channel-id FullStat lines), its alert ladder, and its
+    resume state must all survive a mid-run kill alongside the lag windows."""
+    global N_JVMS, TX_PER_JVM
+    saved = (N_JVMS, TX_PER_JVM)
+    N_JVMS, TX_PER_JVM = 6, 250
+    try:
+        per_file = write_fleet(tmp_path)
+        cfg = soak_config(tmp_path)
+        cfg["tpuEngine"]["ewmaChannels"] = [
+            {"ALPHA": 0.2, "THRESHOLD": 3.0, "WARMUP": 3, "CHANNEL_ID": -1},
+            {"ALPHA": 0.3, "THRESHOLD": 2.5, "WARMUP": 2,
+             "SEASON_SLOTS": 4, "SLOT_INTERVALS": 2, "CHANNEL_ID": -4},
+        ]
+
+        fed, emitted = [], []
+        pipe1 = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+        drv1 = attach_taps(pipe1, fed, emitted)
+        feed_interleaved(pipe1, per_file, 0)
+        pipe1.shutdown()
+        e1 = np.asarray(drv1.state.ewmas[0].mean)
+        c1 = np.asarray(drv1.state.ewmas[1].count)
+        assert np.isfinite(e1).any(), "EWMA channel never seeded in run 1"
+        assert c1.sum() > 0
+
+        fac = EntryFactory()
+        chan_ids = {int(fac.from_csv(line).lag) for line in emitted}
+        assert {-1, -4} <= chan_ids, f"EWMA channels missing from the wire: {chan_ids}"
+
+        pipe2 = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+        drv2 = attach_taps(pipe2, fed, emitted)
+        # EWMA state must resume bit-for-bit
+        assert np.array_equal(
+            e1, np.asarray(drv2.state.ewmas[0].mean), equal_nan=True
+        ), "EWMA mean did not survive the kill"
+        assert np.array_equal(c1, np.asarray(drv2.state.ewmas[1].count))
+        feed_interleaved(pipe2, per_file, 1)
+        pipe2.shutdown()
+        # the seasonal channel's count advanced in run 2
+        assert np.asarray(drv2.state.ewmas[1].count).sum() > c1.sum()
+    finally:
+        N_JVMS, TX_PER_JVM = saved
