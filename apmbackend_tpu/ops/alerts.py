@@ -231,9 +231,12 @@ class AlertsManager:
                     self.logger.error(f"Error while trying to render graph: {e}")
         self.email_sender("APM Alerts Triggered!", html, image_path)
         with self._lock:
-            # a failed send (exception above) retains the batch; appends that
-            # landed during the send survive the removal of the sent prefix
-            del self.alert_buffer[:count]
+            # a failed send (exception above) retains the batch. Remove the
+            # SENT OBJECTS by identity, not a prefix slice: a cap eviction
+            # during the unlocked send shifts the list, and a prefix delete
+            # would then swallow an unsent alert appended mid-send.
+            sent = {id(el) for el in batch}
+            self.alert_buffer = [el for el in self.alert_buffer if id(el) not in sent]
         self.current_interval_s = interval_s
         return count, interval_s
 

@@ -23,14 +23,17 @@ Exactness notes (SURVEY.md §7.3):
   for p in {75, 95} and realistic n.
 - Each (row, bucket) stores at most CAP samples. Below CAP the stored set is
   every sample and percentiles are exact. Beyond CAP, reservoir sampling
-  (Algorithm R) keeps a uniform random CAP-subset of ALL arrivals, so the
-  percentile is an unbiased estimate with error O(1/sqrt(CAP)) in rank —
-  bounded, unlike first-CAP truncation which is arbitrarily biased toward
-  early arrivals. The reservoir's randomness is a deterministic hash of
-  (row, bucket label, arrival index), so replay and resume reproduce the
-  same reservoir bit-for-bit. ``overflowed`` in the tick output reports
-  rows whose window percentile used a reservoir (counts/averages stay
-  exact regardless).
+  (Algorithm R) keeps a uniform random CAP-subset of that bucket's arrivals,
+  and the window percentile (default "sort" impl) pools the buckets with
+  each sample weighted by its bucket's count/stored — the importance weight
+  that keeps a bursty bucket's arrival mass intact (an unweighted pool
+  would flatten every bucket to <=CAP samples and bias toward quiet
+  buckets). Per-bucket sampling error is O(1/sqrt(CAP)) in rank; first-CAP
+  truncation, by contrast, is arbitrarily biased toward early arrivals.
+  The reservoir's randomness is a deterministic hash of (row, bucket label,
+  arrival index), so replay and resume reproduce the same reservoir
+  bit-for-bit. ``overflowed`` in the tick output reports rows whose window
+  percentile used a reservoir (counts/averages stay exact regardless).
 - ``average`` is sum/count like the reference; NaN where the window is empty
   (the reference's ``undefined``).
 """
@@ -51,9 +54,12 @@ class StatsConfig(NamedTuple):
     interval_len_s: int = 10  # intervalLengthInSeconds
     samples_per_bucket: int = 128  # CAP
     dtype: jnp.dtype = jnp.float32
-    # percentile implementation — ALL exact:
-    #   "auto"   -> "topk" (jax.lax.top_k over the top quarter of each row)
-    #   "sort"   -> XLA per-row full sort + reference index math
+    # percentile implementation — all exact below samplesPerBucket:
+    #   "auto"   -> adaptive: top_k while no bucket overflows, weighted sort
+    #               the moment one does (lax.cond on the overflow flag)
+    #   "sort"   -> argsort + count-weighted reference index math (the only
+    #               impl that keeps burst arrival mass intact in overflow)
+    #   "topk"   -> jax.lax.top_k over the top quarter of each row
     #   "pallas" -> bit-binary-search selection kernel (opt-in until proven
     #               on real TPU hardware; interpret-mode off-TPU)
     percentile_impl: str = "auto"
@@ -288,6 +294,53 @@ def reference_percentile_sorted(sorted_vals: jnp.ndarray, n: jnp.ndarray, p: int
     return jnp.where(n > 0, out, jnp.nan)
 
 
+# percentile_rank computes p*n in int32; clamp n so it cannot overflow
+# (22M arrivals per window row = far beyond any real per-service rate; at
+# that scale a +-1 rank shift is far below the estimator's own error)
+_MAX_RANK_N = (2**31 - 1) // 100
+
+
+def weighted_reference_percentiles(
+    window: jnp.ndarray,  # [S, K] samples (NaN = empty slot)
+    weights: jnp.ndarray,  # [S, K] arrivals each sample represents (0 = empty)
+    n_arrivals: jnp.ndarray,  # [S] int32 TOTAL window arrival count
+    ps,
+) -> tuple:
+    """Reference percentiles over the weighted empirical distribution.
+
+    Each stored sample in bucket b stands for ``count_b / stored_b`` arrivals
+    (its reservoir's sampling weight), so the pooled window estimate weights
+    bursty buckets by their true arrival mass instead of flattening every
+    bucket to CAP samples. The rank is the reference's index math in ARRIVAL
+    space (util_methods.js:112-142 over all n arrivals); the value at rank r
+    is the first sorted sample whose cumulative weight reaches r, averaged
+    with the sample at rank r+1 on take_pair. With no overflow every weight
+    is exactly 1, cumulative weight of the i-th sample is i, and this reduces
+    bit-for-bit to :func:`reference_percentile_sorted`.
+    """
+    order = jnp.argsort(window, axis=-1)  # NaN sorts to the end
+    sv = jnp.take_along_axis(window, order, axis=-1)
+    sw = jnp.take_along_axis(weights, order, axis=-1)
+    cum = jnp.cumsum(sw, axis=-1)  # [S, K]
+    n_r = jnp.minimum(n_arrivals, _MAX_RANK_N)
+    K = window.shape[-1]
+    outs = []
+    for p in ps:
+        rank, take_pair = percentile_rank(n_r, p)
+        # first index with cum >= rank; the 0.5 tolerance absorbs float
+        # cumsum drift (exact-integer cums are never within 0.5 of a
+        # boundary, and fractional-weight drift is ~ulps)
+        idx1 = jnp.sum(cum < rank[..., None].astype(cum.dtype) - 0.5, axis=-1)
+        idx2 = jnp.sum(cum < (rank + 1)[..., None].astype(cum.dtype) - 0.5, axis=-1)
+        idx1 = jnp.clip(idx1, 0, K - 1)
+        idx2 = jnp.clip(jnp.where(take_pair, idx2, idx1), 0, K - 1)
+        v1 = jnp.take_along_axis(sv, idx1[..., None], axis=-1)[..., 0]
+        v2 = jnp.take_along_axis(sv, idx2[..., None], axis=-1)[..., 0]
+        out = jnp.where(take_pair, (v1 + v2) / 2.0, v1)
+        outs.append(jnp.where(n_arrivals > 0, out, jnp.nan))
+    return tuple(outs)
+
+
 def edge_ts_ms(new_label: int, cfg: StatsConfig) -> int:
     """Host-side: the timestamp all stats emitted by tick(new_label) carry —
 
@@ -332,14 +385,35 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
 
     window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
     impl = cfg.percentile_impl
+
+    def _weighted():
+        # count-weighted percentiles: each bucket's reservoir samples carry
+        # weight count/stored (== 1 with no overflow, where this is bit-exact
+        # reference math over every sample). The only impl whose pooled
+        # estimate keeps a bursty bucket's arrival mass intact under
+        # cross-bucket skew.
+        counts_w = state.counts[:, slots_w].astype(cfg.dtype)  # [S, W]
+        stored_w = state.nsamples[:, slots_w]  # [S, W]
+        w_bucket = counts_w / jnp.maximum(stored_w, 1).astype(cfg.dtype)
+        S_rows = window_samples.shape[0]
+        w_flat = jnp.broadcast_to(
+            w_bucket[:, :, None], (S_rows, W, CAP)
+        ).reshape(S_rows, W * CAP)
+        weights = jnp.where(jnp.isnan(window_samples), 0, w_flat)
+        return weighted_reference_percentiles(window_samples, weights, cnt, (75, 95))
+
     if impl == "auto":
-        # top_k: exact (pure XLA semantics, no hardware-specific kernel to
-        # prove), and only touches the top quarter of each row instead of
-        # sorting the whole window. The Pallas selection kernel stays opt-in
-        # ("pallas") until benchmarks/bench_pallas.py has proven it on real
-        # TPU hardware; "sort" remains as the reference-shaped fallback.
-        impl = "topk"
-    if impl == "topk":
+        # adaptive: with no overflow anywhere, top_k is exact and touches
+        # only the top quarter of each row; the moment any bucket overflows,
+        # the weighted sort takes over so burst mass is not flattened.
+        # (pallas stays opt-in until its hardware proof,
+        # benchmarks/bench_pallas.py.)
+        per75, per95 = jax.lax.cond(
+            jnp.any(overflowed),
+            _weighted,
+            lambda: topk_percentiles(window_samples, stored, (75, 95)),
+        )
+    elif impl == "topk":
         per75, per95 = topk_percentiles(window_samples, stored, (75, 95))
     elif impl == "pallas":
         if cfg.dtype == jnp.float64:
@@ -353,9 +427,7 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
             interpret=jax.default_backend() != "tpu",
         )
     else:
-        sorted_samples = jnp.sort(window_samples, axis=-1)  # NaN sorts to the end
-        per75 = reference_percentile_sorted(sorted_samples, stored, 75)
-        per95 = reference_percentile_sorted(sorted_samples, stored, 95)
+        per75, per95 = _weighted()
 
     tpm = cnt / (cfg.window_sz * cfg.interval_len_s / 60.0)  # stream_calc_stats.js:186
 

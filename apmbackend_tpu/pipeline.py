@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -37,6 +38,10 @@ from .ops import stats as dstats
 from .ops import zscore as dzscore
 from .ops.registry import CapacityExceeded, ServiceRegistry
 from .utils.heap import MinHeap
+
+# the numeric forms whose numpy float parse == JS parseInt truncation; rows
+# outside this shape fall back to js_parse_int in feed_csv_batch
+_PLAIN_NUMBER = re.compile(r"^[+-]?\d+(?:\.\d+)?$")
 
 
 class LagSpec(NamedTuple):
@@ -412,9 +417,12 @@ class PipelineDriver:
     # -- feed ----------------------------------------------------------------
     def feed(self, tx: TxEntry) -> None:
         """One transaction (consumeMsg parity, stream_calc_stats.js:331-371)."""
-        if math.isnan(tx.end_ts):
+        if math.isnan(tx.end_ts) or math.isnan(tx.elapsed):
+            # malformed numerics are rejected at intake: a stored NaN sample
+            # would poison window sums AND make the percentile basis depend
+            # on the impl's NaN ordering (sort vs top_k)
             if self.logger:
-                self.logger.error(f"NaN bucket label generated from txEntry: {tx}")
+                self.logger.error(f"NaN end_ts/elapsed in txEntry, dropped: {tx}")
             return
         label = int(tx.end_ts) // 10000
         # host-side label mirror: avoids a device->host sync per message
@@ -478,21 +486,40 @@ class PipelineDriver:
         if not good:
             return 0
         fields = np.array(good, dtype=object)  # [N, 9] strings
-        try:
+        # numpy float parsing accepts forms JS parseInt does not ('1e5',
+        # 'inf', '1_0'); the wire is explicitly interoperable, so rows whose
+        # numerics are not plain decimals take the js_parse_int slow path to
+        # keep this batch path's labels identical to feed()'s
+        plain = np.fromiter(
+            (
+                bool(_PLAIN_NUMBER.match(p[6])) and bool(_PLAIN_NUMBER.match(p[7]))
+                for p in good
+            ),
+            bool,
+            len(good),
+        )
+        from .entries import js_parse_int
+
+        if plain.all():
             end_ts = fields[:, 6].astype(np.float64)
             elaps = fields[:, 7].astype(np.float64)
-        except (ValueError, TypeError):  # rare malformed numerics: slow decode
-            from .entries import js_parse_int
-
-            end_ts = np.array([js_parse_int(x) for x in fields[:, 6]], np.float64)
-            elaps = np.array([js_parse_int(x) for x in fields[:, 7]], np.float64)
+        else:
+            end_ts = np.empty(len(good), np.float64)
+            elaps = np.empty(len(good), np.float64)
+            pi = np.nonzero(plain)[0]
+            if len(pi):
+                end_ts[pi] = fields[pi, 6].astype(np.float64)
+                elaps[pi] = fields[pi, 7].astype(np.float64)
+            for i in np.nonzero(~plain)[0]:
+                end_ts[i] = js_parse_int(fields[i, 6])
+                elaps[i] = js_parse_int(fields[i, 7])
         end_ts = np.trunc(end_ts)  # TxEntry applies js_parse_int (int truncation)
         elaps = np.trunc(elaps)
-        ok = ~np.isnan(end_ts)
+        ok = ~np.isnan(end_ts) & ~np.isnan(elaps)  # same intake filter as feed()
         n_nan = int(len(end_ts) - ok.sum())
         if n_nan:
             if self.logger:
-                self.logger.error(f"NaN bucket labels in batch: {n_nan} lines dropped")
+                self.logger.error(f"NaN end_ts/elapsed in batch: {n_nan} lines dropped")
             fields, end_ts, elaps = fields[ok], end_ts[ok], elaps[ok]
             good_lines = [gl for gl, o in zip(good_lines, ok) if o]
             if len(fields) == 0:

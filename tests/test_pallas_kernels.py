@@ -151,17 +151,22 @@ class TestPercentileRankParity:
 
 class TestStatsTickPallas:
     def test_tick_pallas_matches_sort(self):
-        """Full tick parity: percentile_impl='pallas' vs 'sort' on f32."""
+        """Full tick parity: percentile_impl='pallas' vs 'sort' on f32.
+
+        Below samplesPerBucket only — every impl is exact there. In the
+        overflow regime they differ BY DESIGN: 'sort' importance-weights
+        pooled reservoirs by bucket arrival counts, while pallas/topk rank
+        over the stored samples unweighted (see ops/stats.py docstring)."""
         rng = np.random.RandomState(0)
         cfg_s = dstats.StatsConfig(
-            capacity=16, window_sz=4, buffer_sz=1, samples_per_bucket=8,
+            capacity=16, window_sz=4, buffer_sz=1, samples_per_bucket=32,
             dtype=jnp.float32, percentile_impl="sort",
         )
         cfg_p = cfg_s._replace(percentile_impl="pallas")
         state = dstats.init_state(cfg_s)
         label = 1000
         res_s, state = dstats.tick(state, cfg_s, label)
-        B = 256
+        B = 64  # ~4 samples per (row, bucket): far under CAP=32
         for t in range(8):
             rows = rng.randint(0, 16, B).astype(np.int32)
             labels = np.full(B, label, np.int32)
@@ -170,6 +175,7 @@ class TestStatsTickPallas:
             label += 1
             res_s, state_s = dstats.tick(state, cfg_s, label)
             res_p, state_p = dstats.tick(state, cfg_p, label)
+            assert not bool(np.asarray(res_s.overflowed).any()), "test premise: exact regime"
             np.testing.assert_array_equal(np.asarray(res_s.per75), np.asarray(res_p.per75))
             np.testing.assert_array_equal(np.asarray(res_s.per95), np.asarray(res_p.per95))
             state = state_s

@@ -390,3 +390,41 @@ def test_fuzz_random_stream_vs_oracle(seed):
     g, d = drive_both(events, cfg)
     assert len(d) > 50
     assert_rows_match(g, d)
+
+
+def test_weighted_pooling_keeps_burst_mass():
+    """Cross-bucket skew: a burst bucket with 100x the arrivals of the quiet
+    buckets must dominate the pooled window percentile even though every
+    bucket stores at most CAP samples (the importance-weighted pooling)."""
+    cap = 16
+    cfg = make_cfg(capacity=1, cap=cap, dtype=jnp.float32)
+    label = BASE_LABEL
+    state = dstats.init_state(cfg)
+    _, state = dstats.tick(state, cfg, label)
+
+    def pour(lbl, n, value):
+        s = state_box[0]
+        for i in range(0, n, 512):
+            m = min(512, n - i)
+            s = dstats.ingest(
+                s, cfg,
+                np.zeros(m, np.int32), np.full(m, lbl, np.int32),
+                np.full(m, value, np.float32), np.ones(m, bool),
+            )
+        state_box[0] = s
+
+    state_box = [state]
+    # 10 quiet buckets: 64 arrivals each at ~100 ms
+    for k in range(10):
+        pour(label - k, 64, 100.0)
+    # 1 burst bucket: 6400 arrivals at ~1000 ms => ~91% of all arrivals
+    pour(label, 6400, 1000.0)
+    res, _ = dstats.tick(state_box[0], cfg, label + cfg.buffer_sz + 1)
+    assert bool(res.overflowed[0])
+    assert int(res.count[0]) == 10 * 64 + 6400
+    # p75 and p95 both sit deep inside the burst's arrival mass
+    assert float(res.per75[0]) == pytest.approx(1000.0), float(res.per75[0])
+    assert float(res.per95[0]) == pytest.approx(1000.0), float(res.per95[0])
+    # the pooled average stays exact regardless
+    want_avg = (10 * 64 * 100.0 + 6400 * 1000.0) / (10 * 64 + 6400)
+    assert float(res.average[0]) == pytest.approx(want_avg, rel=1e-5)
