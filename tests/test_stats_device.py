@@ -428,3 +428,26 @@ def test_weighted_pooling_keeps_burst_mass():
     # the pooled average stays exact regardless
     want_avg = (10 * 64 * 100.0 + 6400 * 1000.0) / (10 * 64 + 6400)
     assert float(res.average[0]) == pytest.approx(want_avg, rel=1e-5)
+
+
+def test_weighted_percentiles_reduce_to_reference_at_unit_weight():
+    """With every weight exactly 1 the weighted path must be bit-identical to
+    reference_percentile_sorted for all fill levels (the sub-CAP contract)."""
+    rng = np.random.RandomState(7)
+    S, K = 64, 31 * 8
+    window = np.full((S, K), np.nan, np.float32)
+    counts = rng.randint(0, K + 1, S).astype(np.int32)
+    counts[0], counts[1], counts[2] = 0, 1, K
+    for s in range(S):
+        window[s, : counts[s]] = rng.randint(1, 500, counts[s]).astype(np.float32)
+    w = jnp.asarray(window)
+    n = jnp.asarray(counts)
+    weights = jnp.where(jnp.isnan(w), 0.0, 1.0).astype(jnp.float32)
+    srt = jnp.sort(w, axis=-1)
+    for p in (75, 95):
+        want = np.asarray(dstats.reference_percentile_sorted(srt, n, p))
+        got = np.asarray(
+            dstats.weighted_reference_percentiles(w, weights, n, (p,))[0]
+        )
+        same = (want == got) | (np.isnan(want) & np.isnan(got))
+        assert same.all(), (p, np.nonzero(~same), want[~same], got[~same])
