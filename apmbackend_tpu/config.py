@@ -408,7 +408,11 @@ _DEFAULT_CONFIG: dict = {
         # windows (no reference equivalent; SURVEY.md §7.2 step 10). Keys are
         # uppercase like streamCalcZScore.defaults. SEASON_SLOTS=24 +
         # SLOT_INTERVALS=360 keeps one baseline per UTC hour-of-day at the
-        # stock 10 s cadence; CHANNEL_ID is the (negative) wire 'lag'.
+        # stock 10 s cadence; CHANNEL_ID is the (negative) wire 'lag';
+        # TREND_BETA > 0 upgrades the channel to a Holt (level+trend)
+        # baseline that tracks legitimately-ramping services instead of
+        # letting the flat EWMA's variance inflate around the ramp residual
+        # and mask real regressions.
         "ewmaChannels": [],
     },
 }

@@ -17,6 +17,14 @@ O(lag) ring:
   (incremental form of West 1979): ``delta = x - mean``,
   ``mean += alpha·delta``, ``var = (1 - alpha)·(var + alpha·delta²)``; the
   first observation of a slot seeds ``mean = x, var = 0``.
+- ``trend_beta > 0`` upgrades a channel to Holt's double exponential
+  smoothing (Holt-Winters without the multiplicative season — the additive
+  season is already covered by the slot axis): the baseline becomes
+  ``level + trend`` and the recursion tracks both, so a service whose
+  latency is *legitimately ramping* (deploy rollout, cache warm-up, organic
+  load growth) is judged against the extrapolated ramp rather than a lagging
+  flat mean — the flat EWMA's systematic false-positive mode. ``trend_beta
+  = 0`` is bit-for-bit the plain EWMA recursion (trend stays 0).
 - signal semantics mirror the z-score channel's quirks so the downstream alert
   ladder treats the channels identically: warm-up gating on per-slot update
   count (the lag-length analog), zero variance -> std undefined -> no bounds
@@ -57,12 +65,21 @@ class EwmaSpec(NamedTuple):
     # anomaly can't immediately inflate the EWMA variance and mask itself
     # (the classic EWMA control-chart weakness). 1.0 = no damping.
     influence: float = 1.0
+    # Holt trend smoothing factor in [0, 1): 0 disables the trend term (plain
+    # EWMA, the default); > 0 makes the channel a double-exponential
+    # (level + trend) baseline judged against the extrapolated value.
+    trend_beta: float = 0.0
 
 
 class EwmaState(NamedTuple):
-    mean: jnp.ndarray  # [S, 3, K]
-    var: jnp.ndarray  # [S, 3, K]
+    mean: jnp.ndarray  # [S, 3, K] level
+    var: jnp.ndarray  # [S, 3, K] residual variance
     count: jnp.ndarray  # [S, K] int32 per-slot update count
+    # per-slot Holt trend; zeros() for trend_beta == 0 channels, so plain-EWMA
+    # snapshots/states stay shape-compatible and the recursion is unchanged.
+    # No default: omitting it must fail at the construction site, not as a
+    # NoneType subscript inside the jitted step.
+    trend: jnp.ndarray  # [S, 3, K]
 
 
 class EwmaResult(NamedTuple):
@@ -79,6 +96,7 @@ def init_state(capacity: int, spec: EwmaSpec, dtype=jnp.float32) -> EwmaState:
         mean=jnp.full((S, N_METRICS, K), jnp.nan, dtype),
         var=jnp.zeros((S, N_METRICS, K), dtype),
         count=jnp.zeros((S, K), jnp.int32),
+        trend=jnp.zeros((S, N_METRICS, K), dtype),
     )
 
 
@@ -99,29 +117,42 @@ def step(
     label,  # int32 scalar: the tick's bucket label (selects the season slot)
 ) -> Tuple[EwmaResult, EwmaState]:
     k = slot_for_label(label, spec)
-    mean_k = state.mean[:, :, k]  # [S, 3]
+    mean_k = state.mean[:, :, k]  # [S, 3] level
     var_k = state.var[:, :, k]
     cnt_k = state.count[:, k]  # [S]
+    trend_k = state.trend[:, :, k]  # [S, 3] (all-zero for trend_beta == 0)
+
+    # the baseline the new value is judged against: the Holt one-step
+    # prediction level + trend. For trend_beta == 0 trend is identically 0,
+    # so pred == mean and every expression below reduces to the plain EWMA.
+    pred_k = mean_k + trend_k
 
     warm = cnt_k >= spec.warmup  # [S]
     has_avg = warm[:, None] & ~jnp.isnan(mean_k)
     has_std = has_avg & (var_k > 0)  # zero variance -> undefined, like zscore
     std = jnp.where(has_std, jnp.sqrt(var_k), jnp.nan)
 
-    lb = jnp.where(has_std, mean_k - spec.threshold * std, jnp.nan)
-    ub = jnp.where(has_std, mean_k + spec.threshold * std, jnp.nan)
+    lb = jnp.where(has_std, pred_k - spec.threshold * std, jnp.nan)
+    ub = jnp.where(has_std, pred_k + spec.threshold * std, jnp.nan)
 
     new_ok = ~jnp.isnan(new_values)
-    exceeds = has_std & new_ok & (jnp.abs(new_values - mean_k) > spec.threshold * std)
-    signal = jnp.where(exceeds, jnp.where(new_values > mean_k, 1, -1), 0).astype(jnp.int32)
+    exceeds = has_std & new_ok & (jnp.abs(new_values - pred_k) > spec.threshold * std)
+    signal = jnp.where(exceeds, jnp.where(new_values > pred_k, 1, -1), 0).astype(jnp.int32)
 
-    # EWMA mean/var update (skip NaN inputs; first observation seeds the slot).
-    # Signalling values are influence-damped before entering the recursion.
-    pushed = jnp.where(exceeds, spec.influence * new_values + (1.0 - spec.influence) * mean_k, new_values)
+    # Holt level/trend/var update (skip NaN inputs; first observation seeds
+    # the slot: level = x, trend = 0, var = 0). Signalling values are
+    # influence-damped against the prediction before entering the recursion.
+    pushed = jnp.where(exceeds, spec.influence * new_values + (1.0 - spec.influence) * pred_k, new_values)
     seeded = ~jnp.isnan(mean_k)
-    delta = jnp.where(new_ok & seeded, pushed - mean_k, 0)
+    delta = jnp.where(new_ok & seeded, pushed - pred_k, 0)  # one-step residual
     incr = spec.alpha * delta
-    upd_mean = jnp.where(new_ok, jnp.where(seeded, mean_k + incr, new_values), mean_k)
+    new_level = pred_k + incr  # == alpha*pushed + (1-alpha)*(level+trend)
+    upd_mean = jnp.where(new_ok, jnp.where(seeded, new_level, new_values), mean_k)
+    upd_trend = jnp.where(
+        new_ok & seeded,
+        spec.trend_beta * (new_level - mean_k) + (1.0 - spec.trend_beta) * trend_k,
+        jnp.where(new_ok, 0.0, trend_k),  # seeding resets trend
+    )
     # seeding resets var to 0 (not just mean): a NaN var — e.g. rows grown
     # past a resume snapshot's capacity — must not poison the recursion forever
     upd_var = jnp.where(
@@ -133,17 +164,18 @@ def step(
     dtype = state.mean.dtype
     new_mean = state.mean.at[:, :, k].set(upd_mean.astype(dtype))
     new_var = state.var.at[:, :, k].set(upd_var.astype(dtype))
+    new_trend = state.trend.at[:, :, k].set(upd_trend.astype(dtype))
     # per-slot count advances when any metric updated (all 3 share the tick)
     any_ok = jnp.any(new_ok, axis=1)
     new_count = state.count.at[:, k].add(jnp.where(any_ok, 1, 0).astype(jnp.int32))
 
     result = EwmaResult(
-        window_avg=jnp.where(has_avg, mean_k, jnp.nan).astype(dtype),
+        window_avg=jnp.where(has_avg, pred_k, jnp.nan).astype(dtype),
         lower_bound=lb.astype(dtype),
         upper_bound=ub.astype(dtype),
         signal=signal,
     )
-    return result, EwmaState(new_mean, new_var, new_count)
+    return result, EwmaState(new_mean, new_var, new_count, new_trend)
 
 
 def grow_state(state: EwmaState, new_capacity: int) -> EwmaState:
@@ -155,6 +187,7 @@ def grow_state(state: EwmaState, new_capacity: int) -> EwmaState:
         mean=jnp.pad(state.mean, ((0, pad), (0, 0), (0, 0)), constant_values=jnp.nan),
         var=jnp.pad(state.var, ((0, pad), (0, 0), (0, 0))),
         count=jnp.pad(state.count, ((0, pad), (0, 0))),
+        trend=jnp.pad(state.trend, ((0, pad), (0, 0), (0, 0))),
     )
 
 
@@ -182,7 +215,12 @@ def specs_from_config(eng_config: dict) -> Tuple[EwmaSpec, ...]:
             channel_id=int(d.get("CHANNEL_ID", -(i + 1))),
             suppressed=bool(d.get("SUPPRESSED", False)),
             influence=float(d.get("INFLUENCE", 1.0)),
+            trend_beta=float(d.get("TREND_BETA", 0.0)),
         )
+        if not (0.0 <= spec.trend_beta < 1.0):
+            raise ValueError(
+                f"ewmaChannels[{i}]: TREND_BETA must be in [0, 1), got {spec.trend_beta}"
+            )
         # channel_id is the wire 'lag' and the resume-snapshot key: it must be
         # negative (so it can't collide with a real lag window) and unique
         # (a collision would silently merge two channels' resume state)

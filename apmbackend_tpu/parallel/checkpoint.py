@@ -99,6 +99,9 @@ class ShardedCheckpointer:
                 )["meta"]
                 if meta["signature"] != _shape_signature(cfg):
                     continue
+            except Exception:
+                continue
+            try:
                 restored = self.manager.restore(
                     step,
                     args=ocp.args.Composite(
@@ -107,10 +110,49 @@ class ShardedCheckpointer:
                 )["state"]
                 state = EngineState(**restored)
             except Exception:
-                continue
+                # pre-Holt snapshots lack the EwmaState.trend leaf; a
+                # structure mismatch must not silently discard the learned
+                # baselines (the npz load_resume path zero-fills the same way)
+                state = self._restore_without_trend(step, template, cfg)
+                if state is None:
+                    continue
             registry = tuple(tuple(k.split("\x00", 1)) for k in meta["registry"])
             return state, registry, step
         return None
+
+    def _restore_without_trend(
+        self, step: int, template: EngineState, cfg: EngineConfig
+    ) -> Optional[EngineState]:
+        """Restore a pre-Holt snapshot (EwmaState saved without ``trend``)
+        against a trend-less template, then zero-fill the trend leaves with
+        the template's sharding. Returns None when this snapshot is not that
+        legacy shape either."""
+        if not cfg.ewma:
+            return None
+        td = template._asdict()
+        legacy_ewmas = tuple(
+            {"mean": e.mean, "var": e.var, "count": e.count} for e in td["ewmas"]
+        )
+        legacy = dict(td, ewmas=legacy_ewmas)
+        try:
+            restored = self.manager.restore(
+                step, args=ocp.args.Composite(state=ocp.args.StandardRestore(legacy))
+            )["state"]
+            ewmas = []
+            for node, tmpl in zip(restored["ewmas"], td["ewmas"]):
+                trend = jax.device_put(
+                    np.zeros(tmpl.trend.shape, tmpl.trend.dtype), tmpl.trend.sharding
+                )
+                ewmas.append(
+                    type(tmpl)(
+                        mean=node["mean"], var=node["var"], count=node["count"],
+                        trend=trend,
+                    )
+                )
+            restored = dict(restored, ewmas=tuple(ewmas))
+            return EngineState(**restored)
+        except Exception:
+            return None
 
     def close(self) -> None:
         self.manager.wait_until_finished()

@@ -92,7 +92,9 @@ def _state_specs(cfg: EngineConfig) -> EngineState:
         stats=StatsState(latest_bucket=P(), counts=_ROW, sums=_ROW, samples=_ROW, nsamples=_ROW),
         zscores=tuple(ZScoreState(values=_ROW, fill=_ROW, pos=_ROW) for _ in cfg.lags),
         alert_counters=tuple(_ROW for _ in cfg.lags),
-        ewmas=tuple(EwmaState(mean=_ROW, var=_ROW, count=_ROW) for _ in cfg.ewma),
+        ewmas=tuple(
+            EwmaState(mean=_ROW, var=_ROW, count=_ROW, trend=_ROW) for _ in cfg.ewma
+        ),
         ewma_counters=tuple(_ROW for _ in cfg.ewma),
     )
 

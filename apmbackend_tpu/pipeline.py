@@ -777,6 +777,7 @@ class PipelineDriver:
             arrays[f"{ek}_var"] = np.asarray(e.var)
             arrays[f"{ek}_count"] = np.asarray(e.count)
             arrays[f"{ek}_counters"] = np.asarray(self.state.ewma_counters[i])
+            arrays[f"{ek}_trend"] = np.asarray(e.trend)
         keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
         # pending ordered-tx records (not yet past the window edge) must
         # survive a restart — the reference keeps its heap in the resume file
@@ -854,11 +855,20 @@ class PipelineDriver:
         estates, ecounters = [], []
         for espec in self.cfg.ewma:
             ek = f"e{espec.channel_id}x{espec.season_slots}x{espec.slot_intervals}"
+            mean = pad_rows(data[f"{ek}_mean"])
+            # trend is absent in pre-Holt snapshots: zero-fill == the exact
+            # plain-EWMA state those snapshots were saved under
+            trend = (
+                pad_rows(data[f"{ek}_trend"])
+                if f"{ek}_trend" in data
+                else np.zeros_like(mean)
+            )
             estates.append(
                 dewma.EwmaState(
-                    mean=jnp.asarray(pad_rows(data[f"{ek}_mean"])),
+                    mean=jnp.asarray(mean),
                     var=jnp.asarray(pad_rows(data[f"{ek}_var"])),
                     count=jnp.asarray(pad_rows(data[f"{ek}_count"])),
+                    trend=jnp.asarray(trend),
                 )
             )
             ecounters.append(jnp.asarray(pad_rows(data[f"{ek}_counters"])))

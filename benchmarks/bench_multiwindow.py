@@ -2,10 +2,10 @@
 alert threshold eval.
 
 The engine with BOTH fixed-lag z-score windows (1 h + 24 h) and the O(1)
-EWMA/seasonal channels (plain EWMA + 24-slot hour-of-day seasonal), each with
-the full alert rule ladder (hard thresholds, both-only gate, rolling
-bad-interval counters) evaluated on device. Reports metrics/sec/chip across
-all four channels against the per-chip north star.
+EWMA-family channels (plain EWMA + 24-slot hour-of-day seasonal + Holt
+level-and-trend), each with the full alert rule ladder (hard thresholds,
+both-only gate, rolling bad-interval counters) evaluated on device. Reports
+metrics/sec/chip across all five channels against the per-chip north star.
 """
 
 from __future__ import annotations
@@ -20,6 +20,10 @@ EWMA_CHANNELS = [
     {"ALPHA": 0.05, "THRESHOLD": 3.0, "WARMUP": 30, "CHANNEL_ID": -1},
     {"ALPHA": 0.2, "THRESHOLD": 3.0, "WARMUP": 3, "SEASON_SLOTS": 24,
      "SLOT_INTERVALS": 360, "CHANNEL_ID": -24},
+    # Holt level+trend channel: baselines ramping services against the
+    # extrapolated slope (ops/ewma.py trend_beta)
+    {"ALPHA": 0.1, "THRESHOLD": 3.0, "WARMUP": 30, "CHANNEL_ID": -2,
+     "TREND_BETA": 0.2},
 ]
 
 

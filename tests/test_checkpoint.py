@@ -133,3 +133,63 @@ def test_empty_directory_returns_none(tmp_path, engine):
     ckpt = ShardedCheckpointer(str(tmp_path / "empty"))
     assert ckpt.restore(cfg) is None
     ckpt.close()
+
+
+def test_pre_holt_snapshot_restores_with_zero_trend(tmp_path):
+    """Upgrade path: an orbax snapshot saved by the pre-Holt build (EwmaState
+    without the ``trend`` leaf) must restore with trend zero-filled — learned
+    baselines survive the upgrade, matching load_resume's npz fallback."""
+    import orbax.checkpoint as ocp
+
+    from apmbackend_tpu.parallel.checkpoint import _shape_signature
+
+    chan = {"ALPHA": 0.3, "THRESHOLD": 3.0, "WARMUP": 2, "CHANNEL_ID": -1}
+    cfg, state, params = make_demo_engine(16, 8, [(4, 20.0, 0.1)], ewma_channels=[chan])
+    # move the ewma state off init values
+    label = 1000
+    tick = jax.jit(engine_tick, static_argnums=1)
+    ingest = jax.jit(engine_ingest, static_argnums=1)
+    rng = np.random.RandomState(1)
+    for _ in range(12):  # > buffer_sz so ingested data enters the stats window
+        label += 1
+        _, state = tick(state, cfg, label, params)
+        state = ingest(state, cfg, rng.randint(0, 16, 64).astype(np.int32),
+                       np.full(64, label, np.int32),
+                       (100 + rng.rand(64) * 50).astype(np.float32), np.ones(64, bool))
+    assert int(np.asarray(state.ewmas[0].count).sum()) > 0
+
+    # write the snapshot the way the pre-Holt build serialized it: the same
+    # _asdict() tree but with 3-field ewma nodes (no 'trend')
+    legacy_tree = state._asdict()
+    legacy_tree["ewmas"] = tuple(
+        {"mean": e.mean, "var": e.var, "count": e.count} for e in state.ewmas
+    )
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
+    meta = {"signature": _shape_signature(cfg), "registry": ["srvA\x00svc1"]}
+    ckpt.manager.save(
+        5,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardSave(legacy_tree),
+            meta=ocp.args.JsonSave(meta),
+        ),
+    )
+    ckpt.wait()
+
+    out = ckpt.restore(cfg)
+    assert out is not None, "legacy snapshot must be restorable"
+    restored, registry, step = out
+    assert step == 5 and registry == (("srvA", "svc1"),)
+    np.testing.assert_array_equal(
+        np.asarray(state.ewmas[0].count), np.asarray(restored.ewmas[0].count)
+    )
+    np.testing.assert_allclose(
+        np.nan_to_num(np.asarray(state.ewmas[0].mean)),
+        np.nan_to_num(np.asarray(restored.ewmas[0].mean)),
+    )
+    np.testing.assert_array_equal(
+        np.zeros_like(np.asarray(state.ewmas[0].trend)), np.asarray(restored.ewmas[0].trend)
+    )
+    # and the restored state steps under the Holt-aware engine
+    em, _ = jax.jit(engine_tick, static_argnums=1)(restored, cfg, label + 1, params)
+    jax.block_until_ready(em.tpm)
+    ckpt.close()

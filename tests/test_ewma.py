@@ -18,42 +18,52 @@ from apmbackend_tpu.pipeline import (
 
 
 class OracleEwma:
-    """Scalar float64 EWMA mean/var recursion, one (slot,) baseline."""
+    """Scalar float64 Holt level/trend/var recursion, one (slot,) baseline.
 
-    def __init__(self, alpha, threshold, warmup, season_slots=1, slot_intervals=1, influence=1.0):
+    trend_beta == 0 is the plain EWMA recursion (trend stays 0, the baseline
+    is the level itself)."""
+
+    def __init__(self, alpha, threshold, warmup, season_slots=1, slot_intervals=1,
+                 influence=1.0, trend_beta=0.0):
         self.alpha = alpha
         self.threshold = threshold
         self.warmup = warmup
         self.K = season_slots
         self.slot_intervals = slot_intervals
         self.influence = influence
+        self.beta = trend_beta
         self.mean = [float("nan")] * season_slots
         self.var = [0.0] * season_slots
         self.count = [0] * season_slots
+        self.trend = [0.0] * season_slots
 
     def step(self, x, label):
         k = (label // self.slot_intervals) % self.K
-        mean, var, cnt = self.mean[k], self.var[k], self.count[k]
+        mean, var, cnt, trend = self.mean[k], self.var[k], self.count[k], self.trend[k]
+        pred = mean + trend
         warm = cnt >= self.warmup
         has_avg = warm and not math.isnan(mean)
         has_std = has_avg and var > 0
         std = math.sqrt(var) if has_std else float("nan")
-        lb = mean - self.threshold * std if has_std else float("nan")
-        ub = mean + self.threshold * std if has_std else float("nan")
+        lb = pred - self.threshold * std if has_std else float("nan")
+        ub = pred + self.threshold * std if has_std else float("nan")
         signal = 0
-        if has_std and not math.isnan(x) and abs(x - mean) > self.threshold * std:
-            signal = 1 if x > mean else -1
+        if has_std and not math.isnan(x) and abs(x - pred) > self.threshold * std:
+            signal = 1 if x > pred else -1
         if not math.isnan(x):
-            pushed = self.influence * x + (1 - self.influence) * mean if signal else x
+            pushed = self.influence * x + (1 - self.influence) * pred if signal else x
             if math.isnan(mean):
                 self.mean[k] = x
+                self.trend[k] = 0.0
             else:
-                delta = pushed - mean
+                delta = pushed - pred
                 incr = self.alpha * delta
-                self.mean[k] = mean + incr
+                new_level = pred + incr
+                self.mean[k] = new_level
+                self.trend[k] = self.beta * (new_level - mean) + (1 - self.beta) * trend
                 self.var[k] = (1 - self.alpha) * (var + delta * incr)
             self.count[k] = cnt + 1
-        return {"avg": mean if has_avg else float("nan"), "lb": lb, "ub": ub, "signal": signal}
+        return {"avg": pred if has_avg else float("nan"), "lb": lb, "ub": ub, "signal": signal}
 
 
 def same(a, b):
@@ -165,6 +175,118 @@ def test_seasonal_slots_are_independent():
     assert int(results[-1].signal[0, 0]) == 1  # flagged vs slot-0 baseline
 
 
+@pytest.mark.parametrize("beta", [0.1, 0.3])
+def test_holt_trend_matches_oracle(beta):
+    """trend_beta > 0: device recursion == the scalar Holt oracle, including
+    signals, bounds, influence damping and NaN gaps."""
+    rng = np.random.RandomState(13)
+    series = list(200 + 3.0 * np.arange(100) + 10 * rng.rand(100))  # ramp
+    series[60] = 1500.0  # spike far above the ramp
+    series[70] = float("nan")
+    labels = list(range(2000, 2000 + len(series)))
+    spec = de.EwmaSpec(alpha=0.3, threshold=3.0, warmup=10, influence=0.2, trend_beta=beta)
+    oracle = OracleEwma(0.3, 3.0, 10, influence=0.2, trend_beta=beta)
+    results = drive(spec, series, labels)
+    for t, (x, label) in enumerate(zip(series, labels)):
+        g = oracle.step(x, label)
+        d = results[t]
+        assert same(g["avg"], float(d.window_avg[0, 0])), f"t={t} avg"
+        assert same(g["lb"], float(d.lower_bound[0, 0])), f"t={t} lb"
+        assert same(g["ub"], float(d.upper_bound[0, 0])), f"t={t} ub"
+        assert g["signal"] == int(d.signal[0, 0]), f"t={t} signal"
+
+
+def test_trend_beta_zero_is_plain_ewma():
+    """trend_beta=0 must be bit-for-bit the plain EWMA channel (same jitted
+    math, trend identically zero)."""
+    rng = np.random.RandomState(5)
+    series = list(300 + 50 * rng.rand(80))
+    series[40] = 2000.0
+    labels = list(range(len(series)))
+    plain = drive(de.EwmaSpec(alpha=0.2, threshold=3.0, warmup=5), series, labels)
+    holt0 = drive(de.EwmaSpec(alpha=0.2, threshold=3.0, warmup=5, trend_beta=0.0), series, labels)
+    for t in range(len(series)):
+        np.testing.assert_array_equal(
+            np.asarray(plain[t].window_avg), np.asarray(holt0[t].window_avg)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain[t].signal), np.asarray(holt0[t].signal)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain[t].upper_bound), np.asarray(holt0[t].upper_bound)
+        )
+
+
+def test_holt_detects_step_that_ramp_inflated_ewma_masks():
+    """The motivating scenario: a service whose latency is legitimately
+    ramping. The flat EWMA's variance recursion absorbs the systematic
+    on-ramp residual (steady-state std ~ the lag slope*(1-a)/a, far above the
+    noise floor), so its bounds balloon and a real step change hides inside
+    them. The Holt channel learns the slope: its residuals stay at the noise
+    floor, bounds stay tight, and the same step is flagged immediately."""
+    rng = np.random.RandomState(23)
+    T = 150
+    ramp = 200 + 8.0 * np.arange(T) + 2.0 * rng.rand(T)  # sustained clean ramp
+    step_jump = 100.0  # genuine regression, small vs the inflated bounds
+    series = list(ramp) + [float(200 + 8.0 * T + step_jump)]
+    labels = list(range(len(series)))
+    plain_res = drive(de.EwmaSpec(alpha=0.1, threshold=3.0, warmup=10), series, labels)
+    holt_res = drive(
+        de.EwmaSpec(alpha=0.1, threshold=3.0, warmup=10, trend_beta=0.2), series, labels
+    )
+    # steady ramp (past onset transient): Holt stays quiet with tight bounds;
+    # the flat EWMA is quiet only because its band inflated ~50x wider
+    steady = slice(80, T)
+    assert all(int(r.signal[0, 0]) == 0 for r in holt_res[steady])
+    holt_half_band = np.nanmedian(
+        [float(r.upper_bound[0, 0] - r.window_avg[0, 0]) for r in holt_res[steady]]
+    )
+    plain_half_band = np.nanmedian(
+        [float(r.upper_bound[0, 0] - r.window_avg[0, 0]) for r in plain_res[steady]]
+    )
+    assert holt_half_band < 20.0, f"Holt band should sit at the noise floor, got {holt_half_band}"
+    assert plain_half_band > 100.0, f"flat EWMA band should inflate, got {plain_half_band}"
+    # the step: masked by the inflated flat-EWMA band, caught by Holt
+    assert int(plain_res[-1].signal[0, 0]) == 0, "flat EWMA masks the step"
+    assert int(holt_res[-1].signal[0, 0]) == 1, "Holt flags the step"
+
+
+def test_holt_channel_config_and_resume(tmp_path):
+    """TREND_BETA flows from config; trend state survives the resume file."""
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import TxEntry
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["serviceCapacity"] = 8
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = 8
+    cfg_tree["tpuEngine"]["ewmaChannels"] = [
+        {"ALPHA": 0.5, "THRESHOLD": 3.0, "WARMUP": 2, "CHANNEL_ID": -2,
+         "TREND_BETA": 0.3}
+    ]
+    cfg_tree["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0}]
+    d1 = PipelineDriver(cfg_tree, capacity=8)
+    assert d1.cfg.ewma[0].trend_beta == 0.3
+    ts = 170_000_000_0000
+    for t in range(10):
+        d1.feed(TxEntry("s1", "svcA", f"L{t}", "A", ts - 100, float(ts), 100.0 + 20 * t, "Y"))
+        ts += 10_000
+    path = str(tmp_path / "resume.npz")
+    d1.save_resume(path)
+    assert float(np.abs(np.asarray(d1.state.ewmas[0].trend)).sum()) > 0  # trend moved
+    d2 = PipelineDriver(cfg_tree, capacity=8)
+    assert d2.load_resume(path)
+    np.testing.assert_array_equal(
+        np.asarray(d1.state.ewmas[0].trend), np.asarray(d2.state.ewmas[0].trend)
+    )
+
+
+def test_trend_beta_validation():
+    with pytest.raises(ValueError, match="TREND_BETA"):
+        de.specs_from_config({"ewmaChannels": [
+            {"ALPHA": 0.5, "THRESHOLD": 3.0, "CHANNEL_ID": -1, "TREND_BETA": 1.0}
+        ]})
+
+
 def test_engine_integration_ewma_channel_alerts():
     """End-to-end: engine with an EWMA channel raises a device-side trigger."""
     chan = {"ALPHA": 0.3, "THRESHOLD": 2.0, "WARMUP": 3, "CHANNEL_ID": -1}
@@ -253,6 +375,7 @@ def test_nan_var_recovers_on_seed():
         mean=jnp.full((1, 3, 1), jnp.nan, jnp.float64),
         var=jnp.full((1, 3, 1), jnp.nan, jnp.float64),  # poisoned pad
         count=jnp.zeros((1, 1), jnp.int32),
+        trend=jnp.full((1, 3, 1), jnp.nan, jnp.float64),  # poisoned pad
     )
     vals = [100.0, 110.0, 90.0, 105.0, 500.0]
     res = None
