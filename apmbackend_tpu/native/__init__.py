@@ -8,6 +8,10 @@ demand with ``make`` (g++, no external deps) and exposes:
 - :class:`LineRing` — lock-free SPSC byte ring (native/ring.cpp): the
   bounded host buffer between producers and the device step loop, with
   full-ring push failure as the backpressure signal (queue.js:250-256 role).
+- :class:`TxDecoder` — batch tx pipe-CSV decoder (native/decoder.cpp): one
+  C++ pass over a newline-joined blob -> dense (end_ts, elapsed, key id,
+  line span) arrays with first-appearance key interning; the host intake
+  hot path behind pipeline.feed_csv_batch.
 
 Everything degrades gracefully: with no compiler available the build
 functions return None and callers fall back to the pure-Python paths.
@@ -95,6 +99,126 @@ def _load_ring_lib():
     lib.apmring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
     _ring_lib = lib
     return lib
+
+
+_decode_lib = None
+
+
+def _load_decode_lib():
+    global _decode_lib
+    if _decode_lib is not None:
+        return _decode_lib
+    build = ensure_built()
+    if build is None:
+        return None
+    so = os.path.join(build, "libapmdecode.so")
+    if not os.path.isfile(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.apmdec_create.restype = ctypes.c_void_p
+    lib.apmdec_create.argtypes = []
+    lib.apmdec_destroy.argtypes = [ctypes.c_void_p]
+    lib.apmdec_key_count.restype = ctypes.c_int32
+    lib.apmdec_key_count.argtypes = [ctypes.c_void_p]
+    lib.apmdec_batch.restype = ctypes.c_int64
+    lib.apmdec_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.apmdec_keys.restype = ctypes.c_int64
+    lib.apmdec_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    _decode_lib = lib
+    return lib
+
+
+class TxDecoder:
+    """Batch decoder for ``tx|...`` wire lines over libapmdecode.
+
+    ``decode(blob)`` parses a newline-separated byte blob in one native pass
+    and returns numpy arrays; (server, service) keys are interned to dense
+    int32 ids in first-appearance order, monotonic for the decoder's
+    lifetime (``key_count``/``keys_from`` expose the id -> key mapping).
+    Numeric fields follow entries.js_parse_int semantics; records whose
+    numeric fields contain non-ASCII bytes come back flagged so the caller
+    re-parses them with the Python reference implementation.
+    """
+
+    def __init__(self):
+        lib = _load_decode_lib()
+        if lib is None:
+            raise RuntimeError("native decoder unavailable (no toolchain?)")
+        self._lib = lib
+        self._h = lib.apmdec_create()
+        if not self._h:
+            raise MemoryError("apmdec_create failed")
+
+    def decode(self, blob: bytes):
+        """-> (end_ts[f8], elapsed[f8], keyid[i4], line_off[i8], line_len[i4],
+        flags[u1], n_bad). Arrays are trimmed to the parsed record count."""
+        import numpy as np
+
+        if not self._h:
+            raise RuntimeError("decoder closed")
+        # upper bound on records: one per newline + the unterminated tail
+        cap = blob.count(b"\n") + 1
+        end_ts = np.empty(cap, np.float64)
+        elapsed = np.empty(cap, np.float64)
+        keyid = np.empty(cap, np.int32)
+        line_off = np.empty(cap, np.int64)
+        line_len = np.empty(cap, np.int32)
+        flags = np.empty(cap, np.uint8)
+        n_bad = ctypes.c_uint64(0)
+        n = self._lib.apmdec_batch(
+            self._h, blob, len(blob),
+            end_ts.ctypes.data_as(ctypes.c_void_p),
+            elapsed.ctypes.data_as(ctypes.c_void_p),
+            keyid.ctypes.data_as(ctypes.c_void_p),
+            line_off.ctypes.data_as(ctypes.c_void_p),
+            line_len.ctypes.data_as(ctypes.c_void_p),
+            flags.ctypes.data_as(ctypes.c_void_p),
+            cap, ctypes.byref(n_bad),
+        )
+        n = int(n)
+        return (end_ts[:n], elapsed[:n], keyid[:n], line_off[:n], line_len[:n],
+                flags[:n], int(n_bad.value))
+
+    @property
+    def key_count(self) -> int:
+        return int(self._lib.apmdec_key_count(self._h)) if self._h else 0
+
+    def keys_from(self, start: int):
+        """[(server, service), ...] for interned ids >= start, in id order."""
+        if not self._h:
+            return []
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = int(self._lib.apmdec_keys(self._h, start, buf, cap))
+            if n >= 0:
+                raw = buf.raw[:n]
+                break
+            cap = -n
+        out = []
+        for rec in raw.split(b"\n"):
+            if rec:
+                srv, _, svc = rec.partition(b"\x00")
+                out.append((srv.decode("utf-8", "replace"), svc.decode("utf-8", "replace")))
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.apmdec_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class LineRing:

@@ -354,6 +354,15 @@ class PipelineDriver:
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
         self._latest_label = 0  # host mirror of stats.latest_bucket (hot path)
+        # native batch decoder (native/decoder.cpp): created lazily on the
+        # first feed_csv_batch; None = unavailable or disabled, use the
+        # numpy path. _decode2row maps decoder key ids -> registry rows.
+        self._use_native_decode = bool(
+            apm_config.get("tpuEngine", {}).get("nativeDecode", True)
+        )
+        self._native_dec = None
+        self._native_dec_tried = False
+        self._reset_decode_map()
         self._refresh_params()
         # jax.jit memoizes per static EngineConfig, so growth (a new cfg)
         # recompiles automatically through these two callables
@@ -471,6 +480,10 @@ class PipelineDriver:
                     self.logger.info(f"Not a transactions entry: {line[:200]}")
             return n
 
+        dec = self._decoder()
+        if dec is not None:
+            return self.feed_csv_bytes("\n".join(lines).encode("utf-8"))
+
         good = []
         good_lines: List[str] = []
         n_bad = 0
@@ -570,6 +583,143 @@ class PipelineDriver:
             self._tx_backlog.extend(zip(ets_list[idx:], good_lines[idx:]))
         self._ingest_arrays(resolve_rows(idx, len(labels)), labels[idx:], elaps[idx:])
         return len(labels)
+
+    def _reset_decode_map(self) -> None:
+        # decoder-id -> registry row; -1 = interned but never registered (the
+        # id's records were all NaN-dropped so far — the numpy path would not
+        # have registered that key either). _decode_keys caches the decoder's
+        # id -> (server, service) strings, fetched incrementally.
+        self._decode2row = np.full(256, -1, np.int32)
+        self._decode_keys: List[Tuple[str, str]] = []
+
+    def _decoder(self):
+        """The native batch decoder, created lazily; None when disabled or
+        the toolchain is unavailable (callers fall back to the numpy path)."""
+        if not self._use_native_decode:
+            return None
+        if not self._native_dec_tried:
+            self._native_dec_tried = True
+            try:
+                from .native import TxDecoder
+
+                self._native_dec = TxDecoder()
+                self._reset_decode_map()
+            except Exception as e:
+                self._native_dec = None
+                if self.logger:
+                    self.logger.info(f"native decoder unavailable, using numpy path: {e}")
+        return self._native_dec
+
+    def feed_csv_bytes(self, blob: bytes) -> int:
+        """Bulk intake of a newline-separated ``tx|...`` byte blob through the
+        native decoder — one C++ pass instead of per-line Python string ops.
+        Emission/tick semantics are identical to :meth:`feed_csv_batch`
+        (asserted by tests/test_native.py parity tests). Falls back to the
+        numpy path when the native decoder is unavailable."""
+        dec = self._decoder()
+        if dec is None or self.on_ordered_tx is not None:
+            return self.feed_csv_batch(blob.decode("utf-8", "replace").splitlines())
+
+        from .entries import js_parse_int
+
+        end_ts, elaps, keyids, offs, lens, flags, n_bad = dec.decode(blob)
+        if n_bad and self.logger:
+            self.logger.info(f"Skipped {n_bad} non-tx/malformed lines in batch")
+        if len(end_ts) == 0:
+            return 0
+        # exotic numerics (non-ASCII bytes): re-parse with the reference
+        # implementation so the native path cannot silently diverge
+        for i in np.nonzero(flags & 1)[0]:
+            o, l = int(offs[i]), int(lens[i])
+            p = blob[o : o + l].decode("utf-8", "replace").split("|")
+            end_ts[i] = js_parse_int(p[6])
+            elaps[i] = js_parse_int(p[7])
+        ok = ~np.isnan(end_ts) & ~np.isnan(elaps)  # same intake filter as feed()
+        n_nan = int(len(end_ts) - ok.sum())
+        if n_nan:
+            if self.logger:
+                self.logger.error(f"NaN end_ts/elapsed in batch: {n_nan} lines dropped")
+            end_ts, elaps, keyids = end_ts[ok], elaps[ok], keyids[ok]
+            offs, lens = offs[ok], lens[ok]
+            if len(end_ts) == 0:
+                return 0
+        labels = (end_ts.astype(np.int64) // 10000).astype(np.int32)
+
+        self._flush_pending()  # interleaved feed() entries must not reorder
+        # tick exactly where feed() would (see feed_csv_batch)
+        running_max = np.maximum(np.maximum.accumulate(labels), self._latest_label)
+        prior = np.concatenate([[self._latest_label], running_max[:-1]])
+        tick_points = np.nonzero(running_max > prior)[0]
+        track_ordered = self.on_ordered_csv is not None
+        ets_list = end_ts.tolist() if track_ordered else None
+        if track_ordered:
+            # ASCII blob (the wire norm): byte offsets == str offsets, so one
+            # whole-blob decode + str slicing replaces per-line bytes.decode
+            text = blob.decode("ascii") if blob.isascii() else None
+            offs_l = offs.tolist()
+            lens_l = lens.tolist()
+
+        def backlog(lo: int, hi: int) -> None:
+            if text is not None:
+                self._tx_backlog.extend(
+                    (ets_list[j], text[offs_l[j] : offs_l[j] + lens_l[j]])
+                    for j in range(lo, hi)
+                )
+            else:
+                self._tx_backlog.extend(
+                    (ets_list[j], blob[offs_l[j] : offs_l[j] + lens_l[j]].decode("utf-8", "replace"))
+                    for j in range(lo, hi)
+                )
+
+        idx = 0
+        for i in tick_points:
+            i = int(i)
+            if i > idx:
+                if track_ordered:
+                    backlog(idx, i)
+                self._ingest_arrays(
+                    self._resolve_decoded_rows(keyids[idx:i]), labels[idx:i], elaps[idx:i]
+                )
+                idx = i
+            label = int(labels[i])
+            self._run_tick(label)
+            self._latest_label = label
+        if track_ordered:
+            backlog(idx, len(labels))
+        self._ingest_arrays(
+            self._resolve_decoded_rows(keyids[idx:]), labels[idx:], elaps[idx:]
+        )
+        return len(labels)
+
+    def _resolve_decoded_rows(self, seg_ids: np.ndarray) -> np.ndarray:
+        """Registry rows for one tick segment of decoder key ids.
+
+        A key registers at its first id that actually reaches a segment
+        (post NaN-filter) — NOT at interning time, because the decoder
+        interns tx-shaped lines whose numerics turn out unparseable, and the
+        numpy path never registers those phantom keys. Unregistered ids stay
+        -1 in the map until a surviving record arrives. Decoder ids are
+        assigned in first-appearance order, so registering a segment's
+        unmapped ids in ascending id order IS the numpy path's
+        first-appearance registration order."""
+        if seg_ids.size == 0:
+            return seg_ids.astype(np.int32)
+        top = int(seg_ids.max()) + 1
+        known = len(self._decode_keys)
+        if top > known:
+            self._decode_keys.extend(self._native_dec.keys_from(known))
+            if len(self._decode_keys) > len(self._decode2row):
+                grown = np.full(
+                    max(len(self._decode_keys), 2 * len(self._decode2row)), -1, np.int32
+                )
+                grown[: len(self._decode2row)] = self._decode2row
+                self._decode2row = grown
+        rows = self._decode2row[seg_ids]
+        if (rows == -1).any():
+            for i in np.unique(seg_ids[rows == -1]).tolist():
+                self._decode2row[i] = self._row_for(*self._decode_keys[i])
+            rows = self._decode2row[seg_ids]
+        return rows
 
     def _ingest_arrays(self, rows: np.ndarray, labels: np.ndarray, elaps: np.ndarray) -> None:
         """Scatter pre-decoded arrays in micro_batch_size chunks (one fixed
@@ -827,6 +977,14 @@ class PipelineDriver:
         self.registry = ServiceRegistry(self.cfg.capacity)
         for server, service in keys:
             self.registry.lookup_or_add(server, service)
+        # the registry was rebuilt: decoder-id -> row mappings are stale, and
+        # re-resolving old interned keys eagerly would register absent
+        # services early. Start a fresh decoder lazily instead.
+        if self._native_dec is not None:
+            self._native_dec.close()
+        self._native_dec = None
+        self._native_dec_tried = False
+        self._reset_decode_map()
 
         def pad_rows(a: np.ndarray) -> np.ndarray:
             if a.shape and a.shape[0] < self.cfg.capacity:

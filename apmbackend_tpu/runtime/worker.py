@@ -264,14 +264,14 @@ class WorkerApp:
         contract. Overflowed lines (ring-full escape hatch) are older than
         anything pushed after them, so they drain once the ring is empty and
         block newer pushes until gone (FIFO preserved)."""
-        lines: list = []
+        recs: list = []  # raw byte records straight off the ring
         max_batch = 4096
         while not self._ring_stop.is_set():
             rec = self._ring.pop()
             if rec is None:
-                if lines:
-                    self._feed_lines(lines)
-                    lines = []
+                if recs:
+                    self._feed_recs(recs)
+                    recs = []
                 elif self._overflow:
                     batch = self._drain_overflow_locked_pop(max_batch)
                     if batch:
@@ -279,22 +279,30 @@ class WorkerApp:
                 else:
                     time.sleep(0.002)
                 continue
-            lines.append(rec.decode("utf-8", "replace"))
-            if len(lines) >= max_batch:
-                self._feed_lines(lines)
-                lines = []
+            recs.append(rec)
+            if len(recs) >= max_batch:
+                self._feed_recs(recs)
+                recs = []
         while (rec := self._ring.pop()) is not None:  # final drain on stop
-            lines.append(rec.decode("utf-8", "replace"))
-        if lines:
-            self._feed_lines(lines)
+            recs.append(rec)
+        if recs:
+            self._feed_recs(recs)
         tail = self._drain_overflow_locked_pop(self._overflow_max)
         if tail:
             self._feed_lines(tail)
 
+    def _feed_recs(self, recs: list) -> None:
+        """Byte records -> one blob -> the native bulk decode path (falls back
+        to the numpy path inside feed_csv_bytes when no toolchain)."""
+        self._feed_guarded(lambda: self.driver.feed_csv_bytes(b"\n".join(recs)), len(recs))
+
     def _feed_lines(self, lines: list) -> None:
+        self._feed_guarded(lambda: self.driver.feed_csv_batch(lines), len(lines))
+
+    def _feed_guarded(self, fn, n: int) -> None:
         try:
             with self._driver_lock:
-                self.driver.feed_csv_batch(lines)
+                fn()
         except Exception:
             # the device loop must survive a bad batch: a dead loop would
             # wedge the broker thread against a full ring forever. The batch
@@ -303,11 +311,11 @@ class WorkerApp:
             import traceback
 
             self.runtime.logger.error(
-                f"Device loop: feed_csv_batch failed; {len(lines)} lines dropped:\n"
+                f"Device loop: bulk feed failed; {n} lines dropped:\n"
                 + traceback.format_exc()
             )
         finally:
-            self._ring_fed += len(lines)
+            self._ring_fed += n
 
     @property
     def intake_pending(self) -> bool:

@@ -1,0 +1,194 @@
+// apmdec — batch decoder for the tx pipe-CSV wire format.
+//
+// Role: the host intake hot path. The reference parses every record with
+// per-message JS string ops (stream_parse_transactions.js emits, and
+// stream_calc_stats.js:331-371 re-parses, one pipe-CSV line per message);
+// the TPU rebuild feeds the device in micro-batches, so the decode cost is
+// batched too: one C++ pass over a newline-separated blob produces dense
+// arrays (end_ts, elapsed, key id, line span) ready for the label/segment
+// math in pipeline.feed_csv_batch.
+//
+// Key interning: (server, service) pairs are mapped to dense int32 ids in
+// FIRST-APPEARANCE order, monotonically across the decoder's lifetime. The
+// Python side maps decoder ids -> registry rows (apmbackend_tpu/ops/
+// registry.py owns growth + resume); new ids within a tick segment form a
+// contiguous range, preserving the per-segment registration-order contract
+// of the pure-Python path.
+//
+// Numeric semantics are the wire contract shared with entries.js_parse_int
+// (entries.js TxEntry parseInt fields): optional ASCII whitespace, optional
+// sign, then a decimal-digit prefix; no digits => NaN. This equals the
+// Python fast path's "plain decimal -> float -> trunc" on every plain
+// input, and js_parse_int on the rest. Fields containing non-ASCII bytes
+// are flagged (bit 0) so the caller can re-parse them with the Python
+// reference implementation (re \d matches Unicode digits; the wire never
+// carries them, but parity must not silently diverge).
+//
+// Records are one line each, '\n'-separated; a line is a tx record when it
+// has exactly 9 '|'-separated fields and field 0 == "tx" (entries.js:19
+// layout: tx|server|service|logId|acctNum|startTs|endTs|elapsed|topLevel).
+// Non-tx/malformed lines are counted, empty lines skipped.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct ApmDec {
+    std::unordered_map<std::string, int32_t> ids;
+    // id -> key string; unordered_map nodes are pointer-stable, so raw
+    // pointers into the map's keys stay valid across rehash
+    std::vector<const std::string*> by_id;
+};
+
+constexpr double kNaN = __builtin_nan("");
+
+inline bool is_ws(char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v';
+}
+
+// entries.js_parse_int over a byte span: ws* sign? digit+ prefix, else NaN.
+// Sets *exotic — and returns NaN so the caller re-parses with the Python
+// reference impl — for spans with non-ASCII bytes (re \d matches Unicode
+// digits) or more than 18 digits (Python converts the exact big int to
+// double; per-digit accumulation here would be off by an ulp).
+inline double parse_int_prefix(const char* p, const char* end, bool* exotic) {
+    for (const char* q = p; q < end; ++q) {
+        if (static_cast<unsigned char>(*q) >= 0x80) {
+            *exotic = true;
+            return kNaN;
+        }
+    }
+    while (p < end && is_ws(*p)) ++p;
+    double sign = 1.0;
+    if (p < end && (*p == '+' || *p == '-')) {
+        if (*p == '-') sign = -1.0;
+        ++p;
+    }
+    if (p >= end || *p < '0' || *p > '9') return kNaN;
+    int64_t v = 0;
+    int digits = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+        if (digits >= 18) {
+            *exotic = true;
+            return kNaN;
+        }
+        v = v * 10 + (*p - '0');
+        ++digits;
+        ++p;
+    }
+    return sign * static_cast<double>(v);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* apmdec_create() { return new (std::nothrow) ApmDec(); }
+
+void apmdec_destroy(void* h) { delete static_cast<ApmDec*>(h); }
+
+int32_t apmdec_key_count(void* h) {
+    return static_cast<int32_t>(static_cast<ApmDec*>(h)->by_id.size());
+}
+
+// Decode up to max_out tx records from buf[0..len). Outputs per record:
+// end_ts/elapsed (double, NaN = unparseable), keyid (int32), line_off/
+// line_len (byte span of the record's line within buf), flags (bit 0 =
+// exotic numerics, re-parse in Python). Returns records written; *n_bad
+// counts skipped non-tx/malformed lines. A buf with more than max_out tx
+// records returns exactly max_out; the caller re-invokes on the remainder
+// starting at line_off[max_out-1] + line_len[max_out-1] + 1.
+int64_t apmdec_batch(void* h, const char* buf, uint64_t len, double* end_ts,
+                     double* elapsed, int32_t* keyid, int64_t* line_off,
+                     int32_t* line_len, uint8_t* flags, uint64_t max_out,
+                     uint64_t* n_bad) {
+    ApmDec* dec = static_cast<ApmDec*>(h);
+    uint64_t bad = 0;
+    uint64_t out = 0;
+    const char* base = buf;
+    const char* end = buf + len;
+    const char* line = buf;
+    std::string key;
+    while (line < end && out < max_out) {
+        const char* nl = static_cast<const char*>(memchr(line, '\n', end - line));
+        const char* le = nl ? nl : end;
+        const char* next = nl ? nl + 1 : end;
+        if (le == line) {  // empty line: skip silently (blob-join artifact)
+            line = next;
+            continue;
+        }
+        // split into 9 fields on '|'
+        const char* f[10];
+        int nf = 0;
+        f[nf++] = line;
+        for (const char* p = line; p < le && nf <= 9;) {
+            const char* bar = static_cast<const char*>(memchr(p, '|', le - p));
+            if (!bar) break;
+            f[nf++] = bar + 1;
+            p = bar + 1;
+        }
+        bool is_tx = nf == 9 && (f[1] - f[0]) == 3 && f[0][0] == 't' && f[0][1] == 'x';
+        if (!is_tx) {
+            ++bad;
+            line = next;
+            continue;
+        }
+        // field spans: f[i] .. f[i+1]-1 ('|' excluded); last field ends at le
+        const char* srv_b = f[1];
+        const char* srv_e = f[2] - 1;
+        const char* svc_b = f[2];
+        const char* svc_e = f[3] - 1;
+        const char* ets_b = f[6];
+        const char* ets_e = f[7] - 1;
+        const char* ela_b = f[7];
+        const char* ela_e = f[8] - 1;
+
+        bool exotic = false;
+        end_ts[out] = parse_int_prefix(ets_b, ets_e, &exotic);
+        elapsed[out] = parse_int_prefix(ela_b, ela_e, &exotic);
+        flags[out] = exotic ? 1 : 0;
+
+        key.assign(srv_b, srv_e - srv_b);
+        key.push_back('\0');
+        key.append(svc_b, svc_e - svc_b);
+        auto it = dec->ids.find(key);
+        int32_t id;
+        if (it == dec->ids.end()) {
+            id = static_cast<int32_t>(dec->by_id.size());
+            auto ins = dec->ids.emplace(key, id);
+            dec->by_id.push_back(&ins.first->first);
+        } else {
+            id = it->second;
+        }
+        keyid[out] = id;
+        line_off[out] = line - base;
+        line_len[out] = static_cast<int32_t>(le - line);
+        ++out;
+        line = next;
+    }
+    *n_bad = bad;
+    return static_cast<int64_t>(out);
+}
+
+// Copy keys [from, key_count) as server'\0'service'\n' records into out.
+// Returns bytes written, or -needed when cap is too small.
+int64_t apmdec_keys(void* h, int32_t from, char* out, uint64_t cap) {
+    ApmDec* dec = static_cast<ApmDec*>(h);
+    uint64_t need = 0;
+    for (size_t i = from; i < dec->by_id.size(); ++i) need += dec->by_id[i]->size() + 1;
+    if (need > cap) return -static_cast<int64_t>(need);
+    char* p = out;
+    for (size_t i = from; i < dec->by_id.size(); ++i) {
+        const std::string& k = *dec->by_id[i];
+        memcpy(p, k.data(), k.size());
+        p += k.size();
+        *p++ = '\n';
+    }
+    return static_cast<int64_t>(p - out);
+}
+
+}  // extern "C"

@@ -290,3 +290,212 @@ class TestLineRing:
         assert len(out) == N
         assert out == [f"{i}".encode() for i in range(N)]
         ring.close()
+
+
+class TestTxDecoder:
+    """native/decoder.cpp: numeric parity with entries.js_parse_int, key
+    interning, and end-to-end emission parity with the numpy path."""
+
+    @pytest.fixture
+    def dec(self):
+        from apmbackend_tpu.native import TxDecoder
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        d = TxDecoder()
+        yield d
+        d.close()
+
+    def _line(self, ets, ela, server="jvm1", service="svcA", i=0):
+        return f"tx|{server}|{service}|l{i}|1|{ets}|{ets}|{ela}|Y"
+
+    def test_numeric_parity_with_js_parse_int(self, dec):
+        import math
+
+        from apmbackend_tpu.entries import js_parse_int
+
+        cases = [
+            "1700000010000", "-123", "+45", " 77", "\t8", "12.9", "-0.5",
+            "1e5", "0x1A", "12.34.56", "abc", "", "  ", "9" * 25, "5xyz",
+            "٥٤",  # unicode digits: flagged exotic, re-parsed in Python
+        ]
+        lines = [self._line(c, c, i=i) for i, c in enumerate(cases)]
+        blob = "\n".join(lines).encode("utf-8")
+        end_ts, elapsed, keyid, offs, lens, flags, n_bad = dec.decode(blob)
+        assert n_bad == 0 and len(end_ts) == len(cases)
+        for i, c in enumerate(cases):
+            expect = js_parse_int(c)
+            got = float(end_ts[i])
+            if flags[i] & 1:
+                # exotic: the decoder defers to Python; pipeline re-parses
+                assert math.isnan(got)
+            elif math.isnan(expect):
+                assert math.isnan(got), f"case {c!r}"
+            else:
+                assert got == expect, f"case {c!r}: {got} != {expect}"
+
+    def test_line_classification(self, dec):
+        blob = b"\n".join([
+            b"tx|s|v|l|1|100000|100010|10|Y",   # good
+            b"",                                 # empty: skipped silently
+            b"st|1|2|3",                         # non-tx
+            b"tx|too|few",                       # short
+            b"tx|s|v|l|1|100000|100010|10|Y|extra",  # 10 fields
+            b"txx|s|v|l|1|100000|100010|10|Y",   # wrong tag
+            b"tx|s|v|l|1|100000|100020|20|N",    # good (no trailing \n)
+        ])
+        end_ts, elapsed, keyid, offs, lens, flags, n_bad = dec.decode(blob)
+        assert len(end_ts) == 2
+        assert n_bad == 4
+        assert [float(x) for x in elapsed] == [10.0, 20.0]
+
+    def test_key_interning_first_appearance_order(self, dec):
+        lines = [
+            self._line(100000, 1, "b", "z"),
+            self._line(100000, 2, "a", "y"),
+            self._line(100000, 3, "b", "z"),  # repeat
+            self._line(100000, 4, "c", "x"),
+        ]
+        _, _, keyid, *_rest = dec.decode("\n".join(lines).encode())
+        assert keyid.tolist() == [0, 1, 0, 2]
+        assert dec.key_count == 3
+        assert dec.keys_from(0) == [("b", "z"), ("a", "y"), ("c", "x")]
+        assert dec.keys_from(2) == [("c", "x")]
+        # interning persists across decode calls
+        _, _, keyid2, *_ = dec.decode(self._line(100000, 5, "a", "y").encode())
+        assert keyid2.tolist() == [1]
+
+    def test_line_spans_recover_lines(self, dec):
+        lines = [self._line(100000 + i, i, i=i) for i in range(5)]
+        blob = "\n".join(lines).encode()
+        _, _, _, offs, lens, _, _ = dec.decode(blob)
+        for i in range(5):
+            assert blob[offs[i] : offs[i] + lens[i]].decode() == lines[i]
+
+
+class TestFeedCsvBytesParity:
+    """feed_csv_bytes (native) must be emission-identical to the numpy
+    feed_csv_batch across ticks, registration order, backlog, and resume."""
+
+    def _mkcfg(self, native, capacity=64):
+        from apmbackend_tpu.config import default_config
+
+        cfg = default_config()
+        cfg["tpuEngine"]["serviceCapacity"] = capacity
+        cfg["tpuEngine"]["samplesPerBucket"] = 8
+        cfg["tpuEngine"]["nativeDecode"] = native
+        cfg["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1}]
+        return cfg
+
+    def _mklines(self, label, n, seed):
+        import numpy as np
+
+        r = np.random.RandomState(seed)
+        rows = r.randint(0, 40, n)
+        elaps = r.randint(50, 900, n)
+        return [
+            f"tx|jvm{x % 4}|svc{x:03d}|l{i}|1|{label * 10000 - e}|{label * 10000 + i % 9999}|{e}|Y"
+            for i, (x, e) in enumerate(zip(rows, elaps))
+        ]
+
+    def test_emissions_identical(self):
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        base = 170_000_000
+        outs = {}
+        for native in (False, True):
+            got = []
+            drv = PipelineDriver(
+                self._mkcfg(native), micro_batch_size=512,
+                on_fullstat_csv=lambda ls: got.extend(ls),
+                on_ordered_csv=lambda line: got.append(line),
+            )
+            for t in range(5):
+                lines = self._mklines(base + t, 700, seed=t) + ["junk", "tx|bad"]
+                if native:
+                    drv.feed_csv_bytes("\n".join(lines).encode())
+                else:
+                    drv.feed_csv_batch(lines)
+            outs[native] = got
+            if native:
+                assert drv._native_dec is not None  # actually took the native path
+        assert outs[False] == outs[True]
+
+    def test_mixed_feed_and_bytes_with_resume(self, tmp_path):
+        """feed() object path interleaved with blob batches; resume resets the
+        decoder and the restored driver keeps emitting correctly."""
+        import numpy as np
+
+        from apmbackend_tpu.entries import TxEntry
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        cfg = self._mkcfg(True)
+        drv = PipelineDriver(cfg, micro_batch_size=256)
+        base = 170_000_000
+        drv.feed_csv_bytes("\n".join(self._mklines(base, 300, 1)).encode())
+        ts = (base + 1) * 10000.0
+        drv.feed(TxEntry("jvmX", "svcNew", "L1", "A", ts - 100, ts, 100.0, "Y"))
+        drv.feed_csv_bytes("\n".join(self._mklines(base + 2, 300, 2)).encode())
+        rows_before = list(drv.registry.rows())
+        path = str(tmp_path / "resume.npz")
+        drv.save_resume(path)
+
+        drv2 = PipelineDriver(cfg, micro_batch_size=256)
+        assert drv2.load_resume(path)
+        assert drv2._native_dec is None  # decoder reset with the registry
+        drv2.feed_csv_bytes("\n".join(self._mklines(base + 3, 300, 3)).encode())
+        assert drv2._native_dec is not None
+        # pre-kill keys keep their exact rows (row order is the prefix), and
+        # post-restore feeding only appends
+        assert list(drv2.registry.rows())[: len(rows_before)] == rows_before
+        assert len(drv2.registry.rows()) >= len(rows_before)
+
+    def test_phantom_keys_do_not_register(self):
+        """A tx-shaped line whose numerics are unparseable is interned by the
+        decoder but NaN-dropped by the intake filter — it must NOT register a
+        registry row (the numpy path never would). The key registers later
+        if a valid record arrives."""
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        base = 170_000_000
+        phantom = "tx|phantomSrv|phantomSvc|l0|1|abc|abc|abc|Y"
+        good = f"tx|goodSrv|goodSvc|l1|1|{base * 10000 - 5}|{base * 10000}|55|Y"
+        outs = {}
+        for native in (False, True):
+            drv = PipelineDriver(self._mkcfg(native), micro_batch_size=64)
+            if native:
+                drv.feed_csv_bytes(f"{phantom}\n{good}".encode())
+                assert drv._native_dec is not None
+            else:
+                drv.feed_csv_batch([phantom, good])
+            outs[native] = list(drv.registry.rows())
+            if native:
+                # the phantom key registers once a VALID record shows up
+                ok_line = f"tx|phantomSrv|phantomSvc|l2|1|{base * 10000 - 3}|{base * 10000 + 1}|33|Y"
+                drv.feed_csv_bytes(ok_line.encode())
+                assert ("phantomSrv", "phantomSvc") in drv.registry.rows()
+        assert outs[False] == outs[True] == [("goodSrv", "goodSvc")]
+
+    def test_growth_through_native_path(self):
+        """Capacity growth (recompile) triggered by decoder-fed keys."""
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        cfg = self._mkcfg(True, capacity=8)
+        drv = PipelineDriver(cfg, micro_batch_size=64)
+        base = 170_000_000
+        lines = [
+            f"tx|j|svc{i}|l{i}|1|{base * 10000 - 5}|{base * 10000 + i}|{50 + i}|Y"
+            for i in range(20)  # 20 services > capacity 8 -> two growths
+        ]
+        n = drv.feed_csv_bytes("\n".join(lines).encode())
+        assert n == 20
+        assert drv.cfg.capacity >= 20
+        assert len(drv.registry.rows()) == 20

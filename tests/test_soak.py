@@ -102,6 +102,15 @@ def attach_taps(pipe, fed_lines, fullstat_lines):
         return orig_feed(lines)
 
     drv.feed_csv_batch = tee_feed
+    # the worker's device loop feeds byte blobs through feed_csv_bytes when
+    # the native decoder is available — tap that entry point too
+    orig_bytes = drv.feed_csv_bytes
+
+    def tee_bytes(blob):
+        fed_lines.extend(blob.decode("utf-8", "replace").split("\n"))
+        return orig_bytes(blob)
+
+    drv.feed_csv_bytes = tee_bytes
     orig_fs = drv.on_fullstat_csv
 
     def tee_fs(lines):
