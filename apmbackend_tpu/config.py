@@ -292,6 +292,12 @@ _DEFAULT_CONFIG: dict = {
         "verboseQueueWrite": False,
         "resumeFileFullPath": "save/stream_calc_z_score.resume",
         "resumeFileSaveFrequencyInSeconds": 60,
+        # Per-lag baselining windows (apm_config.json:136-145 shape). Each
+        # entry may also set "ROBUST": true to baseline with median/MAD
+        # (1.4826 consistency scaling) instead of mean/std — immune to the
+        # classic z-score's self-contamination, where an outlier burst
+        # inflates the window std and masks later anomalies until it ages
+        # out (no reference equivalent; per-lag static, recompiles on change).
         "defaults": [
             {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1},
             {"LAG": 8640, "THRESHOLD": 15.0, "INFLUENCE": 0.0},

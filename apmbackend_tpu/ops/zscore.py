@@ -41,6 +41,13 @@ class ZScoreConfig(NamedTuple):
     capacity: int  # S
     lag: int  # L (window length in intervals)
     dtype: jnp.dtype = jnp.float32
+    # robust mode (no reference equivalent): baseline = window median, spread
+    # = 1.4826 * MAD instead of mean/std. The classic z-score's weakness is
+    # self-contamination — past outliers inflate the window std and mask
+    # later anomalies until they age out of the lag window; median/MAD has a
+    # 50% breakdown point, so bounds stay tight through outlier bursts. Costs
+    # two sorts over [S, 3, L] per step instead of one reduction.
+    robust: bool = False
 
 
 class ZScoreState(NamedTuple):
@@ -80,6 +87,23 @@ def fused_window_partials(vals: jnp.ndarray, valid: jnp.ndarray):
     )
 
 
+def _median_from_sorted(s: jnp.ndarray, cnt: jnp.ndarray) -> jnp.ndarray:
+    """NaN-aware median over the last axis of an ascending-sorted array (NaN
+    tail) with ``cnt`` valid entries per row; NaN where cnt == 0."""
+    K = s.shape[-1]
+    i1 = jnp.clip((cnt - 1) // 2, 0, K - 1)
+    i2 = jnp.clip(cnt // 2, 0, K - 1)
+    v1 = jnp.take_along_axis(s, i1[..., None], axis=-1)[..., 0]
+    v2 = jnp.take_along_axis(s, i2[..., None], axis=-1)[..., 0]
+    return jnp.where(cnt > 0, (v1 + v2) / 2, jnp.nan)
+
+
+# MAD -> sigma consistency constant for normal data (1 / Phi^-1(3/4)): with
+# it the robust bounds coincide with the classic ones on clean gaussian
+# windows, so a per-lag THRESHOLD keeps one meaning across both modes
+MAD_SIGMA = 1.4826
+
+
 class ZScoreResult(NamedTuple):
     # each [S, 3] on the metric axis (average, per75, per95)
     window_avg: jnp.ndarray  # NaN = undefined
@@ -110,23 +134,35 @@ def step(
     full = fill >= L  # [S] — signal eligibility (raw length incl. NaN pushes)
 
     valid = ~jnp.isnan(vals)  # [S, 3, L]
-    cnt, total, vmin, vmax = fused_window_partials(vals, valid)
-    has_avg = (cnt > 0) & full[:, None]
-    mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
+    if cfg.robust:
+        # median/MAD baseline: same gating quirks as the classic mode (warm-up
+        # on raw fill, zero spread -> no signal, NaN new value -> no signal)
+        cnt = jnp.sum(valid.astype(jnp.int32), axis=-1)  # [S, 3]
+        has_avg = (cnt > 0) & full[:, None]
+        mean = jnp.where(has_avg, _median_from_sorted(jnp.sort(vals, axis=-1), cnt), jnp.nan)
+        dev = jnp.where(valid, jnp.abs(vals - mean[..., None]), jnp.nan)
+        mad = _median_from_sorted(jnp.sort(dev, axis=-1), cnt)
+        has_std = has_avg & (mad > 0)  # MAD==0 == the zero-variance quirk
+        std = jnp.where(has_std, MAD_SIGMA * mad, jnp.nan)
+    else:
+        cnt, total, vmin, vmax = fused_window_partials(vals, valid)
+        has_avg = (cnt > 0) & full[:, None]
+        mean = jnp.where(has_avg, total / jnp.maximum(cnt, 1), jnp.nan)
 
-    # Degenerate (all-equal) windows are resolved EXACTLY, not by float luck:
-    # whether sum(x*k)/k reproduces x depends on the value and the summation
-    # order (the reference's linear JS reduce and XLA's tree reduction can
-    # disagree), which would turn "zero variance -> no signal"
-    # (util_methods.js:44-48, the documented intent) into a coin flip with
-    # std ~ 1e-13 signalling on any deviation. max==min is order-independent.
-    all_equal = has_avg & (vmax == vmin)
-    mean = jnp.where(all_equal, vmax, mean)
+        # Degenerate (all-equal) windows are resolved EXACTLY, not by float
+        # luck: whether sum(x*k)/k reproduces x depends on the value and the
+        # summation order (the reference's linear JS reduce and XLA's tree
+        # reduction can disagree), which would turn "zero variance -> no
+        # signal" (util_methods.js:44-48, the documented intent) into a coin
+        # flip with std ~ 1e-13 signalling on any deviation. max==min is
+        # order-independent.
+        all_equal = has_avg & (vmax == vmin)
+        mean = jnp.where(all_equal, vmax, mean)
 
-    diff = jnp.where(valid, vals - mean[..., None], 0)
-    var = jnp.where(has_avg, jnp.sum(diff * diff, axis=-1) / jnp.maximum(cnt, 1), jnp.nan)
-    has_std = has_avg & ~all_equal & (var > 0)  # var==0 -> std undefined (the quirk)
-    std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
+        diff = jnp.where(valid, vals - mean[..., None], 0)
+        var = jnp.where(has_avg, jnp.sum(diff * diff, axis=-1) / jnp.maximum(cnt, 1), jnp.nan)
+        has_std = has_avg & ~all_equal & (var > 0)  # var==0 -> std undefined (the quirk)
+        std = jnp.where(has_std, jnp.sqrt(var), jnp.nan)
 
     thr = threshold[:, None]
     lb = jnp.where(has_std, mean - thr * std, jnp.nan)

@@ -172,6 +172,14 @@ def make_window_sharded_step(mesh: Mesh, cfg: ZScoreConfig):
     """
     n_s = mesh.shape[SERVICE_AXIS]
     n_w = mesh.shape[WINDOW_AXIS]
+    if cfg.robust:
+        # median/MAD needs a distributed selection over the window axis (two
+        # collective sorts), which this all-reduce layout does not implement;
+        # robust lags at extreme-window scale should shard services only
+        raise NotImplementedError(
+            "robust (median/MAD) z-score is not supported with window-axis "
+            "sharding; use service-axis sharding for robust lags"
+        )
     if cfg.capacity % n_s != 0:
         raise ValueError(f"capacity {cfg.capacity} not divisible by service shards {n_s}")
     local_cfg = cfg._replace(capacity=cfg.capacity // n_s)

@@ -47,6 +47,9 @@ _PLAIN_NUMBER = re.compile(r"^[+-]?\d+(?:\.\d+)?$")
 class LagSpec(NamedTuple):
     lag: int
     suppressed: bool  # lag in suppressedLags
+    # median/MAD baseline instead of mean/std (ops/zscore.py ZScoreConfig
+    # .robust); per-lag static — it changes the compiled program
+    robust: bool = False
 
 
 class EngineConfig(NamedTuple):
@@ -108,7 +111,9 @@ def engine_init(cfg: EngineConfig) -> EngineState:
     return EngineState(
         stats=dstats.init_state(cfg.stats),
         zscores=tuple(
-            dzscore.init_state(dzscore.ZScoreConfig(S, spec.lag, cfg.stats.dtype))
+            dzscore.init_state(
+                dzscore.ZScoreConfig(S, spec.lag, cfg.stats.dtype, spec.robust)
+            )
             for spec in cfg.lags
         ),
         alert_counters=tuple(jnp.zeros((S,), jnp.int32) for _ in cfg.lags),
@@ -137,7 +142,7 @@ def engine_tick(
     new_zstates = []
     new_counters = []
     for i, spec in enumerate(cfg.lags):
-        zcfg = dzscore.ZScoreConfig(cfg.capacity, spec.lag, cfg.stats.dtype)
+        zcfg = dzscore.ZScoreConfig(cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust)
         zres, zstate = dzscore.step(
             state.zscores[i], zcfg, new_values,
             params.thresholds[i], params.influences[i], params.active,
@@ -221,7 +226,11 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     )
     suppressed_lags = {int(x) for x in acfg.get("suppressedLags", [])}
     lags = tuple(
-        LagSpec(int(d["LAG"]), int(d["LAG"]) in suppressed_lags)
+        LagSpec(
+            int(d["LAG"]),
+            int(d["LAG"]) in suppressed_lags,
+            bool(d.get("ROBUST", False)),
+        )
         for d in zcfg.get("defaults", [])
     )
     def rule_for(suppressed: bool) -> dalerts.AlertRuleConfig:
@@ -404,7 +413,9 @@ class PipelineDriver:
         stats_state, stats_cfg = dstats.grow_state(self.state.stats, self.cfg.stats, new_capacity)
         zstates = []
         for i, spec in enumerate(self.cfg.lags):
-            zc = dzscore.ZScoreConfig(self.cfg.capacity, spec.lag, self.cfg.stats.dtype)
+            zc = dzscore.ZScoreConfig(
+                self.cfg.capacity, spec.lag, self.cfg.stats.dtype, spec.robust
+            )
             zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
             zstates.append(zs)
         pad_n = new_capacity - self.cfg.capacity
@@ -556,33 +567,50 @@ class PipelineDriver:
                 rowmap[j] = self._row_for(*uk[j].split("\x00", 1))
             return rowmap[inv]
 
+        track_ordered = self.on_ordered_csv is not None
+        ets_list = end_ts.tolist() if track_ordered else None
+
+        def backlog(lo: int, hi: int) -> None:
+            self._tx_backlog.extend(zip(ets_list[lo:hi], good_lines[lo:hi]))
+
+        self._walk_tick_segments(
+            labels,
+            lambda lo, hi: self._ingest_arrays(
+                resolve_rows(lo, hi), labels[lo:hi], elaps[lo:hi]
+            ),
+            backlog if track_ordered else None,
+        )
+        return len(labels)
+
+    def _walk_tick_segments(self, labels: np.ndarray, ingest_segment, backlog_segment) -> None:
+        """Shared tick-ordering walk for the bulk intake paths.
+
+        Ticks fire exactly where feed() would fire them: before each entry
+        whose label exceeds every label seen so far — INCLUDING the pre-batch
+        latest. Without the floor, a batch that is internally increasing but
+        wholly below the resumed latest (stale backfill after a restart)
+        would tick backward and regress the label mirror (caught by the soak
+        test's mid-run kill/restore). Entries between two ticks form one
+        segment: ``backlog_segment(lo, hi)`` (if given) then
+        ``ingest_segment(lo, hi)`` run before the tick that follows them."""
         self._flush_pending()  # interleaved feed() entries must not reorder
-        # tick exactly where feed() would: before each entry whose label
-        # exceeds every label seen so far — INCLUDING the pre-batch latest.
-        # Without the floor, a batch that is internally increasing but wholly
-        # below the resumed latest (stale backfill after a restart) would
-        # tick backward and regress the label mirror (caught by the soak
-        # test's mid-run kill/restore).
         running_max = np.maximum(np.maximum.accumulate(labels), self._latest_label)
         prior = np.concatenate([[self._latest_label], running_max[:-1]])
         tick_points = np.nonzero(running_max > prior)[0]
-        track_ordered = self.on_ordered_csv is not None
-        ets_list = end_ts.tolist() if track_ordered else None
         idx = 0
         for i in tick_points:
             i = int(i)
             if i > idx:
-                if track_ordered:
-                    self._tx_backlog.extend(zip(ets_list[idx:i], good_lines[idx:i]))
-                self._ingest_arrays(resolve_rows(idx, i), labels[idx:i], elaps[idx:i])
+                if backlog_segment is not None:
+                    backlog_segment(idx, i)
+                ingest_segment(idx, i)
                 idx = i
             label = int(labels[i])
             self._run_tick(label)
             self._latest_label = label
-        if track_ordered:
-            self._tx_backlog.extend(zip(ets_list[idx:], good_lines[idx:]))
-        self._ingest_arrays(resolve_rows(idx, len(labels)), labels[idx:], elaps[idx:])
-        return len(labels)
+        if backlog_segment is not None:
+            backlog_segment(idx, len(labels))
+        ingest_segment(idx, len(labels))
 
     def _reset_decode_map(self) -> None:
         # decoder-id -> registry row; -1 = interned but never registered (the
@@ -645,14 +673,9 @@ class PipelineDriver:
                 return 0
         labels = (end_ts.astype(np.int64) // 10000).astype(np.int32)
 
-        self._flush_pending()  # interleaved feed() entries must not reorder
-        # tick exactly where feed() would (see feed_csv_batch)
-        running_max = np.maximum(np.maximum.accumulate(labels), self._latest_label)
-        prior = np.concatenate([[self._latest_label], running_max[:-1]])
-        tick_points = np.nonzero(running_max > prior)[0]
         track_ordered = self.on_ordered_csv is not None
-        ets_list = end_ts.tolist() if track_ordered else None
         if track_ordered:
+            ets_list = end_ts.tolist()
             # ASCII blob (the wire norm): byte offsets == str offsets, so one
             # whole-blob decode + str slicing replaces per-line bytes.decode
             text = blob.decode("ascii") if blob.isascii() else None
@@ -671,23 +694,12 @@ class PipelineDriver:
                     for j in range(lo, hi)
                 )
 
-        idx = 0
-        for i in tick_points:
-            i = int(i)
-            if i > idx:
-                if track_ordered:
-                    backlog(idx, i)
-                self._ingest_arrays(
-                    self._resolve_decoded_rows(keyids[idx:i]), labels[idx:i], elaps[idx:i]
-                )
-                idx = i
-            label = int(labels[i])
-            self._run_tick(label)
-            self._latest_label = label
-        if track_ordered:
-            backlog(idx, len(labels))
-        self._ingest_arrays(
-            self._resolve_decoded_rows(keyids[idx:]), labels[idx:], elaps[idx:]
+        self._walk_tick_segments(
+            labels,
+            lambda lo, hi: self._ingest_arrays(
+                self._resolve_decoded_rows(keyids[lo:hi]), labels[lo:hi], elaps[lo:hi]
+            ),
+            backlog if track_ordered else None,
         )
         return len(labels)
 
@@ -715,8 +727,15 @@ class PipelineDriver:
                 grown[: len(self._decode2row)] = self._decode2row
                 self._decode2row = grown
         rows = self._decode2row[seg_ids]
-        if (rows == -1).any():
-            for i in np.unique(seg_ids[rows == -1]).tolist():
+        unmapped = rows == -1
+        if unmapped.any():
+            # register in FIRST-APPEARANCE order within the segment (not
+            # ascending id): a phantom-interned key re-appearing valid after
+            # a newer key must register after it, exactly as the numpy path
+            # (which never saw the phantom) would
+            uk, first_idx = np.unique(seg_ids[unmapped], return_index=True)
+            for j in np.argsort(first_idx, kind="stable"):
+                i = int(uk[j])
                 self._decode2row[i] = self._row_for(*self._decode_keys[i])
             rows = self._decode2row[seg_ids]
         return rows

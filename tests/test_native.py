@@ -482,6 +482,31 @@ class TestFeedCsvBytesParity:
                 assert ("phantomSrv", "phantomSvc") in drv.registry.rows()
         assert outs[False] == outs[True] == [("goodSrv", "goodSvc")]
 
+    def test_phantom_then_valid_interleaved_registration_order(self):
+        """A phantom-interned key that later turns valid must register AFTER
+        keys whose valid records appeared before it — first-appearance order
+        of SURVIVING records, matching the numpy path exactly."""
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        base = 170_000_000
+        lines = [
+            "tx|A|A|l0|1|abc|abc|abc|Y",  # key A: interned, NaN-dropped
+            f"tx|B|B|l1|1|{base * 10000 - 5}|{base * 10000}|55|Y",  # key B valid
+            f"tx|A|A|l2|1|{base * 10000 - 3}|{base * 10000 + 1}|33|Y",  # A valid now
+        ]
+        outs = {}
+        for native in (False, True):
+            drv = PipelineDriver(self._mkcfg(native), micro_batch_size=64)
+            if native:
+                drv.feed_csv_bytes("\n".join(lines).encode())
+                assert drv._native_dec is not None
+            else:
+                drv.feed_csv_batch(lines)
+            outs[native] = list(drv.registry.rows())
+        assert outs[True] == outs[False] == [("B", "B"), ("A", "A")]
+
     def test_growth_through_native_path(self):
         """Capacity growth (recompile) triggered by decoder-fed keys."""
         from apmbackend_tpu.pipeline import PipelineDriver

@@ -95,20 +95,33 @@ def feed_interleaved(pipe, per_file, segment):
 
 def attach_taps(pipe, fed_lines, fullstat_lines):
     drv = pipe.worker.driver
+    # feed_csv_batch and feed_csv_bytes delegate to EACH OTHER through the
+    # (tapped) instance attributes — batch->bytes with a native decoder,
+    # bytes->batch without one — so a depth guard keeps each line counted
+    # exactly once, at the outermost entry point only.
+    depth = {"n": 0}
     orig_feed = drv.feed_csv_batch
 
     def tee_feed(lines):
-        fed_lines.extend(lines)
-        return orig_feed(lines)
+        if depth["n"] == 0:
+            fed_lines.extend(lines)
+        depth["n"] += 1
+        try:
+            return orig_feed(lines)
+        finally:
+            depth["n"] -= 1
 
     drv.feed_csv_batch = tee_feed
-    # the worker's device loop feeds byte blobs through feed_csv_bytes when
-    # the native decoder is available — tap that entry point too
     orig_bytes = drv.feed_csv_bytes
 
     def tee_bytes(blob):
-        fed_lines.extend(blob.decode("utf-8", "replace").split("\n"))
-        return orig_bytes(blob)
+        if depth["n"] == 0:
+            fed_lines.extend(blob.decode("utf-8", "replace").split("\n"))
+        depth["n"] += 1
+        try:
+            return orig_bytes(blob)
+        finally:
+            depth["n"] -= 1
 
     drv.feed_csv_bytes = tee_bytes
     orig_fs = drv.on_fullstat_csv

@@ -194,3 +194,149 @@ def test_grow_state():
     grown, gcfg = dz.grow_state(state, cfg, 8)
     assert grown.values.shape == (8, 3, 4)
     assert int(grown.fill[0]) == 1 and int(grown.fill[5]) == 0
+
+
+# ---------------------------------------------------------------- robust ----
+
+class RobustOracle:
+    """Scalar float64 median/MAD oracle mirroring the classic oracle's gating
+    quirks (warm-up on raw fill, NaN skip, zero spread -> no signal,
+    influence damping toward the last pushed value)."""
+
+    def __init__(self, lag, threshold, influence):
+        self.lag = lag
+        self.threshold = threshold
+        self.influence = influence
+        self.values = []  # raw pushed (may contain NaN)
+
+    @staticmethod
+    def _median(xs):
+        xs = sorted(xs)
+        n = len(xs)
+        if n == 0:
+            return float("nan")
+        return (xs[(n - 1) // 2] + xs[n // 2]) / 2
+
+    def step(self, x):
+        full = len(self.values) >= self.lag
+        window = self.values[-self.lag:] if full else []
+        vals = [v for v in window if not math.isnan(v)]
+        has_avg = full and len(vals) > 0
+        med = self._median(vals) if has_avg else float("nan")
+        mad = self._median([abs(v - med) for v in vals]) if has_avg else float("nan")
+        has_std = has_avg and mad > 0
+        spread = dz.MAD_SIGMA * mad if has_std else float("nan")
+        lb = med - self.threshold * spread if has_std else float("nan")
+        ub = med + self.threshold * spread if has_std else float("nan")
+        signal = 0
+        if has_std and not math.isnan(x) and abs(x - med) > self.threshold * spread:
+            signal = 1 if x > med else -1
+        pushed = x
+        if signal and self.values and not math.isnan(self.values[-1]):
+            pushed = self.influence * x + (1 - self.influence) * self.values[-1]
+        self.values.append(pushed)
+        if len(self.values) > self.lag:
+            self.values = self.values[-self.lag:]
+        return {"avg": med if has_avg else float("nan"), "lb": lb, "ub": ub, "signal": signal}
+
+
+def drive_robust(series, lag, threshold, influence, capacity=2):
+    cfg = dz.ZScoreConfig(capacity=capacity, lag=lag, dtype=jnp.float64, robust=True)
+    state = dz.init_state(cfg)
+    thr = jnp.full(capacity, threshold, jnp.float64)
+    infl = jnp.full(capacity, influence, jnp.float64)
+    step = jax.jit(dz.step, static_argnums=1)
+    out = []
+    for x in series:
+        nv = np.full((capacity, 3), np.nan)
+        nv[0] = (x, x + 1, x + 2)
+        res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+        out.append(res)
+    return out
+
+
+@pytest.mark.parametrize("influence", [1.0, 0.2])
+def test_robust_matches_oracle(influence):
+    rng = np.random.RandomState(31)
+    series = list(200 + 30 * rng.rand(90))
+    series[40] = 5000.0
+    series[41] = 4800.0
+    series[60] = float("nan")
+    oracle = RobustOracle(12, 3.0, influence)
+    results = drive_robust(series, 12, 3.0, influence)
+    for t, x in enumerate(series):
+        g = oracle.step(x)
+        d = results[t]
+        for f, got in (("avg", float(d.window_avg[0, 0])),
+                       ("lb", float(d.lower_bound[0, 0])),
+                       ("ub", float(d.upper_bound[0, 0]))):
+            if math.isnan(g[f]):
+                assert math.isnan(got), (t, f)
+            else:
+                assert g[f] == pytest.approx(got, rel=1e-9, abs=1e-12), (t, f)
+        assert g["signal"] == int(d.signal[0, 0]), f"t={t}"
+
+
+def test_robust_zero_mad_no_signal():
+    # constant window: MAD == 0 -> spread undefined -> no signal (the
+    # zero-variance quirk carried over)
+    series = [100.0] * 20 + [500.0]
+    results = drive_robust(series, 10, 3.0, 1.0)
+    assert int(results[-1].signal[0, 0]) == 0
+    assert math.isnan(float(results[-1].upper_bound[0, 0]))
+
+
+def test_robust_survives_outlier_contamination_classic_masked():
+    """The motivating scenario: an outlier burst lands in the window. The
+    classic z-score's std inflates (self-contamination) and a later genuine
+    regression hides inside the widened bounds; median/MAD shrugs off the
+    burst and flags the same regression."""
+    rng = np.random.RandomState(7)
+    lag, thr = 30, 3.0
+    base = list(200 + 4 * rng.rand(60))
+    burst = [4000.0, 4200.0, 3900.0]  # 3 outliers (10% of the window)
+    calm = list(200 + 4 * rng.rand(20))
+    probe = [260.0]  # genuine step: ~15 sigma of the clean noise, well under
+    series = base + burst + calm + probe  # the burst-inflated classic bounds
+    # classic path (influence=1: burst enters the window undamped)
+    classic = drive_both(
+        [{0: (x, x, x)} for x in series], lag, thr, influence=1.0, capacity=2
+    )
+    classic_last = [c for c in classic if c[0] == len(series) - 1 and c[2] == "avg"][0]
+    assert classic_last[3]["signal"] == 0, "classic must be blinded by its own window"
+    # robust path on the same series
+    robust = drive_robust(series, lag, thr, 1.0)
+    assert int(robust[-1].signal[0, 0]) == 1, "median/MAD must flag the step"
+
+
+def test_robust_flows_from_config():
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import PipelineDriver, build_engine_config
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["serviceCapacity"] = 8
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = 8
+    cfg_tree["streamCalcZScore"]["defaults"] = [
+        {"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1},
+        {"LAG": 8, "THRESHOLD": 3, "INFLUENCE": 0.1, "ROBUST": True},
+    ]
+    ecfg = build_engine_config(cfg_tree, 8)
+    assert [spec.robust for spec in ecfg.lags] == [False, True]
+    # the engine ticks with a mixed classic/robust lag set
+    from apmbackend_tpu.entries import TxEntry
+
+    drv = PipelineDriver(cfg_tree, capacity=8)
+    ts = 170_000_000_0000
+    for t in range(14):
+        drv.feed(TxEntry("s", "svc", f"L{t}", "A", ts - 100, float(ts), 100.0 + t, "Y"))
+        ts += 10_000
+    assert drv._latest_label > 0
+
+
+def test_robust_window_sharding_not_supported():
+    from apmbackend_tpu.parallel import make_mesh2d, make_window_sharded_step
+
+    mesh = make_mesh2d(1, 2)
+    cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, robust=True)
+    with pytest.raises(NotImplementedError, match="robust"):
+        make_window_sharded_step(mesh, cfg)
