@@ -14,6 +14,9 @@ Commands map to the reference's process/tool set:
 - ``qstat``       queue depth/memory (qstat.sh)
 - ``backup``      timestamped source/config backups (backup.sh)
 - ``config``      print the full default config as commented JSON
+- ``smoke``       manual integration harnesses: db insert, Grafana
+                  annotation/render, path resolution (the reference's
+                  dbtest/posttest/imagedltest/maptest scratch scripts)
 """
 
 import importlib
@@ -36,6 +39,7 @@ COMMANDS = {
     "qstat": ("apmbackend_tpu.tools.qstat", True),
     "backup": ("apmbackend_tpu.tools.backup", True),
     "config": ("apmbackend_tpu.config", True),
+    "smoke": ("apmbackend_tpu.tools.smoke", True),
 }
 
 

@@ -1,0 +1,178 @@
+"""Manual smoke harnesses: ``python -m apmbackend_tpu smoke <target>``.
+
+The reference kept a drawer of scratch scripts for poking each external
+integration by hand — ``dbtest.js`` (2-row batch insert), ``posttest.js``
+(Grafana annotation POST), ``imagedltest.js`` (render -> download -> email
+roundtrip), ``maptest.js`` (path-resolution experiments) — see SURVEY.md
+§2.4. This CLI packages those seams as first-class subcommands against the
+real production code paths (sinks.db executors, integrations.grafana/email),
+so "is the DB reachable / is Grafana auth right / does the server pattern
+match my log paths" stays a one-liner in the rebuild:
+
+- ``db``          insert two fixture rows into the configured tx table and
+                  read them back (dbtest.js:22-42 role); honors
+                  ``streamInsertDb.dbBackend`` (fake/sqlite/postgres)
+- ``annotation``  POST a maintenance annotation (posttest.js:43-58 role);
+                  ``--dry-run`` prints URL + body without HTTP
+- ``render``      build the alert graph render URL from a synthetic alert
+                  buffer; optionally fetch the PNG and email it
+                  (imagedltest.js:65-78 role); ``--dry-run`` default
+- ``paths``       resolve serverFromPathPattern against sample paths
+                  (maptest.js:13 role)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..config import default_config, load_config
+
+CONFIG_ENV_VAR = "APM_CONFIG_PATH"
+
+
+def _load(path: str | None) -> dict:
+    path = path or os.environ.get(CONFIG_ENV_VAR)
+    return load_config(path) if path else default_config()
+
+
+def smoke_db(cfg: dict, out) -> int:
+    from ..sinks.db import column_sets_from_config, make_executor
+
+    db_cfg = cfg.get("streamInsertDb", {})
+    column_sets = column_sets_from_config(db_cfg)
+    cs = column_sets["tx"]
+    ex = make_executor(db_cfg)
+    now_ms = int(time.time() * 1000)
+    rows = [
+        {"server": "smoke", "service": "smoke_test", "log_id": f"smoke-{now_ms}",
+         "acct_num": "0", "start_ts": now_ms - 5, "end_ts": now_ms, "elapsed": 5,
+         "top_level": "Y"},
+        {"server": "smoke", "service": "smoke_test", "log_id": f"smoke-{now_ms}-2",
+         "acct_num": "0", "start_ts": now_ms - 7, "end_ts": now_ms, "elapsed": 7,
+         "top_level": "N"},
+    ]
+    t0 = time.perf_counter()
+    ex.insert_many(cs, rows)
+    ms = (time.perf_counter() - t0) * 1000
+    print(f"db smoke: inserted {len(rows)} rows into '{cs.table}' "
+          f"({db_cfg.get('dbBackend', 'fake')} backend) in {ms:.1f} ms", file=out)
+    tables = getattr(ex, "tables", None)
+    if tables is not None:  # fake executor records rows
+        print(f"db smoke: fake executor holds {sum(len(v) for v in tables.values())} rows", file=out)
+    ex.close()
+    return 0
+
+
+def smoke_annotation(cfg: dict, out, *, dry_run: bool, text: str) -> int:
+    from ..integrations.grafana import GrafanaClient
+
+    gcfg = cfg.get("grafana", {})
+    if not gcfg.get("grafanaURL"):
+        print("annotation smoke: no grafana.grafanaURL configured", file=out)
+        return 1
+    client = GrafanaClient(gcfg)
+    tags = ["maintenance", "smoke"]
+    if dry_run:
+        print(f"annotation smoke (dry-run): would POST to "
+              f"{gcfg['grafanaURL']}/api/annotations", file=out)
+        print(json.dumps({"text": text, "tags": tags}), file=out)
+        return 0
+    ok = client.post_annotation(text, tags)
+    print(f"annotation smoke: POST {'ok' if ok else 'FAILED'}", file=out)
+    return 0 if ok else 1
+
+
+def smoke_render(cfg: dict, out, *, dry_run: bool, email_to: str | None) -> int:
+    from ..integrations.grafana import GrafanaClient
+
+    gcfg = cfg.get("grafana", {})
+    if not gcfg.get("grafanaURL"):
+        print("render smoke: no grafana.grafanaURL configured", file=out)
+        return 1
+    client = GrafanaClient(gcfg)
+    now_ms = int(time.time() * 1000)
+    # alert-buffer elements carry the FullStat wire line re-delimited to '&'
+    # (AlertEntry nesting, entries.js:210); build two synthetic ones
+    def fs_line(service: str, lag: int) -> str:
+        fields = [
+            "fs", str(now_ms - 60000), "smoke", service, str(lag), "12.00",
+            "250.0:240.0:200.0:280.0:1", "300.0:290.0:240.0:340.0:1",
+            "400.0:380.0:300.0:460.0:1",
+        ]
+        return "&".join(fields)
+
+    fake_alerts = [
+        {"entry": fs_line("smoke_test", 360)},
+        {"entry": fs_line("other_svc", 8640)},
+    ]
+    view_url, render_url = client.alert_urls(fake_alerts)
+    print(f"render smoke: view   {view_url}", file=out)
+    print(f"render smoke: render {render_url}", file=out)
+    if dry_run:
+        return 0
+    path = client.render(render_url)
+    if path is None:
+        print("render smoke: download FAILED", file=out)
+        return 1
+    print(f"render smoke: downloaded {path} ({os.path.getsize(path)} bytes)", file=out)
+    if email_to:
+        from ..integrations.email_sender import EmailSender
+
+        sender = EmailSender(cfg.get("streamProcessAlerts", {}).get("fromEmail", "apm@localhost"), email_to)
+        ok = sender("APM render smoke", "<p>render smoke roundtrip</p>", image_path=path)
+        print(f"render smoke: email {'sent' if ok else 'FAILED'}", file=out)
+        return 0 if ok else 1
+    return 0
+
+
+def smoke_paths(cfg: dict, out, sample_paths: list) -> int:
+    import re
+
+    pattern = cfg.get("streamParseTransactions", {}).get("serverFromPathPattern")
+    if not pattern:
+        print("paths smoke: no streamParseTransactions.serverFromPathPattern configured; "
+              "the default path-segment rule applies", file=out)
+    rx = re.compile(pattern) if pattern else None
+    samples = sample_paths or [
+        "/apps/logs/wildfly_jvm01.log", "/apps/logs/soap_io_jvm01.log",
+        "/var/log/app/server.log",
+    ]
+    for p in samples:
+        if rx is not None:
+            m = rx.search(p)
+            server = m.group(1) if m else "(no match)"
+        else:
+            parts = p.split("/")
+            server = parts[2] if len(parts) > 2 else p
+        print(f"paths smoke: {p} -> server {server!r}", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu smoke", description=__doc__)
+    ap.add_argument("target", choices=["db", "annotation", "render", "paths"])
+    ap.add_argument("--config", help=f"config path (default ${CONFIG_ENV_VAR} or built-ins)")
+    ap.add_argument("--live", action="store_true",
+                    help="annotation/render: actually perform HTTP (default dry-run)")
+    ap.add_argument("--text", default="smoke test annotation", help="annotation text")
+    ap.add_argument("--email-to", help="render: email the PNG to this address (implies --live)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="paths: sample log paths to resolve")
+    args = ap.parse_args(argv)
+    cfg = _load(args.config)
+    if args.target == "db":
+        return smoke_db(cfg, sys.stdout)
+    if args.target == "annotation":
+        return smoke_annotation(cfg, sys.stdout, dry_run=not args.live, text=args.text)
+    if args.target == "render":
+        live = args.live or bool(args.email_to)
+        return smoke_render(cfg, sys.stdout, dry_run=not live, email_to=args.email_to)
+    return smoke_paths(cfg, sys.stdout, list(args.paths))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

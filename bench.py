@@ -123,6 +123,13 @@ def run_worker(args) -> None:
     # feed_csv_batch — the boundary the reference crosses per-message
     host_intake_tx_s = _measure_host_intake()
 
+    # reference-production-scale detection budget: the reference fleet is
+    # ~100 (server, service) keys (SURVEY.md §6, ~760 FullStats per 10 s over
+    # 2 lags); measure the same full tick at that scale so the <100 ms p50
+    # north star is checked at the scale the reference actually ran, even on
+    # the CPU fallback (the 8192-row headline is ~80x that key count)
+    ref_scale = _measure_reference_scale(args)
+
     result = {
         "metric": "zscore_baselining_throughput",
         "value": round(throughput, 1),
@@ -139,12 +146,61 @@ def run_worker(args) -> None:
             "p95_detection_latency_ms": round(float(np.percentile(np.array(tick_latencies) * 1000, 95)), 3),
             "ingest_tx_per_sec": round(ingest_tx_s, 1),
             "host_intake_tx_per_sec": round(host_intake_tx_s, 1),
+            "reference_scale": ref_scale,
             "overflow_row_ticks": overflow_row_ticks,
             "wall_s": round(total, 3),
             "north_star": "1M metrics/sec on v5e-8 => 125k/sec/chip; <100ms p50 detection",
         },
     }
     print(json.dumps(result))
+
+
+def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict:
+    """Full fused tick at the reference's production key count (~100 rows):
+    {metrics_per_sec, p50_detection_latency_ms, meets_100ms_budget}."""
+    import numpy as np
+
+    import jax
+
+    from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
+
+    cfg, state, params = make_demo_engine(
+        capacity, args.samples_per_bucket, [(lag, 20.0, 0.1) for lag in args.lags]
+    )
+    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    rng = np.random.RandomState(1)
+    label = 180_000_000
+    B = 1024
+
+    def batch(lbl):
+        return (rng.randint(0, capacity, B).astype(np.int32),
+                np.full(B, lbl, np.int32),
+                (200 + 50 * rng.rand(B)).astype(np.float32),
+                np.ones(B, bool))
+
+    for _ in range(3):
+        label += 1
+        em, state = tick(state, cfg, label, params)
+        jax.block_until_ready(em.tpm)
+        state = ingest(state, cfg, *batch(label))
+    lats = []
+    for _ in range(ticks):
+        label += 1
+        t0 = time.perf_counter()
+        em, state = tick(state, cfg, label, params)
+        _ = [np.asarray(l.trigger) for l in em.lags]
+        np.asarray(em.tpm)
+        lats.append(time.perf_counter() - t0)
+        state = ingest(state, cfg, *batch(label))
+    p50 = float(np.percentile(np.array(lats) * 1000, 50))
+    metrics_per_tick = capacity * 3 * len(cfg.lags)
+    return {
+        "services": capacity,
+        "metrics_per_sec": round(metrics_per_tick * ticks / sum(lats), 1),
+        "p50_detection_latency_ms": round(p50, 3),
+        "meets_100ms_budget": p50 < 100.0,
+    }
 
 
 def _measure_host_intake(capacity: int = 1024, per_batch: int = 50000, batches: int = 4) -> float:

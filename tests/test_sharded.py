@@ -219,3 +219,54 @@ class TestMultihostExchange:
         assert plan.source_slot == plan.local_device_indices[0]
         with _pytest.raises(ValueError):
             host_shard_plan(mesh, 63)  # not divisible
+
+
+def test_sharded_tick_robust_lag_matches_single_chip():
+    """A robust (median/MAD) lag through the shard_map tick must equal the
+    single-chip step row-for-row (service-axis sharding: each shard owns
+    whole rings, so robust stats need no collectives)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apmbackend_tpu.parallel import make_mesh, make_sharded_tick, shard_rows
+    from apmbackend_tpu.pipeline import engine_init, engine_tick, make_demo_engine
+
+    n = 8
+    cfg, _, params = make_demo_engine(8 * n, 8, [(4, 2.0, 0.1)])
+    cfg = cfg._replace(lags=(cfg.lags[0]._replace(robust=True),))
+    state = engine_init(cfg)
+
+    rng = np.random.RandomState(3)
+    label = 170_000_001
+    # drive a few ticks with data so medians are non-trivial
+    import jax
+
+    tick1 = jax.jit(engine_tick, static_argnums=1)
+    from apmbackend_tpu.pipeline import engine_ingest
+
+    ingest1 = jax.jit(engine_ingest, static_argnums=1)
+    for t in range(10):
+        label += 1
+        em_single, state = tick1(state, cfg, jnp.int32(label), params)
+        B = 256
+        rows = rng.randint(0, 8 * n, B).astype(np.int32)
+        elaps = (100 + 900 * rng.rand(B)).astype(np.float32)
+        state = ingest1(state, cfg, rows, np.full(B, label, np.int32), elaps, np.ones(B, bool))
+
+    # single-chip reference FIRST: the sharded tick donates its (re-placed)
+    # state buffers, and on a 1-process CPU mesh re-placement can alias
+    em_single, _ = tick1(state, cfg, jnp.int32(label + 1), params)
+    mesh = make_mesh(n)
+    tick_sh = make_sharded_tick(mesh, cfg)
+    em_sh, _rollup, _state_sh = tick_sh(
+        shard_rows(state, mesh), jnp.int32(label + 1), shard_rows(params, mesh)
+    )
+    for field in ("window_avg", "lower_bound", "upper_bound"):
+        a = np.asarray(getattr(em_single.lags[0], field))
+        b = np.asarray(getattr(em_sh.lags[0], field))
+        np.testing.assert_allclose(
+            np.nan_to_num(a), np.nan_to_num(b), rtol=1e-6, atol=1e-6, err_msg=field
+        )
+    np.testing.assert_array_equal(
+        np.asarray(em_single.lags[0].signal), np.asarray(em_sh.lags[0].signal)
+    )

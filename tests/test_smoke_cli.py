@@ -1,0 +1,101 @@
+"""The `smoke` debug CLI (tools/smoke.py) — §2.4 manual-harness parity, run
+against the fake/sqlite backends and dry-run HTTP."""
+
+import json
+
+import pytest
+
+from apmbackend_tpu.tools import smoke
+
+
+def _cfg(tmp_path, backend="fake"):
+    from apmbackend_tpu.config import default_config
+
+    cfg = default_config()
+    cfg["streamInsertDb"]["dbBackend"] = backend
+    if backend == "sqlite":
+        cfg["streamInsertDb"]["dbFileFullPath"] = str(tmp_path / "smoke.db")
+    cfg["grafana"]["grafanaURL"] = "http://grafana.example:3000"
+    return cfg
+
+
+def test_smoke_db_fake(tmp_path, capsys):
+    import sys
+
+    assert smoke.smoke_db(_cfg(tmp_path), sys.stdout) == 0
+    out = capsys.readouterr().out
+    assert "inserted 2 rows" in out
+    assert "fake executor holds 2 rows" in out
+
+
+def test_smoke_db_sqlite(tmp_path, capsys):
+    import sqlite3
+    import sys
+
+    cfg = _cfg(tmp_path, backend="sqlite")
+    assert smoke.smoke_db(cfg, sys.stdout) == 0
+    out = capsys.readouterr().out
+    assert "inserted 2 rows" in out and "sqlite" in out
+    con = sqlite3.connect(cfg["streamInsertDb"]["dbFileFullPath"])
+    n = con.execute("SELECT COUNT(*) FROM tx").fetchone()[0]
+    con.close()
+    assert n == 2
+
+
+def test_smoke_annotation_dry_run(tmp_path, capsys):
+    import sys
+
+    assert smoke.smoke_annotation(
+        _cfg(tmp_path), sys.stdout, dry_run=True, text="hello"
+    ) == 0
+    out = capsys.readouterr().out
+    assert "/api/annotations" in out
+    body = json.loads(out.strip().splitlines()[-1])
+    assert body["text"] == "hello" and "maintenance" in body["tags"]
+
+
+def test_smoke_annotation_requires_url(tmp_path, capsys):
+    import sys
+
+    cfg = _cfg(tmp_path)
+    cfg["grafana"]["grafanaURL"] = ""
+    assert smoke.smoke_annotation(cfg, sys.stdout, dry_run=True, text="x") == 1
+
+
+def test_smoke_render_dry_run_builds_urls(tmp_path, capsys):
+    import sys
+
+    assert smoke.smoke_render(_cfg(tmp_path), sys.stdout, dry_run=True, email_to=None) == 0
+    out = capsys.readouterr().out
+    assert "/render" in out
+    assert "var-server=smoke" in out
+    assert "var-service=smoke_test" in out and "var-service=other_svc" in out
+    assert "var-lag=360" in out and "var-lag=8640" in out
+
+
+def test_smoke_paths_pattern(tmp_path, capsys):
+    import sys
+
+    cfg = _cfg(tmp_path)
+    cfg["streamParseTransactions"]["serverFromPathPattern"] = r"_([A-Za-z0-9]+)\.log$"
+    assert smoke.smoke_paths(cfg, sys.stdout, ["/x/wildfly_jvm07.log", "/x/other.txt"]) == 0
+    out = capsys.readouterr().out
+    assert "'jvm07'" in out and "(no match)" in out
+
+
+def test_smoke_cli_dispatch(tmp_path, capsys, monkeypatch):
+    # through the real argv entry point, config from file
+    cfg = _cfg(tmp_path)
+    path = str(tmp_path / "cfg.json")
+    with open(path, "w") as fh:
+        json.dump(cfg, fh)
+    assert smoke.main(["db", "--config", path]) == 0
+    assert "inserted 2 rows" in capsys.readouterr().out
+    assert smoke.main(["paths", "--config", path, "/a/b_jvm01.log"]) == 0
+    assert "jvm01" in capsys.readouterr().out
+
+
+def test_smoke_registered_in_dispatcher():
+    from apmbackend_tpu.__main__ import COMMANDS
+
+    assert COMMANDS["smoke"] == ("apmbackend_tpu.tools.smoke", True)

@@ -289,3 +289,76 @@ def test_soak_lite_with_ewma_channels_and_resume(tmp_path):
         assert np.asarray(drv2.state.ewmas[1].count).sum() > c1.sum()
     finally:
         N_JVMS, TX_PER_JVM = saved
+
+
+def test_soak_all_detector_families_with_restore(tmp_path):
+    """Every detector family live at once — classic z-score lag, robust
+    median/MAD lag, plain EWMA, hour-of-day seasonal, Holt level+trend —
+    through the full standalone stack with a mid-run kill/restore. Each
+    channel must emit FullStat wire lines in BOTH halves, and every family's
+    device state must survive the restart byte-for-byte (snapshot vs
+    restored)."""
+    n_jvms = 8
+    per_file = {}
+    for i in range(n_jvms):
+        d = tmp_path / "fleet" / f"jvm{i:02d}"
+        paths = write_fixture_logs(
+            str(d), n_transactions=400, seed=900 + i, server=f"jvm{i:02d}",
+            services=("getAccountInfo", "getOffers"),
+        )
+        for p in paths.values():
+            with open(p) as fh:
+                per_file[p] = fh.read().splitlines()
+
+    cfg = soak_config(tmp_path)
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 6, "THRESHOLD": 2.0, "INFLUENCE": 0.1},
+        {"LAG": 12, "THRESHOLD": 3.0, "INFLUENCE": 0.0, "ROBUST": True},
+    ]
+    cfg["tpuEngine"]["ewmaChannels"] = [
+        {"ALPHA": 0.3, "THRESHOLD": 3.0, "WARMUP": 3, "CHANNEL_ID": -1},
+        {"ALPHA": 0.3, "THRESHOLD": 3.0, "WARMUP": 2, "SEASON_SLOTS": 24,
+         "SLOT_INTERVALS": 360, "CHANNEL_ID": -24},
+        {"ALPHA": 0.2, "THRESHOLD": 3.0, "WARMUP": 3, "CHANNEL_ID": -2,
+         "TREND_BETA": 0.25},
+    ]
+    channel_ids = {"6", "12", "-1", "-24", "-2"}
+
+    emitted_1, emitted_2 = [], []
+
+    pipe1 = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    drv1 = attach_taps(pipe1, [], emitted_1)
+    feed_interleaved(pipe1, per_file, 0)
+    # snapshot family states BEFORE shutdown mutates them further
+    pipe1.shutdown()
+    state1 = drv1.state
+    classic_ring = np.asarray(state1.zscores[0].values)
+    robust_ring = np.asarray(state1.zscores[1].values)
+    holt_trend = np.asarray(state1.ewmas[2].trend)
+    seasonal_count = np.asarray(state1.ewmas[1].count)
+
+    chans_1 = {line.split("|")[4] for line in emitted_1 if line.startswith("fs|")}
+    assert chans_1 == channel_ids, f"first half emitted {chans_1}"
+
+    pipe2 = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    drv2 = attach_taps(pipe2, [], emitted_2)
+    # restored state == saved state for every family
+    np.testing.assert_array_equal(
+        classic_ring, np.asarray(drv2.state.zscores[0].values), err_msg="classic ring"
+    )
+    np.testing.assert_array_equal(
+        robust_ring, np.asarray(drv2.state.zscores[1].values), err_msg="robust ring"
+    )
+    np.testing.assert_array_equal(
+        holt_trend, np.asarray(drv2.state.ewmas[2].trend), err_msg="holt trend"
+    )
+    np.testing.assert_array_equal(
+        seasonal_count, np.asarray(drv2.state.ewmas[1].count), err_msg="seasonal counts"
+    )
+    feed_interleaved(pipe2, per_file, 1)
+    pipe2.shutdown()
+    chans_2 = {line.split("|")[4] for line in emitted_2 if line.startswith("fs|")}
+    assert chans_2 == channel_ids, f"second half emitted {chans_2}"
+    # the Holt channel's trend state actually moved (a zero trend would mean
+    # the TREND_BETA config never reached the device recursion)
+    assert float(np.abs(np.nan_to_num(np.asarray(drv2.state.ewmas[2].trend))).sum()) > 0
