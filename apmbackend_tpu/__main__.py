@@ -17,6 +17,8 @@ Commands map to the reference's process/tool set:
 - ``smoke``       manual integration harnesses: db insert, Grafana
                   annotation/render, path resolution (the reference's
                   dbtest/posttest/imagedltest/maptest scratch scripts)
+- ``schema``      generate/apply sink DDL + the Grafana alert-inspector
+                  dashboard JSON for the configured table names
 """
 
 import importlib
@@ -40,6 +42,7 @@ COMMANDS = {
     "backup": ("apmbackend_tpu.tools.backup", True),
     "config": ("apmbackend_tpu.config", True),
     "smoke": ("apmbackend_tpu.tools.smoke", True),
+    "schema": ("apmbackend_tpu.tools.schema", True),
 }
 
 

@@ -73,13 +73,23 @@ def column_sets_from_config(db_config: dict) -> Dict[str, ColumnSet]:
     }
 
 
+def _iso_z(dt: datetime) -> str:
+    return dt.astimezone(timezone.utc).isoformat(timespec="milliseconds").replace("+00:00", "Z")
+
+
 def _adapt(value):
     """Common scalar adaptation: datetime -> ISO-8601 Z (JS Date.toJSON shape),
-    dict -> compact JSON (jsonb columns), NaN -> None."""
+    dict -> compact JSON (jsonb columns), NaN -> None. Nested dicts may carry
+    datetimes of their own (AlertEntry embeds the full triggering entry,
+    entries.js:210) — they serialize to the same ISO-Z shape JSON.stringify
+    gives a Date."""
     if isinstance(value, datetime):
-        return value.astimezone(timezone.utc).isoformat(timespec="milliseconds").replace("+00:00", "Z")
+        return _iso_z(value)
     if isinstance(value, dict):
-        return json.dumps(value, separators=(",", ":"), allow_nan=False)
+        return json.dumps(
+            value, separators=(",", ":"), allow_nan=False,
+            default=lambda o: _iso_z(o) if isinstance(o, datetime) else str(o),
+        )
     if isinstance(value, float) and math.isnan(value):
         return None
     return value
@@ -100,6 +110,10 @@ class FakeExecutor:
         for row in rows:
             table.append(tuple(_adapt(row.get(c)) for c in cs.columns))
         self.batches.append((cs.table, len(rows)))
+
+    def execute_script(self, sql: str) -> None:
+        self.scripts = getattr(self, "scripts", [])
+        self.scripts.append(sql)
 
     def close(self) -> None:
         pass
@@ -131,6 +145,12 @@ class SQLiteExecutor:
                 f"INSERT INTO {cs.table} ({cols}) VALUES ({ph})",
                 [tuple(_adapt(r.get(c)) for c in cs.columns) for r in rows],
             )
+            self._conn.commit()
+
+    def execute_script(self, sql: str) -> None:
+        """Run provisioning DDL (tools/schema.py) on this backend."""
+        with self._lock:
+            self._conn.executescript(sql)
             self._conn.commit()
 
     def close(self) -> None:
@@ -186,6 +206,18 @@ class PostgresExecutor:  # pragma: no cover - requires a driver + live server
                         f"INSERT INTO {cs.table} ({cols}) VALUES ({ph})",
                         **{f"p{i}": v for i, v in enumerate(row)},
                     )
+
+    def execute_script(self, sql: str) -> None:
+        """Run provisioning DDL (tools/schema.py); driver differences stay here."""
+        with self._lock:
+            if self._driver == "psycopg2":
+                with self._conn.cursor() as cur:
+                    cur.execute(sql)
+                self._conn.commit()
+            else:  # pg8000.native: one statement per run()
+                for stmt in sql.split(";"):
+                    if stmt.strip():
+                        self._conn.run(stmt)
 
     def close(self) -> None:
         self._conn.close()

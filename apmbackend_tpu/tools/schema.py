@@ -1,0 +1,160 @@
+"""Sink provisioning: ``python -m apmbackend_tpu schema <ddl|dashboard>``.
+
+The reference assumes its Postgres tables (``tx``/``stats``/``alerts``/
+``jmx``, config/apm_config.json:226-229) and its Grafana alert-inspector
+dashboard already exist — neither DDL nor dashboard JSON is in its repo, so
+standing up a fresh deployment means reverse-engineering both from
+``stream_insert_db.js`` and ``generateGrafanaURL``. This tool generates
+them from the same column sets the sink actually writes
+(sinks/db.py column_sets_from_config <- stream_insert_db.js:149-160):
+
+- ``ddl``        CREATE TABLE statements (+ the indexes the dashboard
+                 queries need) for the configured table names; Postgres
+                 types by default, ``--dialect sqlite`` for the local
+                 backend. ``--apply`` executes against the configured
+                 backend instead of printing.
+- ``dashboard``  a minimal Grafana dashboard JSON with the template
+                 variables the alert-email render URLs reference
+                 (var-server / var-service / var-lag —
+                 stream_process_alerts.js:153-206 parity), wired to the
+                 stats table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .smoke import CONFIG_ENV_VAR, _load
+
+# column -> SQL type, per the shapes to_postgres() emits (entries.py):
+# _ms_to_dt -> timestamptz, counts -> bigint, rates/loads -> double
+# precision, nested dicts -> jsonb
+_PG_TYPES = {
+    "endts": "timestamptz", "startts": "timestamptz", "timestamp": "timestamptz",
+    "alerttimestamp": "timestamptz", "entrytimestamp": "timestamptz",
+    "server": "text", "service": "text", "logid": "text", "toplevel": "text",
+    "cause": "text",
+    "acctnum": "bigint", "elapsed": "bigint", "lag": "bigint",
+    "tpm": "double precision", "sysload": "double precision",
+    "stats": "jsonb", "entry": "jsonb",
+}
+_PG_DEFAULT = "bigint"  # jmx counters/bytes
+
+# (table-key, index columns) — what the Grafana panels filter/group by
+_INDEXES = {
+    "tx": ("endts", "server", "service"),
+    "fs": ("timestamp", "server", "service", "lag"),
+    "al": ("alerttimestamp", "server", "service"),
+    "jx": ("timestamp", "server"),
+}
+
+
+def _sql_type(col: str, dialect: str) -> str:
+    pg = _PG_TYPES.get(col, _PG_DEFAULT)
+    if dialect == "sqlite":  # affinity names; sqlite stores dynamically anyway
+        return {"timestamptz": "TEXT", "text": "TEXT", "bigint": "INTEGER",
+                "double precision": "REAL", "jsonb": "TEXT"}[pg]
+    return pg
+
+
+def build_ddl(cfg: dict, dialect: str = "postgres") -> str:
+    from ..sinks.db import column_sets_from_config
+
+    db_cfg = cfg.get("streamInsertDb", {})
+    out = []
+    for key, cs in column_sets_from_config(db_cfg).items():
+        cols = ",\n  ".join(f"{c} {_sql_type(c, dialect)}" for c in cs.columns)
+        out.append(f"CREATE TABLE IF NOT EXISTS {cs.table} (\n  {cols}\n);")
+        for ix_col in _INDEXES[key]:
+            out.append(
+                f"CREATE INDEX IF NOT EXISTS ix_{cs.table}_{ix_col} "
+                f"ON {cs.table} ({ix_col});"
+            )
+    return "\n".join(out) + "\n"
+
+
+def build_dashboard(cfg: dict) -> dict:
+    """Minimal alert-inspector dashboard: the template variables MUST be
+    var-server/var-service/var-lag — the names generateGrafanaURL embeds in
+    alert-email links (integrations/grafana.py alert_url_params)."""
+    db_cfg = cfg.get("streamInsertDb", {})
+    stats_table = db_cfg.get("dbStatTable", "stats")
+    grafana_cfg = cfg.get("grafana", {})
+    rel = grafana_cfg.get("alertInspectorRelativeURL", "/d/alert-inspector")
+    uid = rel.rstrip("/").split("/")[-1] or "alert-inspector"
+
+    def variable(name: str, col: str) -> dict:
+        return {
+            "name": name, "type": "query", "multi": True, "includeAll": True,
+            "query": f"SELECT DISTINCT {col} FROM {stats_table} ORDER BY 1",
+            "refresh": 2,
+        }
+
+    def panel(pid: int, title: str, field: str, y: int) -> dict:
+        return {
+            "id": pid, "type": "timeseries", "title": title,
+            "gridPos": {"h": 8, "w": 24, "x": 0, "y": y},
+            "targets": [{
+                "format": "time_series", "rawSql": (
+                    f"SELECT timestamp AS time, server || '/' || service AS metric, "
+                    f"{field} FROM {stats_table} WHERE server IN ($server) AND "
+                    f"service IN ($service) AND lag IN ($lag) AND "
+                    f"$__timeFilter(timestamp) ORDER BY 1"
+                ),
+            }],
+        }
+
+    return {
+        "uid": uid,
+        "title": "APM Alert Inspector",
+        "tags": ["apm", "generated"],
+        "templating": {"list": [
+            variable("server", "server"),
+            variable("service", "service"),
+            variable("lag", "lag"),
+        ]},
+        "panels": [
+            panel(1, "TPM", "tpm", 0),
+            panel(2, "Average (ms) with bounds", "(stats->>'average')::float", 8),
+            panel(3, "p95 (ms) with bounds", "(stats->>'per95')::float", 16),
+        ],
+        "schemaVersion": 39,
+        "time": {"from": "now-6h", "to": "now"},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu schema", description=__doc__)
+    ap.add_argument("target", choices=["ddl", "dashboard"])
+    ap.add_argument("--config", help=f"config path (default ${CONFIG_ENV_VAR} or built-ins)")
+    ap.add_argument("--dialect", choices=["postgres", "sqlite"], default="postgres")
+    ap.add_argument("--apply", action="store_true",
+                    help="ddl: execute against the configured streamInsertDb backend")
+    args = ap.parse_args(argv)
+    cfg = _load(args.config)
+    if args.target == "dashboard":
+        json.dump(build_dashboard(cfg), sys.stdout, indent=2)
+        print()
+        return 0
+    db_cfg = cfg.get("streamInsertDb", {})
+    backend = db_cfg.get("dbBackend", "fake")
+    dialect = "sqlite" if (args.apply and backend == "sqlite") else args.dialect
+    ddl = build_ddl(cfg, dialect)
+    if not args.apply:
+        sys.stdout.write(ddl)
+        return 0
+    from ..sinks.db import make_executor
+
+    ex = make_executor(db_cfg)
+    try:
+        ex.execute_script(ddl)
+    finally:
+        ex.close()
+    print(f"applied DDL to {backend} backend", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

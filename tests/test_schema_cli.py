@@ -1,0 +1,113 @@
+"""tools/schema.py: sink DDL + dashboard provisioning generated from the
+same column sets the writer uses — applied DDL must accept the writer's
+real row shapes end-to-end."""
+
+import json
+import sqlite3
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.tools import schema
+
+
+def _cfg(tmp_path=None, backend="fake"):
+    cfg = default_config()
+    cfg["streamInsertDb"]["dbBackend"] = backend
+    if backend == "sqlite":
+        cfg["streamInsertDb"]["dbFileFullPath"] = str(tmp_path / "apm.db")
+    return cfg
+
+
+def test_ddl_covers_all_tables_with_configured_names():
+    cfg = _cfg()
+    cfg["streamInsertDb"]["dbTxTable"] = "my_tx"
+    cfg["streamInsertDb"]["dbJmxTable"] = "my_jmx"
+    ddl = schema.build_ddl(cfg)
+    for table in ("my_tx", "stats", "alerts", "my_jmx"):
+        assert f"CREATE TABLE IF NOT EXISTS {table}" in ddl
+    assert "endts timestamptz" in ddl
+    assert "stats jsonb" in ddl
+    assert "tpm double precision" in ddl
+    assert "heapused bigint" in ddl
+    assert "CREATE INDEX IF NOT EXISTS ix_stats_lag ON stats (lag);" in ddl
+
+
+def test_applied_sqlite_ddl_accepts_writer_rows(tmp_path):
+    """Provision via --apply, then run the REAL sink writer against the
+    provisioned tables: every entry type's to_postgres() row must insert."""
+    import math
+
+    from apmbackend_tpu.entries import (
+        AlertEntry, EntryFactory, FullStatEntry, JmxEntry, TxEntry,
+    )
+    from apmbackend_tpu.sinks.db import column_sets_from_config, make_executor
+
+    cfg = _cfg(tmp_path, backend="sqlite")
+    assert schema.main(["ddl", "--apply", "--config", _write(tmp_path, cfg)]) == 0
+
+    db_cfg = cfg["streamInsertDb"]
+    ex = make_executor(db_cfg)
+    sets = column_sets_from_config(db_cfg)
+    ts = 1_700_000_000_000.0
+    tx = TxEntry("s1", "svcA", "L1", "123", ts - 50, ts, 50.0, "Y")
+    fs = FullStatEntry(ts, "s1", "svcA", 12.0, 360,
+                       *(float(v) for v in range(15)))
+    al = AlertEntry(ts, ts, "s1", "svcA", "avg", fs.to_csv().replace("|", "&"))
+    jx = JmxEntry(ts, "s1", *(float(i) for i in range(16)))
+    ex.insert_many(sets["tx"], [tx.to_postgres()])
+    ex.insert_many(sets["fs"], [fs.to_postgres()])
+    ex.insert_many(sets["al"], [al.to_postgres()])
+    ex.insert_many(sets["jx"], [jx.to_postgres()])
+    ex.close()
+
+    con = sqlite3.connect(db_cfg["dbFileFullPath"])
+    for table in ("tx", "stats", "alerts", "jmx"):
+        assert con.execute(f"SELECT COUNT(*) FROM {table}").fetchone()[0] == 1
+    # provisioned index exists
+    names = {r[0] for r in con.execute(
+        "SELECT name FROM sqlite_master WHERE type='index'"
+    )}
+    con.close()
+    assert "ix_stats_timestamp" in names
+
+
+def test_dashboard_variables_match_render_url_contract(tmp_path):
+    """The dashboard's template variables must be exactly the var-* names
+    generateGrafanaURL embeds in alert-email links."""
+    from apmbackend_tpu.integrations.grafana import GrafanaClient
+
+    cfg = _cfg()
+    cfg["grafana"]["grafanaURL"] = "http://g:3000"
+    dash = schema.build_dashboard(cfg)
+    var_names = {v["name"] for v in dash["templating"]["list"]}
+    assert var_names == {"server", "service", "lag"}
+
+    client = GrafanaClient(cfg["grafana"])
+    fs_line = "&".join([
+        "fs", "1700000000000", "srv", "svc", "360", "1.00",
+        "1:1:1:1:0", "1:1:1:1:0", "1:1:1:1:0",
+    ])
+    _view, render = client.alert_urls([{"entry": fs_line}])
+    for name in var_names:
+        assert f"var-{name}=" in render
+    # dashboard uid matches the configured inspector URL tail
+    assert dash["uid"] == cfg["grafana"].get(
+        "alertInspectorRelativeURL", "/d/alert-inspector"
+    ).rstrip("/").split("/")[-1]
+
+
+def test_fake_backend_records_script(tmp_path):
+    cfg = _cfg()
+    assert schema.main(["ddl", "--apply", "--config", _write(tmp_path, cfg)]) == 0
+
+
+def test_registered_in_dispatcher():
+    from apmbackend_tpu.__main__ import COMMANDS
+
+    assert COMMANDS["schema"] == ("apmbackend_tpu.tools.schema", True)
+
+
+def _write(tmp_path, cfg) -> str:
+    path = str(tmp_path / "cfg.json")
+    with open(path, "w") as fh:
+        json.dump(cfg, fh)
+    return path
