@@ -30,7 +30,7 @@ from .sharded import _state_specs
 
 def _shape_signature(cfg: EngineConfig) -> dict:
     """The config facts a snapshot must agree on to be resumable."""
-    return {
+    sig = {
         "capacity": cfg.capacity,
         "num_buckets": cfg.stats.num_buckets,
         "samples_per_bucket": cfg.stats.samples_per_bucket,
@@ -41,6 +41,13 @@ def _shape_signature(cfg: EngineConfig) -> dict:
         ],
         "dtype": str(np.dtype(cfg.stats.dtype)),
     }
+    if cfg.zscore_ring_dtype is not None:
+        # a non-default ring storage dtype changes the saved arrays' dtype,
+        # so bf16 configs must refuse f32 snapshots (and vice versa). The
+        # key is OMITTED for default configs so pre-existing snapshots
+        # (saved before this key existed) keep restoring.
+        sig["ring_dtype"] = np.dtype(cfg.zscore_ring_dtype).name
+    return sig
 
 
 class ShardedCheckpointer:

@@ -90,7 +90,8 @@ def _local_step(cfg: ZScoreConfig, n_window_shards: int):
 
     def fn(state: ZScoreState, new_values, threshold, influence):
         widx = jax.lax.axis_index(WINDOW_AXIS)
-        vals = state.values  # [S_loc, 3, L_loc]
+        raw = state.values  # [S_loc, 3, L_loc] in storage dtype
+        vals = raw.astype(cfg.dtype) if raw.dtype != cfg.dtype else raw
         fill, pos = state.fill, state.pos
         full = fill >= L
 
@@ -141,14 +142,15 @@ def _local_step(cfg: ZScoreConfig, n_window_shards: int):
         infl = influence[:, None]
         pushed = jnp.where(can_damp, infl * new_values + (1 - infl) * last_val, new_values)
 
-        # ring write: one owner shard stores; everyone advances counters
+        # ring write: one owner shard stores; everyone advances counters.
+        # Write against the RAW ring so storage bits round-trip exactly.
         wglobal = jnp.where(full, pos, fill)  # [S]
         owner_w = (wglobal // L_loc) == widx
         lw = wglobal % L_loc
         written = jax.vmap(lambda v, i, p: v.at[:, i].set(p))(
-            vals, lw, pushed.astype(cfg.dtype)
+            raw, lw, pushed.astype(raw.dtype)
         )
-        new_vals = jnp.where(owner_w[:, None, None], written, vals)
+        new_vals = jnp.where(owner_w[:, None, None], written, raw)
         new_fill = jnp.minimum(fill + 1, L)
         new_pos = jnp.where(full, (pos + 1) % L, pos)
 

@@ -60,6 +60,9 @@ class EngineConfig(NamedTuple):
     # multi-window extension (SURVEY.md §7.2 step 10): EWMA/seasonal channels
     ewma: Tuple[dewma.EwmaSpec, ...] = ()
     ewma_rules: Tuple[dalerts.AlertRuleConfig, ...] = ()  # one per channel
+    # storage dtype for the z-score rings (None = stats dtype); bfloat16
+    # halves the dominant HBM read per tick (ops/zscore.py ring_dtype)
+    zscore_ring_dtype: Optional[jnp.dtype] = None
 
     @property
     def capacity(self) -> int:
@@ -112,7 +115,9 @@ def engine_init(cfg: EngineConfig) -> EngineState:
         stats=dstats.init_state(cfg.stats),
         zscores=tuple(
             dzscore.init_state(
-                dzscore.ZScoreConfig(S, spec.lag, cfg.stats.dtype, spec.robust)
+                dzscore.ZScoreConfig(
+                    S, spec.lag, cfg.stats.dtype, spec.robust, cfg.zscore_ring_dtype
+                )
             )
             for spec in cfg.lags
         ),
@@ -142,7 +147,9 @@ def engine_tick(
     new_zstates = []
     new_counters = []
     for i, spec in enumerate(cfg.lags):
-        zcfg = dzscore.ZScoreConfig(cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust)
+        zcfg = dzscore.ZScoreConfig(
+            cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust, cfg.zscore_ring_dtype
+        )
         zres, zstate = dzscore.step(
             state.zscores[i], zcfg, new_values,
             params.thresholds[i], params.influences[i], params.active,
@@ -215,6 +222,20 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     if capacity is None:
         capacity = int(eng.get("serviceCapacity", 1024))
     dtype = jnp.float64 if eng.get("dtype") == "float64" else jnp.float32
+    ring_name = eng.get("zscoreRingDtype") or None
+    if ring_name is not None:
+        ring_dtypes = {"float32": jnp.float32, "float64": jnp.float64,
+                       "bfloat16": jnp.bfloat16}
+        if ring_name not in ring_dtypes:
+            raise ValueError(
+                f"tpuEngine.zscoreRingDtype must be one of {sorted(ring_dtypes)}, "
+                f"got {ring_name!r}"
+            )
+        ring_dtype = ring_dtypes[ring_name]
+        if ring_dtype == dtype:
+            ring_dtype = None  # same as compute dtype: keep configs hashable-equal
+    else:
+        ring_dtype = None
     stats_cfg = dstats.StatsConfig(
         capacity=capacity,
         window_sz=int(calc.get("windowSizeInIntervals", 30)),
@@ -248,7 +269,7 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     ewma_rules = tuple(rule_for(spec.suppressed) for spec in ewma_specs)
     return EngineConfig(
         stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True,
-        ewma=ewma_specs, ewma_rules=ewma_rules,
+        ewma=ewma_specs, ewma_rules=ewma_rules, zscore_ring_dtype=ring_dtype,
     )
 
 
@@ -414,7 +435,8 @@ class PipelineDriver:
         zstates = []
         for i, spec in enumerate(self.cfg.lags):
             zc = dzscore.ZScoreConfig(
-                self.cfg.capacity, spec.lag, self.cfg.stats.dtype, spec.robust
+                self.cfg.capacity, spec.lag, self.cfg.stats.dtype, spec.robust,
+                self.cfg.zscore_ring_dtype,
             )
             zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
             zstates.append(zs)
@@ -931,7 +953,12 @@ class PipelineDriver:
         }
         for i, spec in enumerate(self.cfg.lags):
             z = self.state.zscores[i]
-            arrays[f"z{spec.lag}_values"] = np.asarray(z.values)
+            zvals = np.asarray(z.values)
+            if zvals.dtype not in (np.float32, np.float64):
+                # bf16 rings: .npz has no portable bfloat16 — store f32
+                # (exact upcast; load downcasts back to identical bits)
+                zvals = zvals.astype(np.float32)
+            arrays[f"z{spec.lag}_values"] = zvals
             arrays[f"z{spec.lag}_fill"] = np.asarray(z.fill)
             arrays[f"z{spec.lag}_pos"] = np.asarray(z.pos)
             arrays[f"z{spec.lag}_counters"] = np.asarray(self.state.alert_counters[i])
@@ -1020,10 +1047,11 @@ class PipelineDriver:
             nsamples=jnp.asarray(pad_rows(data["nsamples"])),
         )
         zstates, counters = [], []
+        ring_dtype = self.cfg.zscore_ring_dtype or self.cfg.stats.dtype
         for spec in self.cfg.lags:
             zstates.append(
                 dzscore.ZScoreState(
-                    values=jnp.asarray(pad_rows(data[f"z{spec.lag}_values"])),
+                    values=jnp.asarray(pad_rows(data[f"z{spec.lag}_values"])).astype(ring_dtype),
                     fill=jnp.asarray(pad_rows(data[f"z{spec.lag}_fill"])),
                     pos=jnp.asarray(pad_rows(data[f"z{spec.lag}_pos"])),
                 )

@@ -77,6 +77,15 @@ def _iso_z(dt: datetime) -> str:
     return dt.astimezone(timezone.utc).isoformat(timespec="milliseconds").replace("+00:00", "Z")
 
 
+def _json_datetime_only(o):
+    """json.dumps default for jsonb columns: datetimes serialize like
+    JSON.stringify'd Dates; anything else stays a LOUD TypeError so corrupt
+    objects fail the flush (and re-queue) instead of persisting as reprs."""
+    if isinstance(o, datetime):
+        return _iso_z(o)
+    raise TypeError(f"Object of type {type(o).__name__} is not JSON serializable")
+
+
 def _adapt(value):
     """Common scalar adaptation: datetime -> ISO-8601 Z (JS Date.toJSON shape),
     dict -> compact JSON (jsonb columns), NaN -> None. Nested dicts may carry
@@ -88,7 +97,7 @@ def _adapt(value):
     if isinstance(value, dict):
         return json.dumps(
             value, separators=(",", ":"), allow_nan=False,
-            default=lambda o: _iso_z(o) if isinstance(o, datetime) else str(o),
+            default=_json_datetime_only,
         )
     if isinstance(value, float) and math.isnan(value):
         return None
@@ -101,6 +110,7 @@ class FakeExecutor:
     def __init__(self):
         self.tables: Dict[str, List[tuple]] = {}
         self.batches: List[Tuple[str, int]] = []
+        self.scripts: List[str] = []
         self.fail = False
 
     def insert_many(self, cs: ColumnSet, rows: List[dict]) -> None:
@@ -112,7 +122,6 @@ class FakeExecutor:
         self.batches.append((cs.table, len(rows)))
 
     def execute_script(self, sql: str) -> None:
-        self.scripts = getattr(self, "scripts", [])
         self.scripts.append(sql)
 
     def close(self) -> None:

@@ -135,6 +135,25 @@ def test_empty_directory_returns_none(tmp_path, engine):
     ckpt.close()
 
 
+def test_ring_dtype_mismatch_refuses_restore(tmp_path, engine):
+    """A bf16-ring config must not resume an f32-ring snapshot (array dtypes
+    differ), while the default config's signature stays key-compatible with
+    snapshots saved before ring_dtype existed."""
+    import jax.numpy as jnp
+
+    from apmbackend_tpu.parallel.checkpoint import _shape_signature
+
+    cfg, state, _ = engine
+    assert "ring_dtype" not in _shape_signature(cfg)  # default: legacy-compatible
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
+    ckpt.save(1, state, cfg, REGISTRY)
+    bf16_cfg = cfg._replace(zscore_ring_dtype=jnp.bfloat16)
+    assert _shape_signature(bf16_cfg)["ring_dtype"] == "bfloat16"
+    assert ckpt.restore(bf16_cfg) is None
+    assert ckpt.restore(cfg) is not None
+    ckpt.close()
+
+
 def test_pre_holt_snapshot_restores_with_zero_trend(tmp_path):
     """Upgrade path: an orbax snapshot saved by the pre-Holt build (EwmaState
     without the ``trend`` leaf) must restore with trend zero-filled — learned

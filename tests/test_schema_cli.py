@@ -95,9 +95,32 @@ def test_dashboard_variables_match_render_url_contract(tmp_path):
     ).rstrip("/").split("/")[-1]
 
 
-def test_fake_backend_records_script(tmp_path):
+def test_fake_backend_records_script(tmp_path, monkeypatch):
+    from apmbackend_tpu.sinks.db import FakeExecutor
+    from apmbackend_tpu.tools import schema as schema_mod
+
+    captured = FakeExecutor()
+    import apmbackend_tpu.sinks.db as db_mod
+
+    monkeypatch.setattr(db_mod, "make_executor", lambda _cfg_d: captured)
     cfg = _cfg()
-    assert schema.main(["ddl", "--apply", "--config", _write(tmp_path, cfg)]) == 0
+    assert schema_mod.main(["ddl", "--apply", "--config", _write(tmp_path, cfg)]) == 0
+    assert len(captured.scripts) == 1
+    assert "CREATE TABLE IF NOT EXISTS tx" in captured.scripts[0]
+
+
+def test_adapt_rejects_non_datetime_objects_in_jsonb():
+    """Corrupt nested objects must fail the flush loudly (re-queue path),
+    not persist as reprs."""
+    import pytest as _pytest
+
+    from apmbackend_tpu.sinks.db import _adapt
+
+    class Junk:
+        pass
+
+    with _pytest.raises(TypeError):
+        _adapt({"bad": Junk()})
 
 
 def test_registered_in_dispatcher():

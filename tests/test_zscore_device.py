@@ -340,3 +340,123 @@ def test_robust_window_sharding_not_supported():
     cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, robust=True)
     with pytest.raises(NotImplementedError, match="robust"):
         make_window_sharded_step(mesh, cfg)
+
+
+# ----------------------------------------------------------- bf16 ring ----
+
+def _drive_ring(series, ring_dtype, lag=12, thr=3.0, infl=0.2, capacity=2):
+    cfg = dz.ZScoreConfig(capacity=capacity, lag=lag, dtype=jnp.float32,
+                          ring_dtype=ring_dtype)
+    state = dz.init_state(cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    thr_v = jnp.full(capacity, thr, jnp.float32)
+    infl_v = jnp.full(capacity, infl, jnp.float32)
+    out = []
+    for x in series:
+        nv = np.full((capacity, 3), np.nan, np.float32)
+        nv[0] = (x, x + 1, x + 2)
+        res, state = step(state, cfg, jnp.asarray(nv), thr_v, infl_v)
+        out.append(res)
+    return out, state
+
+
+def test_bf16_ring_storage_and_approx_parity():
+    """bfloat16 ring: stored values are bf16 (half the HBM bytes), statistics
+    accumulate in f32, and results track the f32 ring within bf16's ~0.4%
+    relative error — with clear-margin signals identical."""
+    rng = np.random.RandomState(17)
+    series = list(200 + 20 * rng.rand(40))
+    series[30] = 5000.0  # far beyond any bound perturbation
+    f32_res, f32_state = _drive_ring(series, None)
+    bf_res, bf_state = _drive_ring(series, jnp.bfloat16)
+    assert bf_state.values.dtype == jnp.bfloat16
+    assert f32_state.values.dtype == jnp.float32
+    for t in range(len(series)):
+        a, b = f32_res[t], bf_res[t]
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(a.window_avg)),
+            np.nan_to_num(np.asarray(b.window_avg)), rtol=2e-2, atol=1e-2,
+        )
+        np.testing.assert_array_equal(np.asarray(a.signal), np.asarray(b.signal))
+
+
+def test_bf16_ring_exact_quirks():
+    # constant series: every stored bf16 value is identical -> max==min ->
+    # zero-variance quirk holds EXACTLY (no float luck needed)
+    series = [128.0] * 20 + [500.0]
+    res, _ = _drive_ring(series, jnp.bfloat16)
+    assert int(res[-1].signal[0, 0]) == 0
+    assert math.isnan(float(res[-1].upper_bound[0, 0]))
+    # warm-up gating unchanged
+    assert all(int(r.signal[0, 0]) == 0 for r in res[:12])
+
+
+def test_bf16_ring_resume_roundtrip(tmp_path):
+    """npz stores the bf16 ring as f32 (exact upcast); load returns the exact
+    same bf16 bits."""
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import TxEntry
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["serviceCapacity"] = 8
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = 8
+    cfg_tree["tpuEngine"]["dtype"] = "float32"
+    cfg_tree["tpuEngine"]["zscoreRingDtype"] = "bfloat16"
+    cfg_tree["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 3, "INFLUENCE": 0.1}]
+    d1 = PipelineDriver(cfg_tree, capacity=8)
+    assert d1.state.zscores[0].values.dtype == jnp.bfloat16
+    ts = 170_000_000_0000
+    for t in range(12):
+        d1.feed(TxEntry("s", "svc", f"L{t}", "A", ts - 100, float(ts), 100.0 + 7 * t, "Y"))
+        ts += 10_000
+    path = str(tmp_path / "resume.npz")
+    d1.save_resume(path)
+    d2 = PipelineDriver(cfg_tree, capacity=8)
+    assert d2.load_resume(path)
+    assert d2.state.zscores[0].values.dtype == jnp.bfloat16
+    a = np.asarray(d1.state.zscores[0].values.astype(jnp.float32))
+    b = np.asarray(d2.state.zscores[0].values.astype(jnp.float32))
+    np.testing.assert_array_equal(np.nan_to_num(a), np.nan_to_num(b))
+
+
+def test_ring_dtype_config_validation():
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import build_engine_config
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["zscoreRingDtype"] = "float16"
+    with pytest.raises(ValueError, match="zscoreRingDtype"):
+        build_engine_config(cfg_tree, 8)
+    cfg_tree["tpuEngine"]["zscoreRingDtype"] = "float32"  # == dtype -> None
+    assert build_engine_config(cfg_tree, 8).zscore_ring_dtype is None
+    cfg_tree["tpuEngine"]["zscoreRingDtype"] = "bfloat16"
+    assert build_engine_config(cfg_tree, 8).zscore_ring_dtype == jnp.bfloat16
+
+
+def test_bf16_ring_window_sharded_matches_single_chip():
+    from apmbackend_tpu.parallel import make_mesh2d, make_window_sharded_step, shard_zstate
+
+    cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, ring_dtype=jnp.bfloat16)
+    state_s = dz.init_state(cfg)
+    state_w = shard_zstate(dz.init_state(cfg), make_mesh2d(2, 4))
+    mesh = make_mesh2d(2, 4)
+    wstep = make_window_sharded_step(mesh, cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    rng = np.random.RandomState(5)
+    thr = jnp.full(8, 2.0, jnp.float32)
+    infl = jnp.full(8, 0.3, jnp.float32)
+    for t in range(12):
+        nv = jnp.asarray((200 + 30 * rng.rand(8, 3)).astype(np.float32))
+        res_s, state_s = step(state_s, cfg, nv, thr, infl)
+        res_w, state_w = wstep(state_w, nv, thr, infl)
+    assert state_w.values.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(res_s.window_avg)),
+        np.nan_to_num(np.asarray(res_w.window_avg)),
+    )
+    np.testing.assert_array_equal(np.asarray(res_s.signal), np.asarray(res_w.signal))
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(state_s.values.astype(jnp.float32))),
+        np.nan_to_num(np.asarray(state_w.values.astype(jnp.float32))),
+    )
