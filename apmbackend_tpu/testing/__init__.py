@@ -1,0 +1,3 @@
+"""Test affordances: fault injection for the transport fabric."""
+
+from .chaos import ChaosChannel, ChaosStats  # noqa: F401

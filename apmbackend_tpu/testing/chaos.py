@@ -1,0 +1,131 @@
+"""Transport fault injection (chaos) — the testing seam the reference lacked.
+
+The reference's failure story was only ever exercised in production
+(SURVEY.md §5.3: "No fault injection exists"); its manual-test seams were
+consume toggles and destructive queue peeks. This module makes broker
+misbehavior a first-class, DETERMINISTIC test input: wrap any
+:class:`~apmbackend_tpu.transport.base.Channel` in a :class:`ChaosChannel`
+and inject
+
+- **forced-full windows** (``force_full()`` / ``release()``): ``send()``
+  refuses like a broker under memory/disk alarm, driving the real
+  pause → buffer → drain → resume stack (queue.js:245-263, 88-106 contract)
+  on demand instead of by luck;
+- **message drops** (``drop_p``): delivery loss after the ack — the
+  at-most-once window the reference accepts (queue.js:277-283);
+- **duplicate deliveries** (``dup_p``): broker redelivery, which
+  ack-on-receipt consumers see as double-processing.
+
+Randomness is a seeded ``random.Random``: a failing chaos test replays
+bit-identically. Counters (:class:`ChaosStats`) expose exactly what was
+injected so assertions can account for every message.
+
+This is a *testing* module: production code never constructs it. Wire it by
+wrapping the backend factory handed to ``QueueManager``::
+
+    broker = MemoryBroker()
+    chaos = ChaosChannel(MemoryChannel(broker), drop_p=0.1, seed=7)
+    qm = QueueManager(lambda direction: chaos if direction == "p" else ...)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List
+
+from ..transport.base import Channel
+
+
+@dataclass
+class ChaosStats:
+    refused_sends: int = 0
+    dropped: int = 0
+    duplicated: int = 0
+    delivered: int = 0
+    sent: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+
+class ChaosChannel(Channel):
+    """Fault-injecting decorator around a real transport Channel."""
+
+    def __init__(
+        self,
+        inner: Channel,
+        *,
+        drop_p: float = 0.0,
+        dup_p: float = 0.0,
+        seed: int = 0,
+    ):
+        if not (0.0 <= drop_p <= 1.0 and 0.0 <= dup_p <= 1.0):
+            raise ValueError("drop_p/dup_p must be probabilities")
+        self.inner = inner
+        self.drop_p = drop_p
+        self.dup_p = dup_p
+        self.stats = ChaosStats()
+        self._rng = random.Random(seed)
+        self._forced_full = False
+        self._drain_cbs: List[Callable[[], None]] = []
+        # real backend drains propagate through the same callback list the
+        # chaos-released drains use
+        inner.on_drain(self._fire_drain)
+
+    # -- producer-side faults -------------------------------------------------
+    def force_full(self) -> None:
+        """Subsequent ``send()`` calls refuse (broker alarm engaged)."""
+        self._forced_full = True
+
+    def release(self) -> None:
+        """End the forced-full window and fire the drain event, exactly like
+        a broker clearing its alarm (connection.unblocked -> drain)."""
+        self._forced_full = False
+        self._fire_drain()
+
+    def send(self, name: str, payload: bytes) -> bool:
+        if self._forced_full:
+            self.stats._bump("refused_sends")
+            return False
+        ok = self.inner.send(name, payload)
+        if ok:
+            self.stats._bump("sent")
+        return ok
+
+    # -- consumer-side faults -------------------------------------------------
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+        def chaotic(payload: bytes) -> None:
+            # the backend already removed the message (ack-on-receipt): a
+            # drop here IS the at-most-once loss window
+            if self.drop_p and self._rng.random() < self.drop_p:
+                self.stats._bump("dropped")
+                return
+            self.stats._bump("delivered")
+            callback(payload)
+            if self.dup_p and self._rng.random() < self.dup_p:
+                self.stats._bump("duplicated")
+                self.stats._bump("delivered")
+                callback(payload)
+
+        self.inner.consume(name, chaotic, consumer_tag)
+
+    # -- passthrough ----------------------------------------------------------
+    def assert_queue(self, name: str) -> None:
+        self.inner.assert_queue(name)
+
+    def cancel(self, consumer_tag: str) -> None:
+        self.inner.cancel(consumer_tag)
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        self._drain_cbs.append(callback)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def _fire_drain(self) -> None:
+        for cb in list(self._drain_cbs):
+            cb()
