@@ -206,6 +206,24 @@ def service_alert_overrides(alerts_config: dict, service: str) -> Optional[dict]
     return overrides.get(service)
 
 
+def service_ewma_overrides(eng_config: dict, service: str) -> dict:
+    """Per-service EWMA-channel overrides: channel-id-string -> partial
+    {THRESHOLD, INFLUENCE}, null-safe and truthiness-filtered exactly like
+    :func:`service_zscore_settings` (falsy values are ignored, matching
+    stream_calc_z_score.js:106-132 — a 0 override is a no-op, not a
+    signal-on-everything threshold)."""
+    overrides = (eng_config.get("ewmaChannelOverrides", {}) or {}).get("services", {}) or {}
+    chans = overrides.get(service) or {}
+    out = {}
+    for chan_key, vals in chans.items():
+        kept = {
+            k: vals[k] for k in ("THRESHOLD", "INFLUENCE") if vals.get(k)
+        }
+        if kept:
+            out[int(chan_key)] = kept
+    return out
+
+
 def default_config() -> dict:
     """A complete default config mirroring the reference's shipped apm_config.json
 
@@ -425,6 +443,11 @@ _DEFAULT_CONFIG: dict = {
         # letting the flat EWMA's variance inflate around the ramp residual
         # and mask real regressions.
         "ewmaChannels": [],
+        # Per-service THRESHOLD/INFLUENCE overrides for the EWMA-family
+        # channels, keyed service -> channel id (the streamCalcZScore
+        # .overrides shape extended to these channels):
+        #   {"services": {"getOffers": {"-1": {"THRESHOLD": 2.0}}}}
+        "ewmaChannelOverrides": {"services": {}},
     },
 }
 

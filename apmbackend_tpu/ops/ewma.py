@@ -115,7 +115,13 @@ def step(
     spec: EwmaSpec,
     new_values: jnp.ndarray,  # [S, 3]: this tick's average/per75/per95 per row
     label,  # int32 scalar: the tick's bucket label (selects the season slot)
+    threshold: jnp.ndarray = None,  # [S] per-row override; None = spec.threshold
+    influence: jnp.ndarray = None,  # [S] per-row override; None = spec.influence
 ) -> Tuple[EwmaResult, EwmaState]:
+    # per-row parameter vectors (service overrides, registry.ewma_params);
+    # scalars broadcast from the spec when the caller has no overrides
+    thr_v = spec.threshold if threshold is None else threshold[:, None]
+    infl_v = spec.influence if influence is None else influence[:, None]
     k = slot_for_label(label, spec)
     mean_k = state.mean[:, :, k]  # [S, 3] level
     var_k = state.var[:, :, k]
@@ -132,17 +138,17 @@ def step(
     has_std = has_avg & (var_k > 0)  # zero variance -> undefined, like zscore
     std = jnp.where(has_std, jnp.sqrt(var_k), jnp.nan)
 
-    lb = jnp.where(has_std, pred_k - spec.threshold * std, jnp.nan)
-    ub = jnp.where(has_std, pred_k + spec.threshold * std, jnp.nan)
+    lb = jnp.where(has_std, pred_k - thr_v * std, jnp.nan)
+    ub = jnp.where(has_std, pred_k + thr_v * std, jnp.nan)
 
     new_ok = ~jnp.isnan(new_values)
-    exceeds = has_std & new_ok & (jnp.abs(new_values - pred_k) > spec.threshold * std)
+    exceeds = has_std & new_ok & (jnp.abs(new_values - pred_k) > thr_v * std)
     signal = jnp.where(exceeds, jnp.where(new_values > pred_k, 1, -1), 0).astype(jnp.int32)
 
     # Holt level/trend/var update (skip NaN inputs; first observation seeds
     # the slot: level = x, trend = 0, var = 0). Signalling values are
     # influence-damped against the prediction before entering the recursion.
-    pushed = jnp.where(exceeds, spec.influence * new_values + (1.0 - spec.influence) * pred_k, new_values)
+    pushed = jnp.where(exceeds, infl_v * new_values + (1.0 - infl_v) * pred_k, new_values)
     seeded = ~jnp.isnan(mean_k)
     delta = jnp.where(new_ok & seeded, pushed - pred_k, 0)  # one-step residual
     incr = spec.alpha * delta

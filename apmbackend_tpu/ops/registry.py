@@ -19,7 +19,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..config import service_alert_overrides, service_zscore_settings
+from ..config import (
+    service_alert_overrides,
+    service_ewma_overrides,
+    service_zscore_settings,
+)
 
 
 class CapacityExceeded(Exception):
@@ -102,6 +106,31 @@ class ServiceRegistry:
                 if lag in out:
                     out[lag]["threshold"][row] = float(setting["THRESHOLD"])
                     out[lag]["influence"][row] = float(setting["INFLUENCE"])
+        return out
+
+    def ewma_params(self, eng_config: dict, specs, dtype=np.float32) -> Dict[int, dict]:
+        """Per-channel {threshold: [S], influence: [S]} vectors for the
+        EWMA-family channels, with per-service overrides.
+
+        Overrides live at ``tpuEngine.ewmaChannelOverrides.services.<service>.
+        <channel_id>`` with THRESHOLD/INFLUENCE keys — the same
+        service-name-keyed shape AND truthiness semantics as
+        streamCalcZScore.overrides (config.service_ewma_overrides resolves
+        the shape, like its zscore/alert siblings). Rows beyond the
+        registered count carry the channel defaults.
+        """
+        out = {}
+        for spec in specs:
+            thr = np.full(self.capacity, float(spec.threshold), dtype=dtype)
+            infl = np.full(self.capacity, float(spec.influence), dtype=dtype)
+            out[spec.channel_id] = {"threshold": thr, "influence": infl}
+        for row, (_server, service) in enumerate(self._rows):
+            for chan_id, ov in service_ewma_overrides(eng_config, service).items():
+                if chan_id in out:
+                    if "THRESHOLD" in ov:
+                        out[chan_id]["threshold"][row] = float(ov["THRESHOLD"])
+                    if "INFLUENCE" in ov:
+                        out[chan_id]["influence"][row] = float(ov["INFLUENCE"])
         return out
 
     def alert_params(self, alerts_config: dict, dtype=np.float32) -> dict:

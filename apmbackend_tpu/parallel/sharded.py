@@ -106,6 +106,8 @@ def _params_specs(cfg: EngineConfig) -> EngineParams:
         hard_max_ms=_ROW,
         suppressed=_ROW,
         active=_ROW,
+        ewma_thresholds=tuple(_ROW for _ in cfg.ewma),
+        ewma_influences=tuple(_ROW for _ in cfg.ewma),
     )
 
 

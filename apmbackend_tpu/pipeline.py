@@ -89,6 +89,10 @@ class EngineParams(NamedTuple):
     # service first seen mid-run waits a full lag window (reference per-key
     # list-creation semantics). None = treat every row as active.
     active: Optional[jnp.ndarray] = None  # [S] bool
+    # per-row EWMA-channel overrides (registry.ewma_params); empty tuples =
+    # every row uses the channel spec's scalar defaults
+    ewma_thresholds: Tuple[jnp.ndarray, ...] = ()  # [S] per channel
+    ewma_influences: Tuple[jnp.ndarray, ...] = ()  # [S] per channel
 
 
 class LagEmission(NamedTuple):
@@ -179,7 +183,11 @@ def engine_tick(
     new_estates = []
     new_ecounters = []
     for i, espec in enumerate(cfg.ewma):
-        eres, estate = dewma.step(state.ewmas[i], espec, new_values, edge_label)
+        eres, estate = dewma.step(
+            state.ewmas[i], espec, new_values, edge_label,
+            params.ewma_thresholds[i] if i < len(params.ewma_thresholds) else None,
+            params.ewma_influences[i] if i < len(params.ewma_influences) else None,
+        )
         ares = dalerts.eval_rules(
             state.ewma_counters[i],
             cfg.ewma_rules[i],
@@ -312,6 +320,14 @@ def make_demo_engine(
         hard_max_ms=jnp.full(S, hard_max_ms, cfg.stats.dtype),
         suppressed=jnp.zeros(S, bool),
         active=jnp.ones(S, bool),  # demo fleets are fully populated
+        # populated whenever channels exist so the params pytree matches the
+        # sharded in_specs (parallel/sharded._params_specs mirrors cfg.ewma)
+        ewma_thresholds=tuple(
+            jnp.full(S, spec.threshold, cfg.stats.dtype) for spec in cfg.ewma
+        ),
+        ewma_influences=tuple(
+            jnp.full(S, spec.influence, cfg.stats.dtype) for spec in cfg.ewma
+        ),
     )
     return cfg, state, params
 
@@ -407,12 +423,21 @@ class PipelineDriver:
         np_dtype = self._np_dtype()
         zparams = self.registry.zscore_params(zcfg, lag_values, dtype=np_dtype)
         aparams = self.registry.alert_params(acfg, dtype=np_dtype)
+        eparams = self.registry.ewma_params(
+            self.apm_config.get("tpuEngine", {}), self.cfg.ewma, dtype=np_dtype
+        )
         self.params = EngineParams(
             thresholds=tuple(jnp.asarray(zparams[l]["threshold"]) for l in lag_values),
             influences=tuple(jnp.asarray(zparams[l]["influence"]) for l in lag_values),
             hard_max_ms=jnp.asarray(aparams["hard_max_ms"]),
             suppressed=jnp.asarray(aparams["suppressed"]),
             active=jnp.asarray(np.arange(self.cfg.capacity) < self.registry.count),
+            ewma_thresholds=tuple(
+                jnp.asarray(eparams[spec.channel_id]["threshold"]) for spec in self.cfg.ewma
+            ),
+            ewma_influences=tuple(
+                jnp.asarray(eparams[spec.channel_id]["influence"]) for spec in self.cfg.ewma
+            ),
         )
         self._params_registry_count = self.registry.count
 

@@ -384,3 +384,92 @@ def test_nan_var_recovers_on_seed():
         res, state = de.step(state, spec, jnp.asarray(nv), jnp.int32(t))
     assert not math.isnan(float(state.var[0, 0, 0]))
     assert int(res.signal[0, 0]) == 1  # the spike is detected
+
+
+def test_per_service_channel_overrides():
+    """tpuEngine.ewmaChannelOverrides: one service gets a tighter THRESHOLD
+    on one channel; the same deviation signals only for that service, and
+    the override flows through hot reload (apply_config)."""
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import TxEntry
+
+    cfg_tree = default_config()
+    cfg_tree["tpuEngine"]["serviceCapacity"] = 8
+    cfg_tree["tpuEngine"]["samplesPerBucket"] = 16
+    cfg_tree["tpuEngine"]["ewmaChannels"] = [
+        {"ALPHA": 0.3, "THRESHOLD": 50.0, "WARMUP": 3, "CHANNEL_ID": -1}
+    ]
+    cfg_tree["tpuEngine"]["ewmaChannelOverrides"] = {
+        "services": {"svcTight": {"-1": {"THRESHOLD": 2.0, "INFLUENCE": 0.5}}}
+    }
+    cfg_tree["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 99, "INFLUENCE": 0}]
+
+    sigs = {}
+    d = PipelineDriver(
+        cfg_tree, capacity=8,
+        on_fullstat=lambda fs: sigs.setdefault(
+            (fs.service, fs.lag), []
+        ).append(fs.average_signal),
+    )
+    rng = np.random.RandomState(2)
+    ts = 170_000_000_0000
+    # identical traffic for both services: steady ~200ms, then a ~4 sigma bump
+    for t in range(40):
+        ms = 200.0 + rng.rand() * 4 if t < 34 else 230.0
+        for svc in ("svcTight", "svcLoose"):
+            d.feed(TxEntry("s1", svc, f"L{t}-{svc}", "A", ts - ms, float(ts), ms, "Y"))
+        ts += 10_000
+    d.flush()
+    tight = sigs[("svcTight", -1)]
+    loose = sigs[("svcLoose", -1)]
+    assert any(s == 1 for s in tight), "tight override must flag the bump"
+    assert all(s == 0 for s in loose), "default THRESHOLD=50 must stay quiet"
+
+    # hot reload: drop the override -> svcTight goes quiet for a fresh bump
+    import copy
+
+    new_tree = copy.deepcopy(cfg_tree)
+    new_tree["tpuEngine"]["ewmaChannelOverrides"] = {"services": {}}
+    d.apply_config(new_tree)
+    sigs.clear()
+    for t in range(6):
+        for svc in ("svcTight", "svcLoose"):
+            d.feed(TxEntry("s1", svc, f"R{t}-{svc}", "A", ts - 230, float(ts), 235.0, "Y"))
+        ts += 10_000
+    d.flush()
+    assert all(s == 0 for s in sigs.get(("svcTight", -1), [])), "override removed on reload"
+
+
+def test_registry_ewma_params_defaults_and_overrides():
+    from apmbackend_tpu.ops.registry import ServiceRegistry
+
+    reg = ServiceRegistry(4)
+    reg.lookup_or_add("s", "a")
+    reg.lookup_or_add("s", "b")
+    spec = de.EwmaSpec(alpha=0.1, threshold=3.0, warmup=1, channel_id=-7, influence=0.9)
+    eng = {"ewmaChannelOverrides": {"services": {"b": {"-7": {"THRESHOLD": 1.5}}}}}
+    out = reg.ewma_params(eng, [spec], dtype=np.float64)
+    np.testing.assert_array_equal(out[-7]["threshold"], [3.0, 1.5, 3.0, 3.0])
+    np.testing.assert_array_equal(out[-7]["influence"], [0.9, 0.9, 0.9, 0.9])
+
+
+def test_registry_ewma_params_null_and_falsy_semantics():
+    """Null-guard and truthiness parity with the z-score override helper:
+    a nulled overrides key must not crash, and a 0-valued THRESHOLD is a
+    no-op (stream_calc_z_score.js:106-132 semantics), never a
+    signal-on-everything threshold."""
+    from apmbackend_tpu.ops.registry import ServiceRegistry
+
+    reg = ServiceRegistry(2)
+    reg.lookup_or_add("s", "a")
+    spec = de.EwmaSpec(alpha=0.1, threshold=3.0, warmup=1, channel_id=-1)
+    # JSON config that nulls the key to disable overrides
+    out = reg.ewma_params({"ewmaChannelOverrides": None}, [spec])
+    np.testing.assert_array_equal(out[-1]["threshold"], [3.0, 3.0])
+    out = reg.ewma_params({"ewmaChannelOverrides": {"services": None}}, [spec])
+    np.testing.assert_array_equal(out[-1]["threshold"], [3.0, 3.0])
+    # falsy override values are skipped, like service_zscore_settings
+    eng = {"ewmaChannelOverrides": {"services": {"a": {"-1": {"THRESHOLD": 0, "INFLUENCE": 0.5}}}}}
+    out = reg.ewma_params(eng, [spec])
+    np.testing.assert_array_equal(out[-1]["threshold"], [3.0, 3.0])
+    np.testing.assert_array_equal(out[-1]["influence"], [0.5, 1.0])
