@@ -426,6 +426,9 @@ _DEFAULT_CONFIG: dict = {
         # still accumulate in `dtype`; ~0.4% relative rounding on stored
         # values). "" / unset = same as `dtype`.
         "zscoreRingDtype": "",
+        # HBM watchdog (device-side analog of the manager's RSS watchdog):
+        # manager-alert when bytes_in_use/bytes_limit crosses this fraction
+        "deviceMemoryAlarmFraction": 0.9,
         "checkpointDir": "save/tpu_engine",
         "resumeFileFullPath": "save/tpu_engine.resume.npz",
         "microBatchSize": 65536,

@@ -160,6 +160,18 @@ class WorkerApp:
         stat_s = int(config.get("statLogIntervalInSeconds", 60))
         runtime.every(stat_s, self._log_intake_stats, name="intake-stats")
 
+        # HBM watchdog — the device-side analog of the manager's host-RSS
+        # watchdog (apm_manager.js:486-509 role): the engine state lives on
+        # the chip, so capacity growth or a lag/config change can exhaust
+        # device memory long before host RSS moves. Telemetry every stats
+        # interval; a rate-limited manager alert past the alarm fraction.
+        self._hbm_alarm_fraction = float(eng_cfg.get("deviceMemoryAlarmFraction", 0.9))
+        self._hbm_alerted = False
+        self.hbm_bytes_in_use = 0
+        self.hbm_bytes_limit = 0
+        self._device_memory_stats = self._real_device_memory_stats  # test seam
+        runtime.every(stat_s, self._check_device_memory, name="hbm-watchdog")
+
         # -- intake ----------------------------------------------------------
         self._factory = EntryFactory()
         in_queue_name = stats_cfg.get("inQueue", "transactions")
@@ -195,6 +207,40 @@ class WorkerApp:
             f"dropped: {self.intake_dropped} - reservoir row-ticks: "
             f"{self.driver.overflow_rows_total}"
         )
+
+    @staticmethod
+    def _real_device_memory_stats() -> dict:
+        try:
+            import jax
+
+            return jax.local_devices()[0].memory_stats() or {}
+        except Exception:
+            return {}
+
+    def _check_device_memory(self) -> None:
+        stats = self._device_memory_stats()
+        used = stats.get("bytes_in_use")
+        if used is None:  # backend exposes no memory stats (e.g. CPU)
+            return
+        limit = stats.get("bytes_limit") or 0
+        self.hbm_bytes_in_use = int(used)
+        self.hbm_bytes_limit = int(limit)
+        self.runtime.logger.info(
+            f"HBM> in use: {used / 2**20:.1f} MiB"
+            + (f" / {limit / 2**20:.1f} MiB ({used / limit:.0%})" if limit else "")
+        )
+        if limit and used / limit >= self._hbm_alarm_fraction:
+            if not self._hbm_alerted:
+                self._hbm_alerted = True
+                self.ops_alerts.add(
+                    f"Device memory at {used / limit:.0%} of {limit / 2**20:.0f} MiB "
+                    f"(alarm fraction {self._hbm_alarm_fraction:.0%}): the next "
+                    f"capacity growth or lag increase may OOM the chip. Shard the "
+                    f"fleet across more devices or reduce serviceCapacity/"
+                    f"samplesPerBucket/lags (or set zscoreRingDtype=bfloat16)."
+                )
+        elif self._hbm_alerted and limit and used / limit < self._hbm_alarm_fraction * 0.8:
+            self._hbm_alerted = False  # re-arm after recovery with hysteresis
 
     def _on_overflow(self, label: int, n_rows: int) -> None:
         """Percentile-reservoir overflow -> manager alert, heavily rate-limited

@@ -116,3 +116,43 @@ def test_tiny_ring_overflow_path_no_loss(tmp_path):
     assert pipe.worker._ring_fed == pipe.worker._ring_pushed
     assert pipe.worker.driver.registry.count > 0
     pipe.shutdown()
+
+
+def test_hbm_watchdog_telemetry_and_alarm(tmp_path):
+    """The device-memory watchdog (worker _check_device_memory): telemetry
+    fields update, the manager alert fires once past the alarm fraction,
+    stays silent while latched, and re-arms after recovery hysteresis."""
+    cfg = small_config(tmp_path)
+    pipe = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    w = pipe.worker
+    try:
+        GiB = 2**30
+        fake = {"bytes_in_use": 1 * GiB, "bytes_limit": 16 * GiB}
+        w._device_memory_stats = lambda: fake
+        w._check_device_memory()
+        assert w.hbm_bytes_in_use == 1 * GiB and w.hbm_bytes_limit == 16 * GiB
+        assert not w._hbm_alerted
+        before = len(w.ops_alerts.buffer)
+
+        fake = {"bytes_in_use": 15 * GiB, "bytes_limit": 16 * GiB}  # 94% > 90%
+        w._check_device_memory()
+        assert w._hbm_alerted
+        assert len(w.ops_alerts.buffer) == before + 1
+        w._check_device_memory()  # latched: no repeat alert
+        assert len(w.ops_alerts.buffer) == before + 1
+
+        fake = {"bytes_in_use": 14.6 * GiB, "bytes_limit": 16 * GiB}  # 91%: still latched
+        w._check_device_memory()
+        assert w._hbm_alerted
+        fake = {"bytes_in_use": 8 * GiB, "bytes_limit": 16 * GiB}  # < 72%: re-arm
+        w._check_device_memory()
+        assert not w._hbm_alerted
+        fake = {"bytes_in_use": 15 * GiB, "bytes_limit": 16 * GiB}
+        w._check_device_memory()
+        assert len(w.ops_alerts.buffer) == before + 2
+
+        # no memory stats (CPU backend): a clean no-op
+        fake = {}
+        w._check_device_memory()
+    finally:
+        pipe.shutdown()
