@@ -19,6 +19,9 @@ Commands map to the reference's process/tool set:
                   dbtest/posttest/imagedltest/maptest scratch scripts)
 - ``schema``      generate/apply sink DDL + the Grafana alert-inspector
                   dashboard JSON for the configured table names
+- ``demo``        sixty-second tour: synthetic log fleet with an injected
+                  latency regression through the whole pipeline; exit 0 iff
+                  exactly that service alerts
 """
 
 import importlib
@@ -43,6 +46,7 @@ COMMANDS = {
     "config": ("apmbackend_tpu.config", True),
     "smoke": ("apmbackend_tpu.tools.smoke", True),
     "schema": ("apmbackend_tpu.tools.schema", True),
+    "demo": ("apmbackend_tpu.tools.demo", True),
 }
 
 

@@ -125,8 +125,16 @@ def write_fixture_logs(
     services: Tuple[str, ...] = ("getAccountInfo", "getOffers", "Provider[credit-check]"),
     seed: int = 0,
     server: str = "jvmhost1",
+    anomaly: Optional[dict] = None,
 ) -> Dict[str, str]:
-    """Generate a mixed fixture log directory; returns {file_name: path}."""
+    """Generate a mixed fixture log directory; returns {file_name: path}.
+
+    ``anomaly`` injects a latency regression for end-to-end detection tests
+    and demos: ``{"service": name, "start_frac": 0.75, "factor": 8.0}``
+    multiplies that service's elapsed times by ``factor`` for every
+    transaction past ``start_frac`` of the stream (the other services stay
+    healthy — the detector must single it out).
+    """
     gen = FixtureGenerator(server=server, seed=seed)
     rng = random.Random(seed + 1)
     lines_by_file: Dict[str, List[str]] = {}
@@ -135,9 +143,15 @@ def write_fixture_logs(
         for fname, line in pairs:
             lines_by_file.setdefault(fname, []).append(line)
 
+    a_service = (anomaly or {}).get("service")
+    a_start = int((anomaly or {}).get("start_frac", 0.75) * n_transactions)
+    a_factor = float((anomaly or {}).get("factor", 8.0))
+
     for i in range(n_transactions):
         service = services[rng.randrange(len(services))]
         elapsed = rng.randint(50, 1200)
+        if a_service is not None and service == a_service and i >= a_start:
+            elapsed = int(elapsed * a_factor)
         acct = rng.randint(10**8, 10**9 - 1)
         kind = rng.random()
         if kind < 0.5:

@@ -1,0 +1,126 @@
+"""End-to-end demo: ``python -m apmbackend_tpu demo``.
+
+The sixty-second tour for someone switching from the reference: generate a
+synthetic WildFly log fleet with a latency regression injected into ONE
+service, run the COMPLETE pipeline over it in-process (parser correlation →
+broker → native intake ring → fused device tick with z-score baselining →
+alert rules → cooldowns → sqlite sink), and print what was detected.
+
+Everything is the production code path — the only demo-specific parts are
+the generated fixtures and a config tuned so warm-up fits a short replay
+(small lag windows, responsive alert rule). Exit code 0 iff the injected
+regression was detected and no healthy service false-alarmed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sqlite3
+import sys
+import tempfile
+
+
+def build_demo_config(workdir: str, *, lag: int = 12) -> dict:
+    from ..config import default_config
+
+    cfg = default_config()
+    cfg["logDir"] = os.path.join(workdir, "logs")
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 64
+    eng["samplesPerBucket"] = 64
+    eng["microBatchSize"] = 4096
+    eng["resumeFileFullPath"] = os.path.join(workdir, "engine.resume.npz")
+    # short windows so baselines warm up within a few minutes of log time
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": lag, "THRESHOLD": 4.0, "INFLUENCE": 0.3},
+    ]
+    alerts = cfg["streamProcessAlerts"]
+    alerts["alertsResumeFileFullPath"] = os.path.join(workdir, "alerts.resume")
+    alerts["rollingAlertWindowSizeInIntervals"] = 6
+    alerts["requiredNumberBadIntervalsInAlertWindowToTrigger"] = 3
+    alerts["hardMinMsAlertThreshold"] = 200
+    alerts["hardMinTpmAlertThreshold"] = 0.5
+    alerts["emailsEnabled"] = False  # alerts accumulate in the buffer
+    db = cfg["streamInsertDb"]
+    db["dbBackend"] = "sqlite"
+    db["dbFileFullPath"] = os.path.join(workdir, "apm.db")
+    db["bufferResumeFileFullPath"] = os.path.join(workdir, "db.resume")
+    db["dbMaxTimeBetweenInsertsMs"] = 100000
+    pt = cfg["streamParseTransactions"]
+    pt["tailPauseFileFullPath"] = os.path.join(workdir, "PAUSE")
+    pt["serverFromPathPattern"] = r"_([A-Za-z0-9]+)\.log$"
+    pt["serverPathComponentIndex"] = None
+    return cfg
+
+
+def run_demo(workdir: str, *, n_tx: int = 1500, bad_service: str = "getOffers",
+             factor: float = 8.0, out=sys.stdout) -> int:
+    from ..ingest.replay import write_fixture_logs
+    from ..standalone import StandalonePipeline
+
+    log_dir = os.path.join(workdir, "fixtures")
+    print(f"demo: generating {n_tx} transactions across 3 services "
+          f"({bad_service} regresses {factor}x after 75% of the stream)", file=out)
+    files = write_fixture_logs(
+        log_dir, n_transactions=n_tx, server="jvm01",
+        services=("getAccountInfo", "getOffers", "Provider[credit-check]"),
+        anomaly={"service": bad_service, "start_frac": 0.75, "factor": factor},
+    )
+    cfg = build_demo_config(workdir)
+    pipe = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    try:
+        for path in files.values():
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    pipe.parser.read_line(path, line.rstrip("\n"))
+        pipe.drain()
+        drv = pipe.worker.driver
+        amgr = pipe.worker.alerts_manager
+        alerts = list(amgr.alert_buffer)
+        n_rows = len(drv.registry.rows())
+        print(f"demo: parsed and ingested; {n_rows} (server, service) keys, "
+              f"latest bucket {drv._latest_label}", file=out)
+    finally:
+        pipe.shutdown()
+
+    # what landed in the DB (the Grafana-facing tables)
+    con = sqlite3.connect(cfg["streamInsertDb"]["dbFileFullPath"])
+    tx_n = con.execute("SELECT COUNT(*) FROM tx").fetchone()[0]
+    fs_n = con.execute("SELECT COUNT(*) FROM stats").fetchone()[0]
+    con.close()
+    print(f"demo: sqlite sink holds {tx_n} tx rows, {fs_n} fullstat rows", file=out)
+
+    alerted = sorted({a["service"] for a in alerts})
+    print(f"demo: {len(alerts)} alert(s) raised for service(s): {alerted or 'NONE'}", file=out)
+    for a in alerts[:5]:
+        print(f"  ALERT {a['server']}/{a['service']} cause={a['cause']}", file=out)
+    # the parser prefixes wire service names with the record kind (e.g.
+    # 'S:getOffers' for standard CommonTiming): match on the base name
+    ok = bool(alerted) and all(bad_service in s for s in alerted)
+    if ok:
+        print(f"demo: PASS — the injected {bad_service} regression was detected; "
+              f"healthy services stayed quiet", file=out)
+    else:
+        print(f"demo: FAIL — expected exactly [{bad_service}] to alert, got {alerted}", file=out)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu demo", description=__doc__)
+    ap.add_argument("--transactions", type=int, default=1500)
+    ap.add_argument("--service", default="getOffers", help="service to regress")
+    ap.add_argument("--factor", type=float, default=8.0, help="latency multiplier")
+    ap.add_argument("--workdir", help="keep artifacts here (default: temp dir)")
+    args = ap.parse_args(argv)
+    if args.workdir:
+        os.makedirs(args.workdir, exist_ok=True)
+        return run_demo(args.workdir, n_tx=args.transactions,
+                        bad_service=args.service, factor=args.factor)
+    with tempfile.TemporaryDirectory(prefix="apm_demo_") as d:
+        return run_demo(d, n_tx=args.transactions, bad_service=args.service,
+                        factor=args.factor)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

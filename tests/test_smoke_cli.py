@@ -99,3 +99,48 @@ def test_smoke_registered_in_dispatcher():
     from apmbackend_tpu.__main__ import COMMANDS
 
     assert COMMANDS["smoke"] == ("apmbackend_tpu.tools.smoke", True)
+
+
+def test_demo_detects_injected_regression(tmp_path):
+    """The demo CLI end-to-end: the injected regression is detected and only
+    that service alerts (exit code contract)."""
+    from apmbackend_tpu.tools import demo
+
+    rc = demo.run_demo(str(tmp_path), n_tx=900, bad_service="getOffers", factor=10.0)
+    assert rc == 0
+
+
+def test_fixture_anomaly_injection():
+    """write_fixture_logs(anomaly=...): only the chosen service's tail
+    regresses; the others' distributions are unchanged vs no-anomaly run."""
+    import re
+    import tempfile
+
+    from apmbackend_tpu.ingest.replay import write_fixture_logs
+
+    def elapsed_by_service(paths):
+        out = {}
+        rx = re.compile(r"(?:EJB (\S+) call: (\d+) ms|Stop (\S+) completed in time: (\d+) ms)")
+        for p in paths.values():
+            for line in open(p, encoding="utf-8"):
+                m = rx.search(line)
+                if m:
+                    svc = m.group(1) or m.group(3)
+                    out.setdefault(svc, []).append(int(m.group(2) or m.group(4)))
+        return out
+
+    with tempfile.TemporaryDirectory() as d1, tempfile.TemporaryDirectory() as d2:
+        base = elapsed_by_service(write_fixture_logs(d1, n_transactions=400, seed=5))
+        anom = elapsed_by_service(write_fixture_logs(
+            d2, n_transactions=400, seed=5,
+            anomaly={"service": "getOffers", "start_frac": 0.5, "factor": 10.0},
+        ))
+    assert base["getAccountInfo"] == anom["getAccountInfo"]  # untouched
+    assert max(anom["getOffers"]) > max(base["getOffers"]) * 5  # tail regressed
+    # the pre-anomaly head is intact: at least the first half of the base
+    # values survive unchanged (multiset intersection — per-file collection
+    # order is not chronological)
+    from collections import Counter
+
+    common = sum((Counter(anom["getOffers"]) & Counter(base["getOffers"])).values())
+    assert common >= len(base["getOffers"]) // 3
