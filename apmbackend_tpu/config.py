@@ -429,6 +429,10 @@ _DEFAULT_CONFIG: dict = {
         # HBM watchdog (device-side analog of the manager's RSS watchdog):
         # manager-alert when bytes_in_use/bytes_limit crosses this fraction
         "deviceMemoryAlarmFraction": 0.9,
+        # z-score window variance: "auto" (one ring pass via shifted sumsq in
+        # f32 — ~1.4x the dominant reduce, <=1e-5 relative var error; f64
+        # parity mode always keeps the exact two-pass), "one", or "two".
+        "zscoreVariancePass": "auto",
         "checkpointDir": "save/tpu_engine",
         "resumeFileFullPath": "save/tpu_engine.resume.npz",
         "microBatchSize": 65536,

@@ -182,6 +182,15 @@ def make_window_sharded_step(mesh: Mesh, cfg: ZScoreConfig):
             "robust (median/MAD) z-score is not supported with window-axis "
             "sharding; use service-axis sharding for robust lags"
         )
+    if cfg.onepass_var and cfg.dtype != jnp.float64:
+        # this path computes the exact two-pass variance collectively;
+        # silently ignoring the flag would let sharded and single-chip
+        # deployments diverge beyond reduction-order rounding (the module's
+        # parity contract) — refuse instead, like robust
+        raise NotImplementedError(
+            "one-pass variance is not implemented for window-axis sharding; "
+            "set tpuEngine.zscoreVariancePass='two' for window-sharded lags"
+        )
     if cfg.capacity % n_s != 0:
         raise ValueError(f"capacity {cfg.capacity} not divisible by service shards {n_s}")
     local_cfg = cfg._replace(capacity=cfg.capacity // n_s)

@@ -63,6 +63,9 @@ class EngineConfig(NamedTuple):
     # storage dtype for the z-score rings (None = stats dtype); bfloat16
     # halves the dominant HBM read per tick (ops/zscore.py ring_dtype)
     zscore_ring_dtype: Optional[jnp.dtype] = None
+    # one-pass shifted variance (ops/zscore.py onepass_var); f64 parity mode
+    # always keeps the exact two-pass regardless
+    zscore_onepass: bool = True
 
     @property
     def capacity(self) -> int:
@@ -120,7 +123,8 @@ def engine_init(cfg: EngineConfig) -> EngineState:
         zscores=tuple(
             dzscore.init_state(
                 dzscore.ZScoreConfig(
-                    S, spec.lag, cfg.stats.dtype, spec.robust, cfg.zscore_ring_dtype
+                    S, spec.lag, cfg.stats.dtype, spec.robust,
+                    cfg.zscore_ring_dtype, cfg.zscore_onepass,
                 )
             )
             for spec in cfg.lags
@@ -152,7 +156,8 @@ def engine_tick(
     new_counters = []
     for i, spec in enumerate(cfg.lags):
         zcfg = dzscore.ZScoreConfig(
-            cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust, cfg.zscore_ring_dtype
+            cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust,
+            cfg.zscore_ring_dtype, cfg.zscore_onepass,
         )
         zres, zstate = dzscore.step(
             state.zscores[i], zcfg, new_values,
@@ -275,9 +280,18 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     rules = tuple(rule_for(spec.suppressed) for spec in lags)
     ewma_specs = dewma.specs_from_config(eng)
     ewma_rules = tuple(rule_for(spec.suppressed) for spec in ewma_specs)
+    vp = str(eng.get("zscoreVariancePass", "auto"))
+    if vp not in ("auto", "one", "two"):
+        raise ValueError(
+            f"tpuEngine.zscoreVariancePass must be auto|one|two, got {vp!r}"
+        )
+    # "auto" = one-pass for f32 production (ops/zscore.py itself pins f64
+    # parity mode to the exact two-pass regardless of this flag)
+    onepass = vp != "two"
     return EngineConfig(
         stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True,
         ewma=ewma_specs, ewma_rules=ewma_rules, zscore_ring_dtype=ring_dtype,
+        zscore_onepass=onepass,
     )
 
 
@@ -461,7 +475,7 @@ class PipelineDriver:
         for i, spec in enumerate(self.cfg.lags):
             zc = dzscore.ZScoreConfig(
                 self.cfg.capacity, spec.lag, self.cfg.stats.dtype, spec.robust,
-                self.cfg.zscore_ring_dtype,
+                self.cfg.zscore_ring_dtype, self.cfg.zscore_onepass,
             )
             zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
             zstates.append(zs)

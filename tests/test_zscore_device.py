@@ -460,3 +460,149 @@ def test_bf16_ring_window_sharded_matches_single_chip():
         np.nan_to_num(np.asarray(state_s.values.astype(jnp.float32))),
         np.nan_to_num(np.asarray(state_w.values.astype(jnp.float32))),
     )
+
+
+# -------------------------------------------------------- one-pass var ----
+
+def test_onepass_f64_guard_pins_twopass():
+    """onepass_var is IGNORED in f64 parity mode: bit-identical outputs to
+    the two-pass config on the same stream."""
+    rng = np.random.RandomState(41)
+    series = list(300 + 40 * rng.rand(60))
+    series[50] = 4000.0
+    outs = {}
+    for onepass in (False, True):
+        cfg = dz.ZScoreConfig(capacity=2, lag=12, dtype=jnp.float64, onepass_var=onepass)
+        state = dz.init_state(cfg)
+        step = jax.jit(dz.step, static_argnums=1)
+        thr = jnp.full(2, 3.0, jnp.float64)
+        infl = jnp.full(2, 0.2, jnp.float64)
+        out = []
+        for x in series:
+            nv = np.full((2, 3), np.nan)
+            nv[0] = (x, x + 1, x + 2)
+            res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+            out.append(np.nan_to_num(np.asarray(res.upper_bound)))
+        outs[onepass] = out
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_onepass_var_f32_matches_oracle_loose():
+    """The one-pass branch itself (f32) against the float64 golden oracle:
+    bounds within f32-appropriate tolerance, signals identical on
+    clear-margin anomalies, including across a NaN data gap."""
+    rng = np.random.RandomState(41)
+    series = list(300 + 40 * rng.rand(80))
+    series[40] = float("nan")  # data gap: the anchor must survive it
+    series[50] = 4000.0
+    golden = GoldenZScore(12, 3.0, 0.2)
+    cfg = dz.ZScoreConfig(capacity=2, lag=12, dtype=jnp.float32, onepass_var=True)
+    state = dz.init_state(cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    thr = jnp.full(2, 3.0, jnp.float32)
+    infl = jnp.full(2, 0.2, jnp.float32)
+    for t, x in enumerate(series):
+        nv = np.full((2, 3), np.nan, np.float32)
+        nv[0] = (x, x + 1, x + 2)
+        g = golden.step("s", "svc", x, x + 1, x + 2)["avg"]
+        res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+        got = float(res.upper_bound[0, 0])
+        if math.isnan(g["ub"]):
+            assert math.isnan(got), t
+        else:
+            assert g["ub"] == pytest.approx(got, rel=5e-4), t
+        assert g["signal"] == int(res.signal[0, 0]), f"t={t}"
+
+
+def test_onepass_var_survives_nan_gap_at_large_magnitude():
+    """Regression for the anchor=0 cancellation bug: large-magnitude values
+    (~1e6) with a NaN push right before a genuine spike — the one-pass
+    variance must stay sane (a zero anchor computes var as a huge negative,
+    clamps to 0, and silently suppresses the signal)."""
+    rng = np.random.RandomState(7)
+    base = 1_000_000.0
+    series = list(base + 2000 * rng.rand(30))
+    series += [float("nan")]          # the gap: last pushed value becomes NaN
+    series += [base + 60_000.0]       # clear spike (~30 sigma) right after
+    cfg = dz.ZScoreConfig(capacity=1, lag=16, dtype=jnp.float32, onepass_var=True)
+    state = dz.init_state(cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    thr = jnp.full(1, 3.0, jnp.float32)
+    infl = jnp.full(1, 1.0, jnp.float32)
+    res = None
+    for x in series:
+        nv = np.full((1, 3), x, np.float32)
+        res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+    assert int(res.signal[0, 0]) == 1, "spike after a data gap must still signal"
+    assert not math.isnan(float(res.upper_bound[0, 0]))
+
+
+def test_onepass_var_f32_approximates_twopass():
+    """f32: one-pass bounds/avg within 1e-4 relative of two-pass; signals
+    identical on clear-margin anomalies; the all-equal zero-variance quirk
+    stays EXACT."""
+    rng = np.random.RandomState(43)
+    series = list(500 + 60 * rng.rand(60))
+    series[45] = 9000.0  # unambiguous spike
+    results = {}
+    for onepass in (False, True):
+        cfg = dz.ZScoreConfig(capacity=2, lag=16, dtype=jnp.float32, onepass_var=onepass)
+        state = dz.init_state(cfg)
+        step = jax.jit(dz.step, static_argnums=1)
+        thr = jnp.full(2, 3.0, jnp.float32)
+        infl = jnp.full(2, 0.2, jnp.float32)
+        out = []
+        for x in series:
+            nv = np.full((2, 3), np.nan, np.float32)
+            nv[0] = (x, x + 1, x + 2)
+            res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+            out.append(res)
+        results[onepass] = out
+    for t in range(len(series)):
+        a, b = results[False][t], results[True][t]
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(a.window_avg)), np.nan_to_num(np.asarray(b.window_avg)),
+            rtol=1e-4, atol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.nan_to_num(np.asarray(a.upper_bound)), np.nan_to_num(np.asarray(b.upper_bound)),
+            rtol=1e-3, atol=1e-2,
+        )
+        np.testing.assert_array_equal(np.asarray(a.signal), np.asarray(b.signal))
+
+
+def test_onepass_var_all_equal_exact():
+    cfg = dz.ZScoreConfig(capacity=1, lag=8, dtype=jnp.float32, onepass_var=True)
+    state = dz.init_state(cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    thr = jnp.full(1, 1.0, jnp.float32)
+    infl = jnp.full(1, 1.0, jnp.float32)
+    res = None
+    for x in [333.3] * 12 + [900.0]:
+        nv = np.full((1, 3), x, np.float32)
+        res, state = step(state, cfg, jnp.asarray(nv), thr, infl)
+    assert int(res.signal[0, 0]) == 0  # zero-variance quirk held exactly
+    assert math.isnan(float(res.upper_bound[0, 0]))
+
+
+def test_variance_pass_config_flow():
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import build_engine_config
+
+    tree = default_config()
+    assert build_engine_config(tree, 8).zscore_onepass  # auto
+    tree["tpuEngine"]["zscoreVariancePass"] = "two"
+    assert not build_engine_config(tree, 8).zscore_onepass
+    tree["tpuEngine"]["zscoreVariancePass"] = "bogus"
+    with pytest.raises(ValueError, match="zscoreVariancePass"):
+        build_engine_config(tree, 8)
+
+
+def test_onepass_window_sharding_refused():
+    from apmbackend_tpu.parallel import make_mesh2d, make_window_sharded_step
+
+    mesh = make_mesh2d(1, 2)
+    cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, onepass_var=True)
+    with pytest.raises(NotImplementedError, match="one-pass"):
+        make_window_sharded_step(mesh, cfg)
