@@ -30,8 +30,7 @@ import sys
 import time
 
 from ..config import default_config, load_config
-
-CONFIG_ENV_VAR = "APM_CONFIG_PATH"
+from ..runtime.module_base import CONFIG_ENV_VAR  # the env var every tool honors
 
 
 def _load(path: str | None) -> dict:
