@@ -524,3 +524,35 @@ class TestFeedCsvBytesParity:
         assert n == 20
         assert drv.cfg.capacity >= 20
         assert len(drv.registry.rows()) == 20
+
+    def test_non_ascii_blob_backlog_and_keys(self):
+        """UTF-8 service names: the ordered-CSV backlog takes the per-line
+        decode fallback (blob.isascii() False) and decoder key interning is
+        byte-faithful — emissions still match the numpy path."""
+        from apmbackend_tpu.pipeline import PipelineDriver
+
+        if ensure_built() is None:
+            pytest.skip("no native toolchain")
+        base = 170_000_000
+        svc = "svcĀéè"  # multi-byte UTF-8
+        lines = [
+            f"tx|jvmÜ|{svc}|l{i}|1|{(base + i // 50) * 10000 - 5}|"
+            f"{(base + i // 50) * 10000 + i}|{50 + i}|Y"
+            for i in range(150)
+        ]
+        outs = {}
+        for native in (False, True):
+            got = []
+            drv = PipelineDriver(
+                self._mkcfg(native), micro_batch_size=64,
+                on_fullstat_csv=lambda ls: got.extend(ls),
+                on_ordered_csv=lambda line: got.append(line),
+            )
+            if native:
+                drv.feed_csv_bytes("\n".join(lines).encode("utf-8"))
+                assert drv._native_dec is not None
+            else:
+                drv.feed_csv_batch(lines)
+            outs[native] = got
+            assert ("jvmÜ", svc) in drv.registry.rows()
+        assert outs[False] == outs[True]
