@@ -24,8 +24,21 @@ import numpy as np
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding
 
-from ..pipeline import EngineConfig, EngineState
+from ..ops import zscore as dzscore
+from ..pipeline import EngineConfig, EngineState, engine_derive_aggs
 from .sharded import _state_specs
+
+
+def _strip_agg(state: EngineState) -> EngineState:
+    """Drop the sliding aggregates (derived state, ops/zscore.py SlidingAgg)
+    from a state pytree. Checkpoints save the stripped tree so snapshots are
+    variance-mode independent: sliding and ring-pass configs restore each
+    other's checkpoints, and pre-sliding snapshots keep restoring 1:1.
+    Restore re-derives via pipeline.engine_derive_aggs (the same helper the
+    npz load_resume path uses)."""
+    return state._replace(
+        zscores=tuple(z._replace(agg=None) for z in state.zscores)
+    )
 
 
 def _shape_signature(cfg: EngineConfig) -> dict:
@@ -77,7 +90,7 @@ class ShardedCheckpointer:
         self.manager.save(
             step,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state._asdict()),
+                state=ocp.args.StandardSave(_strip_agg(state)._asdict()),
                 meta=ocp.args.JsonSave(meta),
             ),
         )
@@ -108,24 +121,72 @@ class ShardedCheckpointer:
                     continue
             except Exception:
                 continue
-            try:
-                restored = self.manager.restore(
-                    step,
-                    args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(template._asdict())
-                    ),
-                )["state"]
-                state = EngineState(**restored)
-            except Exception:
-                # pre-Holt snapshots lack the EwmaState.trend leaf; a
-                # structure mismatch must not silently discard the learned
-                # baselines (the npz load_resume path zero-fills the same way)
-                state = self._restore_without_trend(step, template, cfg)
+            state = self._try_restore(step, template)
+            if state is None:
+                # legacy snapshot shapes must not silently discard the
+                # learned baselines (the npz load_resume path migrates the
+                # same ways): pre-global-cursor snapshots carry per-row
+                # z-score cursors (pos [S]); pre-Holt snapshots additionally
+                # lack the EwmaState.trend leaf. Try each downgrade in turn
+                # (current-template without-trend, then the legacy-pos pair).
+                # Migration failures fall through to older retained steps —
+                # the never-crashes contract above covers them too.
+                try:
+                    state = self._restore_without_trend(step, template, cfg)
+                    if state is None:
+                        legacy_tmpl = self._legacy_pos_template(template)
+                        state = self._try_restore(step, legacy_tmpl)
+                        if state is None:
+                            state = self._restore_without_trend(step, legacy_tmpl, cfg)
+                        if state is not None:
+                            state = self._migrate_per_row_cursors(state, template, cfg)
+                except Exception:
+                    state = None
                 if state is None:
                     continue
             registry = tuple(tuple(k.split("\x00", 1)) for k in meta["registry"])
-            return state, registry, step
+            return engine_derive_aggs(state, cfg), registry, step
         return None
+
+    def _try_restore(self, step: int, template: EngineState) -> Optional[EngineState]:
+        try:
+            restored = self.manager.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template._asdict())
+                ),
+            )["state"]
+            return EngineState(**restored)
+        except Exception:
+            return None
+
+    @staticmethod
+    def _legacy_pos_template(template: EngineState) -> EngineState:
+        """Template for pre-global-cursor snapshots: z-score pos was a
+        per-row [S] int32 — exactly the shape/dtype/sharding of fill."""
+        return template._replace(
+            zscores=tuple(z._replace(pos=z.fill) for z in template.zscores)
+        )
+
+    @staticmethod
+    def _migrate_per_row_cursors(
+        state: EngineState, template: EngineState, cfg: EngineConfig
+    ) -> EngineState:
+        """Rotate each row's ring onto the shared global cursor (see
+        dzscore.normalize_legacy_ring) and collapse pos to the scalar 0.
+        Host-side numpy — a one-time migration cost at restore."""
+        zs = []
+        for z, tz, spec in zip(state.zscores, template.zscores, cfg.lags):
+            values = dzscore.normalize_legacy_ring(
+                np.asarray(z.values), np.asarray(z.fill), np.asarray(z.pos), spec.lag
+            )
+            zs.append(
+                z._replace(
+                    values=jax.device_put(values, tz.values.sharding),
+                    pos=jax.device_put(np.zeros((), np.int32), tz.pos.sharding),
+                )
+            )
+        return state._replace(zscores=tuple(zs))
 
     def _restore_without_trend(
         self, step: int, template: EngineState, cfg: EngineConfig
@@ -171,7 +232,7 @@ def _template_state(cfg: EngineConfig, mesh: Optional[Mesh]) -> EngineState:
     allocation: eval_shape)."""
     from ..pipeline import engine_init
 
-    abstract = jax.eval_shape(lambda: engine_init(cfg))
+    abstract = _strip_agg(jax.eval_shape(lambda: engine_init(cfg)))
     leaves, treedef = jax.tree_util.tree_flatten(abstract)
     if mesh is None:
         # explicit single-device placement: without it orbax re-applies the
@@ -188,7 +249,7 @@ def _template_state(cfg: EngineConfig, mesh: Optional[Mesh]) -> EngineState:
 
     # pair each abstract leaf with its PartitionSpec; specs' P nodes are
     # tuples (sub-pytrees), so flatten them up to the state's structure
-    spec_leaves = treedef.flatten_up_to(_state_specs(cfg))
+    spec_leaves = treedef.flatten_up_to(_strip_agg(_state_specs(cfg)))
     out = [
         jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=NamedSharding(mesh, spec))
         for x, spec in zip(leaves, spec_leaves)

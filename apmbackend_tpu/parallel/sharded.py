@@ -34,7 +34,7 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..ops.ewma import EwmaState
 from ..ops.stats import StatsState
-from ..ops.zscore import ZScoreState
+from ..ops.zscore import SlidingAgg, ZScoreState
 from ..pipeline import (
     EngineConfig,
     EngineParams,
@@ -42,7 +42,9 @@ from ..pipeline import (
     LagEmission,
     TickEmission,
     engine_ingest,
+    engine_rebuild_aggs,
     engine_tick,
+    zscore_cfg,
 )
 from .mesh import SERVICE_AXIS
 
@@ -87,10 +89,25 @@ def _local_tick_with_rollup(cfg: EngineConfig):
 _ROW = P(SERVICE_AXIS)
 
 
+def _zstate_spec(cfg: EngineConfig, spec) -> ZScoreState:
+    # sliding aggregates are all per-row ([S, 3]); the pytree spec must
+    # mirror what zscore.init_state builds for this lag or shard_map rejects
+    # the state
+    agg = (
+        SlidingAgg(
+            cnt=_ROW, vsum=_ROW, vsumsq=_ROW, anchor=_ROW,
+            run_len=_ROW, last_valid=_ROW, last_push=_ROW,
+        )
+        if zscore_cfg(cfg, spec).sliding_active
+        else None
+    )
+    return ZScoreState(values=_ROW, fill=_ROW, pos=P(), agg=agg)  # pos: global scalar
+
+
 def _state_specs(cfg: EngineConfig) -> EngineState:
     return EngineState(
         stats=StatsState(latest_bucket=P(), counts=_ROW, sums=_ROW, samples=_ROW, nsamples=_ROW),
-        zscores=tuple(ZScoreState(values=_ROW, fill=_ROW, pos=_ROW) for _ in cfg.lags),
+        zscores=tuple(_zstate_spec(cfg, spec) for spec in cfg.lags),
         alert_counters=tuple(_ROW for _ in cfg.lags),
         ewmas=tuple(
             EwmaState(mean=_ROW, var=_ROW, count=_ROW, trend=_ROW) for _ in cfg.ewma
@@ -141,6 +158,25 @@ def make_sharded_tick(mesh: Mesh, cfg: EngineConfig):
     )
     # donate the state: without it every tick copies the [S, NB, CAP] sample
     # buffers (the dominant HBM traffic); callers always rebind state
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):
+    """jit(shard_map(engine_rebuild_aggs)): the pod-scale counterpart of the
+    host-counted periodic exact rebuild of the sliding z-score aggregates
+    (pipeline.engine_rebuild_aggs — drift cancellation + anchor refresh).
+    Every sharded tick loop owes a call each cfg.zscore_rebuild_every ticks,
+    exactly like PipelineDriver's single-chip loop. Purely shard-local (the
+    aggregates are per-row), so no collectives ride the rebuild."""
+    n = mesh.devices.size
+    lcfg = local_config(cfg, n)
+
+    mapped = shard_map(
+        lambda state: engine_rebuild_aggs(state, lcfg),
+        mesh=mesh,
+        in_specs=(_state_specs(cfg),),
+        out_specs=_state_specs(cfg),
+    )
     return jax.jit(mapped, donate_argnums=(0,))
 
 

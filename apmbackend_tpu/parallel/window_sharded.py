@@ -77,7 +77,7 @@ def shard_zstate(state: ZScoreState, mesh: Mesh) -> ZScoreState:
     return ZScoreState(
         values=jax.device_put(state.values, NamedSharding(mesh, P(SERVICE_AXIS, None, WINDOW_AXIS))),
         fill=jax.device_put(state.fill, NamedSharding(mesh, P(SERVICE_AXIS))),
-        pos=jax.device_put(state.pos, NamedSharding(mesh, P(SERVICE_AXIS))),
+        pos=jax.device_put(state.pos, NamedSharding(mesh, P())),  # global scalar cursor
     )
 
 
@@ -124,35 +124,33 @@ def _local_step(cfg: ZScoreConfig, n_window_shards: int):
         exceeds = has_std & new_ok & (jnp.abs(new_values - mean) > thr * std)
         signal = jnp.where(exceeds, jnp.where(new_values > mean, 1, -1), 0).astype(jnp.int32)
 
-        # last pushed value lives on exactly one window shard: masked psum
-        last_idx = jnp.where(full, (pos - 1) % L, jnp.maximum(fill - 1, 0))  # [S] global
-        owner = (last_idx // L_loc) == widx  # [S]
-        lidx = last_idx % L_loc
-        lv = jnp.take_along_axis(
-            vals, lidx[:, None, None].repeat(N_METRICS, 1), axis=-1
-        )[..., 0]  # [S, 3]
+        # last pushed value lives on exactly one window shard (the GLOBAL
+        # scalar cursor means the same slot for every row): masked psum
+        last_idx = (pos - 1) % L  # [] global slot
+        owner = (last_idx // L_loc) == widx  # [] bool: this shard holds it
+        lv = jax.lax.dynamic_slice_in_dim(vals, last_idx % L_loc, 1, axis=2)[..., 0]
         lv_nan = jnp.isnan(lv)
         last_val = jax.lax.psum(
-            jnp.where(owner[:, None] & ~lv_nan, lv, 0), WINDOW_AXIS
+            jnp.where(owner & ~lv_nan, lv, 0), WINDOW_AXIS
         )
         last_nan = (
-            jax.lax.psum(jnp.where(owner[:, None], lv_nan.astype(jnp.int32), 0), WINDOW_AXIS) > 0
+            jax.lax.psum(jnp.where(owner, lv_nan.astype(jnp.int32), 0), WINDOW_AXIS) > 0
         )
         can_damp = exceeds & ~last_nan & (fill > 0)[:, None]
         infl = influence[:, None]
         pushed = jnp.where(can_damp, infl * new_values + (1 - infl) * last_val, new_values)
 
-        # ring write: one owner shard stores; everyone advances counters.
+        # ring write: the owner shard stores, everyone else writes its slot's
+        # current content back — the write stays ONE contiguous in-place
+        # dynamic_update_slice on every shard (never a whole-ring select).
         # Write against the RAW ring so storage bits round-trip exactly.
-        wglobal = jnp.where(full, pos, fill)  # [S]
-        owner_w = (wglobal // L_loc) == widx
-        lw = wglobal % L_loc
-        written = jax.vmap(lambda v, i, p: v.at[:, i].set(p))(
-            raw, lw, pushed.astype(raw.dtype)
-        )
-        new_vals = jnp.where(owner_w[:, None, None], written, raw)
+        owner_w = (pos // L_loc) == widx  # [] bool
+        lw = pos % L_loc
+        cur = jax.lax.dynamic_slice_in_dim(raw, lw, 1, axis=2)[..., 0]
+        store = jnp.where(owner_w, pushed.astype(raw.dtype), cur)
+        new_vals = jax.lax.dynamic_update_slice_in_dim(raw, store[:, :, None], lw, axis=2)
         new_fill = jnp.minimum(fill + 1, L)
-        new_pos = jnp.where(full, (pos + 1) % L, pos)
+        new_pos = (pos + 1) % L
 
         result = ZScoreResult(
             window_avg=mean.astype(cfg.dtype),
@@ -182,6 +180,19 @@ def make_window_sharded_step(mesh: Mesh, cfg: ZScoreConfig):
             "robust (median/MAD) z-score is not supported with window-axis "
             "sharding; use service-axis sharding for robust lags"
         )
+    if cfg.sliding_active:
+        # the O(1) sliding aggregates make the per-tick window read vanish
+        # entirely on a single chip, which removes THIS module's reason to
+        # exist for most deployments (window sharding only still pays when
+        # the ring itself exceeds one chip's HBM). The sharded step keeps
+        # the exact collective two-pass; refuse the flag combination rather
+        # than silently diverging from what the config asked for.
+        raise NotImplementedError(
+            "sliding aggregates are not implemented for window-axis "
+            "sharding; set tpuEngine.zscoreVariancePass='two' for "
+            "window-sharded lags (or drop window sharding — the sliding "
+            "step no longer reads the window per tick)"
+        )
     if cfg.onepass_var and cfg.dtype != jnp.float64:
         # this path computes the exact two-pass variance collectively;
         # silently ignoring the flag would let sharded and single-chip
@@ -199,7 +210,7 @@ def make_window_sharded_step(mesh: Mesh, cfg: ZScoreConfig):
     state_spec = ZScoreState(
         values=P(SERVICE_AXIS, None, WINDOW_AXIS),
         fill=P(SERVICE_AXIS),
-        pos=P(SERVICE_AXIS),
+        pos=P(),
     )
     row2 = P(SERVICE_AXIS, None)
     row = P(SERVICE_AXIS)

@@ -66,6 +66,11 @@ class EngineConfig(NamedTuple):
     # one-pass shifted variance (ops/zscore.py onepass_var); f64 parity mode
     # always keeps the exact two-pass regardless
     zscore_onepass: bool = True
+    # O(1)-per-tick incremental window aggregates (ops/zscore.py sliding;
+    # module docstring there). Takes precedence over onepass; inert for f64
+    # parity mode and robust lags. Production default: ON.
+    zscore_sliding: bool = True
+    zscore_rebuild_every: int = 64
 
     @property
     def capacity(self) -> int:
@@ -116,18 +121,23 @@ class TickEmission(NamedTuple):
     ewma: Tuple[LagEmission, ...] = ()  # one per EWMA/seasonal channel
 
 
+def zscore_cfg(cfg: EngineConfig, spec: LagSpec) -> dzscore.ZScoreConfig:
+    """The ONE place an EngineConfig lag becomes a ZScoreConfig (init, tick,
+    grow, restore and the sharded spec builders all route through here so the
+    variance-mode/state-shape decision cannot drift between them)."""
+    return dzscore.ZScoreConfig(
+        cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust,
+        cfg.zscore_ring_dtype, cfg.zscore_onepass,
+        cfg.zscore_sliding, cfg.zscore_rebuild_every,
+    )
+
+
 def engine_init(cfg: EngineConfig) -> EngineState:
     S = cfg.capacity
     return EngineState(
         stats=dstats.init_state(cfg.stats),
         zscores=tuple(
-            dzscore.init_state(
-                dzscore.ZScoreConfig(
-                    S, spec.lag, cfg.stats.dtype, spec.robust,
-                    cfg.zscore_ring_dtype, cfg.zscore_onepass,
-                )
-            )
-            for spec in cfg.lags
+            dzscore.init_state(zscore_cfg(cfg, spec)) for spec in cfg.lags
         ),
         alert_counters=tuple(jnp.zeros((S,), jnp.int32) for _ in cfg.lags),
         ewmas=tuple(dewma.init_state(S, spec, cfg.stats.dtype) for spec in cfg.ewma),
@@ -135,10 +145,21 @@ def engine_init(cfg: EngineConfig) -> EngineState:
     )
 
 
-def engine_tick(
-    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams
-) -> Tuple[TickEmission, EngineState]:
-    """The fused per-interval step — the flagship jittable function."""
+def _engine_tick_impl(
+    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams,
+    evicted: Optional[Tuple[jnp.ndarray, ...]],
+) -> Tuple[TickEmission, EngineState, Tuple[jnp.ndarray, ...]]:
+    """Shared fused-tick body. ``evicted`` selects the execution shape:
+
+    - None: single-program mode — sliding lags compose their ring read and
+      write inside this program (dzscore.step). Used by shard_map and the
+      compile-check entry; pays one ring copy per sliding lag on XLA:CPU.
+    - tuple of [S, 3] slices (one per sliding lag, in lag order): STAGED
+      mode — sliding lags run ring-free (dzscore.step_core) and this
+      function returns their pushes; the caller owes the ring_write
+      dispatches (make_engine_step wires the three programs together so the
+      big rings are only ever touched by an in-place dynamic_update_slice).
+    """
     res, stats_state = dstats.tick(state.stats, cfg.stats, new_label)
 
     if cfg.quantize:
@@ -154,15 +175,24 @@ def engine_tick(
     lag_emissions = []
     new_zstates = []
     new_counters = []
+    pushes = []
     for i, spec in enumerate(cfg.lags):
-        zcfg = dzscore.ZScoreConfig(
-            cfg.capacity, spec.lag, cfg.stats.dtype, spec.robust,
-            cfg.zscore_ring_dtype, cfg.zscore_onepass,
-        )
-        zres, zstate = dzscore.step(
-            state.zscores[i], zcfg, new_values,
-            params.thresholds[i], params.influences[i], params.active,
-        )
+        zcfg = zscore_cfg(cfg, spec)
+        if evicted is not None and zcfg.sliding_active:
+            act = params.active
+            if act is None:
+                act = jnp.ones((cfg.capacity,), bool)
+            zres, zstate, push = dzscore.step_core(
+                state.zscores[i], zcfg, new_values,
+                params.thresholds[i], params.influences[i], act,
+                evicted[len(pushes)],
+            )
+            pushes.append(push)
+        else:
+            zres, zstate = dzscore.step(
+                state.zscores[i], zcfg, new_values,
+                params.thresholds[i], params.influences[i], params.active,
+            )
         ares = dalerts.eval_rules(
             state.alert_counters[i],
             cfg.alert_rules[i],
@@ -213,16 +243,123 @@ def engine_tick(
         tpm, new_values, res.count, res.overflowed,
         tuple(lag_emissions), tuple(ewma_emissions),
     )
-    return emission, EngineState(
+    new_state = EngineState(
         stats_state, tuple(new_zstates), tuple(new_counters),
         tuple(new_estates), tuple(new_ecounters),
     )
+    return emission, new_state, tuple(pushes)
+
+
+def engine_tick(
+    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams
+) -> Tuple[TickEmission, EngineState]:
+    """The fused per-interval step — the flagship jittable function
+    (single-program form; latency-critical hosts use make_engine_step)."""
+    emission, new_state, _ = _engine_tick_impl(state, cfg, new_label, params, None)
+    return emission, new_state
+
+
+def engine_core_tick(
+    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams,
+    evicted: Tuple[jnp.ndarray, ...],
+) -> Tuple[TickEmission, EngineState, Tuple[jnp.ndarray, ...]]:
+    """Ring-free fused tick (staged mode; see _engine_tick_impl)."""
+    return _engine_tick_impl(state, cfg, new_label, params, evicted)
+
+
+def make_engine_step(cfg: EngineConfig):
+    """The staged per-tick executor: ``step(state, new_label, params) ->
+    (emission, new_state)`` with donation throughout.
+
+    Three dispatches when any lag runs sliding aggregates:
+      1. evict-read: one program slicing every sliding ring's about-to-be-
+         overwritten slot (read-only — the rings must NOT be donated here),
+      2. core tick: everything else, rings passed through as identity
+         (donated, so per-row state updates in place),
+      3. ring-write: one program of pure dynamic_update_slices (donated —
+         the ONLY writer of the big rings, so XLA keeps them in place; any
+         same-program read would force a whole-ring copy on XLA:CPU,
+         measured 736 ms vs 0.6 ms at [8192, 3, 8640]).
+    Collapses to plain jitted engine_tick when no lag is sliding."""
+    sliding_idx = tuple(
+        i for i, spec in enumerate(cfg.lags) if zscore_cfg(cfg, spec).sliding_active
+    )
+    if not sliding_idx:
+        tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+
+        def step_plain(state, new_label, params):
+            return tick(state, cfg, new_label, params)
+
+        return step_plain
+
+    evict = jax.jit(
+        lambda rings, cursors: tuple(
+            dzscore.ring_evict_read(r, g) for r, g in zip(rings, cursors)
+        )
+    )
+    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
+    # write slot = the cursor BEFORE the core advanced it = new_pos - 1
+    write = jax.jit(
+        lambda rings, pushes, new_cursors: tuple(
+            dzscore.ring_write(r, p, (g - 1) % r.shape[-1])
+            for r, p, g in zip(rings, pushes, new_cursors)
+        ),
+        donate_argnums=(0,),
+    )
+
+    def step(state, new_label, params):
+        rings = tuple(state.zscores[i].values for i in sliding_idx)
+        cursors = tuple(state.zscores[i].pos for i in sliding_idx)
+        evicted = evict(rings, cursors)
+        emission, state2, pushes = core(state, cfg, new_label, params, evicted)
+        # the core aliased the rings through untouched; write in place
+        rings2 = tuple(state2.zscores[i].values for i in sliding_idx)
+        new_cursors = tuple(state2.zscores[i].pos for i in sliding_idx)
+        new_rings = write(rings2, pushes, new_cursors)
+        zs = list(state2.zscores)
+        for i, ring in zip(sliding_idx, new_rings):
+            zs[i] = zs[i]._replace(values=ring)
+        return emission, state2._replace(zscores=tuple(zs))
+
+    return step
 
 
 def engine_ingest(state: EngineState, cfg: EngineConfig, rows, labels, elapsed, valid) -> EngineState:
     return state._replace(
         stats=dstats.ingest(state.stats, cfg.stats, rows, labels, elapsed, valid)
     )
+
+
+def engine_rebuild_aggs(state: EngineState, cfg: EngineConfig) -> EngineState:
+    """Amortized exact rebuild of every sliding lag's running aggregates.
+
+    Host loops (PipelineDriver, bench) call this every
+    ``cfg.zscore_rebuild_every`` ticks; jittable and donation-friendly. A
+    no-op (identity pytree) when no lag runs sliding."""
+    zstates = tuple(
+        dzscore.rebuild_agg_state(z, zscore_cfg(cfg, spec))
+        for z, spec in zip(state.zscores, cfg.lags)
+    )
+    return state._replace(zscores=zstates)
+
+
+def engine_needs_rebuild(cfg: EngineConfig) -> bool:
+    """True when any lag maintains sliding aggregates (the host loop then
+    owes a periodic engine_rebuild_aggs call)."""
+    return any(zscore_cfg(cfg, spec).sliding_active for spec in cfg.lags)
+
+
+def engine_derive_aggs(state: EngineState, cfg: EngineConfig) -> EngineState:
+    """Derive the sliding aggregates from freshly-restored rings — the ONE
+    restore-time derivation, shared by the npz load_resume and the orbax
+    checkpoint restore (the aggregates are never serialized; SlidingAgg
+    docstring)."""
+    zstates = []
+    for z, spec in zip(state.zscores, cfg.lags):
+        zc = zscore_cfg(cfg, spec)
+        agg = dzscore.build_agg(z.values, zc, z.pos) if zc.sliding_active else None
+        zstates.append(z._replace(agg=agg))
+    return state._replace(zscores=tuple(zstates))
 
 
 def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> EngineConfig:
@@ -281,17 +418,20 @@ def build_engine_config(apm_config: dict, capacity: Optional[int] = None) -> Eng
     ewma_specs = dewma.specs_from_config(eng)
     ewma_rules = tuple(rule_for(spec.suppressed) for spec in ewma_specs)
     vp = str(eng.get("zscoreVariancePass", "auto"))
-    if vp not in ("auto", "one", "two"):
+    if vp not in ("auto", "sliding", "one", "two"):
         raise ValueError(
-            f"tpuEngine.zscoreVariancePass must be auto|one|two, got {vp!r}"
+            f"tpuEngine.zscoreVariancePass must be auto|sliding|one|two, got {vp!r}"
         )
-    # "auto" = one-pass for f32 production (ops/zscore.py itself pins f64
-    # parity mode to the exact two-pass regardless of this flag)
+    # "auto" = sliding O(1) aggregates for f32 production (ops/zscore.py pins
+    # f64 parity mode and robust lags to the full-window computation
+    # regardless of this flag); "one"/"two" force the ring-pass variants
+    sliding = vp in ("auto", "sliding")
     onepass = vp != "two"
     return EngineConfig(
         stats=stats_cfg, lags=lags, alert_rules=rules, quantize=True,
         ewma=ewma_specs, ewma_rules=ewma_rules, zscore_ring_dtype=ring_dtype,
-        zscore_onepass=onepass,
+        zscore_onepass=onepass, zscore_sliding=sliding,
+        zscore_rebuild_every=int(eng.get("zscoreRebuildEvery", 64)),
     )
 
 
@@ -426,8 +566,11 @@ class PipelineDriver:
         self._refresh_params()
         # jax.jit memoizes per static EngineConfig, so growth (a new cfg)
         # recompiles automatically through these two callables
-        self._tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+        self._step = make_engine_step(self.cfg)
         self._ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+        self._rebuild = jax.jit(engine_rebuild_aggs, static_argnums=1, donate_argnums=(0,))
+        self._needs_rebuild = engine_needs_rebuild(self.cfg)
+        self._ticks_since_rebuild = 0
 
     # -- params / growth -----------------------------------------------------
     def _refresh_params(self) -> None:
@@ -473,11 +616,9 @@ class PipelineDriver:
         stats_state, stats_cfg = dstats.grow_state(self.state.stats, self.cfg.stats, new_capacity)
         zstates = []
         for i, spec in enumerate(self.cfg.lags):
-            zc = dzscore.ZScoreConfig(
-                self.cfg.capacity, spec.lag, self.cfg.stats.dtype, spec.robust,
-                self.cfg.zscore_ring_dtype, self.cfg.zscore_onepass,
+            zs, _ = dzscore.grow_state(
+                self.state.zscores[i], zscore_cfg(self.cfg, spec), new_capacity
             )
-            zs, _ = dzscore.grow_state(self.state.zscores[i], zc, new_capacity)
             zstates.append(zs)
         pad_n = new_capacity - self.cfg.capacity
         counters = tuple(jnp.pad(c, (0, pad_n)) for c in self.state.alert_counters)
@@ -485,6 +626,8 @@ class PipelineDriver:
         ecounters = tuple(jnp.pad(c, (0, pad_n)) for c in self.state.ewma_counters)
         self.cfg = self.cfg._replace(stats=stats_cfg)
         self.state = EngineState(stats_state, tuple(zstates), counters, estates, ecounters)
+        # the staged step closes over cfg (capacity changed: new programs)
+        self._step = make_engine_step(self.cfg)
         self._refresh_params()
 
     def _row_for(self, server: str, service: str) -> int:
@@ -846,7 +989,14 @@ class PipelineDriver:
             # newly registered services activate (z-score warm-up starts) at
             # the next tick boundary — the reference's per-key list creation
             self._refresh_params()
-        emission, self.state = self._tick(self.state, self.cfg, new_label, self.params)
+        emission, self.state = self._step(self.state, new_label, self.params)
+        # amortized exact rebuild of the sliding z-score aggregates (drift
+        # cancellation; ops/zscore.py rebuild_agg_state). Host-counted so the
+        # jitted tick never has to hold the whole ring in a cond branch.
+        self._ticks_since_rebuild += 1
+        if self._needs_rebuild and self._ticks_since_rebuild >= self.cfg.zscore_rebuild_every:
+            self._ticks_since_rebuild = 0
+            self.state = self._rebuild(self.state, self.cfg)
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
 
         # ordered tx drain to DB (heap pop up to edge timestamp)
@@ -1088,11 +1238,26 @@ class PipelineDriver:
         zstates, counters = [], []
         ring_dtype = self.cfg.zscore_ring_dtype or self.cfg.stats.dtype
         for spec in self.cfg.lags:
+            values_np = pad_rows(data[f"z{spec.lag}_values"])
+            fill_np = pad_rows(data[f"z{spec.lag}_fill"])
+            pos_np = np.asarray(data[f"z{spec.lag}_pos"])
+            if pos_np.ndim == 0:
+                pos = jnp.asarray(pos_np, jnp.int32)
+            else:
+                # legacy snapshot with PER-ROW cursors: rotate each row so
+                # its next-write slot lands on the shared cursor 0 (window
+                # content and eviction order are rotation-invariant, so the
+                # restored engine is bit-equivalent to the legacy layout)
+                values_np = dzscore.normalize_legacy_ring(
+                    values_np, fill_np, pad_rows(pos_np), spec.lag
+                )
+                pos = jnp.zeros((), jnp.int32)
+            values = jnp.asarray(values_np).astype(ring_dtype)
             zstates.append(
                 dzscore.ZScoreState(
-                    values=jnp.asarray(pad_rows(data[f"z{spec.lag}_values"])).astype(ring_dtype),
-                    fill=jnp.asarray(pad_rows(data[f"z{spec.lag}_fill"])),
-                    pos=jnp.asarray(pad_rows(data[f"z{spec.lag}_pos"])),
+                    values=values,
+                    fill=jnp.asarray(fill_np),
+                    pos=pos,
                 )
             )
             counters.append(jnp.asarray(pad_rows(data[f"z{spec.lag}_counters"])))
@@ -1116,8 +1281,14 @@ class PipelineDriver:
                 )
             )
             ecounters.append(jnp.asarray(pad_rows(data[f"{ek}_counters"])))
-        self.state = EngineState(
-            stats_state, tuple(zstates), tuple(counters), tuple(estates), tuple(ecounters)
+        # the sliding aggregates are DERIVED state: rebuilt exactly from the
+        # restored rings, so snapshot schemas are unchanged and pre-sliding
+        # snapshots restore 1:1 (shared derivation: engine_derive_aggs)
+        self.state = engine_derive_aggs(
+            EngineState(
+                stats_state, tuple(zstates), tuple(counters), tuple(estates), tuple(ecounters)
+            ),
+            self.cfg,
         )
         self._latest_label = int(data["latest_bucket"])
         self.heap = MinHeap(lambda tx: tx.end_ts)

@@ -25,6 +25,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     from apmbackend_tpu.parallel import (
         make_mesh,
         make_sharded_ingest,
+        make_sharded_rebuild,
         make_sharded_tick,
         route_batch,
         shard_rows,
@@ -41,6 +42,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     mesh = make_mesh(n_dev)
     tick = make_sharded_tick(mesh, cfg)
     ingest = make_sharded_ingest(mesh, cfg)
+    rebuild = make_sharded_rebuild(mesh, cfg)
     state = shard_rows(state, mesh)
     params = shard_rows(params, mesh)
 
@@ -69,9 +71,14 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     jax.block_until_ready(state.stats.counts)
 
     lat = []
+    since_rebuild = 0
     t_start = time.perf_counter()
     for _ in range(ticks):
         label += 1
+        since_rebuild += 1
+        if since_rebuild >= cfg.zscore_rebuild_every:
+            since_rebuild = 0
+            state = rebuild(state)
         t0 = time.perf_counter()
         em, rollup, state = tick(state, jnp.int32(label), params)
         # fleet view must reach the host: rollup + trigger masks

@@ -34,11 +34,28 @@ REGISTRY = (("srvA", "svc1"), ("srvA", "svc2"), ("srvB", "svc1"))
 
 
 def assert_state_equal(a, b):
-    fa, _ = jax.tree_util.tree_flatten(a)
-    fb, _ = jax.tree_util.tree_flatten(b)
+    """Bit-equality on every PERSISTED leaf. The sliding z-score aggregates
+    are derived state (checkpoint strips them; restore rebuilds from the
+    ring via build_agg), so they are compared semantically: counts exact,
+    sums to fp tolerance (tree-reduce vs incremental summation order), and
+    the restart of the drift clock / conservative run-length are by design."""
+    from apmbackend_tpu.parallel.checkpoint import _strip_agg
+
+    fa, _ = jax.tree_util.tree_flatten(_strip_agg(a))
+    fb, _ = jax.tree_util.tree_flatten(_strip_agg(b))
     assert len(fa) == len(fb)
     for x, y in zip(fa, fb):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for za, zb in zip(a.zscores, b.zscores):
+        assert (za.agg is None) == (zb.agg is None)
+        if za.agg is not None:
+            np.testing.assert_array_equal(np.asarray(za.agg.cnt), np.asarray(zb.agg.cnt))
+            np.testing.assert_allclose(
+                np.asarray(za.agg.vsum), np.asarray(zb.agg.vsum), rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_array_equal(
+                np.asarray(za.agg.last_push), np.asarray(zb.agg.last_push)
+            )
 
 
 def test_roundtrip_unsharded(tmp_path, engine):
@@ -50,6 +67,65 @@ def test_roundtrip_unsharded(tmp_path, engine):
     restored, registry, step = out
     assert step == 7 and registry == REGISTRY
     assert_state_equal(state, restored)
+    ckpt.close()
+
+
+def test_legacy_per_row_cursor_snapshot_migrates(tmp_path, engine):
+    """A pre-global-cursor orbax snapshot (z-score pos saved per-row, [S])
+    restores via the legacy template and the per-row rings are rotated onto
+    the shared cursor bit-exactly (checkpoint._migrate_per_row_cursors)."""
+    import orbax.checkpoint as ocp
+
+    from apmbackend_tpu.parallel.checkpoint import _shape_signature, _strip_agg
+
+    cfg, state, params = engine  # 6 ticks; lags 4 and 8
+    # craft the legacy representation: per-row write slots w_r, rings rotated
+    # so old[k] = new[(k - w) % L] — the inverse of the migration, which must
+    # therefore reproduce `state` exactly. The current global cursor must be
+    # 0 for the comparison, so advance to a lag-multiple tick count first.
+    tick = jax.jit(engine_tick, static_argnums=1)
+    label = 2000
+    for _ in range(8 - 6 % 8):  # engine fixture ran 6 ticks; reach 8 (0 mod 4 and 8)
+        label += 1
+        _, state = tick(state, cfg, label, params)
+    rng = np.random.RandomState(3)
+    legacy_zs = []
+    for z, spec in zip(state.zscores, cfg.lags):
+        assert int(np.asarray(z.pos)) == 0
+        L = spec.lag
+        fill = np.asarray(z.fill)
+        w = np.where(fill >= L, rng.randint(0, L, fill.shape[0]), np.minimum(fill, L - 1))
+        new_vals = np.asarray(z.values)
+        k = np.arange(L)[None, :]
+        old_vals = np.empty_like(new_vals)
+        idx = (k - w[:, None]) % L  # old[k] = new[(k - w) % L]
+        old_vals[:] = np.take_along_axis(new_vals, idx[:, None, :], axis=2)
+        legacy_zs.append(
+            {"values": jnp.asarray(old_vals), "fill": z.fill, "pos": jnp.asarray(w.astype(np.int32)), "agg": None}
+        )
+    legacy_tree = _strip_agg(state)._asdict()
+    legacy_tree["zscores"] = tuple(legacy_zs)
+
+    ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
+    meta = {"signature": _shape_signature(cfg), "registry": ["srvA\x00svc1"]}
+    ckpt.manager.save(
+        3,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardSave(legacy_tree), meta=ocp.args.JsonSave(meta)
+        ),
+    )
+    ckpt.wait()
+    out = ckpt.restore(cfg)
+    assert out is not None, "legacy per-row-cursor snapshot must be restorable"
+    restored, _, step = out
+    assert step == 3
+    for z, rz in zip(state.zscores, restored.zscores):
+        assert np.asarray(rz.pos).ndim == 0 and int(np.asarray(rz.pos)) == 0
+        np.testing.assert_array_equal(np.asarray(z.values), np.asarray(rz.values))
+        np.testing.assert_array_equal(np.asarray(z.fill), np.asarray(rz.fill))
+    # and it steps under the current engine
+    em, _ = tick(restored, cfg, label + 1, params)
+    jax.block_until_ready(em.tpm)
     ckpt.close()
 
 
@@ -178,8 +254,12 @@ def test_pre_holt_snapshot_restores_with_zero_trend(tmp_path):
     assert int(np.asarray(state.ewmas[0].count).sum()) > 0
 
     # write the snapshot the way the pre-Holt build serialized it: the same
-    # _asdict() tree but with 3-field ewma nodes (no 'trend')
-    legacy_tree = state._asdict()
+    # _asdict() tree but with 3-field ewma nodes (no 'trend') and no sliding
+    # aggregates (pre-Holt also predates sliding; the current saver strips
+    # them anyway)
+    from apmbackend_tpu.parallel.checkpoint import _strip_agg
+
+    legacy_tree = _strip_agg(state)._asdict()
     legacy_tree["ewmas"] = tuple(
         {"mean": e.mean, "var": e.var, "count": e.count} for e in state.ewmas
     )

@@ -52,14 +52,19 @@ def drive_both(series, lag, threshold, influence, capacity=4):
                 )
         # advance device state only for rows with entries: emulate by writing
         # back selected rows (the pipeline drives all rows every tick; partial
-        # presence is exercised in test_partial_rows_via_pipeline_semantics)
+        # presence is exercised in test_partial_rows_via_pipeline_semantics).
+        # The cursor is GLOBAL, so a frozen row's ring must rotate forward by
+        # one slot to keep its logical window aligned with the shared cursor
+        # (rotation is content-preserving: newest stays at cursor-1, the
+        # about-to-be-overwritten slot stays the oldest).
         mask = np.zeros(capacity, bool)
         for row in tick_vals:
             mask[row] = True
+        rotated_old = jnp.roll(state.values, 1, axis=-1)
         state = dz.ZScoreState(
-            values=jnp.where(jnp.asarray(mask)[:, None, None], state_new.values, state.values),
+            values=jnp.where(jnp.asarray(mask)[:, None, None], state_new.values, rotated_old),
             fill=jnp.where(jnp.asarray(mask), state_new.fill, state.fill),
-            pos=jnp.where(jnp.asarray(mask), state_new.pos, state.pos),
+            pos=state_new.pos,
         )
     return comparisons
 
@@ -606,3 +611,264 @@ def test_onepass_window_sharding_refused():
     cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, onepass_var=True)
     with pytest.raises(NotImplementedError, match="one-pass"):
         make_window_sharded_step(mesh, cfg)
+
+
+# ---------------------------------------------------------------------------
+# sliding O(1) aggregates (ZScoreConfig.sliding): the production default.
+# Battery strategy: drive the SAME stream through the exact two-pass mode and
+# the sliding mode and demand identical signal decisions (bounds to fp
+# tolerance) through every hazard the incremental path owns: NaN gaps,
+# constant rows (run-length guard), outlier damping, late row activation,
+# periodic rebuilds, drain-to-empty windows, large-magnitude anchoring,
+# build_agg restore, and the staged three-program engine executor.
+# ---------------------------------------------------------------------------
+
+
+def _drive_modes(series, active_from=None, lag=6, thr=3.0, infl=0.3,
+                 rebuild_every=7, capacity=None):
+    """Run series through two-pass and sliding (with host-cadenced rebuilds);
+    returns {mode: [ZScoreResult...]}. ``series``: list of [S, 3] float32
+    (NaN allowed). ``active_from``: per-row first-active tick (None = all
+    active from 0)."""
+    S = series[0].shape[0] if capacity is None else capacity
+    out = {}
+    for mode in ("two", "sliding"):
+        cfg = dz.ZScoreConfig(S, lag, jnp.float32,
+                              sliding=(mode == "sliding"),
+                              rebuild_every=rebuild_every)
+        state = dz.init_state(cfg)
+        step = jax.jit(dz.step, static_argnums=1)
+        rebuild = jax.jit(dz.rebuild_agg_state, static_argnums=1)
+        thr_v = jnp.full(S, thr, jnp.float32)
+        infl_v = jnp.full(S, infl, jnp.float32)
+        res_all = []
+        since = 0
+        for t, vals in enumerate(series):
+            if active_from is None:
+                act = jnp.ones(S, bool)
+            else:
+                act = jnp.asarray(np.asarray(active_from) <= t)
+            r, state = step(state, cfg, jnp.asarray(vals), thr_v, infl_v, act)
+            res_all.append(jax.device_get(r))
+            since += 1
+            if mode == "sliding" and since >= rebuild_every:
+                since = 0
+                state = rebuild(state, cfg)
+        out[mode] = res_all
+    return out
+
+
+def _assert_mode_parity(out, rtol=2e-4, atol=1e-3):
+    n_sig = 0
+    for t, (a, b) in enumerate(zip(out["two"], out["sliding"])):
+        np.testing.assert_array_equal(a.signal, b.signal, err_msg=f"tick {t}")
+        n_sig += int(np.abs(a.signal).sum())
+        for f in ("window_avg", "lower_bound", "upper_bound"):
+            x, y = getattr(a, f), getattr(b, f)
+            np.testing.assert_array_equal(np.isnan(x), np.isnan(y), err_msg=f"tick {t} {f}")
+            ok = ~np.isnan(x)
+            if ok.any():
+                np.testing.assert_allclose(x[ok], y[ok], rtol=rtol, atol=atol,
+                                           err_msg=f"tick {t} {f}")
+    return n_sig
+
+
+def test_sliding_matches_twopass_hazard_stream():
+    """The kitchen-sink stream: noise, NaN gaps, an outlier burst (damping),
+    a row that goes constant, and a late-activated row. Signals must be
+    IDENTICAL to the exact two-pass mode at every tick."""
+    rng = np.random.RandomState(7)
+    S, T = 5, 64
+    series = []
+    for t in range(T):
+        v = (100 + 10 * rng.randn(S, 3)).astype(np.float32)
+        if t % 11 == 3:
+            v[1] = np.nan  # recurring NaN gap
+        if t in (30, 31):
+            v[2] += 500  # outlier burst -> signals + influence damping
+        if t >= 40:
+            v[3] = 250.0  # goes constant: run-length guard takes over
+        series.append(v)
+    out = _drive_modes(series, active_from=[0, 0, 0, 0, 20])  # row 4 activates late
+    n_sig = _assert_mode_parity(out)
+    assert n_sig > 0, "stream must actually exercise signals"
+
+
+def test_sliding_large_magnitude_anchor():
+    """Fresh rows at 1e6 scale with tiny variance: the first-value re-anchor
+    must keep the anchored sums tight (no E[x^2]-mean^2 blowup) AND must not
+    leave a phantom (v0 - 0)^2 term behind (the re-anchor consistency bug:
+    both deltas must use the post-re-anchor value)."""
+    rng = np.random.RandomState(11)
+    series = [(1_000_000 + 2 * rng.randn(2, 3)).astype(np.float32) for _ in range(40)]
+    series[25][0] += 100  # ~50 sigma: must signal
+    # semantic comparison, not per-tick signal parity: with an 8-sample
+    # window the std estimate is +-30% noisy and at 1e6 magnitude the f32
+    # delta quantization (ulp 0.0625 vs sigma 2) legitimately flips
+    # borderline draws between modes. What the anchor bugs break is GROSS:
+    # anchor 0 destroys the variance entirely (catastrophic cancellation);
+    # the phantom-(v0)^2 re-anchor bug inflated std ~60% and silenced the
+    # 50-sigma spike. So: spike fires in sliding mode, and the band WIDTH
+    # (ub - lb = 2*thr*std) tracks two-pass within a few percent.
+    out = _drive_modes(series, lag=8, thr=6.0, rebuild_every=10_000)  # no rebuild help
+    spike = out["sliding"][25]
+    assert int(spike.signal[0, 0]) == 1, "50-sigma spike must signal in sliding mode"
+    for t in range(8, 40):
+        a, b = out["two"][t], out["sliding"][t]
+        wa = np.asarray(a.upper_bound) - np.asarray(a.lower_bound)
+        wb = np.asarray(b.upper_bound) - np.asarray(b.lower_bound)
+        ok = ~(np.isnan(wa) | np.isnan(wb))
+        if ok.any():
+            np.testing.assert_allclose(wb[ok], wa[ok], rtol=0.08,
+                                       err_msg=f"band width diverged at tick {t}")
+
+
+def test_sliding_drain_and_refill():
+    """A window that drains to all-NaN and refills: cnt returns to 0, sums
+    reset exactly, and the re-anchor starts clean."""
+    S, lag = 1, 5
+    series = []
+    series += [np.full((S, 3), 77.0, np.float32) for _ in range(7)]
+    series += [np.full((S, 3), np.nan, np.float32) for _ in range(lag + 2)]  # drain
+    rng = np.random.RandomState(3)
+    series += [(40 + rng.rand(S, 3)).astype(np.float32) for _ in range(12)]  # refill
+    out = _drive_modes(series, lag=lag, rebuild_every=10_000)
+    _assert_mode_parity(out)
+
+
+def test_sliding_constant_then_tiny_deviation_no_signal():
+    """Zero-variance quirk under sliding: after the window becomes all-equal
+    (through >= lag equal pushes), a small deviation must NOT signal (std
+    undefined), exactly like the reference and the two-pass guard."""
+    S, lag = 1, 6
+    rng = np.random.RandomState(5)
+    series = [(90 + 5 * rng.randn(S, 3)).astype(np.float32) for _ in range(10)]
+    series += [np.full((S, 3), 120.0, np.float32) for _ in range(lag + 3)]
+    probe = np.full((S, 3), 120.4, np.float32)  # would signal if std ~ float noise
+    series += [probe]
+    out = _drive_modes(series, lag=lag)
+    _assert_mode_parity(out)
+    assert int(out["sliding"][-1].signal.sum()) == 0
+
+
+def test_sliding_build_agg_restore_parity():
+    """Snapshot the ring mid-stream, rebuild the aggregates via build_agg
+    (the resume path), continue — emissions must match the uninterrupted
+    run (restore conservatism may only delay the all-equal guard, which the
+    continuation here re-establishes before it matters)."""
+    rng = np.random.RandomState(13)
+    S, lag = 3, 6
+    series = [(50 + 6 * rng.randn(S, 3)).astype(np.float32) for _ in range(40)]
+    series[33][1] += 200  # a signal after the restore point
+
+    cfg = dz.ZScoreConfig(S, lag, jnp.float32, sliding=True, rebuild_every=10_000)
+    step = jax.jit(dz.step, static_argnums=1)
+    thr = jnp.full(S, 3.0, jnp.float32)
+    infl = jnp.full(S, 0.3, jnp.float32)
+
+    state = dz.init_state(cfg)
+    base = []
+    for vals in series:
+        r, state = step(state, cfg, jnp.asarray(vals), thr, infl)
+        base.append(jax.device_get(r))
+
+    state = dz.init_state(cfg)
+    for vals in series[:20]:
+        r, state = step(state, cfg, jnp.asarray(vals), thr, infl)
+    # restore: keep only the persisted leaves, rederive the aggregates
+    state = dz.ZScoreState(
+        values=state.values, fill=state.fill, pos=state.pos,
+        agg=dz.build_agg(state.values, cfg, state.pos),
+    )
+    resumed = []
+    for vals in series[20:]:
+        r, state = step(state, cfg, jnp.asarray(vals), thr, infl)
+        resumed.append(jax.device_get(r))
+    for t, (a, b) in enumerate(zip(base[20:], resumed)):
+        np.testing.assert_array_equal(a.signal, b.signal, err_msg=f"tick {20+t}")
+        np.testing.assert_allclose(
+            np.nan_to_num(a.upper_bound), np.nan_to_num(b.upper_bound),
+            rtol=2e-4, atol=1e-3,
+        )
+
+
+def test_sliding_grow_state_continues():
+    cfg = dz.ZScoreConfig(4, 5, jnp.float32, sliding=True)
+    state = dz.init_state(cfg)
+    step = jax.jit(dz.step, static_argnums=1)
+    rng = np.random.RandomState(1)
+    for _ in range(8):
+        v = (10 + rng.rand(4, 3)).astype(np.float32)
+        _, state = step(state, cfg, jnp.asarray(v), jnp.full(4, 3.0), jnp.full(4, 0.2))
+    state, cfg2 = dz.grow_state(state, cfg, 8)
+    assert state.agg.cnt.shape == (8, 3)
+    act = jnp.asarray(np.array([True] * 4 + [False] * 4))
+    r, state = step(state, cfg2, jnp.asarray((10 + rng.rand(8, 3)).astype(np.float32)),
+                    jnp.full(8, 3.0), jnp.full(8, 0.2), act)
+    assert int(np.asarray(state.agg.cnt)[4:].sum()) == 0  # inactive rows untouched
+    assert math.isnan(float(np.asarray(state.agg.last_push)[5, 0]))
+
+
+def test_sliding_f64_parity_mode_inert():
+    cfg = dz.ZScoreConfig(2, 6, jnp.float64, sliding=True)
+    assert not cfg.sliding_active
+    state = dz.init_state(cfg)
+    assert state.agg is None
+
+
+def test_sliding_config_flow():
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import build_engine_config
+
+    tree = default_config()
+    assert build_engine_config(tree, 8).zscore_sliding  # auto -> sliding
+    tree["tpuEngine"]["zscoreVariancePass"] = "sliding"
+    assert build_engine_config(tree, 8).zscore_sliding
+    tree["tpuEngine"]["zscoreVariancePass"] = "one"
+    cfg = build_engine_config(tree, 8)
+    assert not cfg.zscore_sliding and cfg.zscore_onepass
+    tree["tpuEngine"]["zscoreVariancePass"] = "two"
+    cfg = build_engine_config(tree, 8)
+    assert not cfg.zscore_sliding and not cfg.zscore_onepass
+
+
+def test_sliding_window_sharding_refused():
+    from apmbackend_tpu.parallel import make_mesh2d, make_window_sharded_step
+
+    mesh = make_mesh2d(1, 2)
+    cfg = dz.ZScoreConfig(capacity=8, lag=8, dtype=jnp.float32, sliding=True)
+    with pytest.raises(NotImplementedError, match="sliding"):
+        make_window_sharded_step(mesh, cfg)
+
+
+def test_staged_engine_step_matches_single_program():
+    """make_engine_step (three-dispatch staged executor) must be BITWISE
+    identical to the single-program jitted engine_tick — same math, only the
+    program boundaries differ."""
+    from apmbackend_tpu.pipeline import (
+        engine_init, engine_tick, make_demo_engine, make_engine_step,
+    )
+
+    cfg, _, params = make_demo_engine(8, 4, [(4, 3.0, 0.2), (6, 3.0, 0.2)])
+    assert cfg.zscore_sliding
+    state_a = engine_init(cfg)
+    state_b = engine_init(cfg)
+    staged = make_engine_step(cfg)
+    mono = jax.jit(engine_tick, static_argnums=1)
+    label = 170_000_000
+    rng = np.random.RandomState(2)
+    for i in range(10):
+        label += 1
+        em_a, state_a = staged(state_a, label, params)
+        em_b, state_b = mono(state_b, cfg, label, params)
+        for la, lb in zip(em_a.lags, em_b.lags):
+            np.testing.assert_array_equal(np.asarray(la.signal), np.asarray(lb.signal))
+            np.testing.assert_array_equal(
+                np.nan_to_num(np.asarray(la.upper_bound)),
+                np.nan_to_num(np.asarray(lb.upper_bound)),
+            )
+    for za, zb in zip(state_a.zscores, state_b.zscores):
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(za.values)), np.nan_to_num(np.asarray(zb.values))
+        )
+        np.testing.assert_array_equal(np.asarray(za.pos), np.asarray(zb.pos))
