@@ -221,7 +221,11 @@ def ingest(state: StatsState, cfg: StatsConfig, rows, labels, elapsed, valid) ->
 
 
 def _advance(state: StatsState, cfg: StatsConfig, new_label: jnp.ndarray) -> StatsState:
-    """Zero ring slots claimed by labels (old_latest, new_label] and bump latest."""
+    """Zero ring slots claimed by labels (old_latest, new_label] and bump
+    latest. Single-program form: the samples clear is a whole-buffer select
+    (handles any label jump in one shot, but costs a full [S, NB, CAP]
+    rewrite — XLA:CPU also copies it under donation). Latency-critical hosts
+    dispatch :func:`advance_one` per new label instead (make_engine_step)."""
     NB = cfg.num_buckets
     old = state.latest_bucket
     k = jnp.minimum(new_label - old, NB)
@@ -233,6 +237,28 @@ def _advance(state: StatsState, cfg: StatsConfig, new_label: jnp.ndarray) -> Sta
     nsamples = jnp.where(clear[None, :], 0, state.nsamples)
     samples = jnp.where(clear[None, :, None], jnp.nan, state.samples)
     return StatsState(new_label.astype(jnp.int32), counts, sums, samples, nsamples)
+
+
+def advance_one(state: StatsState, cfg: StatsConfig, next_label) -> StatsState:
+    """Advance the ring by EXACTLY ONE label: clear the slot ``next_label``
+    claims and bump latest. The samples clear is one contiguous
+    dynamic_update_slice — the in-place-aliasing op — so a donated dispatch
+    never rewrites (or copies) the [S, NB, CAP] reservoir the way the
+    whole-buffer select in :func:`_advance` does. The host loop calls this
+    once per new label (bounded by NB calls on a label jump; the ring only
+    holds NB labels), exactly like the z-score ring_write staging."""
+    NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
+    next_label = jnp.asarray(next_label, jnp.int32)
+    slot = next_label % NB
+    z = jnp.zeros((), jnp.int32)  # same index dtype as slot (x64-safe)
+    S = state.counts.shape[0]
+    hole = jnp.zeros((S, 1), state.counts.dtype)
+    counts = jax.lax.dynamic_update_slice(state.counts, hole, (z, slot))
+    sums = jax.lax.dynamic_update_slice(state.sums, hole.astype(state.sums.dtype), (z, slot))
+    nsamples = jax.lax.dynamic_update_slice(state.nsamples, hole, (z, slot))
+    nan_slab = jnp.full((S, 1, CAP), jnp.nan, state.samples.dtype)
+    samples = jax.lax.dynamic_update_slice(state.samples, nan_slab, (z, slot, z))
+    return StatsState(next_label, counts, sums, samples, nsamples)
 
 
 def percentile_rank(n: jnp.ndarray, p: int):
@@ -250,23 +276,26 @@ def percentile_rank(n: jnp.ndarray, p: int):
     return (idx1 + 1).astype(jnp.int32), take_pair
 
 
-def topk_percentiles(window: jnp.ndarray, n: jnp.ndarray, ps) -> tuple:
+def topk_percentiles(window: jnp.ndarray, n: jnp.ndarray, ps, n_max: int = None) -> tuple:
     """Exact reference percentiles via ``jax.lax.top_k`` instead of a full sort.
 
     For p >= 75 both the rank element and its interpolation neighbor always
-    sit within the top ``0.25n + 1 <= N//4 + 2`` values of the row: the r-th
-    smallest of n (1-indexed, a[r-1] ascending) is d[n-r] in descending
-    order, and r >= ceil(p*n/100) - 1 >= 0.75n - 1 bounds n-r. top_k is
-    O(N log k) and maps far better onto the TPU than the O(N log^2 N)
-    bitonic sort of the whole window; the result is the exact order
-    statistic, not an approximation (property-tested against the sort path).
-    NaN = empty slots (sorted past +inf by the sort path) become -inf here so
-    they fall OUT of the top-k window instead.
+    sit within the top ``0.25n + 1`` values of the row: the r-th smallest of
+    n (1-indexed, a[r-1] ascending) is d[n-r] in descending order, and
+    r >= ceil(p*n/100) - 1 >= 0.75n - 1 bounds n-r. top_k is O(N log k) and
+    maps far better onto the TPU than the O(N log^2 N) bitonic sort of the
+    whole window; the result is the exact order statistic, not an
+    approximation (property-tested against the sort path). NaN = empty
+    slots (sorted past +inf by the sort path) become -inf here so they fall
+    OUT of the top-k window instead. ``n_max`` tightens k when the array is
+    wider than the possible valid count (the masked full-ring read passes
+    W*CAP while the array spans NB*CAP).
     """
     if min(ps) < 75:  # the k bound above assumes p >= 75
         raise ValueError(f"topk percentile path requires p >= 75, got {ps}")
     N = window.shape[-1]
-    k = min(N, N // 4 + 2)
+    bound = N if n_max is None else min(n_max, N)
+    k = min(N, bound // 4 + 2)
     neg = jnp.where(jnp.isnan(window), -jnp.inf, window)
     top = jax.lax.top_k(neg, k)[0]  # [..., k] descending
     outs = []
@@ -358,32 +387,35 @@ class TickResult(NamedTuple):
     overflowed: jnp.ndarray  # [S] bool: percentile computed on truncated samples
 
 
-def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, StatsState]:
-    """New-interval step: compute window stats for all rows, then advance.
+def window_stats(state: StatsState, cfg: StatsConfig) -> TickResult:
+    """Window statistics at the CURRENT latest label — strictly read-only
+    (the staged executor runs it in a program that never writes the big
+    buffers, so XLA keeps them in place; :func:`tick` composes it with the
+    advance for single-program use).
 
-    Mirrors the consumeMsg new-bucket branch (stream_calc_stats.js:348-366):
-    latestBucket = new_label; removeOldBuckets; stats over
-    [latest-keep, latest-buffer] stamped edgeTs = (latest - buffer - 1) * 1e4.
+    The window's buckets are selected by an in-register [NB] slot mask
+    instead of a gathered [S, W, CAP] copy: excluded slots read as NaN
+    (weight 0 / -inf under top_k), which XLA fuses into the percentile
+    pass — one streaming read of the reservoir, no materialized permutation.
     """
-    # Guard against non-increasing labels (the reference only advances on
-    # strictly greater, stream_calc_stats.js:348): clamping makes a stale tick
-    # a harmless re-emission for the current window instead of state corruption.
-    new_label = jnp.maximum(jnp.asarray(new_label, jnp.int32), state.latest_bucket)
-    state = _advance(state, cfg, new_label)
-
-    NB, CAP, W = cfg.num_buckets, cfg.samples_per_bucket, cfg.window_label_count
+    NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
+    latest = state.latest_bucket
     # window labels: latest-keep .. latest-buffer (31 for stock config)
     offsets = jnp.arange(cfg.buffer_sz, cfg.num_keep + 1, dtype=jnp.int32)
-    slots_w = (new_label - offsets) % NB  # [W]
+    slots_w = (latest - offsets) % NB  # [W]
+    in_window = jnp.zeros((NB,), bool).at[slots_w].set(True)  # [NB]
 
-    cnt = jnp.sum(state.counts[:, slots_w], axis=1)  # [S]
-    total = jnp.sum(state.sums[:, slots_w], axis=1)  # [S]
+    cnt = jnp.sum(jnp.where(in_window[None, :], state.counts, 0), axis=1)  # [S]
+    total = jnp.sum(jnp.where(in_window[None, :], state.sums, 0), axis=1)  # [S]
     average = jnp.where(cnt > 0, total / cnt, jnp.nan)
 
-    stored = jnp.sum(state.nsamples[:, slots_w], axis=1)  # [S]
+    stored = jnp.sum(jnp.where(in_window[None, :], state.nsamples, 0), axis=1)  # [S]
     overflowed = stored < cnt
 
-    window_samples = state.samples[:, slots_w, :].reshape(state.samples.shape[0], W * CAP)
+    S_rows = state.samples.shape[0]
+    window_samples = jnp.where(
+        in_window[None, :, None], state.samples, jnp.nan
+    ).reshape(S_rows, NB * CAP)
     impl = cfg.percentile_impl
 
     def _weighted():
@@ -391,14 +423,17 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
         # weight count/stored (== 1 with no overflow, where this is bit-exact
         # reference math over every sample). The only impl whose pooled
         # estimate keeps a bursty bucket's arrival mass intact under
-        # cross-bucket skew.
-        counts_w = state.counts[:, slots_w].astype(cfg.dtype)  # [S, W]
-        stored_w = state.nsamples[:, slots_w]  # [S, W]
-        w_bucket = counts_w / jnp.maximum(stored_w, 1).astype(cfg.dtype)
-        S_rows = window_samples.shape[0]
+        # cross-bucket skew. Excluded slots carry weight 0 and value NaN, so
+        # they sort to the tail and never touch a rank.
+        w_bucket = jnp.where(
+            in_window[None, :],
+            state.counts.astype(cfg.dtype)
+            / jnp.maximum(state.nsamples, 1).astype(cfg.dtype),
+            0,
+        )  # [S, NB]
         w_flat = jnp.broadcast_to(
-            w_bucket[:, :, None], (S_rows, W, CAP)
-        ).reshape(S_rows, W * CAP)
+            w_bucket[:, :, None], (S_rows, NB, CAP)
+        ).reshape(S_rows, NB * CAP)
         weights = jnp.where(jnp.isnan(window_samples), 0, w_flat)
         return weighted_reference_percentiles(window_samples, weights, cnt, (75, 95))
 
@@ -411,10 +446,15 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
         per75, per95 = jax.lax.cond(
             jnp.any(overflowed),
             _weighted,
-            lambda: topk_percentiles(window_samples, stored, (75, 95)),
+            lambda: topk_percentiles(
+                window_samples, stored, (75, 95),
+                n_max=cfg.window_label_count * CAP,
+            ),
         )
     elif impl == "topk":
-        per75, per95 = topk_percentiles(window_samples, stored, (75, 95))
+        per75, per95 = topk_percentiles(
+            window_samples, stored, (75, 95), n_max=cfg.window_label_count * CAP
+        )
     elif impl == "pallas":
         if cfg.dtype == jnp.float64:
             # the kernel is f32-only; a silent downcast would break the f64
@@ -431,7 +471,22 @@ def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, St
 
     tpm = cnt / (cfg.window_sz * cfg.interval_len_s / 60.0)  # stream_calc_stats.js:186
 
-    return TickResult(tpm, average.astype(cfg.dtype), per75, per95, cnt, overflowed), state
+    return TickResult(tpm, average.astype(cfg.dtype), per75, per95, cnt, overflowed)
+
+
+def tick(state: StatsState, cfg: StatsConfig, new_label) -> Tuple[TickResult, StatsState]:
+    """New-interval step: advance, then compute window stats for all rows.
+
+    Mirrors the consumeMsg new-bucket branch (stream_calc_stats.js:348-366):
+    latestBucket = new_label; removeOldBuckets; stats over
+    [latest-keep, latest-buffer] stamped edgeTs = (latest - buffer - 1) * 1e4.
+    """
+    # Guard against non-increasing labels (the reference only advances on
+    # strictly greater, stream_calc_stats.js:348): clamping makes a stale tick
+    # a harmless re-emission for the current window instead of state corruption.
+    new_label = jnp.maximum(jnp.asarray(new_label, jnp.int32), state.latest_bucket)
+    state = _advance(state, cfg, new_label)
+    return window_stats(state, cfg), state
 
 
 def quantize_half_up(x: jnp.ndarray, digits: int) -> jnp.ndarray:

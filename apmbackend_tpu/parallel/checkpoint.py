@@ -162,10 +162,17 @@ class ShardedCheckpointer:
 
     @staticmethod
     def _legacy_pos_template(template: EngineState) -> EngineState:
-        """Template for pre-global-cursor snapshots: z-score pos was a
-        per-row [S] int32 — exactly the shape/dtype/sharding of fill."""
+        """Template for pre-global-cursor snapshots: their ZScoreState had
+        THREE fields — {values, fill, pos} with a per-row [S] int32 pos
+        (same shape/dtype/sharding as fill) and NO 'agg' key at all. Plain
+        dict nodes reproduce that tree structure byte-for-byte; a NamedTuple
+        with agg=None would still carry the 'agg' key and orbax rejects the
+        structure (verified against a real legacy-schema snapshot)."""
         return template._replace(
-            zscores=tuple(z._replace(pos=z.fill) for z in template.zscores)
+            zscores=tuple(
+                {"values": z.values, "fill": z.fill, "pos": z.fill}
+                for z in template.zscores
+            )
         )
 
     @staticmethod
@@ -174,15 +181,18 @@ class ShardedCheckpointer:
     ) -> EngineState:
         """Rotate each row's ring onto the shared global cursor (see
         dzscore.normalize_legacy_ring) and collapse pos to the scalar 0.
-        Host-side numpy — a one-time migration cost at restore."""
+        Host-side numpy — a one-time migration cost at restore. The legacy
+        zscore nodes arrive as 3-key dicts (see _legacy_pos_template)."""
         zs = []
         for z, tz, spec in zip(state.zscores, template.zscores, cfg.lags):
             values = dzscore.normalize_legacy_ring(
-                np.asarray(z.values), np.asarray(z.fill), np.asarray(z.pos), spec.lag
+                np.asarray(z["values"]), np.asarray(z["fill"]), np.asarray(z["pos"]),
+                spec.lag,
             )
             zs.append(
-                z._replace(
+                dzscore.ZScoreState(
                     values=jax.device_put(values, tz.values.sharding),
+                    fill=z["fill"],
                     pos=jax.device_put(np.zeros((), np.int32), tz.pos.sharding),
                 )
             )

@@ -151,16 +151,24 @@ def _engine_tick_impl(
 ) -> Tuple[TickEmission, EngineState, Tuple[jnp.ndarray, ...]]:
     """Shared fused-tick body. ``evicted`` selects the execution shape:
 
-    - None: single-program mode — sliding lags compose their ring read and
-      write inside this program (dzscore.step). Used by shard_map and the
-      compile-check entry; pays one ring copy per sliding lag on XLA:CPU.
-    - tuple of [S, 3] slices (one per sliding lag, in lag order): STAGED
-      mode — sliding lags run ring-free (dzscore.step_core) and this
-      function returns their pushes; the caller owes the ring_write
-      dispatches (make_engine_step wires the three programs together so the
-      big rings are only ever touched by an in-place dynamic_update_slice).
+    - None: single-program mode — the stats ring advances in-program and
+      sliding lags compose their ring read and write inside this program
+      (dzscore.step). Used by shard_map and the compile-check entry; pays
+      whole-buffer copies on XLA:CPU.
+    - tuple of [S, 3] slices (one per sliding lag, in lag order; may be
+      empty): STAGED mode — the stats ring arrives PRE-advanced (the host
+      dispatched dstats.advance_one per new label), this program only READS
+      the big buffers (window_stats is read-only; sliding lags run
+      ring-free via dzscore.step_core) and returns the ring pushes; the
+      caller owes the ring_write dispatches. make_engine_step wires the
+      programs together so every big buffer is only ever written by an
+      in-place dynamic_update_slice in a read-free program.
     """
-    res, stats_state = dstats.tick(state.stats, cfg.stats, new_label)
+    if evicted is not None:
+        res = dstats.window_stats(state.stats, cfg.stats)
+        stats_state = state.stats
+    else:
+        res, stats_state = dstats.tick(state.stats, cfg.stats, new_label)
 
     if cfg.quantize:
         tpm = dstats.quantize_half_up(res.tpm, 2)
@@ -271,33 +279,31 @@ def make_engine_step(cfg: EngineConfig):
     """The staged per-tick executor: ``step(state, new_label, params) ->
     (emission, new_state)`` with donation throughout.
 
-    Three dispatches when any lag runs sliding aggregates:
-      1. evict-read: one program slicing every sliding ring's about-to-be-
+    Up to four program kinds per tick, each touching the big buffers only
+    in the way XLA can keep in place:
+      1. stats advance: dstats.advance_one per new label (host-counted from
+         the latest-label scalar; normally one call) — the sample-reservoir
+         clear is a single dynamic_update_slice, never a whole-buffer
+         select,
+      2. evict-read: one program slicing every sliding ring's about-to-be-
          overwritten slot (read-only — the rings must NOT be donated here),
-      2. core tick: everything else, rings passed through as identity
-         (donated, so per-row state updates in place),
-      3. ring-write: one program of pure dynamic_update_slices (donated —
-         the ONLY writer of the big rings, so XLA keeps them in place; any
-         same-program read would force a whole-ring copy on XLA:CPU,
-         measured 736 ms vs 0.6 ms at [8192, 3, 8640]).
-    Collapses to plain jitted engine_tick when no lag is sliding."""
+      3. core tick: everything else — window_stats and the sliding lags
+         only READ the big buffers, which pass through as donated identity,
+      4. ring-write: one program of pure dynamic_update_slices (donated —
+         the ONLY writer of the z-score rings; any same-program read would
+         force a whole-ring copy on XLA:CPU, measured 736 ms vs 0.6 ms at
+         [8192, 3, 8640])."""
     sliding_idx = tuple(
         i for i, spec in enumerate(cfg.lags) if zscore_cfg(cfg, spec).sliding_active
     )
-    if not sliding_idx:
-        tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
-
-        def step_plain(state, new_label, params):
-            return tick(state, cfg, new_label, params)
-
-        return step_plain
-
+    NB = cfg.stats.num_buckets
+    advance = jax.jit(dstats.advance_one, static_argnums=1, donate_argnums=(0,))
+    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
     evict = jax.jit(
         lambda rings, cursors: tuple(
             dzscore.ring_evict_read(r, g) for r, g in zip(rings, cursors)
         )
     )
-    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
     # write slot = the cursor BEFORE the core advanced it = new_pos - 1
     write = jax.jit(
         lambda rings, pushes, new_cursors: tuple(
@@ -308,11 +314,24 @@ def make_engine_step(cfg: EngineConfig):
     )
 
     def step(state, new_label, params):
+        # 1. stats ring advance, one label at a time (a jump clears at most
+        # NB slots — the ring only holds NB labels). The latest-label scalar
+        # is already on host-visible memory from the previous step; reading
+        # it keeps the host counter self-healing across restores.
+        latest = int(state.stats.latest_bucket)
+        nl = int(new_label)
+        st = state.stats
+        for lbl in range(max(latest + 1, nl - NB + 1), nl + 1):
+            st = advance(st, cfg.stats, lbl)
+        state = state._replace(stats=st)
+
+        # 2-4. evict-read -> ring-free core -> in-place ring writes
         rings = tuple(state.zscores[i].values for i in sliding_idx)
         cursors = tuple(state.zscores[i].pos for i in sliding_idx)
-        evicted = evict(rings, cursors)
+        evicted = evict(rings, cursors) if sliding_idx else ()
         emission, state2, pushes = core(state, cfg, new_label, params, evicted)
-        # the core aliased the rings through untouched; write in place
+        if not sliding_idx:
+            return emission, state2
         rings2 = tuple(state2.zscores[i].values for i in sliding_idx)
         new_cursors = tuple(state2.zscores[i].pos for i in sliding_idx)
         new_rings = write(rings2, pushes, new_cursors)

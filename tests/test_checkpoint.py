@@ -100,8 +100,11 @@ def test_legacy_per_row_cursor_snapshot_migrates(tmp_path, engine):
         old_vals = np.empty_like(new_vals)
         idx = (k - w[:, None]) % L  # old[k] = new[(k - w) % L]
         old_vals[:] = np.take_along_axis(new_vals, idx[:, None, :], axis=2)
+        # faithful legacy node: THREE keys only — the old ZScoreState had no
+        # 'agg' field, and orbax treats even an agg=None key as a different
+        # tree structure
         legacy_zs.append(
-            {"values": jnp.asarray(old_vals), "fill": z.fill, "pos": jnp.asarray(w.astype(np.int32)), "agg": None}
+            {"values": jnp.asarray(old_vals), "fill": z.fill, "pos": jnp.asarray(w.astype(np.int32))}
         )
     legacy_tree = _strip_agg(state)._asdict()
     legacy_tree["zscores"] = tuple(legacy_zs)
@@ -253,15 +256,21 @@ def test_pre_holt_snapshot_restores_with_zero_trend(tmp_path):
                        (100 + rng.rand(64) * 50).astype(np.float32), np.ones(64, bool))
     assert int(np.asarray(state.ewmas[0].count).sum()) > 0
 
-    # write the snapshot the way the pre-Holt build serialized it: the same
-    # _asdict() tree but with 3-field ewma nodes (no 'trend') and no sliding
-    # aggregates (pre-Holt also predates sliding; the current saver strips
-    # them anyway)
-    from apmbackend_tpu.parallel.checkpoint import _strip_agg
-
-    legacy_tree = _strip_agg(state)._asdict()
+    # write the snapshot the way the pre-Holt build ACTUALLY serialized it:
+    # 3-field ewma nodes (no 'trend') AND 3-field zscore nodes (no 'agg'
+    # key, per-row [S] cursors — pre-Holt also predates sliding and the
+    # global cursor)
+    legacy_tree = state._asdict()
     legacy_tree["ewmas"] = tuple(
         {"mean": e.mean, "var": e.var, "count": e.count} for e in state.ewmas
+    )
+    legacy_tree["zscores"] = tuple(
+        {
+            "values": z.values,
+            "fill": z.fill,
+            "pos": jnp.broadcast_to(z.pos, z.fill.shape),  # per-row cursors
+        }
+        for z in state.zscores
     )
     ckpt = ShardedCheckpointer(str(tmp_path / "ck"))
     meta = {"signature": _shape_signature(cfg), "registry": ["srvA\x00svc1"]}
