@@ -1,5 +1,8 @@
 #!/bin/sh
-# Run the test suite on the virtual 8-device CPU platform.
+# Run the FULL test suite (including the soak tier) on the virtual 8-device
+# CPU platform. The bare `python -m pytest tests/` default excludes soaks
+# (pytest.ini addopts) for a fast inner loop; this script clears the marker
+# filter so everything runs.
 #
 # PYTHONPATH is stripped because the environment's axon sitecustomize dials the
 # TPU relay at interpreter start; tests must not depend on (or block on) the
@@ -7,4 +10,4 @@
 cd "$(dirname "$0")"
 exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python -m pytest tests/ "$@"
+    python -m pytest tests/ -m "soak or not soak" "$@"

@@ -1,0 +1,107 @@
+"""Worker process for the two-process jax.distributed smoke test.
+
+Each of two processes owns 2 virtual CPU devices; the 4-device service-axis
+mesh spans both. The worker initializes the distributed runtime through the
+PRODUCTION entry point (multihost.init_distributed, env-var driven), builds
+the sharded engine with jit out_shardings (no host-side global device_put —
+the multi-host-correct way), ingests a DISTINCT per-host batch through the
+all-to-all exchange, ticks, and asserts the pod rollup counted both hosts'
+records. Run by tests/test_multihost_procs.py; argv: <coordinator_port>
+<process_id>.
+"""
+
+import os
+import sys
+
+PORT, PID = sys.argv[1], int(sys.argv[2])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_ENABLE_X64"] = "True"
+# the production wiring init_distributed() reads:
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{PORT}"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(PID)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from apmbackend_tpu.parallel.multihost import (  # noqa: E402
+    build_send_blocks,
+    host_shard_plan,
+    init_distributed,
+    make_exchange_ingest,
+    place_global,
+)
+
+assert init_distributed() is True, "two-process env must initialize distributed"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4 and len(jax.local_devices()) == 2
+
+from apmbackend_tpu.parallel import make_mesh, make_sharded_tick  # noqa: E402
+from apmbackend_tpu.parallel.sharded import _params_specs, _state_specs  # noqa: E402
+from apmbackend_tpu.pipeline import engine_init, make_demo_engine  # noqa: E402
+
+CAPACITY, B = 64, 48
+cfg, _, _ = make_demo_engine(CAPACITY, 8, [(4, 3.0, 0.1)])
+mesh = make_mesh(4)
+
+
+def _shardings(spec_tree):
+    return jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp), spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+state = jax.jit(
+    lambda: engine_init(cfg), out_shardings=_shardings(_state_specs(cfg))
+)()
+
+
+def _make_params():
+    from apmbackend_tpu.pipeline import EngineParams
+
+    S = CAPACITY
+    return EngineParams(
+        thresholds=(jnp.full(S, 3.0, jnp.float32),),
+        influences=(jnp.full(S, 0.1, jnp.float32),),
+        hard_max_ms=jnp.full(S, 10000.0, jnp.float32),
+        suppressed=jnp.zeros(S, bool),
+        active=jnp.ones(S, bool),
+    )
+
+
+params = jax.jit(_make_params, out_shardings=_shardings(_params_specs(cfg)))()
+
+tick = make_sharded_tick(mesh, cfg)
+exchange = make_exchange_ingest(mesh, cfg)
+plan = host_shard_plan(mesh, CAPACITY)
+assert plan.n_local == 2 and plan.n_shards == 4
+
+label = 170_000_001
+_em, _roll, state = tick(state, jnp.int32(label), params)
+
+# DISTINCT per-host batches: host 0 sends rows hashed one way, host 1 another
+rng = np.random.RandomState(100 + PID)
+rows = rng.randint(0, CAPACITY, B).astype(np.int32)
+elaps = (100 + 50 * rng.rand(B)).astype(np.float32)
+blocks, dropped = build_send_blocks(
+    plan, rows, np.full(B, label, np.int32), elaps, np.ones(B, bool),
+    capacity=CAPACITY, batch_per_shard=B,
+)
+assert dropped == 0
+state = exchange(state, *place_global(mesh, blocks))
+
+# tick until `label` enters the stats window so the rollup counts the batch
+emission, rollup, state = tick(
+    state, jnp.int32(label + cfg.stats.buffer_sz + 1), params
+)
+total = int(jax.device_get(rollup.total_tx))
+# BOTH hosts' batches must arrive: 2 * B records across the pod
+assert total == 2 * B, f"proc {PID}: rollup {total} != {2 * B}"
+print(f"MP_SMOKE_OK proc={PID} total={total}", flush=True)

@@ -31,6 +31,10 @@ from apmbackend_tpu.standalone import StandalonePipeline
 
 from golden import GoldenStats, GoldenZScore
 
+# endurance tier: excluded from the default fast run (pytest.ini addopts
+# -m "not soak"); run_tests.sh runs the FULL suite including these
+pytestmark = pytest.mark.soak
+
 N_JVMS = 24
 TX_PER_JVM = 700  # ~1s of log time per tx => ~11-12 min => ~70 bucket labels
 LAGS = [(6, 2.0, 0.1), (360, 20.0, 0.0)]
