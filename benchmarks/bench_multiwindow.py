@@ -30,7 +30,7 @@ EWMA_CHANNELS = [
 def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_tick: int = 16384) -> dict:
     import jax
 
-    from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
+    from apmbackend_tpu.pipeline import engine_ingest, make_demo_engine, make_engine_step
 
     if quick:
         capacity, ticks, tx_per_tick = 64, 4, 512
@@ -39,7 +39,8 @@ def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_ti
     cfg, state, params = make_demo_engine(
         capacity, 32 if quick else 64, lags, ewma_channels=EWMA_CHANNELS
     )
-    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    # staged executor: in-place big-buffer writes (pipeline.make_engine_step)
+    tick = make_engine_step(cfg)
     ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
@@ -53,7 +54,7 @@ def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_ti
 
     for _ in range(3):
         label += 1
-        em, state = tick(state, cfg, label, params)
+        em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
         state = ingest(state, cfg, *batch(label))
     jax.block_until_ready(state.stats.counts)
@@ -63,7 +64,7 @@ def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_ti
     for _ in range(ticks):
         label += 1
         t0 = time.perf_counter()
-        em, state = tick(state, cfg, label, params)
+        em, state = tick(state, label, params)
         _ = [np.asarray(l.trigger) for l in em.lags + em.ewma]
         lat.append(time.perf_counter() - t0)
         state = ingest(state, cfg, *batch(label))

@@ -18,14 +18,15 @@ from .common import PER_CHIP_NORTH_STAR, latency_stats_ms, result
 def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tick: int = 4096) -> dict:
     import jax
 
-    from apmbackend_tpu.pipeline import engine_ingest, engine_tick, make_demo_engine
+    from apmbackend_tpu.pipeline import engine_ingest, make_demo_engine, make_engine_step
 
     if quick:
         ticks, tx_per_tick = 5, 256
 
     capacity = 128  # 100 live rows padded to the power-of-two tier
     cfg, state, params = make_demo_engine(capacity, 64, [(360, 20.0, 0.1)])
-    tick = jax.jit(engine_tick, static_argnums=1, donate_argnums=(0,))
+    # staged executor: in-place big-buffer writes (pipeline.make_engine_step)
+    tick = make_engine_step(cfg)
     ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
 
     rng = np.random.RandomState(0)
@@ -39,7 +40,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tic
 
     for _ in range(3):  # warmup/compile
         label += 1
-        em, state = tick(state, cfg, label, params)
+        em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
         state = ingest(state, cfg, *batch(label))
     jax.block_until_ready(state.stats.counts)
@@ -49,7 +50,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tic
     for _ in range(ticks):
         label += 1
         t0 = time.perf_counter()
-        em, state = tick(state, cfg, label, params)
+        em, state = tick(state, label, params)
         jax.block_until_ready(em.lags[0].trigger)
         lat.append(time.perf_counter() - t0)
         state = ingest(state, cfg, *batch(label))

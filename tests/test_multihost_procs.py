@@ -32,6 +32,11 @@ def test_two_process_distributed_exchange():
     port = _free_port()
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)  # the axon sitecustomize must not dial the TPU
+    # share the suite's persistent compile cache (conftest sets it via
+    # jax.config, which does not propagate into Popen'd workers)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.environ.get("APM_TEST_JAX_CACHE", "/tmp/apm_jax_test_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.4")
     procs = [
         subprocess.Popen(
             [sys.executable, _WORKER, str(port), str(pid)],
@@ -51,7 +56,15 @@ def test_two_process_distributed_exchange():
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail("two-process smoke timed out:\n" + "\n".join(outs))
+        # re-communicate after kill to retrieve the HUNG worker's buffered
+        # output — it is the diagnostic that matters
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=10)
+                outs.append(out)
+            except Exception:
+                pass
+        pytest.fail("two-process smoke timed out:\n" + "\n".join(o[-3000:] for o in outs))
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} rc={p.returncode}\n{out[-3000:]}"
         assert f"MP_SMOKE_OK proc={pid}" in out, out[-3000:]

@@ -451,3 +451,73 @@ def test_weighted_percentiles_reduce_to_reference_at_unit_weight():
         )
         same = (want == got) | (np.isnan(want) & np.isnan(got))
         assert same.all(), (p, np.nonzero(~same), want[~same], got[~same])
+
+
+def test_advance_one_equals_advance_jump():
+    """The staged per-label clear (advance_one, one-slot DUS) composed over a
+    label jump must land bit-identically on _advance's whole-buffer select —
+    including jumps larger than the ring (only the last NB labels matter)."""
+    from apmbackend_tpu.ops import stats as dstats
+
+    cfg = dstats.StatsConfig(capacity=8, window_sz=5, buffer_sz=2,
+                             samples_per_bucket=4)
+    NB = cfg.num_buckets
+    rng = np.random.RandomState(0)
+
+    def seeded_state(label):
+        st = dstats.init_state(cfg)
+        st = st._replace(latest_bucket=jnp.asarray(label, jnp.int32))
+        for lbl in range(label - NB + 1, label + 1):
+            rows = rng.randint(0, 8, 16).astype(np.int32)
+            st = dstats.ingest(st, cfg, rows, np.full(16, lbl, np.int32),
+                               (50 + rng.rand(16)).astype(np.float32),
+                               np.ones(16, bool))
+        return st
+
+    for jump in (1, 3, NB - 1, NB, NB + 5):
+        base = seeded_state(1000)
+        target = 1000 + jump
+        a = dstats._advance(base, cfg, jnp.asarray(target, jnp.int32))
+        b = base
+        for lbl in range(max(1001, target - NB + 1), target + 1):
+            b = dstats.advance_one(b, cfg, lbl)
+        assert int(b.latest_bucket) == int(a.latest_bucket) == target
+        np.testing.assert_array_equal(np.asarray(a.counts), np.asarray(b.counts))
+        np.testing.assert_array_equal(np.asarray(a.sums), np.asarray(b.sums))
+        np.testing.assert_array_equal(np.asarray(a.nsamples), np.asarray(b.nsamples))
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(a.samples), nan=-1),
+            np.nan_to_num(np.asarray(b.samples), nan=-1),
+            err_msg=f"jump {jump}",
+        )
+
+
+def test_staged_step_label_jump_and_stale_label():
+    """make_engine_step across a label gap (> buffer) and a stale label must
+    match the single-program engine_tick sequence bitwise."""
+    import jax
+
+    from apmbackend_tpu.pipeline import (
+        engine_init, engine_tick, make_demo_engine, make_engine_step,
+    )
+
+    cfg, _, params = make_demo_engine(8, 4, [(4, 3.0, 0.2)])
+    sa = engine_init(cfg)
+    sb = engine_init(cfg)
+    staged = make_engine_step(cfg)
+    mono = jax.jit(engine_tick, static_argnums=1)
+    labels = [1001, 1002, 1012, 1012, 1013]  # gap of 10, then a stale repeat
+    for lbl in labels:
+        ea, sa = staged(sa, lbl, params)
+        eb, sb = mono(sb, cfg, lbl, params)
+        np.testing.assert_array_equal(np.asarray(ea.count), np.asarray(eb.count))
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(ea.average)), np.nan_to_num(np.asarray(eb.average))
+        )
+    np.testing.assert_array_equal(
+        np.asarray(sa.stats.latest_bucket), np.asarray(sb.stats.latest_bucket)
+    )
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(sa.stats.samples), nan=-1),
+        np.nan_to_num(np.asarray(sb.stats.samples), nan=-1),
+    )
