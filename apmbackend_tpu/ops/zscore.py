@@ -169,22 +169,29 @@ def init_state(cfg: ZScoreConfig) -> ZScoreState:
     )
 
 
-def build_agg(values: jnp.ndarray, cfg: ZScoreConfig, pos=None) -> SlidingAgg:
-    """Exact SlidingAgg from a values ring (restore path / tests).
+def build_agg(values: jnp.ndarray, cfg: ZScoreConfig, pos=None, anchor=None) -> SlidingAgg:
+    """Exact SlidingAgg from a values ring (restore path / periodic rebuild).
 
-    Two fused passes: the first finds the window mean to use as the anchor,
-    the second takes the anchored sums. ``pos`` (the global cursor; 0 when
-    omitted) locates slot g-1 for the ``last_push`` mirror.
-    ``run_len``/``last_valid`` are only recoverable for all-equal windows
-    (min == max); other rows restart at 0, which is conservative — the guard
-    can only under-detect until the row's pushes re-establish the run or the
-    window truly becomes all-equal through >= cnt equal pushes (both exact
-    going forward; module docstring)."""
+    Without ``anchor``: two fused passes — the first finds the window mean
+    to anchor around, the second takes the anchored sums (the restore path,
+    which has no prior estimate). With ``anchor`` (a [S, 3] estimate, e.g.
+    the incremental mean at rebuild time): ONE pass — any anchor inside the
+    window's value range keeps the moment cancellation benign, so an
+    estimate is as good as the exact mean and the rebuild halves its ring
+    traffic. ``pos`` (the global cursor; 0 when omitted) locates slot g-1
+    for the ``last_push`` mirror. ``run_len``/``last_valid`` are only
+    recoverable for all-equal windows (min == max); other rows restart at 0,
+    which is conservative — the guard can only under-detect until the row's
+    pushes re-establish the run or the window truly becomes all-equal
+    through >= cnt equal pushes (both exact going forward; module
+    docstring)."""
     L = values.shape[-1]
     vals = values.astype(cfg.dtype) if values.dtype != cfg.dtype else values
     valid = ~jnp.isnan(vals)
-    cnt0, total0, _, _ = fused_window_partials(vals, valid)
-    anchor = jnp.where(cnt0 > 0, total0 / jnp.maximum(cnt0, 1), 0).astype(cfg.dtype)
+    if anchor is None:
+        cnt0, total0, _, _ = fused_window_partials(vals, valid)
+        anchor = jnp.where(cnt0 > 0, total0 / jnp.maximum(cnt0, 1), 0)
+    anchor = anchor.astype(cfg.dtype)
     cnt, total, sumsq, vmin, vmax = fused_window_partials_sq(vals, valid, anchor[..., None])
     all_eq = (cnt > 0) & (vmin == vmax)
     g = jnp.zeros((), jnp.int32) if pos is None else jnp.asarray(pos, jnp.int32)
@@ -229,8 +236,14 @@ def rebuild_agg_state(state: ZScoreState, cfg: ZScoreConfig) -> ZScoreState:
     blind spot, module docstring). No-op for non-sliding configs."""
     if not cfg.sliding_active or state.agg is None:
         return state
-    fresh = build_agg(state.values, cfg, state.pos)
     old = state.agg
+    # the incremental mean is a perfectly good anchor (it only needs to sit
+    # inside the window's value range) — passing it makes the rebuild ONE
+    # ring pass instead of two
+    anchor_est = jnp.where(
+        old.cnt > 0, old.anchor + old.vsum / jnp.maximum(old.cnt, 1), old.anchor
+    )
+    fresh = build_agg(state.values, cfg, state.pos, anchor_est)
     # rows build_agg proves all-equal (min==max) take the repaired run;
     # everything else keeps the incrementally-exact counters
     proved = fresh.run_len > 0

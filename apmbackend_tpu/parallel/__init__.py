@@ -4,6 +4,7 @@ from .sharded import (  # noqa: F401
     local_config,
     make_sharded_ingest,
     make_sharded_rebuild,
+    make_sharded_step,
     make_sharded_tick,
     route_batch,
 )
@@ -26,7 +27,8 @@ __all__ = [
     "SERVICE_AXIS", "WINDOW_AXIS", "FleetRollup", "HostShardPlan",
     "ShardedCheckpointer", "build_send_blocks", "host_shard_plan",
     "init_distributed", "local_config", "make_exchange_ingest", "make_mesh",
-    "make_mesh2d", "make_sharded_ingest", "make_sharded_rebuild", "make_sharded_tick",
+    "make_mesh2d", "make_sharded_ingest", "make_sharded_rebuild", "make_sharded_step",
+    "make_sharded_tick",
     "make_window_sharded_step", "padded_capacity", "place_global",
     "replicated", "route_batch", "row_sharding", "shard_rows", "shard_zstate",
 ]

@@ -59,29 +59,47 @@ class FleetRollup(NamedTuple):
     alerts: jnp.ndarray  # [n_lags + n_ewma] int: alert triggers this tick
 
 
+def _fleet_rollup(emission: TickEmission) -> FleetRollup:
+    """ICI all-reduce of the shard-local emission into the pod-wide view —
+    the one place the per-tick collectives live (shared by the mono and
+    staged sharded executors so the rollup semantics cannot drift)."""
+    total_tx = jax.lax.psum(jnp.sum(emission.count), SERVICE_AXIS)
+    avg = emission.average[:, 0]
+    defined = ~jnp.isnan(avg)
+    s = jax.lax.psum(jnp.sum(jnp.where(defined, avg, 0)), SERVICE_AXIS)
+    n = jax.lax.psum(jnp.sum(defined), SERVICE_AXIS)
+    mean_elapsed = jnp.where(n > 0, s / jnp.maximum(n, 1), jnp.nan)
+    # lag windows first, then EWMA/seasonal channels (axis order matches
+    # cfg.lags + cfg.ewma)
+    chans = list(emission.lags) + list(emission.ewma)
+    sig_hi = jnp.stack(
+        [jax.lax.psum(jnp.sum(l.signal[:, 0] == 1), SERVICE_AXIS) for l in chans]
+    )
+    sig_lo = jnp.stack(
+        [jax.lax.psum(jnp.sum(l.signal[:, 0] == -1), SERVICE_AXIS) for l in chans]
+    )
+    alerts = jnp.stack(
+        [jax.lax.psum(jnp.sum(l.trigger), SERVICE_AXIS) for l in chans]
+    )
+    return FleetRollup(total_tx, mean_elapsed, sig_hi, sig_lo, alerts)
+
+
 def _local_tick_with_rollup(cfg: EngineConfig):
     def fn(state: EngineState, new_label, params: EngineParams):
         emission, new_state = engine_tick(state, cfg, new_label, params)
-        total_tx = jax.lax.psum(jnp.sum(emission.count), SERVICE_AXIS)
-        avg = emission.average[:, 0]
-        defined = ~jnp.isnan(avg)
-        s = jax.lax.psum(jnp.sum(jnp.where(defined, avg, 0)), SERVICE_AXIS)
-        n = jax.lax.psum(jnp.sum(defined), SERVICE_AXIS)
-        mean_elapsed = jnp.where(n > 0, s / jnp.maximum(n, 1), jnp.nan)
-        # lag windows first, then EWMA/seasonal channels (axis order matches
-        # cfg.lags + cfg.ewma)
-        chans = list(emission.lags) + list(emission.ewma)
-        sig_hi = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.signal[:, 0] == 1), SERVICE_AXIS) for l in chans]
+        return emission, _fleet_rollup(emission), new_state
+
+    return fn
+
+
+def _local_core_with_rollup(cfg: EngineConfig):
+    from ..pipeline import engine_core_tick
+
+    def fn(state: EngineState, new_label, params: EngineParams, evicted):
+        emission, new_state, pushes = engine_core_tick(
+            state, cfg, new_label, params, evicted
         )
-        sig_lo = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.signal[:, 0] == -1), SERVICE_AXIS) for l in chans]
-        )
-        alerts = jnp.stack(
-            [jax.lax.psum(jnp.sum(l.trigger), SERVICE_AXIS) for l in chans]
-        )
-        rollup = FleetRollup(total_tx, mean_elapsed, sig_hi, sig_lo, alerts)
-        return emission, rollup, new_state
+        return emission, _fleet_rollup(emission), new_state, pushes
 
     return fn
 
@@ -159,6 +177,51 @@ def make_sharded_tick(mesh: Mesh, cfg: EngineConfig):
     # donate the state: without it every tick copies the [S, NB, CAP] sample
     # buffers (the dominant HBM traffic); callers always rebind state
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
+    """The STAGED pod-scale executor: ``step(state, new_label, params) ->
+    (emission, rollup, new_state)`` — the sharded counterpart of
+    pipeline.make_engine_step, with the same read-free-writer staging so the
+    big per-shard buffers are never copied (XLA:CPU copy hazard; on TPU the
+    staged layout is likewise the guaranteed in-place shape):
+
+      1. stats advance-one DUS per new label (plain jit — the slice update
+         touches the UNsharded bucket axis, so SPMD partitioning handles the
+         row-sharded arrays without collectives or shard_map),
+      2. z-ring evict slices (plain jit, read-only, same SPMD argument),
+      3. the shard_mapped ring-free core with the ICI fleet rollup — the
+         only program with collectives,
+      4. pure-DUS ring writes (plain jit, donated).
+    """
+    from ..pipeline import make_staged_executor, sliding_lag_indices
+
+    n = mesh.devices.size
+    lcfg = local_config(cfg, n)
+    espec = tuple(_ROW for _ in sliding_lag_indices(cfg))
+    core = jax.jit(
+        shard_map(
+            _local_core_with_rollup(lcfg),
+            mesh=mesh,
+            in_specs=(_state_specs(cfg), P(), _params_specs(cfg), espec),
+            out_specs=(
+                _emission_specs(cfg),
+                FleetRollup(P(), P(), P(), P(), P()),
+                _state_specs(cfg),
+                espec,
+            ),
+        ),
+        donate_argnums=(0,),
+    )
+    # the staging choreography itself (advance clamp, evict/write slot math,
+    # donation order) is pipeline.make_staged_executor — ONE implementation
+    # for the single-chip and pod executors
+    return make_staged_executor(
+        cfg,
+        core=lambda state, nl, params, evicted: core(
+            state, jnp.int32(nl), params, evicted
+        ),
+    )
 
 
 def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):

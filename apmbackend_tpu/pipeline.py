@@ -293,18 +293,30 @@ def make_engine_step(cfg: EngineConfig):
          the ONLY writer of the z-score rings; any same-program read would
          force a whole-ring copy on XLA:CPU, measured 736 ms vs 0.6 ms at
          [8192, 3, 8640])."""
-    sliding_idx = tuple(
+    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
+    return make_staged_executor(
+        cfg,
+        core=lambda state, nl, params, evicted: core(state, cfg, nl, params, evicted),
+    )
+
+
+def sliding_lag_indices(cfg: EngineConfig) -> Tuple[int, ...]:
+    """Which lags maintain sliding aggregates (ring staging applies)."""
+    return tuple(
         i for i, spec in enumerate(cfg.lags) if zscore_cfg(cfg, spec).sliding_active
     )
-    NB = cfg.stats.num_buckets
-    advance = jax.jit(dstats.advance_one, static_argnums=1, donate_argnums=(0,))
-    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
+
+
+def staged_ring_programs():
+    """The two ring-only jitted programs of the staging contract, shared by
+    the single-chip and pod executors: the read-only evict slices and the
+    donated pure-DUS writes (write slot = the cursor BEFORE the core
+    advanced it = new_pos - 1)."""
     evict = jax.jit(
         lambda rings, cursors: tuple(
             dzscore.ring_evict_read(r, g) for r, g in zip(rings, cursors)
         )
     )
-    # write slot = the cursor BEFORE the core advanced it = new_pos - 1
     write = jax.jit(
         lambda rings, pushes, new_cursors: tuple(
             dzscore.ring_write(r, p, (g - 1) % r.shape[-1])
@@ -312,12 +324,34 @@ def make_engine_step(cfg: EngineConfig):
         ),
         donate_argnums=(0,),
     )
+    return evict, write
+
+
+def make_staged_executor(cfg: EngineConfig, *, core):
+    """The ONE staging choreography (single-chip make_engine_step and the
+    pod-scale parallel.sharded.make_sharded_step both run on it, so the
+    label-advance clamp, evict/write slot math and donation ordering cannot
+    drift between them).
+
+    ``core(state, new_label_int, params, evicted) -> (*outs, new_state,
+    pushes)`` is the ring-free fused program (possibly shard_mapped, possibly
+    emitting extra outputs like the fleet rollup); the returned
+    ``step(state, new_label, params) -> (*outs, new_state)`` wraps it with:
+
+      1. stats ring advance, one label at a time (a jump clears at most NB
+         slots — the ring only holds NB labels). The latest-label scalar is
+         already host-visible from the previous step; reading it keeps the
+         host counter self-healing across restores.
+      2. the read-only z-ring evict slices,
+      3. the core program,
+      4. the in-place pure-DUS ring writes.
+    """
+    sliding_idx = sliding_lag_indices(cfg)
+    NB = cfg.stats.num_buckets
+    advance = jax.jit(dstats.advance_one, static_argnums=1, donate_argnums=(0,))
+    evict, write = staged_ring_programs()
 
     def step(state, new_label, params):
-        # 1. stats ring advance, one label at a time (a jump clears at most
-        # NB slots — the ring only holds NB labels). The latest-label scalar
-        # is already on host-visible memory from the previous step; reading
-        # it keeps the host counter self-healing across restores.
         latest = int(state.stats.latest_bucket)
         nl = int(new_label)
         st = state.stats
@@ -325,20 +359,19 @@ def make_engine_step(cfg: EngineConfig):
             st = advance(st, cfg.stats, lbl)
         state = state._replace(stats=st)
 
-        # 2-4. evict-read -> ring-free core -> in-place ring writes
         rings = tuple(state.zscores[i].values for i in sliding_idx)
         cursors = tuple(state.zscores[i].pos for i in sliding_idx)
         evicted = evict(rings, cursors) if sliding_idx else ()
-        emission, state2, pushes = core(state, cfg, new_label, params, evicted)
-        if not sliding_idx:
-            return emission, state2
-        rings2 = tuple(state2.zscores[i].values for i in sliding_idx)
-        new_cursors = tuple(state2.zscores[i].pos for i in sliding_idx)
-        new_rings = write(rings2, pushes, new_cursors)
-        zs = list(state2.zscores)
-        for i, ring in zip(sliding_idx, new_rings):
-            zs[i] = zs[i]._replace(values=ring)
-        return emission, state2._replace(zscores=tuple(zs))
+        *outs, state2, pushes = core(state, nl, params, evicted)
+        if sliding_idx:
+            rings2 = tuple(state2.zscores[i].values for i in sliding_idx)
+            new_cursors = tuple(state2.zscores[i].pos for i in sliding_idx)
+            new_rings = write(rings2, pushes, new_cursors)
+            zs = list(state2.zscores)
+            for i, ring in zip(sliding_idx, new_rings):
+                zs[i] = zs[i]._replace(values=ring)
+            state2 = state2._replace(zscores=tuple(zs))
+        return (*outs, state2)
 
     return step
 

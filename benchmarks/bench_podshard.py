@@ -26,7 +26,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
         make_mesh,
         make_sharded_ingest,
         make_sharded_rebuild,
-        make_sharded_tick,
+        make_sharded_step,
         route_batch,
         shard_rows,
     )
@@ -40,7 +40,8 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     lags = [(4, 20.0, 0.1), (8, 15.0, 0.0)] if quick else [(360, 20.0, 0.1), (8640, 15.0, 0.0)]
     cfg, state, params = make_demo_engine(capacity, 32 if quick else 64, lags)
     mesh = make_mesh(n_dev)
-    tick = make_sharded_tick(mesh, cfg)
+    # staged pod executor: in-place big-buffer writes per shard
+    tick = make_sharded_step(mesh, cfg)
     ingest = make_sharded_ingest(mesh, cfg)
     rebuild = make_sharded_rebuild(mesh, cfg)
     state = shard_rows(state, mesh)
@@ -65,7 +66,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
 
     for _ in range(3):  # warmup/compile
         label += 1
-        em, rollup, state = tick(state, jnp.int32(label), params)
+        em, rollup, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
         state = ingest(state, *routed(label))
     jax.block_until_ready(state.stats.counts)
@@ -80,7 +81,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
             since_rebuild = 0
             state = rebuild(state)
         t0 = time.perf_counter()
-        em, rollup, state = tick(state, jnp.int32(label), params)
+        em, rollup, state = tick(state, label, params)
         # fleet view must reach the host: rollup + trigger masks
         _ = int(rollup.total_tx)
         _ = [np.asarray(l.trigger) for l in em.lags]

@@ -270,3 +270,46 @@ def test_sharded_tick_robust_lag_matches_single_chip():
     np.testing.assert_array_equal(
         np.asarray(em_single.lags[0].signal), np.asarray(em_sh.lags[0].signal)
     )
+
+
+def test_staged_sharded_step_matches_mono():
+    """make_sharded_step (staged pod executor) must match make_sharded_tick
+    (single-program shard_map) bitwise — same math, different program
+    boundaries — including the rollup collectives and the ring contents."""
+    import jax.numpy as jnp
+
+    from apmbackend_tpu.parallel import make_mesh, make_sharded_step, make_sharded_tick, shard_rows
+    from apmbackend_tpu.pipeline import engine_init, make_demo_engine
+
+    cfg, _, params = make_demo_engine(32, 8, [(4, 3.0, 0.2), (6, 3.0, 0.2)])
+    mesh = make_mesh(8)
+    sa = shard_rows(engine_init(cfg), mesh)
+    sb = shard_rows(engine_init(cfg), mesh)
+    pa = shard_rows(params, mesh)
+    staged = make_sharded_step(mesh, cfg)
+    mono = make_sharded_tick(mesh, cfg)
+    # consecutive labels, a >buffer gap, a stale repeat — the shared host
+    # advance loop must clamp identically to the in-program _advance
+    labels = [170_000_001, 170_000_002, 170_000_014, 170_000_014, 170_000_015,
+              170_000_016, 170_000_017, 170_000_018]
+    for lbl in labels:
+        ea, ra, sa = staged(sa, lbl, pa)
+        eb, rb, sb = mono(sb, jnp.int32(lbl), pa)
+        np.testing.assert_array_equal(np.asarray(ea.count), np.asarray(eb.count))
+        for la, lb in zip(ea.lags, eb.lags):
+            np.testing.assert_array_equal(np.asarray(la.signal), np.asarray(lb.signal))
+            np.testing.assert_array_equal(
+                np.nan_to_num(np.asarray(la.upper_bound)),
+                np.nan_to_num(np.asarray(lb.upper_bound)),
+            )
+        assert int(ra.total_tx) == int(rb.total_tx)
+        np.testing.assert_array_equal(np.asarray(ra.signals_high), np.asarray(rb.signals_high))
+    for za, zb in zip(sa.zscores, sb.zscores):
+        np.testing.assert_array_equal(
+            np.nan_to_num(np.asarray(za.values)), np.nan_to_num(np.asarray(zb.values))
+        )
+        np.testing.assert_array_equal(np.asarray(za.pos), np.asarray(zb.pos))
+    np.testing.assert_array_equal(
+        np.nan_to_num(np.asarray(sa.stats.samples), nan=-1),
+        np.nan_to_num(np.asarray(sb.stats.samples), nan=-1),
+    )
