@@ -294,3 +294,65 @@ class LineRing:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------- percentile
+
+_pct_lib = None
+
+
+def _load_percentile_lib():
+    global _pct_lib
+    if _pct_lib is not None:
+        return _pct_lib
+    build = ensure_built()
+    if build is None:
+        return None
+    so = os.path.join(build, "libapmpercentile.so")
+    if not os.path.isfile(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.apm_window_percentiles.restype = ctypes.c_int
+    lib.apm_window_percentiles.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+    ]
+    _pct_lib = lib
+    return lib
+
+
+def have_native_percentiles() -> bool:
+    """True when libapmpercentile built/loaded (toolchain present)."""
+    return _load_percentile_lib() is not None
+
+
+def window_percentiles_native(samples, mask, ps):
+    """Exact reference percentiles over the window reservoir, selected with
+    std::nth_element per row — the CPU-fallback fast path for the staged
+    executor's percentile stage (native/percentile.cpp; exact-parity with
+    ops/stats.py topk/sort in the no-overflow regime, fuzz-tested).
+
+    samples: [S, NB, CAP] float32 C-contiguous numpy (NaN = empty slot);
+    mask: [NB] bool window-slot selector; ps: iterable of int percentiles.
+    Returns [S, len(ps)] float32 (NaN where a row's window is empty).
+    Raises RuntimeError when the library is unavailable or rejects the call.
+    """
+    import numpy as np
+
+    lib = _load_percentile_lib()
+    if lib is None:
+        raise RuntimeError("libapmpercentile unavailable (no native toolchain?)")
+    samples = np.ascontiguousarray(samples, dtype=np.float32)
+    S, NB, CAP = samples.shape
+    mask_u8 = np.ascontiguousarray(np.asarray(mask, bool), dtype=np.uint8)
+    if mask_u8.shape != (NB,):
+        raise ValueError(f"mask shape {mask_u8.shape} != ({NB},)")
+    ps_arr = np.ascontiguousarray(list(ps), dtype=np.int32)
+    out = np.empty((S, len(ps_arr)), np.float32)
+    rc = lib.apm_window_percentiles(
+        samples.ctypes.data, S, NB, CAP,
+        mask_u8.ctypes.data, ps_arr.ctypes.data, len(ps_arr), out.ctypes.data,
+    )
+    if rc != 0:
+        raise RuntimeError(f"apm_window_percentiles rc={rc}")
+    return out

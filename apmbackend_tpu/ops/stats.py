@@ -387,6 +387,36 @@ class TickResult(NamedTuple):
     overflowed: jnp.ndarray  # [S] bool: percentile computed on truncated samples
 
 
+def _window_panels(state: StatsState, cfg: StatsConfig):
+    """(in_window [NB], cnt, total, stored — each [S]) from the SMALL bucket
+    panels only; the shared front half of window_pre and window_stats."""
+    NB = cfg.num_buckets
+    latest = state.latest_bucket
+    # window labels: latest-keep .. latest-buffer (31 for stock config)
+    offsets = jnp.arange(cfg.buffer_sz, cfg.num_keep + 1, dtype=jnp.int32)
+    slots_w = (latest - offsets) % NB  # [W]
+    in_window = jnp.zeros((NB,), bool).at[slots_w].set(True)  # [NB]
+    cnt = jnp.sum(jnp.where(in_window[None, :], state.counts, 0), axis=1)  # [S]
+    total = jnp.sum(jnp.where(in_window[None, :], state.sums, 0), axis=1)  # [S]
+    stored = jnp.sum(jnp.where(in_window[None, :], state.nsamples, 0), axis=1)  # [S]
+    return in_window, cnt, total, stored
+
+
+def window_pre(state: StatsState, cfg: StatsConfig) -> TickResult:
+    """Window statistics WITHOUT percentiles (per75/per95 = NaN): the
+    tiny program the native-percentile staging dispatches first — it reads
+    only the [S, NB] bucket panels, never the sample reservoir. The host
+    then fills the percentiles (native selection kernel, or the weighted
+    jitted fallback on overflow) and hands the completed TickResult to the
+    core program."""
+    in_window, cnt, total, stored = _window_panels(state, cfg)
+    average = jnp.where(cnt > 0, total / cnt, jnp.nan)
+    overflowed = stored < cnt
+    tpm = cnt / (cfg.window_sz * cfg.interval_len_s / 60.0)
+    nanv = jnp.full(cnt.shape, jnp.nan, cfg.dtype)
+    return TickResult(tpm, average.astype(cfg.dtype), nanv, nanv, cnt, overflowed)
+
+
 def window_stats(state: StatsState, cfg: StatsConfig) -> TickResult:
     """Window statistics at the CURRENT latest label — strictly read-only
     (the staged executor runs it in a program that never writes the big
@@ -399,17 +429,8 @@ def window_stats(state: StatsState, cfg: StatsConfig) -> TickResult:
     pass — one streaming read of the reservoir, no materialized permutation.
     """
     NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
-    latest = state.latest_bucket
-    # window labels: latest-keep .. latest-buffer (31 for stock config)
-    offsets = jnp.arange(cfg.buffer_sz, cfg.num_keep + 1, dtype=jnp.int32)
-    slots_w = (latest - offsets) % NB  # [W]
-    in_window = jnp.zeros((NB,), bool).at[slots_w].set(True)  # [NB]
-
-    cnt = jnp.sum(jnp.where(in_window[None, :], state.counts, 0), axis=1)  # [S]
-    total = jnp.sum(jnp.where(in_window[None, :], state.sums, 0), axis=1)  # [S]
+    in_window, cnt, total, stored = _window_panels(state, cfg)
     average = jnp.where(cnt > 0, total / cnt, jnp.nan)
-
-    stored = jnp.sum(jnp.where(in_window[None, :], state.nsamples, 0), axis=1)  # [S]
     overflowed = stored < cnt
 
     S_rows = state.samples.shape[0]
@@ -417,6 +438,11 @@ def window_stats(state: StatsState, cfg: StatsConfig) -> TickResult:
         in_window[None, :, None], state.samples, jnp.nan
     ).reshape(S_rows, NB * CAP)
     impl = cfg.percentile_impl
+    if impl == "native":
+        # the native nth_element kernel lives on the HOST side of the staged
+        # executor (pipeline.make_engine_step); inside a single program the
+        # adaptive jitted path is its exact equivalent
+        impl = "auto"
 
     def _weighted():
         # count-weighted percentiles: each bucket's reservoir samples carry

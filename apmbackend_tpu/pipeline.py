@@ -148,6 +148,7 @@ def engine_init(cfg: EngineConfig) -> EngineState:
 def _engine_tick_impl(
     state: EngineState, cfg: EngineConfig, new_label, params: EngineParams,
     evicted: Optional[Tuple[jnp.ndarray, ...]],
+    stats_res: Optional[dstats.TickResult] = None,
 ) -> Tuple[TickEmission, EngineState, Tuple[jnp.ndarray, ...]]:
     """Shared fused-tick body. ``evicted`` selects the execution shape:
 
@@ -164,7 +165,12 @@ def _engine_tick_impl(
       programs together so every big buffer is only ever written by an
       in-place dynamic_update_slice in a read-free program.
     """
-    if evicted is not None:
+    if stats_res is not None:
+        # fully-precomputed window stats (native-percentile staging: the
+        # host filled per75/per95 outside this program)
+        res = stats_res
+        stats_state = state.stats
+    elif evicted is not None:
         res = dstats.window_stats(state.stats, cfg.stats)
         stats_state = state.stats
     else:
@@ -275,6 +281,15 @@ def engine_core_tick(
     return _engine_tick_impl(state, cfg, new_label, params, evicted)
 
 
+def engine_core_tick_stats(
+    state: EngineState, cfg: EngineConfig, new_label, params: EngineParams,
+    evicted: Tuple[jnp.ndarray, ...], stats_res: dstats.TickResult,
+) -> Tuple[TickEmission, EngineState, Tuple[jnp.ndarray, ...]]:
+    """Ring-free fused tick over HOST-completed window stats (the
+    native-percentile staging; see _engine_tick_impl)."""
+    return _engine_tick_impl(state, cfg, new_label, params, evicted, stats_res)
+
+
 def make_engine_step(cfg: EngineConfig):
     """The staged per-tick executor: ``step(state, new_label, params) ->
     (emission, new_state)`` with donation throughout.
@@ -292,12 +307,70 @@ def make_engine_step(cfg: EngineConfig):
       4. ring-write: one program of pure dynamic_update_slices (donated —
          the ONLY writer of the z-score rings; any same-program read would
          force a whole-ring copy on XLA:CPU, measured 736 ms vs 0.6 ms at
-         [8192, 3, 8640])."""
-    core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
-    return make_staged_executor(
-        cfg,
-        core=lambda state, nl, params, evicted: core(state, cfg, nl, params, evicted),
+         [8192, 3, 8640]).
+
+    On the CPU backend (percentileImpl auto/native, f32, toolchain present)
+    the percentile stage additionally moves to the HOST: a tiny jitted
+    program computes the panel stats, the native nth_element kernel selects
+    the exact reference percentiles straight from the (zero-copy) sample
+    reservoir, and the core program receives the completed TickResult —
+    ~3x cheaper than one-core XLA top_k. Any bucket overflow falls back to
+    the jitted count-weighted path for that tick. On TPU the in-program
+    top_k is the right shape and this stage stays fused."""
+    use_native = False
+    if (
+        cfg.stats.percentile_impl in ("auto", "native")
+        and cfg.stats.dtype != jnp.float64
+        and jax.default_backend() == "cpu"
+    ):
+        from . import native as _native
+
+        use_native = _native.have_native_percentiles()
+
+    if not use_native:
+        core = jax.jit(engine_core_tick, static_argnums=1, donate_argnums=(0,))
+        return make_staged_executor(
+            cfg,
+            core=lambda state, nl, params, evicted: core(state, cfg, nl, params, evicted),
+        )
+
+    from .native import window_percentiles_native
+
+    pre = jax.jit(dstats.window_pre, static_argnums=1)
+    # overflow tick: the count-weighted sort keeps burst arrival mass exact
+    weighted = jax.jit(
+        dstats.window_stats, static_argnums=1
     )
+    weighted_cfg = cfg.stats._replace(percentile_impl="sort")
+    core = jax.jit(engine_core_tick_stats, static_argnums=1, donate_argnums=(0,))
+    NB = cfg.stats.num_buckets
+    offsets = np.arange(cfg.stats.buffer_sz, cfg.stats.num_keep + 1)
+
+    def native_core(state, nl, params, evicted):
+        res = pre(state.stats, cfg.stats)
+        if bool(np.asarray(res.overflowed).any()):
+            res = weighted(state.stats, weighted_cfg)
+        else:
+            # anchor the window at the POST-advance latest label, exactly
+            # like window_pre/window_stats — on a stale re-emission tick
+            # (nl < latest: restore/replay out-of-order delivery) the
+            # advance loop left latest unchanged and nl would select the
+            # wrong slots
+            latest = int(state.stats.latest_bucket)
+            mask = np.zeros(NB, bool)
+            mask[(latest - offsets) % NB] = True
+            try:
+                samples = np.from_dlpack(state.stats.samples)  # zero-copy on CPU
+            except Exception:  # pragma: no cover - dlpack unavailable
+                samples = np.asarray(state.stats.samples)
+            pct = window_percentiles_native(samples, mask, (75, 95))
+            res = res._replace(
+                per75=jnp.asarray(pct[:, 0], cfg.stats.dtype),
+                per95=jnp.asarray(pct[:, 1], cfg.stats.dtype),
+            )
+        return core(state, cfg, nl, params, evicted, res)
+
+    return make_staged_executor(cfg, core=native_core)
 
 
 def sliding_lag_indices(cfg: EngineConfig) -> Tuple[int, ...]:

@@ -1,0 +1,119 @@
+// Exact window-percentile selection for the CPU execution path.
+//
+// The device engine's percentile step (apmbackend_tpu/ops/stats.py
+// window_stats) needs the reference's order statistics (util_methods.js
+// 112-142 index math re-expressed in percentile_rank) over each row's
+// window reservoir. On TPU, XLA's top_k is the right shape for the VPU; on
+// the ONE-core CPU fallback it is the dominant tick cost (~350 ms at
+// [8192 rows x 2368 slots]). std::nth_element selection is O(N) per row and
+// ~3x cheaper there, so the staged executor can hand this kernel the raw
+// sample ring (zero-copy via dlpack on the CPU backend) when no bucket has
+// overflowed — the exact-parity regime where every stored sample carries
+// weight 1 (overflow ticks take the count-weighted XLA path instead).
+//
+// Layout contract (ops/stats.py StatsState.samples): row-major
+// [S, NB, CAP] float32, NaN = empty slot; `mask[NB]` selects the window
+// buckets; values are finite or NaN (no infinities on the wire).
+//
+// For each row: gather the non-NaN samples of the masked slots into a
+// scratch buffer (n == the engine's `stored` count by construction), then
+// for each percentile p: rank/take_pair per the reference math; value =
+// nth_element at idx1, averaged with the MINIMUM of the upper partition
+// when take_pair (ascending successor). n == 0 emits NaN.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// mirror of ops/stats.py percentile_rank (itself the reference's
+// util_methods.js:112-142 integer index math): returns 0-based idx1 and
+// whether to average with the ascending successor
+inline void rank_for(int64_t n, int p, int64_t *idx1, bool *take_pair) {
+  const int64_t pn = p * n;
+  const bool is_int = (pn % 100) == 0;
+  const int64_t idx_exact = pn / 100 - 1;
+  const int64_t idx_ceil = (pn - 1) / 100;  // ceil(pn/100 - 1) for non-int
+  const int64_t last = n - 1;
+  *idx1 = (is_int || n == 1) ? std::max<int64_t>(idx_exact, 0) : idx_ceil;
+  *take_pair = !is_int && n > 1 && idx_ceil != last;
+}
+
+}  // namespace
+
+extern "C" {
+
+// samples: [S, NB, CAP] f32 row-major; mask: [NB] uint8 (1 = window slot);
+// ps: [n_ps] percentiles in (0, 100]; out: [S, n_ps] f32.
+// Returns 0 on success.
+int apm_window_percentiles(const float *samples, int64_t S, int64_t NB,
+                           int64_t CAP, const uint8_t *mask, const int *ps,
+                           int n_ps, float *out) {
+  if (S < 0 || NB <= 0 || CAP <= 0 || n_ps <= 0) return 1;
+  std::vector<float> buf;
+  buf.reserve(static_cast<size_t>(NB * CAP));
+  const int64_t row_stride = NB * CAP;
+  // ranks are non-decreasing in p for a fixed n, so process percentiles
+  // DESCENDING and shrink the nth_element range from the right: each
+  // selection also partitions, making the next (smaller-rank) selection
+  // cheaper. The order depends only on ps — computed once, not per row.
+  std::vector<int> order(n_ps);
+  for (int i = 0; i < n_ps; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(),
+            [&](int a, int b) { return ps[a] > ps[b]; });
+  for (int64_t s = 0; s < S; ++s) {
+    buf.clear();
+    const float *row = samples + s * row_stride;
+    for (int64_t b = 0; b < NB; ++b) {
+      if (!mask[b]) continue;
+      const float *slot = row + b * CAP;
+      for (int64_t k = 0; k < CAP; ++k) {
+        const float v = slot[k];
+        if (!std::isnan(v)) buf.push_back(v);
+      }
+    }
+    const int64_t n = static_cast<int64_t>(buf.size());
+    float *orow = out + s * n_ps;
+    if (n == 0) {
+      for (int i = 0; i < n_ps; ++i) orow[i] = std::nanf("");
+      continue;
+    }
+    int64_t hi = n;  // exclusive upper bound of the unpartitioned region
+    for (int oi = 0; oi < n_ps; ++oi) {
+      const int pi = order[oi];
+      int64_t idx1;
+      bool take_pair;
+      rank_for(n, ps[pi], &idx1, &take_pair);
+      if (idx1 >= n) idx1 = n - 1;  // defensive clamp (cannot happen for p<=100)
+      // target index of THIS selection; a previous (larger-p) selection
+      // shrank hi to its own index + 1, and adjacent ranks can make this
+      // target land exactly ON hi — where nth_element over [0, hi) would
+      // be a no-op on an unpartitioned slot. Widen the bound back to n for
+      // that (rare, adjacent-percentile) case; the left-partition property
+      // still holds for every later selection because bound only affects
+      // elements >= the selected rank.
+      const int64_t target = take_pair ? idx1 + 1 : idx1;
+      const int64_t bound = target >= hi ? n : hi;
+      if (take_pair) {
+        // select idx1+1 first: its left partition then holds a[idx1]
+        // as the max of [0, idx1+1)
+        const int64_t idx2 = idx1 + 1;
+        std::nth_element(buf.begin(), buf.begin() + idx2, buf.begin() + bound);
+        const float v2 = buf[idx2];
+        const float v1 =
+            *std::max_element(buf.begin(), buf.begin() + idx2);
+        orow[pi] = (v1 + v2) / 2.0f;
+        hi = idx2 + 1;
+      } else {
+        std::nth_element(buf.begin(), buf.begin() + idx1, buf.begin() + bound);
+        orow[pi] = buf[idx1];
+        hi = idx1 + 1;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

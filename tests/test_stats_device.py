@@ -521,3 +521,174 @@ def test_staged_step_label_jump_and_stale_label():
         np.nan_to_num(np.asarray(sa.stats.samples), nan=-1),
         np.nan_to_num(np.asarray(sb.stats.samples), nan=-1),
     )
+
+
+@pytest.mark.skipif(
+    not __import__("apmbackend_tpu.native", fromlist=["have_native_percentiles"]).have_native_percentiles(),
+    reason="native toolchain unavailable",
+)
+class TestNativePercentiles:
+    """The nth_element kernel (native/percentile.cpp) vs the jitted exact
+    paths: same order statistics, same reference index math, same NaN/empty
+    semantics — and the staged executor's host-percentile mode end to end."""
+
+    def test_kernel_matches_topk_fuzz(self):
+        from apmbackend_tpu.native import window_percentiles_native
+        from apmbackend_tpu.ops import stats as dstats
+
+        rng = np.random.RandomState(42)
+        for trial in range(6):
+            S, NB, CAP = 33, 9, 8
+            samples = (rng.rand(S, NB, CAP) * 1000).astype(np.float32)
+            samples[rng.rand(S, NB, CAP) < 0.35] = np.nan
+            samples[3] = np.nan  # empty row
+            if trial % 2:  # exercise tie-heavy data (take_pair neighbors equal)
+                samples = np.round(samples / 100) * 100
+            mask = np.zeros(NB, bool)
+            mask[rng.choice(NB, 5, replace=False)] = True
+            native = window_percentiles_native(samples, mask, (75, 95))
+            masked = np.where(mask[None, :, None], samples, np.nan).reshape(S, NB * CAP)
+            stored = np.sum(~np.isnan(masked), axis=1).astype(np.int32)
+            p75, p95 = dstats.topk_percentiles(
+                jnp.asarray(masked), jnp.asarray(stored), (75, 95)
+            )
+            np.testing.assert_array_equal(
+                np.nan_to_num(native[:, 0], nan=-1), np.nan_to_num(np.asarray(p75), nan=-1)
+            )
+            np.testing.assert_array_equal(
+                np.nan_to_num(native[:, 1], nan=-1), np.nan_to_num(np.asarray(p95), nan=-1)
+            )
+
+    def test_staged_native_matches_topk_engine(self):
+        """Full staged engine: the native-percentile mode must emit the same
+        wire values as the in-program topk mode tick for tick."""
+        from apmbackend_tpu.pipeline import (
+            engine_init, engine_ingest, make_demo_engine, make_engine_step,
+        )
+
+        cfg, _, params = make_demo_engine(32, 8, [(4, 3.0, 0.2)])
+        assert cfg.stats.percentile_impl == "auto"
+        ingest = jax.jit(engine_ingest, static_argnums=1)
+
+        def drive(cfg_used):
+            rng = np.random.RandomState(7)
+            state = engine_init(cfg_used)
+            step = make_engine_step(cfg_used)
+            label, out = 1000, []
+            for _ in range(12):
+                label += 1
+                e, state = step(state, label, params)
+                out.append(jax.device_get(e.average))
+                rows = rng.randint(0, 32, 96).astype(np.int32)
+                state = ingest(state, cfg_used, rows, np.full(96, label, np.int32),
+                               (100 + 100 * rng.rand(96)).astype(np.float32),
+                               np.ones(96, bool))
+            return out
+
+        a = drive(cfg)  # auto -> native host path on CPU
+        b = drive(cfg._replace(stats=cfg.stats._replace(percentile_impl="topk")))
+        for t, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                np.nan_to_num(x), np.nan_to_num(y), err_msg=f"tick {t}"
+            )
+
+    def test_staged_native_overflow_falls_back_weighted(self):
+        """When a bucket overflows its reservoir the host path must hand the
+        tick to the count-weighted jitted fallback (burst mass kept) — same
+        emissions as the pure jitted auto mode."""
+        from apmbackend_tpu.pipeline import (
+            engine_init, engine_ingest, make_demo_engine, make_engine_step,
+        )
+
+        cfg, _, params = make_demo_engine(8, 4, [(4, 3.0, 0.2)])  # CAP=4: easy overflow
+        ingest = jax.jit(engine_ingest, static_argnums=1)
+
+        def drive(cfg_used):
+            rng = np.random.RandomState(11)
+            state = engine_init(cfg_used)
+            step = make_engine_step(cfg_used)
+            label, out = 1000, []
+            for _ in range(10):
+                label += 1
+                e, state = step(state, label, params)
+                out.append((jax.device_get(e.average), bool(np.asarray(e.overflowed).any())))
+                rows = rng.randint(0, 8, 128).astype(np.int32)  # 16/row >> CAP
+                state = ingest(state, cfg_used, rows, np.full(128, label, np.int32),
+                               (100 + 100 * rng.rand(128)).astype(np.float32),
+                               np.ones(128, bool))
+            return out
+
+        a = drive(cfg)
+        b = drive(cfg._replace(stats=cfg.stats._replace(percentile_impl="sort")))
+        assert any(ov for _, ov in a), "the stream must actually overflow"
+        for t, ((x, _), (y, _)) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                np.nan_to_num(x), np.nan_to_num(y), err_msg=f"tick {t}"
+            )
+
+    def test_kernel_arbitrary_percentiles_vs_reference_math(self):
+        """Arbitrary percentile sets (incl. adjacent ranks hitting the
+        shrink-the-range boundary with take_pair — the case that once read
+        an unpartitioned slot) against the reference index math."""
+        from apmbackend_tpu.native import window_percentiles_native
+
+        def ref(vals, p):
+            a = np.sort(vals)
+            n = len(a)
+            pn = p * n
+            is_int = pn % 100 == 0
+            idx1 = max(pn // 100 - 1, 0) if (is_int or n == 1) else (pn - 1) // 100
+            take = (not is_int) and n > 1 and (pn - 1) // 100 != n - 1
+            return (a[idx1] + a[idx1 + 1]) / 2 if take else a[idx1]
+
+        rng = np.random.RandomState(0)
+        for trial in range(60):
+            n_vals = rng.randint(1, 33)
+            vals = (rng.rand(n_vals) * 100).astype(np.float32)
+            if trial % 3 == 0:
+                vals = np.round(vals / 10) * 10  # ties
+            CAP = 8
+            NB = (n_vals + CAP - 1) // CAP
+            samples = np.full((1, NB, CAP), np.nan, np.float32)
+            samples.ravel()[:n_vals] = vals
+            ps = tuple(sorted(
+                rng.choice(range(1, 101), rng.randint(1, 5), replace=False),
+                reverse=True))
+            out = window_percentiles_native(samples, np.ones(NB, bool), ps)
+            for j, p in enumerate(ps):
+                assert np.isclose(out[0, j], ref(vals, int(p)), rtol=1e-6), (
+                    trial, p, out[0, j], vals)
+
+    def test_staged_native_stale_label_window_anchor(self):
+        """A stale re-emission tick (nl < latest) must anchor the native
+        percentile mask at the POST-advance latest, exactly like the jitted
+        paths — bitwise vs the topk engine through the same stale stream."""
+        from apmbackend_tpu.pipeline import (
+            engine_init, engine_ingest, make_demo_engine, make_engine_step,
+        )
+
+        cfg, _, params = make_demo_engine(16, 8, [(4, 3.0, 0.2)])
+        ingest = jax.jit(engine_ingest, static_argnums=1)
+        labels = [1001, 1002, 1003, 1004, 1005, 1002, 1006]  # stale mid-stream
+
+        def drive(cfg_used):
+            rng = np.random.RandomState(5)
+            state = engine_init(cfg_used)
+            step = make_engine_step(cfg_used)
+            out = []
+            for lbl in labels:
+                e, state = step(state, lbl, params)
+                out.append(jax.device_get(e.average))
+                rows = rng.randint(0, 16, 64).astype(np.int32)
+                state = ingest(state, cfg_used, rows,
+                               np.full(64, max(lbl, 1001), np.int32),
+                               (100 + 100 * rng.rand(64)).astype(np.float32),
+                               np.ones(64, bool))
+            return out
+
+        a = drive(cfg)
+        b = drive(cfg._replace(stats=cfg.stats._replace(percentile_impl="topk")))
+        for t, (x, y) in enumerate(zip(a, b)):
+            np.testing.assert_array_equal(
+                np.nan_to_num(x), np.nan_to_num(y), err_msg=f"label {labels[t]}"
+            )
