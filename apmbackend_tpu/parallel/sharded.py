@@ -193,35 +193,128 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
       3. the shard_mapped ring-free core with the ICI fleet rollup — the
          only program with collectives,
       4. pure-DUS ring writes (plain jit, donated).
+
+    On the CPU backend (single process, percentileImpl auto/native, f32,
+    toolchain present) the percentile stage moves to the HOST exactly like
+    the single-chip executor, but per addressable shard: each device's
+    sample-reservoir block is viewed zero-copy and handed to the native
+    nth_element kernel — on a real pod each HOST would select only its own
+    shards' percentiles, so the reservoir never crosses a host boundary.
+    Overflow ticks fall back to the in-program jitted paths.
     """
     from ..pipeline import make_staged_executor, sliding_lag_indices
 
     n = mesh.devices.size
     lcfg = local_config(cfg, n)
     espec = tuple(_ROW for _ in sliding_lag_indices(cfg))
-    core = jax.jit(
-        shard_map(
-            _local_core_with_rollup(lcfg),
-            mesh=mesh,
-            in_specs=(_state_specs(cfg), P(), _params_specs(cfg), espec),
-            out_specs=(
-                _emission_specs(cfg),
-                FleetRollup(P(), P(), P(), P(), P()),
-                _state_specs(cfg),
-                espec,
+
+    def _make_core(local_fn, extra_in=(), extra_out=()):
+        return jax.jit(
+            shard_map(
+                local_fn,
+                mesh=mesh,
+                in_specs=(_state_specs(cfg), P(), _params_specs(cfg), espec) + extra_in,
+                out_specs=(
+                    _emission_specs(cfg),
+                    FleetRollup(P(), P(), P(), P(), P()),
+                    _state_specs(cfg),
+                    espec,
+                ) + extra_out,
             ),
-        ),
-        donate_argnums=(0,),
+            donate_argnums=(0,),
+        )
+
+    use_native = False
+    if (
+        cfg.stats.percentile_impl in ("auto", "native")
+        and cfg.stats.dtype != jnp.float64
+        and jax.default_backend() == "cpu"
+        and jax.process_count() == 1
+    ):
+        from .. import native as _native
+
+        use_native = _native.have_native_percentiles()
+
+    if not use_native:
+        core = _make_core(_local_core_with_rollup(lcfg))
+        # the staging choreography itself (advance clamp, evict/write slot
+        # math, donation order) is pipeline.make_staged_executor — ONE
+        # implementation for the single-chip and pod executors
+        return make_staged_executor(
+            cfg,
+            core=lambda state, nl, params, evicted: core(
+                state, jnp.int32(nl), params, evicted
+            ),
+        )
+
+    from ..native import window_percentiles_native
+    from ..ops import stats as dstats_mod
+    from ..pipeline import engine_core_tick_stats
+
+    # panel stats per shard (no collectives: per-row quantities)
+    pre = jax.jit(
+        shard_map(
+            lambda st: dstats_mod.window_pre(st, lcfg.stats),
+            mesh=mesh,
+            in_specs=(_state_specs(cfg).stats,),
+            out_specs=dstats_mod.TickResult(_ROW, _ROW, _ROW, _ROW, _ROW, _ROW),
+        )
     )
-    # the staging choreography itself (advance clamp, evict/write slot math,
-    # donation order) is pipeline.make_staged_executor — ONE implementation
-    # for the single-chip and pod executors
-    return make_staged_executor(
-        cfg,
-        core=lambda state, nl, params, evicted: core(
-            state, jnp.int32(nl), params, evicted
-        ),
+    weighted_lcfg = lcfg.stats._replace(percentile_impl="sort")
+    weighted = jax.jit(
+        shard_map(
+            lambda st: dstats_mod.window_stats(st, weighted_lcfg),
+            mesh=mesh,
+            in_specs=(_state_specs(cfg).stats,),
+            out_specs=dstats_mod.TickResult(_ROW, _ROW, _ROW, _ROW, _ROW, _ROW),
+        )
     )
+    res_spec = dstats_mod.TickResult(_ROW, _ROW, _ROW, _ROW, _ROW, _ROW)
+
+    def _core_stats(state, new_label, params, evicted, stats_res):
+        emission, new_state, pushes = engine_core_tick_stats(
+            state, lcfg, new_label, params, evicted, stats_res
+        )
+        return emission, _fleet_rollup(emission), new_state, pushes
+
+    core = _make_core(_core_stats, extra_in=(res_spec,))
+    NB = cfg.stats.num_buckets
+    offsets = np.arange(cfg.stats.buffer_sz, cfg.stats.num_keep + 1)
+    pct_sharding = jax.sharding.NamedSharding(mesh, _ROW)
+
+    def native_core(state, nl, params, evicted):
+        res = pre(state.stats)
+        if bool(np.asarray(res.overflowed).any()):
+            res = weighted(state.stats)
+        else:
+            latest = int(state.stats.latest_bucket)
+            mask = np.zeros(NB, bool)
+            mask[(latest - offsets) % NB] = True
+            # per addressable shard: zero-copy view of the local reservoir
+            # block, kernel per block — the multi-host layout (each host
+            # does only its own shards; shards arrive row-ordered)
+            shards = sorted(
+                state.stats.samples.addressable_shards, key=lambda s: s.index[0].start or 0
+            )
+            blocks = []
+            for sh in shards:
+                try:
+                    block = np.from_dlpack(sh.data)
+                except Exception:  # pragma: no cover - dlpack unavailable
+                    block = np.asarray(sh.data)
+                blocks.append(window_percentiles_native(block, mask, (75, 95)))
+            pct = np.concatenate(blocks, axis=0)
+            res = res._replace(
+                per75=jax.device_put(
+                    np.ascontiguousarray(pct[:, 0]), pct_sharding
+                ).astype(cfg.stats.dtype),
+                per95=jax.device_put(
+                    np.ascontiguousarray(pct[:, 1]), pct_sharding
+                ).astype(cfg.stats.dtype),
+            )
+        return core(state, jnp.int32(nl), params, evicted, res)
+
+    return make_staged_executor(cfg, core=native_core)
 
 
 def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):
