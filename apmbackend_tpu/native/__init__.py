@@ -356,3 +356,82 @@ def window_percentiles_native(samples, mask, ps):
     if rc != 0:
         raise RuntimeError(f"apm_window_percentiles rc={rc}")
     return out
+
+
+# ------------------------------------------------------------------ rebuild
+
+_rebuild_lib = None
+
+
+def _load_rebuild_lib():
+    global _rebuild_lib
+    if _rebuild_lib is not None:
+        return _rebuild_lib
+    build = ensure_built()
+    if build is None:
+        return None
+    so = os.path.join(build, "libapmrebuild.so")
+    if not os.path.isfile(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.apm_rebuild_window_aggs.restype = ctypes.c_int
+    lib.apm_rebuild_window_aggs.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    _rebuild_lib = lib
+    return lib
+
+
+def have_native_rebuild() -> bool:
+    """True when libapmrebuild built/loaded (toolchain present)."""
+    return _load_rebuild_lib() is not None
+
+
+def window_aggs_native(ring_chunk, anchor, last_slot: int):
+    """Streaming anchored window moments over a [R, 3, L] ring chunk — the
+    native partial producer of the staggered sliding-aggregate rebuild
+    (native/rebuild.cpp; double accumulators, so strictly tighter than the
+    f32 XLA reduce it substitutes on the CPU path). Merge-back happens in
+    ops/zscore.py merge_agg_slice, shared with the XLA producer.
+
+    ring_chunk: [R, 3, L] C-contiguous numpy, float32 or bfloat16 exposed as
+    uint16 (ml_dtypes bfloat16 views also accepted); anchor: [R, 3] float32;
+    last_slot: the (pos - 1) mod L ring slot of the most recent push.
+    Returns (cnt i32, vsum f32, vsumsq f32, vmin f32, vmax f32, last_push
+    f32), each [R, 3]. Raises RuntimeError when the library is unavailable.
+    """
+    import numpy as np
+
+    lib = _load_rebuild_lib()
+    if lib is None:
+        raise RuntimeError("libapmrebuild unavailable (no native toolchain?)")
+    ring_chunk = np.ascontiguousarray(ring_chunk)
+    if ring_chunk.dtype == np.float32:
+        is_bf16 = 0
+    elif ring_chunk.dtype.itemsize == 2:  # bfloat16 (ml_dtypes) or uint16 bits
+        is_bf16 = 1
+    else:
+        raise ValueError(f"unsupported ring dtype {ring_chunk.dtype}")
+    R, M, L = ring_chunk.shape
+    if M != 3:
+        raise ValueError(f"expected metric axis 3, got {M}")
+    anchor = np.ascontiguousarray(anchor, np.float32)
+    if anchor.shape != (R, 3):
+        raise ValueError(f"anchor shape {anchor.shape} != ({R}, 3)")
+    cnt = np.empty((R, 3), np.int32)
+    vsum = np.empty((R, 3), np.float32)
+    vsumsq = np.empty((R, 3), np.float32)
+    vmin = np.empty((R, 3), np.float32)
+    vmax = np.empty((R, 3), np.float32)
+    last_push = np.empty((R, 3), np.float32)
+    rc = lib.apm_rebuild_window_aggs(
+        ring_chunk.ctypes.data, is_bf16, R, L, int(last_slot),
+        anchor.ctypes.data, cnt.ctypes.data, vsum.ctypes.data,
+        vsumsq.ctypes.data, vmin.ctypes.data, vmax.ctypes.data,
+        last_push.ctypes.data,
+    )
+    if rc != 0:
+        raise RuntimeError(f"apm_rebuild_window_aggs rc={rc}")
+    return cnt, vsum, vsumsq, vmin, vmax, last_push

@@ -254,6 +254,90 @@ def rebuild_agg_state(state: ZScoreState, cfg: ZScoreConfig) -> ZScoreState:
     return state._replace(agg=agg)
 
 
+def rebuild_chunk_rows(capacity: int, rebuild_every: int) -> int:
+    """Row-chunk size of the STAGGERED rebuild schedule: the whole ring is
+    re-aggregated once per ``rebuild_every`` ticks, one contiguous row chunk
+    per tick, so the worst tick pays ~1/rebuild_every of the full pass
+    instead of one tick absorbing it all (the monolithic rebuild_agg_state
+    stalled a tick for seconds at pod shapes). Every row's rebuild interval
+    stays <= rebuild_every ticks — the drift/blind-spot bound is unchanged."""
+    return max(1, -(-capacity // max(rebuild_every, 1)))
+
+
+def build_agg_slice_partials(state: ZScoreState, cfg: ZScoreConfig, row_start, chunk: int):
+    """Fresh anchored window moments for ring rows [row_start, row_start+chunk)
+    — the in-program (XLA) partial producer of the staggered rebuild. Returns
+    ``(cnt, vsum, vsumsq, anchor, vmin, vmax, last_push)``, each [chunk, 3].
+    Per-row math is identical to build_agg's single-anchor pass (rows are
+    independent under the last-axis reduce), so applying every chunk of a
+    cycle reproduces rebuild_agg_state BITWISE. ``chunk`` is static;
+    ``row_start`` is traced (one compiled program serves the whole rotation).
+    """
+    old = state.agg
+    vals = jax.lax.dynamic_slice_in_dim(state.values, row_start, chunk, axis=0)
+    if vals.dtype != cfg.dtype:
+        vals = vals.astype(cfg.dtype)
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, row_start, chunk, axis=0)
+    # the incremental mean is a valid variance anchor (rebuild_agg_state)
+    cnt_o, vsum_o, anchor_o = sl(old.cnt), sl(old.vsum), sl(old.anchor)
+    anchor = jnp.where(
+        cnt_o > 0, anchor_o + vsum_o / jnp.maximum(cnt_o, 1), anchor_o
+    ).astype(cfg.dtype)
+    valid = ~jnp.isnan(vals)
+    cnt, vsum, vsumsq, vmin, vmax = fused_window_partials_sq(vals, valid, anchor[..., None])
+    L = state.values.shape[-1]
+    g = jnp.asarray(state.pos, jnp.int32)
+    last_push = jax.lax.dynamic_slice_in_dim(vals, (g - 1) % L, 1, axis=2)[..., 0]
+    return (cnt.astype(jnp.int32), vsum.astype(cfg.dtype), vsumsq.astype(cfg.dtype),
+            anchor, vmin, vmax, last_push.astype(cfg.dtype))
+
+
+def merge_agg_slice(
+    agg: SlidingAgg, cfg: ZScoreConfig, row_start,
+    cnt, vsum, vsumsq, anchor, vmin, vmax, last_push,
+) -> SlidingAgg:
+    """Fold freshly-rebuilt chunk partials (either producer: the XLA slice
+    pass above or the native streaming kernel) back into the full [S, 3]
+    aggregates. ONE merge implementation so the two producers cannot drift:
+    the all-equal proof (min == max) repairs run_len/last_valid exactly as
+    rebuild_agg_state does; unproved rows keep their incrementally-exact
+    counters. All leaves are [S, 3] — the DUS writes are noise next to the
+    ring pass they retire."""
+    dt = cfg.dtype
+    all_eq = (cnt > 0) & (vmin == vmax)
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, row_start, cnt.shape[0], axis=0)
+    run_len = jnp.where(all_eq, cnt, sl(agg.run_len)).astype(jnp.int32)
+    last_valid = jnp.where(all_eq, vmax, sl(agg.last_valid)).astype(dt)
+    up = lambda full, part: jax.lax.dynamic_update_slice_in_dim(
+        full, part.astype(full.dtype), row_start, axis=0
+    )
+    return SlidingAgg(
+        cnt=up(agg.cnt, cnt),
+        vsum=up(agg.vsum, vsum),
+        vsumsq=up(agg.vsumsq, vsumsq),
+        anchor=up(agg.anchor, anchor),
+        run_len=up(agg.run_len, run_len),
+        last_valid=up(agg.last_valid, last_valid),
+        last_push=up(agg.last_push, last_push),
+    )
+
+
+def rebuild_agg_slice(state: ZScoreState, cfg: ZScoreConfig, row_start, chunk: int) -> ZScoreState:
+    """One staggered-rebuild step: exact re-aggregation of ring rows
+    [row_start, row_start+chunk) only (rebuild_chunk_rows sizes the chunk so
+    a full rotation spans cfg.rebuild_every ticks). The host loop clamps
+    row_start to capacity-chunk, so when chunk does not divide capacity the
+    tail chunk overlaps a few already-rebuilt rows — exact but not bitwise
+    for those rows (their second rebuild derives its anchor from the
+    just-refreshed aggregates). When chunk divides capacity, applying all
+    chunks back-to-back is BITWISE rebuild_agg_state; ragged capacities are
+    value-exact (both tested). No-op for non-sliding configs."""
+    if not cfg.sliding_active or state.agg is None:
+        return state
+    parts = build_agg_slice_partials(state, cfg, row_start, chunk)
+    return state._replace(agg=merge_agg_slice(state.agg, cfg, row_start, *parts))
+
+
 def _fused_reduce(vals: jnp.ndarray, valid: jnp.ndarray, anchor=None):
     """ONE variadic lax.reduce over the last axis. Without ``anchor``:
     (count, raw sum, min, max). With ``anchor``: (count, shifted sum,

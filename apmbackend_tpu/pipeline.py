@@ -474,6 +474,154 @@ def engine_needs_rebuild(cfg: EngineConfig) -> bool:
     return any(zscore_cfg(cfg, spec).sliding_active for spec in cfg.lags)
 
 
+def engine_rebuild_slice(state: EngineState, cfg: EngineConfig, row_start, chunk: int) -> EngineState:
+    """One STAGGERED-rebuild step: exact re-aggregation of ring rows
+    [row_start, row_start+chunk) for every sliding lag (ops/zscore.py
+    rebuild_agg_slice). RebuildScheduler calls this every tick on a rotating
+    chunk so the whole ring is re-aggregated once per
+    ``cfg.zscore_rebuild_every`` ticks with no tick ever paying a full ring
+    pass — the production cadence replacing the monolithic
+    engine_rebuild_aggs stall. jittable; ``cfg``/``chunk`` static."""
+    zstates = tuple(
+        dzscore.rebuild_agg_slice(z, zscore_cfg(cfg, spec), row_start, chunk)
+        for z, spec in zip(state.zscores, cfg.lags)
+    )
+    return state._replace(zscores=zstates)
+
+
+class RebuildScheduler:
+    """Host-side rotation of the staggered sliding-aggregate rebuild.
+
+    ``step(state)`` is called once per engine tick; it rebuilds ONE
+    contiguous row chunk (rebuild_chunk_rows sizes it so a full rotation
+    spans ``cfg.zscore_rebuild_every`` ticks) and returns the new state.
+    Every row's rebuild interval stays <= rebuild_every ticks — the same
+    drift/blind-spot bound as the monolithic pass, minus the multi-second
+    tick stall at pod shapes (the reference pays its window recompute on
+    EVERY entry, stream_calc_z_score.js:66-104; this is the amortized
+    replacement being staggered).
+
+    Backend-adaptive like the percentile stage: on the single-process CPU
+    backend with the toolchain present, the chunk pass runs in the native
+    streaming kernel (native/rebuild.cpp, ~25x the XLA:CPU variadic reduce)
+    against zero-copy dlpack ring views, and only the [chunk, 3] partials
+    enter the jitted merge (ops/zscore.py merge_agg_slice). Everywhere else
+    (TPU, no toolchain) the whole slice rebuild runs in one jitted program
+    — on TPU the fused reduce over a [chunk, 3, L] slice is microseconds.
+    A native-path failure permanently falls back to the jitted path.
+    """
+
+    def __init__(self, cfg: EngineConfig, *, allow_native: Optional[bool] = None):
+        self.cfg = cfg
+        self.active = engine_needs_rebuild(cfg)
+        if not self.active:
+            return
+        S = cfg.capacity
+        self.chunk = dzscore.rebuild_chunk_rows(S, cfg.zscore_rebuild_every)
+        self.n_chunks = -(-S // self.chunk)
+        # ragged tail chunks clamp (re-rebuilding a few rows is harmless —
+        # the rebuild is idempotent) so ONE compiled program serves all
+        self.starts = [min(i * self.chunk, S - self.chunk) for i in range(self.n_chunks)]
+        self._i = 0
+        self._sliding_idx = sliding_lag_indices(cfg)
+        self._slice_fn = jax.jit(
+            engine_rebuild_slice, static_argnums=(1, 3), donate_argnums=(0,)
+        )
+        if allow_native is None:
+            allow_native = (
+                jax.default_backend() == "cpu"
+                and jax.process_count() == 1
+                and cfg.stats.dtype != jnp.float64
+            )
+        self._native = False
+        if allow_native:
+            from . import native as _native
+
+            self._native = _native.have_native_rebuild()
+        if self._native:
+
+            def _make_merge(zc):
+                def m(agg, row_start, cnt, vsum, vsumsq, anchor, vmin, vmax, last_push):
+                    return dzscore.merge_agg_slice(
+                        agg, zc, row_start, cnt, vsum, vsumsq, anchor, vmin, vmax, last_push
+                    )
+
+                # NO donation: the [S, 3] leaf copies are noise, and keeping
+                # the old agg buffers alive makes the jitted fallback safe
+                # even if a multi-lag native step fails halfway through
+                return jax.jit(m)
+
+            self._merge_fns = {
+                i: _make_merge(zscore_cfg(cfg, cfg.lags[i])) for i in self._sliding_idx
+            }
+
+    def step(self, state: EngineState) -> EngineState:
+        """Rebuild this tick's due chunk; returns the updated state."""
+        if not self.active:
+            return state
+        start = self.starts[self._i]
+        self._i = (self._i + 1) % self.n_chunks
+        if self._native:
+            try:
+                return self._native_step(state, start)
+            except Exception:
+                # e.g. dlpack view unavailable — fall back permanently, but
+                # never silently: the jitted slice path is ~25x slower on CPU
+                self._native = False
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native staggered rebuild failed; falling back to the "
+                    "jitted slice path for the rest of the process",
+                    exc_info=True,
+                )
+        return self._slice_fn(state, self.cfg, start, self.chunk)
+
+    @staticmethod
+    def _ring_view(values) -> np.ndarray:
+        """Zero-copy numpy view of a CPU-backend ring. bfloat16 rings (which
+        numpy's dlpack import rejects) are exposed as their uint16 bit
+        pattern straight from the device buffer — the kernel's is_bf16
+        branch decodes bits << 16, so no 850 MB cast ever materializes."""
+        try:
+            return np.from_dlpack(values)
+        except Exception:
+            import ctypes
+
+            n = int(np.prod(values.shape))
+            ptr = values.addressable_shards[0].data.unsafe_buffer_pointer()
+            buf = (ctypes.c_uint16 * n).from_address(ptr)
+            return np.frombuffer(buf, np.uint16).reshape(values.shape)
+
+    def _native_step(self, state: EngineState, start: int) -> EngineState:
+        from . import native as _native
+
+        zs = list(state.zscores)
+        end = start + self.chunk
+        for i in self._sliding_idx:
+            z = zs[i]
+            agg = z.agg
+            ring = self._ring_view(z.values)  # zero-copy on the CPU backend
+            cnt = np.from_dlpack(agg.cnt)[start:end]
+            vsum = np.from_dlpack(agg.vsum)[start:end]
+            anc = np.from_dlpack(agg.anchor)[start:end]
+            # the incremental mean as the variance anchor (rebuild_agg_state);
+            # maximum(cnt,1) values are exact in f32, so this matches the
+            # jitted producer's f32 arithmetic
+            anchor_est = np.where(
+                cnt > 0, anc + vsum / np.maximum(cnt, 1).astype(np.float32), anc
+            ).astype(np.float32)
+            L = ring.shape[-1]
+            last_slot = (int(z.pos) - 1) % L
+            c, vs, vs2, mn, mx, lastp = _native.window_aggs_native(
+                ring[start:end], anchor_est, last_slot
+            )
+            zs[i] = z._replace(
+                agg=self._merge_fns[i](agg, start, c, vs, vs2, anchor_est, mn, mx, lastp)
+            )
+        return state._replace(zscores=tuple(zs))
+
+
 def engine_derive_aggs(state: EngineState, cfg: EngineConfig) -> EngineState:
     """Derive the sliding aggregates from freshly-restored rings — the ONE
     restore-time derivation, shared by the npz load_resume and the orbax
@@ -567,6 +715,7 @@ def make_demo_engine(
     *,
     hard_max_ms: float = 10000.0,
     ewma_channels: Sequence[dict] = (),
+    ring_dtype: Optional[str] = None,
 ) -> Tuple[EngineConfig, EngineState, EngineParams]:
     """(cfg, fresh state, uniform params) for benches/dryruns/tests.
 
@@ -586,6 +735,8 @@ def make_demo_engine(
     cfg_tree["tpuEngine"]["samplesPerBucket"] = samples_per_bucket
     if ewma_channels:
         cfg_tree["tpuEngine"]["ewmaChannels"] = list(ewma_channels)
+    if ring_dtype is not None:
+        cfg_tree["tpuEngine"]["zscoreRingDtype"] = ring_dtype
     cfg = build_engine_config(cfg_tree, capacity)
     state = engine_init(cfg)
     S = cfg.capacity
@@ -693,9 +844,7 @@ class PipelineDriver:
         # recompiles automatically through these two callables
         self._step = make_engine_step(self.cfg)
         self._ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
-        self._rebuild = jax.jit(engine_rebuild_aggs, static_argnums=1, donate_argnums=(0,))
-        self._needs_rebuild = engine_needs_rebuild(self.cfg)
-        self._ticks_since_rebuild = 0
+        self._rebuild_sched = RebuildScheduler(self.cfg)
 
     # -- params / growth -----------------------------------------------------
     def _refresh_params(self) -> None:
@@ -751,8 +900,10 @@ class PipelineDriver:
         ecounters = tuple(jnp.pad(c, (0, pad_n)) for c in self.state.ewma_counters)
         self.cfg = self.cfg._replace(stats=stats_cfg)
         self.state = EngineState(stats_state, tuple(zstates), counters, estates, ecounters)
-        # the staged step closes over cfg (capacity changed: new programs)
+        # the staged step closes over cfg (capacity changed: new programs);
+        # the rebuild rotation restarts at chunk 0 — harmless (idempotent)
         self._step = make_engine_step(self.cfg)
+        self._rebuild_sched = RebuildScheduler(self.cfg)
         self._refresh_params()
 
     def _row_for(self, server: str, service: str) -> int:
@@ -1115,13 +1266,11 @@ class PipelineDriver:
             # the next tick boundary — the reference's per-key list creation
             self._refresh_params()
         emission, self.state = self._step(self.state, new_label, self.params)
-        # amortized exact rebuild of the sliding z-score aggregates (drift
-        # cancellation; ops/zscore.py rebuild_agg_state). Host-counted so the
-        # jitted tick never has to hold the whole ring in a cond branch.
-        self._ticks_since_rebuild += 1
-        if self._needs_rebuild and self._ticks_since_rebuild >= self.cfg.zscore_rebuild_every:
-            self._ticks_since_rebuild = 0
-            self.state = self._rebuild(self.state, self.cfg)
+        # staggered exact rebuild of the sliding z-score aggregates: one row
+        # chunk per tick on a rotating schedule (RebuildScheduler), so the
+        # full-ring drift cancellation never stalls a tick. Host-dispatched —
+        # the jitted tick never has to hold the whole ring in a cond branch.
+        self.state = self._rebuild_sched.step(self.state)
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
 
         # ordered tx drain to DB (heap pop up to edge timestamp)
