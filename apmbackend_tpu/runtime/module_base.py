@@ -174,7 +174,8 @@ class ModuleRuntime:
         """Stop the interval timers, the queue-stats logger, and the config
         watcher WITHOUT running exit handlers or exiting the process — for
         embedders (standalone pipeline, tests) that tear runtimes down
-        in-process."""
+        in-process. JOINS every timer thread (bounded) so no interval
+        callback can fire into closed log streams after this returns."""
         self._stop.set()
         if self.watcher is not None:
             self.watcher.stop()
@@ -182,6 +183,10 @@ class ModuleRuntime:
             self.qm.queue_stats.stop()
         except Exception:
             pass
+        me = threading.current_thread()
+        for t in self._timers:
+            if t is not me and t.is_alive():
+                t.join(timeout=5.0)
 
     def exit(self, code: int = 0) -> None:
         if self._exiting:

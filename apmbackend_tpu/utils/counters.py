@@ -19,6 +19,10 @@ class QueueStats:
         self._counters: Dict[str, dict] = {}
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        # terminal: once stop() runs, no timer may ever be (re)armed — an
+        # in-flight _fire used to re-schedule AFTER stop() cancelled, leaving
+        # a zombie timer logging into closed streams at interpreter teardown
+        self._stopped = False
 
     def set_interval(self, interval_seconds: int) -> None:
         self.interval = interval_seconds
@@ -26,7 +30,7 @@ class QueueStats:
     def add_counter(self, name: str, ctype: str, init_val: int = 0) -> None:
         with self._lock:
             self._counters[name] = {"type": ctype, "cnt": init_val}
-            need_timer = self._timer is None
+            need_timer = self._timer is None and not self._stopped
         if need_timer:
             self._schedule()
 
@@ -47,20 +51,37 @@ class QueueStats:
     def _schedule(self) -> None:
         # Second-aligned like logQueueStatsRecurs (queue.js:54-63).
         timeout = self.interval - (int(time.time()) % self.interval)
-        self._timer = threading.Timer(timeout, self._fire)
-        self._timer.daemon = True
-        self._timer.start()
+        with self._lock:
+            if self._stopped:
+                return
+            self._timer = threading.Timer(timeout, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
 
     def _fire(self) -> None:
         line = self.snapshot_and_reset()
         if line and self.logger:
-            self.logger.info(line)
+            try:
+                self.logger.info(line)
+            except ValueError:
+                # the log stream closed between our stop() check and the
+                # write (interpreter/suite teardown ordering) — stand down
+                return
         self._schedule()
 
-    def stop(self) -> None:
-        if self._timer:
-            self._timer.cancel()
-            self._timer = None
+    def stop(self, *, join_timeout_s: float = 5.0) -> None:
+        """Terminal: cancel the pending timer and JOIN any in-flight _fire so
+        the stats thread is provably gone before the owner closes its log
+        streams (weak #4, round-4 VERDICT). Idempotent; safe from any thread
+        except the timer thread itself (Timer.join would self-deadlock, so a
+        self-call just cancels)."""
+        with self._lock:
+            self._stopped = True
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
+            if timer is not threading.current_thread():
+                timer.join(timeout=join_timeout_s)
 
 
 class DBStats:

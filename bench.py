@@ -54,6 +54,10 @@ def run_worker(args) -> None:
     """The measurement body. Assumes it owns the process; prints one JSON line."""
     import numpy as np
 
+    from benchmarks.common import enable_compile_cache
+
+    enable_compile_cache()
+
     import jax
 
     from apmbackend_tpu.pipeline import engine_ingest, make_demo_engine, make_engine_step
@@ -61,6 +65,8 @@ def run_worker(args) -> None:
     device = jax.devices()[0]
     # Tell the launcher's watchdog that backend init survived.
     print(f"{READY_SENTINEL} {device.platform}", file=sys.stderr, flush=True)
+
+    from apmbackend_tpu.pipeline import RebuildScheduler
 
     cfg, state, params = make_demo_engine(
         args.capacity, args.samples_per_bucket, [(lag, 20.0, 0.1) for lag in args.lags]
@@ -70,6 +76,10 @@ def run_worker(args) -> None:
     # staged executor: ring writes stay in-place dynamic_update_slices
     tick = make_engine_step(cfg)
     ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    # production rebuild cadence: one staggered row chunk EVERY tick (the
+    # full ring re-aggregates once per zscore_rebuild_every ticks), executed
+    # and charged inside the measured loop — no pro-rata estimates
+    sched = RebuildScheduler(cfg)
 
     rng = np.random.RandomState(0)
     B = args.batch
@@ -88,11 +98,13 @@ def run_worker(args) -> None:
         label += 1
         em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
+        state = sched.step(state)  # compiles the slice/merge programs
         state = ingest(state, cfg, *make_batch(label))
     jax.block_until_ready(state.stats.counts)
 
     # measured loop
     tick_latencies = []
+    rebuild_times = []
     ingest_times = []
     overflow_row_ticks = 0
     t_start = time.perf_counter()
@@ -106,6 +118,11 @@ def run_worker(args) -> None:
         t1 = time.perf_counter()
         tick_latencies.append(t1 - t0)
         overflow_row_ticks += int(np.asarray(em.overflowed).sum())  # untimed: telemetry
+        # the staggered rebuild chunk runs between ticks (detection latency
+        # unaffected) but its wall time is charged to throughput
+        tr = time.perf_counter()
+        state = sched.step_synced(state)
+        rebuild_times.append(time.perf_counter() - tr)
         batch = make_batch(label)
         t2 = time.perf_counter()
         state = ingest(state, cfg, *batch)
@@ -113,26 +130,8 @@ def run_worker(args) -> None:
         ingest_times.append(time.perf_counter() - t2)
     total = time.perf_counter() - t_start
 
-    # amortized cost of the periodic exact rebuild of the sliding z-score
-    # aggregates (pipeline.engine_rebuild_aggs, every zscore_rebuild_every
-    # ticks in the driver): measured once, charged pro-rata to throughput —
-    # detection latency is unaffected (the rebuild runs between ticks)
-    from apmbackend_tpu.pipeline import engine_needs_rebuild, engine_rebuild_aggs
-
-    rebuild_ms = 0.0
-    if engine_needs_rebuild(cfg):
-        rb = jax.jit(engine_rebuild_aggs, static_argnums=1, donate_argnums=(0,))
-        state = rb(state, cfg)
-        jax.block_until_ready(state.stats.counts)  # compile
-        t0 = time.perf_counter()
-        state = rb(state, cfg)
-        jax.block_until_ready(state.stats.counts)
-        rebuild_ms = (time.perf_counter() - t0) * 1000
-
     metrics_per_tick = S * 3 * len(cfg.lags)
-    tick_time_total = sum(tick_latencies) + (
-        rebuild_ms / 1000 * args.ticks / cfg.zscore_rebuild_every
-    )
+    tick_time_total = sum(tick_latencies) + sum(rebuild_times)
     throughput = metrics_per_tick * args.ticks / tick_time_total
     p50_ms = float(np.percentile(np.array(tick_latencies) * 1000, 50))
     ingest_tx_s = B * args.ticks / sum(ingest_times)
@@ -167,8 +166,10 @@ def run_worker(args) -> None:
             "host_intake_tx_per_sec": round(host_intake_tx_s, 1),
             "reference_scale": ref_scale,
             "overflow_row_ticks": overflow_row_ticks,
-            "agg_rebuild_ms": round(rebuild_ms, 1),
-            "agg_rebuild_every": cfg.zscore_rebuild_every,
+            # staggered rebuild: executed IN the measured loop, charged above
+            "rebuild_ms_per_tick": round(sum(rebuild_times) / args.ticks * 1000, 3),
+            "rebuild_every": cfg.zscore_rebuild_every,
+            "rebuild_native": bool(getattr(sched, "_native", False)),
             "wall_s": round(total, 3),
             "north_star": "1M metrics/sec on v5e-8 => 125k/sec/chip; <100ms p50 detection",
         },
@@ -185,12 +186,15 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
 
     from apmbackend_tpu.pipeline import engine_ingest, make_demo_engine, make_engine_step
 
+    from apmbackend_tpu.pipeline import RebuildScheduler
+
     cfg, state, params = make_demo_engine(
         capacity, args.samples_per_bucket, [(lag, 20.0, 0.1) for lag in args.lags]
     )
     # staged executor: ring writes stay in-place dynamic_update_slices
     tick = make_engine_step(cfg)
     ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    sched = RebuildScheduler(cfg)
     rng = np.random.RandomState(1)
     label = 180_000_000
     B = 1024
@@ -205,8 +209,10 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
         label += 1
         em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
+        state = sched.step(state)
         state = ingest(state, cfg, *batch(label))
     lats = []
+    rebuilds = []
     for _ in range(ticks):
         label += 1
         t0 = time.perf_counter()
@@ -214,12 +220,15 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
         _ = [np.asarray(l.trigger) for l in em.lags]
         np.asarray(em.tpm)
         lats.append(time.perf_counter() - t0)
+        tr = time.perf_counter()
+        state = sched.step_synced(state)
+        rebuilds.append(time.perf_counter() - tr)
         state = ingest(state, cfg, *batch(label))
     p50 = float(np.percentile(np.array(lats) * 1000, 50))
     metrics_per_tick = capacity * 3 * len(cfg.lags)
     return {
         "services": capacity,
-        "metrics_per_sec": round(metrics_per_tick * ticks / sum(lats), 1),
+        "metrics_per_sec": round(metrics_per_tick * ticks / (sum(lats) + sum(rebuilds)), 1),
         "p50_detection_latency_ms": round(p50, 3),
         "meets_100ms_budget": p50 < 100.0,
     }

@@ -23,9 +23,9 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     import jax.numpy as jnp
 
     from apmbackend_tpu.parallel import (
+        ShardedRebuildScheduler,
         make_mesh,
         make_sharded_ingest,
-        make_sharded_rebuild,
         make_sharded_step,
         route_batch,
         shard_rows,
@@ -43,7 +43,11 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     # staged pod executor: in-place big-buffer writes per shard
     tick = make_sharded_step(mesh, cfg)
     ingest = make_sharded_ingest(mesh, cfg)
-    rebuild = make_sharded_rebuild(mesh, cfg)
+    # production rebuild cadence: one staggered shard-local chunk EVERY tick
+    # (full rotation per zscore_rebuild_every ticks), executed and charged
+    # inside the measured loop — the r4 VERDICT's accounting fix: the old
+    # 30-tick loop with rebuild_every=64 never executed its rebuild at all
+    sched = ShardedRebuildScheduler(mesh, cfg)
     state = shard_rows(state, mesh)
     params = shard_rows(params, mesh)
 
@@ -68,24 +72,26 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
         label += 1
         em, rollup, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
+        state = sched.step(state)  # compiles the slice/merge programs
         state = ingest(state, *routed(label))
     jax.block_until_ready(state.stats.counts)
 
     lat = []
-    since_rebuild = 0
+    rebuilds = []
     t_start = time.perf_counter()
     for _ in range(ticks):
         label += 1
-        since_rebuild += 1
-        if since_rebuild >= cfg.zscore_rebuild_every:
-            since_rebuild = 0
-            state = rebuild(state)
         t0 = time.perf_counter()
         em, rollup, state = tick(state, label, params)
         # fleet view must reach the host: rollup + trigger masks
         _ = int(rollup.total_tx)
         _ = [np.asarray(l.trigger) for l in em.lags]
         lat.append(time.perf_counter() - t0)
+        # staggered rebuild chunk: between ticks (detection unaffected),
+        # wall time charged to fleet throughput
+        tr = time.perf_counter()
+        state = sched.step_synced(state)
+        rebuilds.append(time.perf_counter() - tr)
         state = ingest(state, *routed(label))
     jax.block_until_ready(state.stats.counts)
     wall = time.perf_counter() - t_start
@@ -128,7 +134,7 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
     exchange_tx_s = ex_delivered / (time.perf_counter() - t0)
 
     metrics_per_tick = capacity * 3 * len(cfg.lags)
-    throughput = metrics_per_tick * ticks / sum(lat)
+    throughput = metrics_per_tick * ticks / (sum(lat) + sum(rebuilds))
     return result(
         "podshard_fleet_throughput",
         throughput,
@@ -143,6 +149,9 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_pe
             "lags": [spec.lag for spec in cfg.lags],
             "ticks": ticks,
             "tick_latency": latency_stats_ms(lat),
+            "rebuild_ms_per_tick": round(sum(rebuilds) / max(ticks, 1) * 1000, 3),
+            "rebuild_every": cfg.zscore_rebuild_every,
+            "rebuild_native": bool(getattr(sched, "_native", False)),
             # host-side DCN scatter layout rate (vectorized route_batch);
             # north star: >=1M records/s so routing never gates the pod
             "route_records_per_sec": round(B * len(route_times) / max(sum(route_times), 1e-9), 1),

@@ -10,10 +10,26 @@ host-pipeline benches.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, List
 
 import numpy as np
+
+
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for benchmark processes (same
+    mechanism as tests/conftest.py): a replay-style run otherwise pays
+    ~3.5 s of XLA:CPU compiles INSIDE its measured window. First-ever run
+    on a machine still compiles; every rerun loads from /tmp. Call before
+    the first jit dispatch."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("APM_BENCH_JAX_CACHE", "/tmp/apm_jax_bench_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.4)
 
 PER_CHIP_NORTH_STAR = 125_000.0  # metrics/sec/chip (1M / 8 chips)
 POD_NORTH_STAR = 1_000_000.0  # metrics/sec, whole pod

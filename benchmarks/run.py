@@ -2,30 +2,70 @@
 
 Prints one JSON result line per benchmark (same schema as bench.py). Use
 ``--quick`` for a smoke-sized pass (CI / CPU).
+
+Each benchmark runs in its OWN subprocess by default (``--in-process`` to
+disable): a shared process distorts later configs badly — measured podshard
+at 486k in-suite vs 1.05M standalone, purely from allocator and cache
+pressure left behind by the earlier 850 MB-ring configs. The subprocess
+inherits the environment (JAX_PLATFORMS, XLA_FLAGS, the persistent compile
+cache), so isolation changes nothing but the starting heap.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+
+
+def _run_isolated(name: str, quick: bool) -> dict:
+    cmd = [sys.executable, "-m", "benchmarks.run", "--config", name, "--in-process"]
+    if quick:
+        cmd.append("--quick")
+    proc = subprocess.run(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+    )
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and "metric" in obj:
+                    return obj
+            except json.JSONDecodeError:
+                continue
+    raise RuntimeError(
+        f"no result line (rc={proc.returncode}): {proc.stderr[-400:]}"
+    )
 
 
 def main(argv=None) -> int:
     from . import REGISTRY
+    from .common import enable_compile_cache
+
+    # entry-point side effect only (never at package import): compiles must
+    # not land inside measured windows, but importing benchmarks.common for
+    # a helper must not rewrite process-global jax config either
+    enable_compile_cache()
 
     ap = argparse.ArgumentParser(prog="benchmarks.run")
     ap.add_argument("--config", choices=sorted(REGISTRY), action="append",
                     help="benchmark(s) to run (default: --all)")
     ap.add_argument("--all", action="store_true")
     ap.add_argument("--quick", action="store_true", help="smoke-sized shapes")
+    ap.add_argument("--in-process", action="store_true",
+                    help="run in this process (no per-config isolation)")
     args = ap.parse_args(argv)
 
     names = args.config or sorted(REGISTRY)
     failed = 0
     for name in names:
         try:
-            res = REGISTRY[name](quick=args.quick)
+            if args.in_process:
+                res = REGISTRY[name](quick=args.quick)
+            else:
+                res = _run_isolated(name, args.quick)
             print(json.dumps(res), flush=True)
         except Exception as e:  # one failing bench must not hide the others
             failed += 1
