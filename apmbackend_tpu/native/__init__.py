@@ -317,6 +317,12 @@ def _load_percentile_lib():
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
     ]
+    lib.apm_window_percentiles_counts.restype = ctypes.c_int
+    lib.apm_window_percentiles_counts.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ctypes.c_void_p,
+    ]
     _pct_lib = lib
     return lib
 
@@ -326,14 +332,18 @@ def have_native_percentiles() -> bool:
     return _load_percentile_lib() is not None
 
 
-def window_percentiles_native(samples, mask, ps):
+def window_percentiles_native(samples, mask, ps, counts=None):
     """Exact reference percentiles over the window reservoir, selected with
     std::nth_element per row — the CPU-fallback fast path for the staged
     executor's percentile stage (native/percentile.cpp; exact-parity with
     ops/stats.py topk/sort in the no-overflow regime, fuzz-tested).
 
     samples: [S, NB, CAP] float32 C-contiguous numpy (NaN = empty slot);
-    mask: [NB] bool window-slot selector; ps: iterable of int percentiles.
+    mask: [NB] bool window-slot selector; ps: iterable of int percentiles;
+    counts (optional): [S, NB] int32 filled-prefix lengths (the engine's
+    nsamples panel) — lets the kernel gather only each bucket's live
+    prefix instead of NaN-scanning all CAP slots (the dominant tick cost
+    at sparse occupancy; results identical, fuzz-tested).
     Returns [S, len(ps)] float32 (NaN where a row's window is empty).
     Raises RuntimeError when the library is unavailable or rejects the call.
     """
@@ -349,10 +359,20 @@ def window_percentiles_native(samples, mask, ps):
         raise ValueError(f"mask shape {mask_u8.shape} != ({NB},)")
     ps_arr = np.ascontiguousarray(list(ps), dtype=np.int32)
     out = np.empty((S, len(ps_arr)), np.float32)
-    rc = lib.apm_window_percentiles(
-        samples.ctypes.data, S, NB, CAP,
-        mask_u8.ctypes.data, ps_arr.ctypes.data, len(ps_arr), out.ctypes.data,
-    )
+    if counts is None:
+        rc = lib.apm_window_percentiles(
+            samples.ctypes.data, S, NB, CAP,
+            mask_u8.ctypes.data, ps_arr.ctypes.data, len(ps_arr), out.ctypes.data,
+        )
+    else:
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        if counts.shape != (S, NB):
+            raise ValueError(f"counts shape {counts.shape} != ({S}, {NB})")
+        rc = lib.apm_window_percentiles_counts(
+            samples.ctypes.data, S, NB, CAP,
+            mask_u8.ctypes.data, counts.ctypes.data,
+            ps_arr.ctypes.data, len(ps_arr), out.ctypes.data,
+        )
     if rc != 0:
         raise RuntimeError(f"apm_window_percentiles rc={rc}")
     return out

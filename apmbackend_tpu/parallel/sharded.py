@@ -42,7 +42,9 @@ from ..pipeline import (
     EngineState,
     LagEmission,
     TickEmission,
+    _StaggeredRebuildBase,
     cpu_zero_copy_view,
+    default_native_rebuild_gate,
     engine_ingest,
     engine_needs_rebuild,
     engine_rebuild_aggs,
@@ -254,6 +256,18 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
         from .. import native as _native
 
         use_native = _native.have_native_percentiles()
+    if jax.process_count() > 1:
+        # the executor CHOICE must be pod-global: toolchain availability and
+        # row-contiguity are host-local facts, and hosts running different
+        # executors dispatch different program sequences => the first staged
+        # tick deadlocks in the collectives. Every host reaches this
+        # allgather (unconditionally), then all take native only if ALL can.
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.array([1 if use_native else 0], np.int32)
+        )
+        use_native = bool(np.min(flags))
 
     if not use_native:
         core = _make_core(_local_core_with_rollup(lcfg))
@@ -323,16 +337,19 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
             # shards' percentiles; the reservoir never crosses a host
             # boundary (shards arrive row-ordered; _local_rows_contiguous
             # guaranteed the concatenation is this host's global row run)
-            shards = sorted(
-                state.stats.samples.addressable_shards, key=lambda s: s.index[0].start or 0
-            )
+            by_row = lambda s: s.index[0].start or 0
+            shards = sorted(state.stats.samples.addressable_shards, key=by_row)
+            cnt_shards = sorted(state.stats.nsamples.addressable_shards, key=by_row)
             blocks = []
-            for sh in shards:
+            for sh, csh in zip(shards, cnt_shards):
                 try:
                     block = np.from_dlpack(sh.data)
+                    cblock = np.from_dlpack(csh.data)
                 except Exception:  # pragma: no cover - dlpack unavailable
                     block = np.asarray(sh.data)
-                blocks.append(window_percentiles_native(block, mask, (75, 95)))
+                    cblock = np.asarray(csh.data)
+                # prefix-bounded gather (pipeline.make_engine_step note)
+                blocks.append(window_percentiles_native(block, mask, (75, 95), cblock))
             pct = np.concatenate(blocks, axis=0)  # f32 — the gate excludes f64
             if multi_host:
                 per75 = jax.make_array_from_process_local_data(
@@ -373,7 +390,7 @@ def make_sharded_rebuild(mesh: Mesh, cfg: EngineConfig):
     return jax.jit(mapped, donate_argnums=(0,))
 
 
-class ShardedRebuildScheduler:
+class ShardedRebuildScheduler(_StaggeredRebuildBase):
     """Pod-scale counterpart of pipeline.RebuildScheduler: the staggered
     sliding-aggregate rebuild over the service-axis mesh.
 
@@ -424,13 +441,7 @@ class ShardedRebuildScheduler:
             donate_argnums=(0,),
         )
         if allow_native is None:
-            allow_native = (
-                jax.default_backend() == "cpu"
-                and jax.process_count() == 1
-                and cfg.stats.dtype != jnp.float64
-                # the kernel decodes f32 and bf16 ring bits only
-                and cfg.zscore_ring_dtype in (None, jnp.bfloat16)
-            )
+            allow_native = default_native_rebuild_gate(cfg)
         self._native = False
         if allow_native:
             from .. import native as _native
@@ -481,32 +492,7 @@ class ShardedRebuildScheduler:
                 )
             )
 
-    def step_synced(self, state: EngineState) -> EngineState:
-        """step() + block until the merged aggregates are materialized (the
-        benchmark timing boundary; see pipeline.RebuildScheduler)."""
-        state = self.step(state)
-        if self.active:
-            jax.block_until_ready([state.zscores[i].agg for i in self._sliding_idx])
-        return state
-
-    def step(self, state: EngineState) -> EngineState:
-        """Rebuild this tick's due chunk on every shard; returns new state."""
-        if not self.active:
-            return state
-        start = self.starts[self._i]
-        self._i = (self._i + 1) % self.n_chunks
-        if self._native:
-            try:
-                return self._native_step(state, start)
-            except Exception:
-                self._native = False
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "native sharded staggered rebuild failed; falling back "
-                    "to the jitted shard_mapped slice path",
-                    exc_info=True,
-                )
+    def _slice_call(self, state: EngineState, start: int) -> EngineState:
         return self._slice_fn(state, jnp.int32(start))
 
     def _native_step(self, state: EngineState, start: int) -> EngineState:

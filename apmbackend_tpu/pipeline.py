@@ -361,9 +361,15 @@ def make_engine_step(cfg: EngineConfig):
             mask[(latest - offsets) % NB] = True
             try:
                 samples = np.from_dlpack(state.stats.samples)  # zero-copy on CPU
+                counts = np.from_dlpack(state.stats.nsamples)
             except Exception:  # pragma: no cover - dlpack unavailable
                 samples = np.asarray(state.stats.samples)
-            pct = window_percentiles_native(samples, mask, (75, 95))
+                counts = np.asarray(state.stats.nsamples)
+            # counts = the filled-prefix panel: the kernel gathers only live
+            # samples instead of NaN-scanning every CAP slot (stats.ingest
+            # fills positions in order; reservoir replacement stays inside
+            # the prefix, so validity == prefix membership)
+            pct = window_percentiles_native(samples, mask, (75, 95), counts)
             res = res._replace(
                 per75=jnp.asarray(pct[:, 0], cfg.stats.dtype),
                 per95=jnp.asarray(pct[:, 1], cfg.stats.dtype),
@@ -510,7 +516,60 @@ def cpu_zero_copy_view(arr) -> np.ndarray:
         return np.frombuffer(buf, np.uint16).reshape(arr.shape)
 
 
-class RebuildScheduler:
+def default_native_rebuild_gate(cfg: EngineConfig) -> bool:
+    """ONE definition of "may the staggered rebuild use the native streaming
+    kernel" shared by the single-chip and pod schedulers: CPU backend,
+    single process, f32 compute, and a ring storage dtype the kernel
+    decodes (f32 bits or bf16 bits)."""
+    return (
+        jax.default_backend() == "cpu"
+        and jax.process_count() == 1
+        and cfg.stats.dtype != jnp.float64
+        and cfg.zscore_ring_dtype in (None, jnp.float32, jnp.bfloat16)
+    )
+
+
+class _StaggeredRebuildBase:
+    """Shared shell of the two staggered-rebuild schedulers: the chunk
+    rotation, the native-try/permanent-fallback policy, and the benchmark
+    sync boundary. Subclasses provide ``_native_step(state, start)`` and
+    ``_slice_call(state, start)`` plus all their construction."""
+
+    active: bool = False
+
+    def step_synced(self, state: EngineState) -> EngineState:
+        """step() + block until the merged aggregates are materialized — the
+        timing boundary benchmarks charge (one definition of "what must be
+        waited on", instead of copies reaching into _sliding_idx)."""
+        state = self.step(state)
+        if self.active:
+            jax.block_until_ready([state.zscores[i].agg for i in self._sliding_idx])
+        return state
+
+    def step(self, state: EngineState) -> EngineState:
+        """Rebuild this tick's due chunk; returns the updated state."""
+        if not self.active:
+            return state
+        start = self.starts[self._i]
+        self._i = (self._i + 1) % self.n_chunks
+        if self._native:
+            try:
+                return self._native_step(state, start)
+            except Exception:
+                # e.g. dlpack view unavailable — fall back permanently, but
+                # never silently: the jitted slice path is ~25x slower on CPU
+                self._native = False
+                import logging
+
+                logging.getLogger(type(self).__module__).warning(
+                    "native staggered rebuild failed; falling back to the "
+                    "jitted slice path for the rest of the process",
+                    exc_info=True,
+                )
+        return self._slice_call(state, start)
+
+
+class RebuildScheduler(_StaggeredRebuildBase):
     """Host-side rotation of the staggered sliding-aggregate rebuild.
 
     ``step(state)`` is called once per engine tick; it rebuilds ONE
@@ -549,13 +608,7 @@ class RebuildScheduler:
             engine_rebuild_slice, static_argnums=(1, 3), donate_argnums=(0,)
         )
         if allow_native is None:
-            allow_native = (
-                jax.default_backend() == "cpu"
-                and jax.process_count() == 1
-                and cfg.stats.dtype != jnp.float64
-                # the kernel decodes f32 and bf16 ring bits only
-                and cfg.zscore_ring_dtype in (None, jnp.bfloat16)
-            )
+            allow_native = default_native_rebuild_gate(cfg)
         self._native = False
         if allow_native:
             from . import native as _native
@@ -578,35 +631,7 @@ class RebuildScheduler:
                 i: _make_merge(zscore_cfg(cfg, cfg.lags[i])) for i in self._sliding_idx
             }
 
-    def step_synced(self, state: EngineState) -> EngineState:
-        """step() + block until the merged aggregates are materialized — the
-        timing boundary benchmarks charge (one definition of "what must be
-        waited on", instead of five copies reaching into _sliding_idx)."""
-        state = self.step(state)
-        if self.active:
-            jax.block_until_ready([state.zscores[i].agg for i in self._sliding_idx])
-        return state
-
-    def step(self, state: EngineState) -> EngineState:
-        """Rebuild this tick's due chunk; returns the updated state."""
-        if not self.active:
-            return state
-        start = self.starts[self._i]
-        self._i = (self._i + 1) % self.n_chunks
-        if self._native:
-            try:
-                return self._native_step(state, start)
-            except Exception:
-                # e.g. dlpack view unavailable — fall back permanently, but
-                # never silently: the jitted slice path is ~25x slower on CPU
-                self._native = False
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "native staggered rebuild failed; falling back to the "
-                    "jitted slice path for the rest of the process",
-                    exc_info=True,
-                )
+    def _slice_call(self, state: EngineState, start: int) -> EngineState:
         return self._slice_fn(state, self.cfg, start, self.chunk)
 
     def _native_step(self, state: EngineState, start: int) -> EngineState:

@@ -47,10 +47,20 @@ extern "C" {
 
 // samples: [S, NB, CAP] f32 row-major; mask: [NB] uint8 (1 = window slot);
 // ps: [n_ps] percentiles in (0, 100]; out: [S, n_ps] f32.
+// counts (nullable): [S, NB] int32 filled-prefix lengths — the engine's
+// nsamples panel. Arrivals fill a bucket's slots IN ORDER (ops/stats.py
+// ingest: positions 0..CAP-1 before any reservoir replacement, which only
+// overwrites within the filled prefix), so the valid samples of a bucket
+// are exactly its first counts[s][b] slots and the kernel can skip the
+// NaN scan of the empty tail: at sparse occupancy (~2 live samples of 64
+// slots at bench rates) this collapses the gather from a full [S, NB, CAP]
+// sweep (~94 MB/tick at the pod shape — the dominant tick cost) to the
+// live prefix bytes. The per-element NaN check stays as defense.
 // Returns 0 on success.
-int apm_window_percentiles(const float *samples, int64_t S, int64_t NB,
-                           int64_t CAP, const uint8_t *mask, const int *ps,
-                           int n_ps, float *out) {
+int apm_window_percentiles_counts(const float *samples, int64_t S, int64_t NB,
+                                  int64_t CAP, const uint8_t *mask,
+                                  const int32_t *counts, const int *ps,
+                                  int n_ps, float *out) {
   if (S < 0 || NB <= 0 || CAP <= 0 || n_ps <= 0) return 1;
   std::vector<float> buf;
   buf.reserve(static_cast<size_t>(NB * CAP));
@@ -69,7 +79,10 @@ int apm_window_percentiles(const float *samples, int64_t S, int64_t NB,
     for (int64_t b = 0; b < NB; ++b) {
       if (!mask[b]) continue;
       const float *slot = row + b * CAP;
-      for (int64_t k = 0; k < CAP; ++k) {
+      const int64_t lim =
+          counts ? std::min<int64_t>(std::max<int32_t>(counts[s * NB + b], 0), CAP)
+                 : CAP;
+      for (int64_t k = 0; k < lim; ++k) {
         const float v = slot[k];
         if (!std::isnan(v)) buf.push_back(v);
       }
@@ -114,6 +127,15 @@ int apm_window_percentiles(const float *samples, int64_t S, int64_t NB,
     }
   }
   return 0;
+}
+
+// legacy full-scan entry point (no counts panel): identical semantics,
+// every slot NaN-scanned
+int apm_window_percentiles(const float *samples, int64_t S, int64_t NB,
+                           int64_t CAP, const uint8_t *mask, const int *ps,
+                           int n_ps, float *out) {
+  return apm_window_percentiles_counts(samples, S, NB, CAP, mask, nullptr, ps,
+                                       n_ps, out);
 }
 
 }  // extern "C"

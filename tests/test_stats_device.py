@@ -559,6 +559,34 @@ class TestNativePercentiles:
                 np.nan_to_num(native[:, 1], nan=-1), np.nan_to_num(np.asarray(p95), nan=-1)
             )
 
+    def test_kernel_counts_path_matches_full_scan_fuzz(self):
+        """The prefix-bounded gather (counts panel) must select the exact
+        same percentiles as the full NaN scan on prefix-shaped reservoirs —
+        the layout stats.ingest actually produces (arrivals fill positions
+        in order; reservoir replacement stays inside the prefix)."""
+        from apmbackend_tpu.native import window_percentiles_native
+
+        rng = np.random.RandomState(7)
+        for trial in range(6):
+            S, NB, CAP = 41, 9, 8
+            samples = np.full((S, NB, CAP), np.nan, np.float32)
+            counts = np.zeros((S, NB), np.int32)
+            for s in range(S):
+                for b in range(NB):
+                    n = int(rng.randint(0, CAP + 1))
+                    counts[s, b] = n
+                    vals = (rng.rand(n) * 1000).astype(np.float32)
+                    if trial % 2:
+                        vals = np.round(vals / 100) * 100  # tie-heavy
+                    samples[s, b, :n] = vals
+            mask = np.zeros(NB, bool)
+            mask[rng.choice(NB, 5, replace=False)] = True
+            full = window_percentiles_native(samples, mask, (75, 95))
+            fast = window_percentiles_native(samples, mask, (75, 95), counts)
+            np.testing.assert_array_equal(
+                np.nan_to_num(full, nan=-1), np.nan_to_num(fast, nan=-1)
+            )
+
     def test_staged_native_matches_topk_engine(self):
         """Full staged engine: the native-percentile mode must emit the same
         wire values as the in-program topk mode tick for tick."""
