@@ -25,6 +25,7 @@ from __future__ import annotations
 import math
 import os
 import re
+import time
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -429,19 +430,37 @@ def make_staged_executor(cfg: EngineConfig, *, core):
     NB = cfg.stats.num_buckets
     advance = jax.jit(dstats.advance_one, static_argnums=1, donate_argnums=(0,))
     evict, write = staged_ring_programs()
+    # APM_STAGE_TIMING=1: accumulate per-stage wall time on step.stage_ms
+    # (diagnostic; each stage then pays a block_until_ready sync)
+    timing = os.environ.get("APM_STAGE_TIMING") == "1"
+    stage_ms = {"advance": 0.0, "evict": 0.0, "core": 0.0, "write": 0.0, "n": 0}
+
+    def _sync(x):
+        jax.block_until_ready(x)
+        return time.perf_counter()
 
     def step(state, new_label, params):
+        t0 = time.perf_counter() if timing else 0.0
         latest = int(state.stats.latest_bucket)
         nl = int(new_label)
         st = state.stats
         for lbl in range(max(latest + 1, nl - NB + 1), nl + 1):
             st = advance(st, cfg.stats, lbl)
         state = state._replace(stats=st)
+        if timing:
+            t1 = _sync(state.stats.counts)
+            stage_ms["advance"] += (t1 - t0) * 1000
 
         rings = tuple(state.zscores[i].values for i in sliding_idx)
         cursors = tuple(state.zscores[i].pos for i in sliding_idx)
         evicted = evict(rings, cursors) if sliding_idx else ()
+        if timing:
+            t2 = _sync(evicted)
+            stage_ms["evict"] += (t2 - t1) * 1000
         *outs, state2, pushes = core(state, nl, params, evicted)
+        if timing:
+            t3 = _sync(pushes)
+            stage_ms["core"] += (t3 - t2) * 1000
         if sliding_idx:
             rings2 = tuple(state2.zscores[i].values for i in sliding_idx)
             new_cursors = tuple(state2.zscores[i].pos for i in sliding_idx)
@@ -450,8 +469,13 @@ def make_staged_executor(cfg: EngineConfig, *, core):
             for i, ring in zip(sliding_idx, new_rings):
                 zs[i] = zs[i]._replace(values=ring)
             state2 = state2._replace(zscores=tuple(zs))
+        if timing:
+            t4 = _sync(state2.zscores[sliding_idx[0]].values if sliding_idx else 0)
+            stage_ms["write"] += (t4 - t3) * 1000
+            stage_ms["n"] += 1
         return (*outs, state2)
 
+    step.stage_ms = stage_ms
     return step
 
 

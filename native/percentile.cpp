@@ -41,6 +41,64 @@ inline void rank_for(int64_t n, int p, int64_t *idx1, bool *take_pair) {
   *take_pair = !is_int && n > 1 && idx_ceil != last;
 }
 
+// Top-k selection for HIGH percentiles at SMALL windows: when every
+// requested rank lives in a short suffix of the sorted order (p75/p95 over
+// the ~62-sample windows the sparse production shape produces => k ~ 17),
+// one pass maintaining the k largest values in a sorted insertion array is
+// ~1.6x cheaper than the nth_element chain (A/B-measured; a std::*_heap
+// variant ties the chain — the constant of push/pop_heap eats the
+// asymptotic win at this size). Exact: the ascending suffix contains every
+// requested rank AND the take_pair successor by construction of k. Returns
+// false for low ranks or k > TOPK_CAP — the chain handles those regimes.
+constexpr int64_t TOPK_CAP = 32;
+
+inline bool select_topk(const std::vector<float> &buf, const int *ps,
+                        int n_ps, const int *order, float *orow) {
+  const int64_t n = static_cast<int64_t>(buf.size());
+  // smallest rank any percentile touches (ranks are non-decreasing in p,
+  // and order[] is descending in p, so the last entry has the smallest)
+  int64_t min_idx;
+  bool tp_min;
+  rank_for(n, ps[order[n_ps - 1]], &min_idx, &tp_min);
+  const int64_t k = n - min_idx;  // suffix [min_idx, n) covers all ranks
+  if (k <= 0 || k > TOPK_CAP) return false;
+  // defensive mirror of the chain path's idx1 clamp: an out-of-contract
+  // p > 100 would index past the suffix — hand it to the chain instead
+  int64_t max_idx;
+  bool tp_max;
+  rank_for(n, ps[order[0]], &max_idx, &tp_max);
+  if (max_idx + (tp_max ? 1 : 0) >= n) return false;
+  float top[TOPK_CAP];  // ascending; top[j] = rank min_idx + j once full
+  int64_t m = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const float v = buf[i];
+    if (m < k) {
+      int64_t j = m++;
+      while (j > 0 && top[j - 1] > v) {
+        top[j] = top[j - 1];
+        --j;
+      }
+      top[j] = v;
+    } else if (v > top[0]) {
+      int64_t j = 0;
+      while (j + 1 < k && top[j + 1] < v) {
+        top[j] = top[j + 1];
+        ++j;
+      }
+      top[j] = v;
+    }
+  }
+  for (int oi = 0; oi < n_ps; ++oi) {
+    const int pi = order[oi];
+    int64_t idx1;
+    bool take_pair;
+    rank_for(n, ps[pi], &idx1, &take_pair);
+    const float v1 = top[idx1 - min_idx];
+    orow[pi] = take_pair ? (v1 + top[idx1 - min_idx + 1]) / 2.0f : v1;
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -93,6 +151,7 @@ int apm_window_percentiles_counts(const float *samples, int64_t S, int64_t NB,
       for (int i = 0; i < n_ps; ++i) orow[i] = std::nanf("");
       continue;
     }
+    if (select_topk(buf, ps, n_ps, order.data(), orow)) continue;
     int64_t hi = n;  // exclusive upper bound of the unpartitioned region
     for (int oi = 0; oi < n_ps; ++oi) {
       const int pi = order[oi];
