@@ -32,31 +32,41 @@ def test_insert_db_module_end_to_end(tmp_path):
     cfg["streamInsertDb"]["bufferResumeFileFullPath"] = str(tmp_path / "db.resume")
     cfg["streamInsertDb"]["dbMaxTimeBetweenInsertsMs"] = 100000  # no timer flush
     runtime = make_runtime("streamInsertDb", cfg, broker)
-    writer = insert_db_main.build(runtime)
+    # try/finally: the interval/queue-stats timer threads must be joined
+    # even when an assertion fails, or the leaked timer fires into the root
+    # logger at the next minute boundary (stray INFO lines after the suite
+    # summary — exactly when a failing run is being read)
+    try:
+        writer = insert_db_main.build(runtime)
 
-    # a producer in "another process": separate manager, same broker
-    producer_qm = make_queue_manager({"brokerBackend": "memory"}, broker=broker)
-    producer = producer_qm.get_queue("db_insert", "p")
-    tx = TxEntry("srv1", "svc", "log1", 42, 1700000000000, 1700000005000, 5000, "Y")
-    for _ in range(5):
-        producer.write_line(tx.to_csv())
-    broker.pump()
-    assert writer.buffered_counts()["tx"] == 5
-    writer.process_all()
-    assert writer.executor.batches == [("tx", 5)]
+        # a producer in "another process": separate manager, same broker
+        producer_qm = make_queue_manager({"brokerBackend": "memory"}, broker=broker)
+        producer = producer_qm.get_queue("db_insert", "p")
+        tx = TxEntry("srv1", "svc", "log1", 42, 1700000000000, 1700000005000, 5000, "Y")
+        for _ in range(5):
+            producer.write_line(tx.to_csv())
+        broker.pump()
+        assert writer.buffered_counts()["tx"] == 5
+        writer.process_all()
+        assert writer.executor.batches == [("tx", 5)]
 
-    # exit handler flushes + saves resume (empty buffers here)
-    for handler in reversed(runtime._exit_handlers):
-        handler()
-    assert (tmp_path / "db.resume").exists()
+        # exit handler flushes + saves resume (empty buffers here)
+        for handler in reversed(runtime._exit_handlers):
+            handler()
+        assert (tmp_path / "db.resume").exists()
+    finally:
+        runtime.stop_timers()
 
 
 def test_module_runtime_reload_handlers():
     runtime = make_runtime("streamInsertDb")
-    seen = []
-    runtime.on_reload(seen.append)
-    new_cfg = default_config()
-    new_cfg["statLogIntervalInSeconds"] = 5
-    runtime._on_config_change(new_cfg)
-    assert seen == [new_cfg]
-    assert runtime.qm.queue_stats.interval == 5
+    try:
+        seen = []
+        runtime.on_reload(seen.append)
+        new_cfg = default_config()
+        new_cfg["statLogIntervalInSeconds"] = 5
+        runtime._on_config_change(new_cfg)
+        assert seen == [new_cfg]
+        assert runtime.qm.queue_stats.interval == 5
+    finally:
+        runtime.stop_timers()

@@ -402,3 +402,33 @@ def test_sharded_staggered_native_matches_jitted():
 
     step = make_sharded_step(mesh, cfg)
     _em, _roll, st_n = step(st_n, BASE + 100, shard_rows(make_params(cfg), mesh))
+
+
+def test_local_rows_contiguous_gate(monkeypatch):
+    """The per-addressable-shard native stages assume each host owns one
+    contiguous run of the row space. Single-process short-circuits True; the
+    multi-host branch is driven here by faking process topology over the
+    virtual devices (a process-interleaved mesh must fall back)."""
+    from apmbackend_tpu.parallel import sharded as sh
+
+    mesh = make_mesh(8)
+    assert sh._local_rows_contiguous(mesh) is True  # single-process
+
+    class _FakeDev:
+        def __init__(self, pidx):
+            self.process_index = pidx
+
+    def fake_mesh(pidxs):
+        class _M:
+            devices = np.array([_FakeDev(p) for p in pidxs])
+
+        return _M()
+
+    monkeypatch.setattr(sh.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(sh.jax, "process_index", lambda: 0)
+    # contiguous halves: proc 0 owns rows of devices 0-3
+    assert sh._local_rows_contiguous(fake_mesh([0, 0, 0, 0, 1, 1, 1, 1])) is True
+    # interleaved ownership: NOT one contiguous row run -> fused fallback
+    assert sh._local_rows_contiguous(fake_mesh([0, 1, 0, 1, 0, 1, 0, 1])) is False
+    # this process owns nothing on the mesh: not contiguous either
+    assert sh._local_rows_contiguous(fake_mesh([1, 1, 1, 1, 1, 1, 1, 1])) is False
