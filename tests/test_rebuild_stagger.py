@@ -283,3 +283,59 @@ def test_driver_runs_staggered_rebuild_every_tick():
         ]
     )
     assert sched._i != before or sched.n_chunks == 1
+
+
+def test_scheduler_inactive_for_robust_and_f64():
+    """Configs with no sliding lag (robust-only, f64 parity) must make the
+    scheduler a no-op that returns the state unchanged."""
+    import jax.numpy as jnp
+
+    from apmbackend_tpu.pipeline import engine_init
+
+    cfg = make_demo_engine(96, 16, [(6, 20.0, 0.1), (24, 15.0, 0.0)])[0]
+    # sliding_active has two independent disablers; cover BOTH
+    cfg_robust = cfg._replace(lags=tuple(s._replace(robust=True) for s in cfg.lags))
+    cfg_f64 = cfg._replace(stats=cfg.stats._replace(dtype=jnp.float64))
+    for c in (cfg_robust, cfg_f64):
+        st = engine_init(c)
+        sched = RebuildScheduler(c)
+        assert not sched.active
+        out = sched.step(st)
+        assert out is st  # identity, no dispatch
+
+
+def test_driver_grow_recreates_scheduler():
+    """Capacity growth recompiles the engine; the rebuild scheduler must
+    follow (new chunk size, fresh rotation) and keep ticking."""
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 8
+    cfg["tpuEngine"]["samplesPerBucket"] = 8
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 4, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
+    ]
+    drv = PipelineDriver(cfg, micro_batch_size=64)
+    s0 = drv._rebuild_sched
+    assert s0.active and s0.chunk == dz.rebuild_chunk_rows(8, drv.cfg.zscore_rebuild_every)
+    base = 170_000_000
+    # register more keys than capacity to force growth (8 -> 16)
+    lines = [
+        f"tx|jvm0|S:svc{r:03d}|l{i}|1|{base * 10000 - 100}|{base * 10000 + i}|{100 + i}|Y"
+        for i, r in enumerate(range(12))
+    ]
+    drv.feed_csv_batch(lines)
+    assert drv.cfg.capacity >= 12
+    s1 = drv._rebuild_sched
+    assert s1 is not s0, "growth must rebuild the scheduler for the new capacity"
+    assert s1.chunk == dz.rebuild_chunk_rows(drv.cfg.capacity, drv.cfg.zscore_rebuild_every)
+    # and ticking advances the NEW scheduler's rotation (a stale reference
+    # or a post-growth stop would leave s1._i at 0)
+    before = s1._i
+    drv.feed_csv_batch([
+        f"tx|jvm0|S:svc000|m{i}|1|{(base + 1) * 10000 - 100}|{(base + 1) * 10000 + i}|{100 + i}|Y"
+        for i in range(4)
+    ])
+    assert drv._rebuild_sched is s1
+    assert s1._i == (before + 1) % s1.n_chunks
