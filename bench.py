@@ -20,7 +20,7 @@ scrubbed-env CPU run if the TPU never comes up.  On ANY outcome it prints
 exactly one single-line JSON object to stdout and exits 0 — never a
 traceback.
 
-Run: python bench.py [--capacity 8192] [--ticks 30] [--batch 16384]
+Run: python bench.py [--capacity 8192] [--ticks 64] [--batch 16384]
 """
 
 import argparse
@@ -39,7 +39,10 @@ READY_SENTINEL = "BENCH_BACKEND_READY"
 def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--capacity", type=int, default=8192)
-    ap.add_argument("--ticks", type=int, default=30)
+    # 64 ticks = one COMPLETE staggered-rebuild rotation inside the measured
+    # loop (zscoreRebuildEvery chunks), so the charged rebuild cost is the
+    # real full-cycle cost, not a partial rotation
+    ap.add_argument("--ticks", type=int, default=64)
     ap.add_argument("--batch", type=int, default=16384)
     ap.add_argument("--samples-per-bucket", type=int, default=64)
     ap.add_argument("--lags", type=int, nargs="+", default=[360, 8640])

@@ -27,7 +27,7 @@ EWMA_CHANNELS = [
 ]
 
 
-def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 30, tx_per_tick: int = 16384) -> dict:
+def run(quick: bool = False, *, capacity: int = 8192, ticks: int = 64, tx_per_tick: int = 16384) -> dict:
     import jax
 
     from apmbackend_tpu.pipeline import (

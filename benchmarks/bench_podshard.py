@@ -18,7 +18,7 @@ import numpy as np
 from .common import POD_NORTH_STAR, latency_stats_ms, result
 
 
-def run(quick: bool = False, *, services: int = 10240, ticks: int = 30, batch_per_shard: int = 2048) -> dict:
+def run(quick: bool = False, *, services: int = 10240, ticks: int = 64, batch_per_shard: int = 2048) -> dict:
     import jax
     import jax.numpy as jnp
 

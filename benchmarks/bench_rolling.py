@@ -15,7 +15,7 @@ import numpy as np
 from .common import PER_CHIP_NORTH_STAR, latency_stats_ms, result
 
 
-def run(quick: bool = False, *, services: int = 100, ticks: int = 50, tx_per_tick: int = 4096) -> dict:
+def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tick: int = 4096) -> dict:
     import jax
 
     from apmbackend_tpu.pipeline import (
