@@ -70,16 +70,17 @@ _SW_STOP_RE = re.compile(r"<stopTime>")
 _SOAP_FILE_RE = re.compile(r"soap_io")
 _SERVER_FILE_RE = re.compile(r"server\.log")
 
-# one alternation pass instead of four sequential .search calls per
-# server-log line — group name selects the handler (the reference's
-# sequential indexOf ladder, stream_parse_transactions.js:741-812, kept
-# semantically: first match in this order wins, and the four patterns are
-# mutually exclusive on real lines)
+# one alternation pass as a PRE-FILTER: most lines carry no timing marker
+# at all (payload/noise), and for them a single scan replaces up to four
+# sequential searches. Lines that DO match re-run the original sequential
+# ladder (stream_parse_transactions.js:741-812 priority) — regex
+# alternation picks the LEFTMOST occurrence, not the ladder's first-pattern
+# priority, so on a line where markers co-occur the ladder must decide.
 _SERVER_DISPATCH_RE = re.compile(
-    r"INFO *\[CommonTiming] The EJB(?P<ejb_entry>)"
-    r"|INFO *\[CommonTiming] Total time(?P<ejb_exit>)"
-    r"|INFO *CommonTiming::Start(?P<ct_entry>)"
-    r"|INFO *CommonTiming::Stop(?P<ct_exit>)"
+    r"INFO *\[CommonTiming] The EJB"
+    r"|INFO *\[CommonTiming] Total time"
+    r"|INFO *CommonTiming::Start"
+    r"|INFO *CommonTiming::Stop"
 )
 
 _ISO_TZ_RE = re.compile(r"T.*-")
@@ -496,21 +497,24 @@ class TransactionParser:
         if kind == 0:
             self._parse_soap(line, file_path)
             return
-        m = _SERVER_DISPATCH_RE.search(line)
-        group = m.lastgroup if m is not None else None
+        has_marker = _SERVER_DISPATCH_RE.search(line) is not None
         if kind == 1:  # server.log: EJB + standard CommonTiming forms
-            if group == "ejb_entry":
+            if not has_marker:
+                return
+            # the reference's sequential priority ladder, run only on
+            # marker-bearing lines (prefilter above)
+            if _EJB_ENTRY_RE.search(line):
                 self._parse_ejb_entry(line, server)
-            elif group == "ejb_exit":
+            elif _EJB_EXIT_RE.search(line):
                 self._parse_ejb_exit(line, file_path, server)
-            elif group == "ct_entry":
+            elif _CT_ENTRY_RE.search(line):
                 self._parse_ct_entry(line, server)
-            elif group == "ct_exit":
+            elif _CT_EXIT_RE.search(line):
                 self._parse_ct_exit(line, file_path, server)
         else:  # APP log: CT forms only; EJB markers fall through to app state
-            if group == "ct_entry":
+            if has_marker and _CT_ENTRY_RE.search(line):
                 self._parse_ct_entry(line, server)
-            elif group == "ct_exit":
+            elif has_marker and _CT_EXIT_RE.search(line):
                 self._parse_ct_exit(line, file_path, server)
             else:
                 self._parse_app_line(line, file_path, server)

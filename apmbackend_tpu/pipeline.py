@@ -532,6 +532,14 @@ def cpu_zero_copy_view(arr) -> np.ndarray:
             # the bit-view fallback is ONLY for 2-byte (bf16) buffers; a
             # wider dtype failing dlpack must surface, not decode as garbage
             raise
+        if len(arr.addressable_shards) != 1:
+            # shard 0's buffer holds only a fraction of a multi-shard
+            # array's elements — reshaping it to the full shape would be an
+            # out-of-bounds read; callers view per-shard blocks instead
+            raise ValueError(
+                "cpu_zero_copy_view bit-view fallback requires a "
+                f"single-shard array, got {len(arr.addressable_shards)} shards"
+            )
         import ctypes
 
         n = int(np.prod(arr.shape))
