@@ -267,3 +267,53 @@ def test_consumer_error_distinguished(caplog):
         )
     assert any("Record consumer failed" in r.message for r in caplog.records)
     assert not any("Unparseable" in r.message for r in caplog.records)
+
+
+def test_marker_cooccurrence_keeps_ladder_priority():
+    """A line where two timing markers CO-OCCUR must dispatch by the
+    reference's sequential ladder priority (EJB entry > EJB exit > CT
+    start > CT stop), not by leftmost occurrence — the alternation scan is
+    only a pre-filter (parser.py _SERVER_DISPATCH_RE note).
+
+    Construct a line whose LOWER-priority marker appears FIRST: leftmost
+    dispatch would pick the exit handler; the ladder must pick entry."""
+    records = []
+    parser = make_parser(records)
+    # 'Total time' (exit marker) textually precedes 'The EJB' (entry
+    # marker); ladder priority says EJB ENTRY wins. Token layout satisfies
+    # _parse_ejb_entry (service at arr[13]).
+    line = ("[jbX] 2024-01-10 09:00:00,000 pre INFO [CommonTiming] Total time "
+            "noise INFO [CommonTiming] The EJB svcY call")
+    parser.read_line("server.log", line)
+    # entry parks a partial (no emission); a ladder regression dispatching
+    # the exit handler would emit an unmatched-exit record immediately
+    assert records == []
+    # the parked partial joins a later real exit for the same logId+service
+    parser.read_line(
+        "server.log",
+        "[jbX] 2024-01-10 09:00:02,000 INFO [CommonTiming] Total time for "
+        "EJB INFO call: 17 ms",
+    )
+    # (service token differs between the synthetic entry and this exit, so
+    # the join misses -> unmatched-exit emission; the assertion above is
+    # the real check: NO emission happened at the co-occurrence line)
+    assert len(records) == 1
+
+
+def test_app_log_ejb_marker_falls_through_to_app_state():
+    """APP logs only dispatch CT handlers; a line carrying an EJB marker
+    (leftmost) plus no CT marker must fall through to the audit-trail state
+    machine, exactly like the reference's APP branch."""
+    records = []
+    parser = make_parser(records)
+    # 14+ tokens so a wrongly-dispatched _parse_ejb_entry would SUCCEED and
+    # park a partial (an 8-token line would just raise-and-swallow, which
+    # records==[] cannot distinguish from correct fall-through)
+    line = ("[jb1] 2024-01-10 09:00:00,000 a b c INFO [CommonTiming] "
+            "The EJB is named svcZ here")
+    parser.read_line("app_1.log", line)
+    assert records == []
+    # DISCRIMINATING check: correct fall-through parks nothing; the EJB
+    # handler regression would have cached a partial under logId jb1
+    assert parser.record_cache.get("jb1") is None
+    assert parser.cache_stats()["record"]["keys"] == 0
