@@ -155,8 +155,15 @@ def run(quick: bool = False, *, services: int = 10240, ticks: int = 64, batch_pe
             # host-side DCN scatter layout rate (vectorized route_batch);
             # north star: >=1M records/s so routing never gates the pod
             "route_records_per_sec": round(B * len(route_times) / max(sum(route_times), 1e-9), 1),
-            # all-to-all host-batch exchange incl. host-side routing/placement
+            # all-to-all host-batch exchange incl. host-side routing/placement.
+            # PER-INGESTING-HOST number: the post-collective scatter width is
+            # [n_src, B] regardless of how many source blocks carry records,
+            # and this single-process bench populates ONE source slot (7 of 8
+            # arrive empty). On a real pod every host exchanges concurrently
+            # through the same per-device scatter, so the FLEET fabric rate
+            # is ~n_hosts x this number for the same per-device cost.
             "exchange_ingest_tx_per_sec": round(exchange_tx_s, 1),
+            "exchange_note": "per-ingesting-host; fleet rate ~= n_hosts x this (see comment)",
             "exchange_dropped": ex_dropped,
             "wall_s": round(wall, 3),
             "note": "ICI-allreduced FleetRollup fetched to host every tick",

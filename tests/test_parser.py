@@ -288,16 +288,18 @@ def test_marker_cooccurrence_keeps_ladder_priority():
     # entry parks a partial (no emission); a ladder regression dispatching
     # the exit handler would emit an unmatched-exit record immediately
     assert records == []
-    # the parked partial joins a later real exit for the same logId+service
+    # a later exit for the same logId but a DIFFERENT service token: the
+    # join deliberately misses (the parked 'S:svcY' partial stays cached)
+    # and the unmatched-exit path emits — pinning that the co-occurrence
+    # line produced no emission of its own
     parser.read_line(
         "server.log",
         "[jbX] 2024-01-10 09:00:02,000 INFO [CommonTiming] Total time for "
         "EJB INFO call: 17 ms",
     )
-    # (service token differs between the synthetic entry and this exit, so
-    # the join misses -> unmatched-exit emission; the assertion above is
-    # the real check: NO emission happened at the co-occurrence line)
     assert len(records) == 1
+    assert records[0][0].service == "S:INFO"  # the unmatched-exit record
+    assert parser.record_cache.get("jbX") and "S:svcY" in parser.record_cache.get("jbX")
 
 
 def test_app_log_ejb_marker_falls_through_to_app_state():
