@@ -20,6 +20,7 @@ scatter, on one host it is just a reshape.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -252,6 +253,10 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
         and cfg.stats.dtype != jnp.float64
         and jax.default_backend() == "cpu"
         and _local_rows_contiguous(mesh)
+        # test hook simulating a host whose toolchain build failed — the
+        # agreement collective below must then force EVERY host fused
+        # (explicit "1": a stray "0" must not silently disable the stage)
+        and os.environ.get("APM_DISABLE_NATIVE_PCT") != "1"
     ):
         from .. import native as _native
 
@@ -267,7 +272,18 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
         flags = multihost_utils.process_allgather(
             np.array([1 if use_native else 0], np.int32)
         )
-        use_native = bool(np.min(flags))
+        agreed = bool(np.min(flags))
+        if use_native and not agreed:
+            # never silently: the native stage is the ~3x CPU percentile win
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "native percentile stage disabled POD-WIDE: %d of %d hosts "
+                "lack it (toolchain/contiguity); all hosts take the fused "
+                "in-program path to keep dispatch sequences identical",
+                int(len(flags) - np.sum(flags)), len(flags),
+            )
+        use_native = agreed
 
     if not use_native:
         core = _make_core(_local_core_with_rollup(lcfg))

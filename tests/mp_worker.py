@@ -139,4 +139,26 @@ if _native.have_native_percentiles() and hasattr(staged, "native_pct"):
 else:  # pragma: no cover - no toolchain
     suffix = " native_pct=skipped"
 
+# DIVERGENT-CAPABILITY scenario: simulate host 1's toolchain being broken.
+# The executor choice must be POD-GLOBAL (sharded.py allgather) — without
+# it host 0 would build the native-stage executor while host 1 builds the
+# fused one, and the first tick would deadlock in mismatched collectives.
+# Meaningful only when the FIRST executor actually went native (otherwise
+# both hosts were already fused and the downgrade path never runs).
+if hasattr(staged, "native_pct"):
+    if PID == 1:
+        os.environ["APM_DISABLE_NATIVE_PCT"] = "1"
+    staged2 = make_sharded_step(mesh, cfg)
+    assert not hasattr(staged2, "native_pct"), (
+        f"proc {PID}: one host lost native capability but this host still "
+        "built the native-stage executor — the pod-global agreement failed"
+    )
+    em4, roll4, state = staged2(state, label + cfg.stats.buffer_sz + 3, params)
+    total4 = int(jax.device_get(roll4.total_tx))
+    assert total4 == 2 * B, f"proc {PID}: divergent-gate rollup {total4} != {2 * B}"
+    os.environ.pop("APM_DISABLE_NATIVE_PCT", None)
+    suffix += " divergent_gate=agreed-fused"
+else:  # pragma: no cover - no toolchain on this machine
+    suffix += " divergent_gate=skipped"
+
 print(f"MP_SMOKE_OK proc={PID} total={total}{suffix}", flush=True)
