@@ -32,9 +32,14 @@ def build_mime(
     msg["To"] = to_addrs
     msg["Subject"] = subject
     if image_path:
-        # Stable cid naming like `graph_<epoch ms>` (util_methods.js:375);
-        # make_msgid supplies the required uniqueness/domain part.
-        cid = make_msgid(idstring=f"graph_{int(clock() * 1000)}")
+        # Stable cid naming like `graph_<epoch ms>` (util_methods.js:375)
+        # with a short random tail for uniqueness. Deliberately NOT
+        # make_msgid: its pid+random+hostname cid exceeds the 78-char header
+        # fold point, and a folded Content-ID header (embedded "\n ") breaks
+        # cid: references in strict clients.
+        import secrets
+
+        cid = f"<graph_{int(clock() * 1000)}.{secrets.token_hex(4)}@apm>"
         html = f'{html}<br><br><img src="cid:{cid[1:-1]}"/>'
         msg.add_alternative(html, subtype="html")
         with open(image_path, "rb") as fh:

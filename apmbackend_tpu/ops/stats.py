@@ -239,17 +239,11 @@ def _advance(state: StatsState, cfg: StatsConfig, new_label: jnp.ndarray) -> Sta
     return StatsState(new_label.astype(jnp.int32), counts, sums, samples, nsamples)
 
 
-def advance_one(state: StatsState, cfg: StatsConfig, next_label) -> StatsState:
-    """Advance the ring by EXACTLY ONE label: clear the slot ``next_label``
-    claims and bump latest. The samples clear is one contiguous
-    dynamic_update_slice — the in-place-aliasing op — so a donated dispatch
-    never rewrites (or copies) the [S, NB, CAP] reservoir the way the
-    whole-buffer select in :func:`_advance` does. The host loop calls this
-    once per new label (bounded by NB calls on a label jump; the ring only
-    holds NB labels), exactly like the z-score ring_write staging."""
-    NB, CAP = cfg.num_buckets, cfg.samples_per_bucket
-    next_label = jnp.asarray(next_label, jnp.int32)
-    slot = next_label % NB
+def _clear_slot(state: StatsState, slot: jnp.ndarray) -> StatsState:
+    """Zero ONE ring slot via contiguous dynamic_update_slices — the
+    in-place-aliasing op shape shared by :func:`advance_one` and
+    :func:`advance_span` (latest_bucket is left for the caller)."""
+    CAP = state.samples.shape[-1]
     z = jnp.zeros((), jnp.int32)  # same index dtype as slot (x64-safe)
     S = state.counts.shape[0]
     hole = jnp.zeros((S, 1), state.counts.dtype)
@@ -258,7 +252,45 @@ def advance_one(state: StatsState, cfg: StatsConfig, next_label) -> StatsState:
     nsamples = jax.lax.dynamic_update_slice(state.nsamples, hole, (z, slot))
     nan_slab = jnp.full((S, 1, CAP), jnp.nan, state.samples.dtype)
     samples = jax.lax.dynamic_update_slice(state.samples, nan_slab, (z, slot, z))
-    return StatsState(next_label, counts, sums, samples, nsamples)
+    return state._replace(counts=counts, sums=sums, samples=samples, nsamples=nsamples)
+
+
+def advance_one(state: StatsState, cfg: StatsConfig, next_label) -> StatsState:
+    """Advance the ring by EXACTLY ONE label: clear the slot ``next_label``
+    claims and bump latest. The samples clear is one contiguous
+    dynamic_update_slice — the in-place-aliasing op — so a donated dispatch
+    never rewrites (or copies) the [S, NB, CAP] reservoir the way the
+    whole-buffer select in :func:`_advance` does. The host loop calls this
+    once per new label (bounded by NB calls on a label jump; the ring only
+    holds NB labels), exactly like the z-score ring_write staging."""
+    NB = cfg.num_buckets
+    next_label = jnp.asarray(next_label, jnp.int32)
+    return _clear_slot(state, next_label % NB)._replace(latest_bucket=next_label)
+
+
+def advance_span(state: StatsState, cfg: StatsConfig, new_label) -> StatsState:
+    """Advance the ring to a TRACED ``new_label`` entirely in-program: clear
+    the slots claimed by labels (latest, new_label] — at most NB, since the
+    ring only holds NB labels — and bump latest. Each clear is the same
+    contiguous DUS as :func:`advance_one`, issued from a bounded fori_loop
+    whose off iterations pass the state through untouched (lax.cond), so a
+    donated dispatch keeps the [S, NB, CAP] reservoir in place for any jump
+    size. This is what lets the fused single-dispatch executor take the new
+    label as a device scalar — no host mirror of latest_bucket, no
+    device->host sync per tick. Stale labels (<= latest) clamp to a no-op
+    clear, exactly like :func:`tick`'s guard."""
+    NB = cfg.num_buckets
+    nl = jnp.maximum(jnp.asarray(new_label, jnp.int32), state.latest_bucket)
+    k = jnp.minimum(nl - state.latest_bucket, NB)
+
+    def body(j, st):
+        # newest-first: label nl - j claims slot (nl - j) % NB; order is
+        # irrelevant (pure clears of distinct slots)
+        return _clear_slot(st, (nl - j) % NB)
+
+    # dynamic trip count (lowers to a while_loop): the common +1 tick runs
+    # exactly one clear instead of NB masked iterations
+    return jax.lax.fori_loop(0, k, body, state)._replace(latest_bucket=nl)
 
 
 def percentile_rank(n: jnp.ndarray, p: int):

@@ -60,6 +60,15 @@ def init_distributed(
     num = num_processes if num_processes is not None else int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     if num <= 1:
         return False
+    try:
+        # multi-process CPU needs an explicit collectives implementation
+        # (jax >= 0.4.34 raises "Multiprocess computations aren't
+        # implemented on the CPU backend" without it). Harmless on TPU pods
+        # — it only configures the host CPU client — and wrapped for jax
+        # versions that renamed/defaulted the option.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - option absent on this jax
+        pass
     jax.distributed.initialize(  # pragma: no cover - needs a real pod
         coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"),
         num,

@@ -37,6 +37,7 @@ from ..ops.ewma import EwmaState
 from ..ops.stats import StatsState
 from ..ops.zscore import SlidingAgg, ZScoreState
 from ..ops import zscore as dzscore
+from ..ops import stats as dstats
 from ..pipeline import (
     EngineConfig,
     EngineParams,
@@ -44,8 +45,11 @@ from ..pipeline import (
     LagEmission,
     TickEmission,
     _StaggeredRebuildBase,
+    _rebuild_rotation,
+    _staged_ring_update,
     cpu_zero_copy_view,
     default_native_rebuild_gate,
+    engine_core_tick,
     engine_ingest,
     engine_needs_rebuild,
     engine_rebuild_aggs,
@@ -225,11 +229,42 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
     shards' percentiles, so the reservoir never crosses a host boundary.
     Overflow ticks fall back to the in-program jitted paths.
     """
-    from ..pipeline import make_staged_executor, sliding_lag_indices
+    from ..pipeline import make_staged_executor
 
     n = mesh.devices.size
     lcfg = local_config(cfg, n)
     espec = tuple(_ROW for _ in sliding_lag_indices(cfg))
+
+    # EXPLICIT fused mode (tpuEngine.tickExecutor="fused" / APM_TICK_EXECUTOR):
+    # the whole staged choreography collapses into ONE shard_mapped donated
+    # dispatch per tick, with the staggered-rebuild chunk folded in
+    # (rebuild_integrated — callers skip ShardedRebuildScheduler). "auto"
+    # deliberately resolves to STAGED here regardless of size: pod shapes
+    # are the staged executor's home turf (per-shard rings are huge, and the
+    # staged native percentile/rebuild kernels are the CPU-fallback wins),
+    # and the two-process agreement tests keep exercising that path.
+    want_fused = (os.environ.get("APM_TICK_EXECUTOR") or cfg.tick_executor) == "fused"
+    if jax.process_count() > 1:
+        # executor choice is part of the dispatch sequence: divergence
+        # (e.g. one host's env override) would deadlock the collectives,
+        # so agree pod-globally — fused only if EVERY host wants it
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.array([1 if want_fused else 0], np.int32)
+        )
+        agreed_fused = bool(np.min(flags))
+        if want_fused and not agreed_fused:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused sharded executor disabled POD-WIDE: %d of %d hosts "
+                "did not request it; all hosts run the staged executor",
+                int(len(flags) - np.sum(flags)), len(flags),
+            )
+        want_fused = agreed_fused
+    if want_fused:
+        return _make_fused_sharded_step(mesh, cfg, lcfg)
 
     def _make_core(local_fn, extra_in=(), extra_out=()):
         return jax.jit(
@@ -384,6 +419,63 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
     native_core.native_pct_ticks = 0
     step = make_staged_executor(cfg, core=native_core)
     step.native_pct = native_core  # test/telemetry hook: .native_pct_ticks
+    return step
+
+
+def _make_fused_sharded_step(mesh: Mesh, cfg: EngineConfig, lcfg: EngineConfig):
+    """The FUSED pod executor: one shard_mapped donated dispatch per tick —
+    advance_span -> staggered-rebuild chunk -> ring-free core + ICI rollup ->
+    in-place ring writes, the sharded counterpart of pipeline.make_fused_step's
+    fused-all form. The rebuild chunk offset is shard-local (all shards
+    rotate in lockstep through their row blocks, same schedule as
+    ShardedRebuildScheduler) and runs BEFORE the tick so the chunk pass only
+    ever reads the ring (the XLA:CPU read+write copy hazard). Signature
+    matches make_sharded_step: ``step(state, new_label, params) ->
+    (emission, rollup, new_state)``; ``step.rebuild_integrated`` is True."""
+    sliding_idx = sliding_lag_indices(cfg)
+    rebuild = engine_needs_rebuild(cfg)
+    chunk, starts = _rebuild_rotation(lcfg) if rebuild else (0, [0])
+    rot = {"i": 0}
+
+    def local_fn(state, nl, params, rb_start):
+        state = state._replace(stats=dstats.advance_span(state.stats, lcfg.stats, nl))
+        if rebuild:
+            state = engine_rebuild_slice(state, lcfg, rb_start, chunk)
+        rings = tuple(state.zscores[i].values for i in sliding_idx)
+        cursors = tuple(state.zscores[i].pos for i in sliding_idx)
+        evicted = tuple(
+            dzscore.ring_evict_read(r, g) for r, g in zip(rings, cursors)
+        )
+        emission, state2, pushes = engine_core_tick(state, lcfg, nl, params, evicted)
+        state2 = _staged_ring_update(lcfg, state2, pushes)
+        return emission, _fleet_rollup(emission), state2
+
+    mapped = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(_state_specs(cfg), P(), _params_specs(cfg), P()),
+        out_specs=(
+            _emission_specs(cfg),
+            FleetRollup(P(), P(), P(), P(), P()),
+            _state_specs(cfg),
+        ),
+        # advance_span's dynamic-trip loop has no replication rule; the
+        # outputs' specs above are authoritative (rollup scalars really are
+        # replicated by the psums)
+        check_rep=False,
+    )
+    jfused = jax.jit(mapped, donate_argnums=(0,))
+
+    def step(state, new_label, params):
+        s = starts[rot["i"]]
+        rot["i"] = (rot["i"] + 1) % len(starts)
+        return jfused(state, np.int32(new_label), params, np.int32(s))
+
+    step.rebuild_integrated = rebuild
+    step.kind = "fused"
+    step.rebuild_rot = rot
+    step.rebuild_chunk = chunk
+    step.rebuild_starts = starts
     return step
 
 

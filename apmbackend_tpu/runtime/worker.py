@@ -103,6 +103,18 @@ class WorkerApp:
             logger=logger,
             micro_batch_size=int(eng_cfg.get("microBatchSize", 65536)),
         )
+        # operability: which per-tick executor this worker resolved to
+        # (tpuEngine.tickExecutor / state-size auto gate) and where the
+        # staggered rebuild runs — the first thing to check when tick
+        # latency looks wrong on a deployment
+        logger.info(
+            "Engine executor: %s (staggered rebuild: %s, async_emission=%s)",
+            self.driver._step.kind,
+            "integrated in tick program"
+            if self.driver._step.rebuild_integrated
+            else "separate scheduler",
+            self.driver._async_emission,
+        )
 
         # -- native intake ring ----------------------------------------------
         # The broker consumer thread pushes raw lines into the C++ SPSC ring;

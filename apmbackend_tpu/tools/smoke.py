@@ -161,7 +161,11 @@ def main(argv=None) -> int:
     ap.add_argument("--email-to", help="render: email the PNG to this address (implies --live)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="paths: sample log paths to resolve")
-    args = ap.parse_args(argv)
+    # intermixed parsing: ``smoke paths --config X /a/b.log`` puts a
+    # positional AFTER an optional — plain parse_args greedily matches the
+    # trailing-positional group at the first pass and then rejects the late
+    # path as "unrecognized arguments"
+    args = ap.parse_intermixed_args(argv)
     cfg = _load(args.config)
     if args.target == "db":
         return smoke_db(cfg, sys.stdout)

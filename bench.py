@@ -82,7 +82,7 @@ def run_worker(args) -> None:
     # production rebuild cadence: one staggered row chunk EVERY tick (the
     # full ring re-aggregates once per zscore_rebuild_every ticks), executed
     # and charged inside the measured loop — no pro-rata estimates
-    sched = RebuildScheduler(cfg)
+    sched = None if tick.rebuild_integrated else RebuildScheduler(cfg)
 
     rng = np.random.RandomState(0)
     B = args.batch
@@ -101,7 +101,8 @@ def run_worker(args) -> None:
         label += 1
         em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
-        state = sched.step(state)  # compiles the slice/merge programs
+        if sched is not None:
+            state = sched.step(state)  # compiles the slice/merge programs
         state = ingest(state, cfg, *make_batch(label))
     jax.block_until_ready(state.stats.counts)
 
@@ -123,9 +124,10 @@ def run_worker(args) -> None:
         overflow_row_ticks += int(np.asarray(em.overflowed).sum())  # untimed: telemetry
         # the staggered rebuild chunk runs between ticks (detection latency
         # unaffected) but its wall time is charged to throughput
-        tr = time.perf_counter()
-        state = sched.step_synced(state)
-        rebuild_times.append(time.perf_counter() - tr)
+        if sched is not None:
+            tr = time.perf_counter()
+            state = sched.step_synced(state)
+            rebuild_times.append(time.perf_counter() - tr)
         batch = make_batch(label)
         t2 = time.perf_counter()
         state = ingest(state, cfg, *batch)
@@ -166,6 +168,8 @@ def run_worker(args) -> None:
             "p50_detection_latency_ms": round(p50_ms, 3),
             "p95_detection_latency_ms": round(float(np.percentile(np.array(tick_latencies) * 1000, 95)), 3),
             "ingest_tx_per_sec": round(ingest_tx_s, 1),
+            "executor": tick.kind,
+            "rebuild_integrated": bool(tick.rebuild_integrated),
             "host_intake_tx_per_sec": round(host_intake_tx_s, 1),
             "reference_scale": ref_scale,
             "overflow_row_ticks": overflow_row_ticks,
@@ -197,7 +201,7 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
     # staged executor: ring writes stay in-place dynamic_update_slices
     tick = make_engine_step(cfg)
     ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
-    sched = RebuildScheduler(cfg)
+    sched = None if tick.rebuild_integrated else RebuildScheduler(cfg)
     rng = np.random.RandomState(1)
     label = 180_000_000
     B = 1024
@@ -212,7 +216,8 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
         label += 1
         em, state = tick(state, label, params)
         jax.block_until_ready(em.tpm)
-        state = sched.step(state)
+        if sched is not None:
+            state = sched.step(state)
         state = ingest(state, cfg, *batch(label))
     lats = []
     rebuilds = []
@@ -223,9 +228,10 @@ def _measure_reference_scale(args, capacity: int = 128, ticks: int = 12) -> dict
         _ = [np.asarray(l.trigger) for l in em.lags]
         np.asarray(em.tpm)
         lats.append(time.perf_counter() - t0)
-        tr = time.perf_counter()
-        state = sched.step_synced(state)
-        rebuilds.append(time.perf_counter() - tr)
+        if sched is not None:
+            tr = time.perf_counter()
+            state = sched.step_synced(state)
+            rebuilds.append(time.perf_counter() - tr)
         state = ingest(state, cfg, *batch(label))
     p50 = float(np.percentile(np.array(lats) * 1000, 50))
     metrics_per_tick = capacity * 3 * len(cfg.lags)

@@ -14,10 +14,11 @@ production scale).
 | podshard    | 3: pod-sharded 10k-service z-score, ICI-allreduced baselines |
 | multiwindow | 4: multi-window seasonal/EWMA baselining + alert eval on device |
 | pallas      | (extra) selection-kernel hardware proof: parity + timing vs XLA sort |
+| dispatch    | (extra) per-tick dispatch-floor microbench at the rolling shape |
 """
 
-from . import (bench_jmx, bench_multiwindow, bench_pallas, bench_podshard,
-               bench_replay, bench_rolling)
+from . import (bench_dispatch, bench_jmx, bench_multiwindow, bench_pallas,
+               bench_podshard, bench_replay, bench_rolling)
 
 REGISTRY = {
     "replay": bench_replay.run,
@@ -26,4 +27,5 @@ REGISTRY = {
     "podshard": bench_podshard.run,
     "multiwindow": bench_multiwindow.run,
     "pallas": bench_pallas.run,
+    "dispatch": bench_dispatch.run,
 }

@@ -18,18 +18,20 @@ import numpy as np
 
 
 def enable_compile_cache() -> None:
-    """Persistent XLA compilation cache for benchmark processes (same
-    mechanism as tests/conftest.py): a replay-style run otherwise pays
-    ~3.5 s of XLA:CPU compiles INSIDE its measured window. First-ever run
-    on a machine still compiles; every rerun loads from /tmp. Call before
-    the first jit dispatch."""
+    """Persistent-compile-cache opt-in for benchmark processes — DISABLED by
+    default since round 6: routing XLA:CPU through the cache's
+    cpu_aot_loader compile path miscompiles buffer donation for fused
+    single-program steps (state corruption reproduced in tests/conftest.py's
+    note; numbers measured over corrupted buffers are worthless). Compiles
+    now happen in each bench's warmup, OUTSIDE the measured windows; set
+    APM_BENCH_JAX_CACHE explicitly to re-enable for experiments."""
     import jax
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("APM_BENCH_JAX_CACHE", "/tmp/apm_jax_bench_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.4)
+    if os.environ.get("APM_BENCH_JAX_CACHE"):
+        jax.config.update(
+            "jax_compilation_cache_dir", os.environ["APM_BENCH_JAX_CACHE"]
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.4)
 
 PER_CHIP_NORTH_STAR = 125_000.0  # metrics/sec/chip (1M / 8 chips)
 POD_NORTH_STAR = 1_000_000.0  # metrics/sec, whole pod

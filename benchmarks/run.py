@@ -56,16 +56,36 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true", help="smoke-sized shapes")
     ap.add_argument("--in-process", action="store_true",
                     help="run in this process (no per-config isolation)")
+    ap.add_argument("--repeat", type=int, default=None,
+                    help="run each config N times, report the median-by-value "
+                    "run (default: 3 for podshard, 1 otherwise)")
     args = ap.parse_args(argv)
 
     names = args.config or sorted(REGISTRY)
     failed = 0
     for name in names:
+        # the podshard margin is the one number the project is named after,
+        # and single runs on a loaded one-core host swing ~±20% (VERDICT r5
+        # weak 3): report the MEDIAN of three subprocess runs so the
+        # north-star claim survives a busy machine. --repeat overrides;
+        # median requires isolation (in-process runs share heap distortion).
+        repeat = args.repeat if args.repeat is not None else (
+            3 if name == "podshard" and not args.in_process and not args.quick else 1
+        )
         try:
-            if args.in_process:
-                res = REGISTRY[name](quick=args.quick)
-            else:
-                res = _run_isolated(name, args.quick)
+            runs = []
+            for _ in range(max(repeat, 1)):
+                if args.in_process:
+                    runs.append(REGISTRY[name](quick=args.quick))
+                else:
+                    runs.append(_run_isolated(name, args.quick))
+            runs.sort(key=lambda r: r.get("value", 0.0))
+            res = runs[len(runs) // 2]
+            if len(runs) > 1:
+                res.setdefault("details", {})["median_of"] = {
+                    "runs": len(runs),
+                    "values": [r.get("value") for r in runs],
+                }
             print(json.dumps(res), flush=True)
         except Exception as e:  # one failing bench must not hide the others
             failed += 1

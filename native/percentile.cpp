@@ -24,6 +24,8 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
+#include <cstring>
 #include <vector>
 
 namespace {
@@ -99,6 +101,103 @@ inline bool select_topk(const std::vector<float> &buf, const int *ps,
   return true;
 }
 
+// Radix selection for DENSE windows: at production-dense occupancy (~1,000
+// window samples per row at the reference's 100-service scale) the
+// nth_element chain is swap-heavy — ~34 us/row measured on the one-core
+// fallback, the dominant tick cost. Selecting through byte histograms of
+// the monotone float32 bit key instead costs three cheap linear passes
+// (key+hist, second-level hist, candidate gather) plus a tiny selection
+// among the <= n candidates sharing the rank's 16-bit prefix — measured
+// ~3x the chain at n ~ 1,000. Exact order statistics: counting is exact;
+// ties resolve by count. (The key is a TOTAL order, so -0.0 sorts below
+// +0.0 — same as XLA's sort/top_k, whereas nth_element's operator< treats
+// them as equal; the selected VALUE can differ only in zero sign.)
+constexpr int64_t RADIX_MIN = 256;  // below this the chain/top-k paths win
+
+// A/B kill-switch for the dispatch-floor microbench: APM_PCT_NO_RADIX=1
+// restores the pre-radix nth_element chain so the legacy configuration can
+// be timed in the same process/run (per-call getenv: ~ns against a ms-scale
+// selection, and it must react to mid-process toggles)
+inline bool radix_disabled() {
+  const char *v = std::getenv("APM_PCT_NO_RADIX");
+  return v && v[0] == '1';
+}
+
+inline uint32_t float_key(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  return (u & 0x80000000u) ? ~u : (u | 0x80000000u);
+}
+
+inline float key_float(uint32_t k) {
+  uint32_t u = (k & 0x80000000u) ? (k & 0x7fffffffu) : ~k;
+  float f;
+  std::memcpy(&f, &u, 4);
+  return f;
+}
+
+// Single-level 16-bit histogram selection over the key buffer: one bin
+// per high-16-bit prefix, a touched-bin list so the 256 KB table resets in
+// O(distinct prefixes) instead of O(65536), one shared ascending walk for
+// all ranks, then one candidate-gather pass per call. ``hist16``/``touched``
+// are caller-owned scratch reused across rows (allocation-free steady
+// state); hist16 MUST be all-zero on entry and is restored to all-zero
+// before returning. ranks must be ascending, each < n.
+inline void radix_select16(const std::vector<uint32_t> &keys,
+                           std::vector<int32_t> &hist16,
+                           std::vector<int32_t> &touched,
+                           const int64_t *ranks, int n_ranks,
+                           float *out_vals) {
+  const int64_t n = static_cast<int64_t>(keys.size());
+  touched.clear();
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t b = static_cast<int32_t>(keys[i] >> 16);
+    if (hist16[b]++ == 0) touched.push_back(b);
+  }
+  std::sort(touched.begin(), touched.end());
+  // one ascending walk resolves every rank's (prefix bin, residual rank)
+  uint32_t bin_of[4];
+  int64_t r2[4];
+  {
+    int64_t acc = 0;
+    size_t t = 0;
+    for (int i = 0; i < n_ranks; ++i) {
+      while (t + 1 < touched.size() && acc + hist16[touched[t]] <= ranks[i]) {
+        acc += hist16[touched[t]];
+        ++t;
+      }
+      bin_of[i] = static_cast<uint32_t>(touched[t]);
+      r2[i] = ranks[i] - acc;
+    }
+  }
+  uint32_t distinct[4];
+  int which[4], n_distinct = 0;
+  for (int i = 0; i < n_ranks; ++i) {
+    int w = -1;
+    for (int k = 0; k < n_distinct; ++k)
+      if (distinct[k] == bin_of[i]) w = k;
+    if (w < 0) {
+      w = n_distinct;
+      distinct[n_distinct++] = bin_of[i];
+    }
+    which[i] = w;
+  }
+  std::vector<uint32_t> cand[4];
+  for (int k = 0; k < n_distinct; ++k)
+    cand[k].reserve(static_cast<size_t>(hist16[distinct[k]]));
+  for (int64_t i = 0; i < n; ++i) {
+    const uint32_t p = keys[i] >> 16;
+    for (int k = 0; k < n_distinct; ++k)
+      if (p == distinct[k]) cand[k].push_back(keys[i]);
+  }
+  for (int i = 0; i < n_ranks; ++i) {
+    std::vector<uint32_t> &c = cand[which[i]];
+    std::nth_element(c.begin(), c.begin() + r2[i], c.end());
+    out_vals[i] = key_float(c[r2[i]]);
+  }
+  for (int32_t b : touched) hist16[b] = 0;  // O(distinct) table reset
+}
+
 }  // namespace
 
 extern "C" {
@@ -122,6 +221,11 @@ int apm_window_percentiles_counts(const float *samples, int64_t S, int64_t NB,
   if (S < 0 || NB <= 0 || CAP <= 0 || n_ps <= 0) return 1;
   std::vector<float> buf;
   buf.reserve(static_cast<size_t>(NB * CAP));
+  std::vector<uint32_t> keys;  // radix path scratch (capacity persists)
+  keys.reserve(static_cast<size_t>(NB * CAP));
+  std::vector<int32_t> hist16(65536, 0);  // all-zero invariant between rows
+  std::vector<int32_t> touched;
+  touched.reserve(static_cast<size_t>(NB * CAP));
   const int64_t row_stride = NB * CAP;
   // ranks are non-decreasing in p for a fixed n, so process percentiles
   // DESCENDING and shrink the nth_element range from the right: each
@@ -152,6 +256,33 @@ int apm_window_percentiles_counts(const float *samples, int64_t S, int64_t NB,
       continue;
     }
     if (select_topk(buf, ps, n_ps, order.data(), orow)) continue;
+    if (n >= RADIX_MIN && n_ps <= 2 && !radix_disabled()) {
+      // dense-window regime: one fused buf->key pass, then the 16-bit
+      // histogram selection (radix_select16)
+      keys.clear();
+      const float *bp = buf.data();
+      for (int64_t i = 0; i < n; ++i) keys.push_back(float_key(bp[i]));
+      int64_t ranks[4];
+      int n_ranks = 0;
+      int64_t idx1s[2];
+      bool tps[2];
+      int vix[2][2];  // [pi] -> rank index of (value, successor)
+      for (int oi = n_ps - 1; oi >= 0; --oi) {
+        const int pi = order[oi];  // ascending p => ascending ranks
+        rank_for(n, ps[pi], &idx1s[pi], &tps[pi]);
+        if (idx1s[pi] >= n) idx1s[pi] = n - 1;  // defensive (p <= 100 never)
+        vix[pi][0] = n_ranks;
+        ranks[n_ranks++] = idx1s[pi];
+        vix[pi][1] = tps[pi] ? n_ranks : vix[pi][0];
+        if (tps[pi]) ranks[n_ranks++] = idx1s[pi] + 1;
+      }
+      float vals[4];
+      radix_select16(keys, hist16, touched, ranks, n_ranks, vals);
+      for (int pi = 0; pi < n_ps; ++pi)
+        orow[pi] = tps[pi] ? (vals[vix[pi][0]] + vals[vix[pi][1]]) / 2.0f
+                           : vals[vix[pi][0]];
+      continue;
+    }
     int64_t hi = n;  // exclusive upper bound of the unpartitioned region
     for (int oi = 0; oi < n_ps; ++oi) {
       const int pi = order[oi];

@@ -161,4 +161,30 @@ if hasattr(staged, "native_pct"):
 else:  # pragma: no cover - no toolchain on this machine
     suffix += " divergent_gate=skipped"
 
+# FUSED-executor agreement (round 6): the executor KIND is part of the
+# dispatch sequence, so it rides the same pod-global agreement as the
+# native-percentile capability. Scenario 1 — divergent request (only host 0
+# asks for fused): every host must downgrade to staged and still tick.
+if PID == 0:
+    os.environ["APM_TICK_EXECUTOR"] = "fused"
+div = make_sharded_step(mesh, cfg)
+assert div.kind != "fused", (
+    f"proc {PID}: one host did not request the fused executor but this host "
+    "built it — the pod-global executor agreement failed"
+)
+em5, roll5, state = div(state, label + cfg.stats.buffer_sz + 4, params)
+assert int(jax.device_get(roll5.total_tx)) == 2 * B
+# Scenario 2 — unanimous request: the single-dispatch fused sharded step
+# (advance_span + integrated staggered rebuild + ICI rollup) must agree
+# with the staged path's rollup over the same window.
+os.environ["APM_TICK_EXECUTOR"] = "fused"
+fused = make_sharded_step(mesh, cfg)
+os.environ.pop("APM_TICK_EXECUTOR", None)
+assert fused.kind == "fused" and fused.rebuild_integrated
+em6, roll6, state = fused(state, label + cfg.stats.buffer_sz + 5, params)
+assert int(jax.device_get(roll6.total_tx)) == 2 * B, (
+    f"proc {PID}: fused sharded rollup {int(jax.device_get(roll6.total_tx))} != {2 * B}"
+)
+suffix += " fused_gate=divergent-staged+unanimous-fused"
+
 print(f"MP_SMOKE_OK proc={PID} total={total}{suffix}", flush=True)

@@ -28,8 +28,12 @@ def test_bench_quick(name):
 
 
 def test_registry_covers_all_five_configs():
-    # the five BASELINE.json configs plus the pallas hardware-proof extra
-    assert set(REGISTRY) == {"replay", "rolling", "jmx", "podshard", "multiwindow", "pallas"}
+    # the five BASELINE.json configs plus the pallas hardware-proof and
+    # dispatch-floor extras
+    assert set(REGISTRY) == {
+        "replay", "rolling", "jmx", "podshard", "multiwindow", "pallas",
+        "dispatch",
+    }
 
 
 def test_runner_cli(capsys):

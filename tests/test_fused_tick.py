@@ -1,0 +1,387 @@
+"""Fused-executor parity: the single/two-dispatch fused tick, the megatick
+scan and the staged executor must be the SAME engine.
+
+The fused paths (pipeline.make_fused_step, make_megatick — the r5
+dispatch-floor fix) re-arrange WHERE each stage runs (one donated program vs
+five, rebuild-before-tick vs tick-then-rebuild, host percentile kernel vs
+in-program), never WHAT is computed: every test here asserts bit-identical
+TickEmission leaves against the staged executor over >= 64 ticks including
+label jumps, ring evictions (lag << ticks) and multiple staggered-rebuild
+rotations. The rebuild phase note: fused integrates the rebuild chunk at the
+START of its tick program (ring-read-only constraint), so the staged
+reference runs its RebuildScheduler immediately BEFORE each tick — the same
+schedule, just expressed by the host loop; both arrangements re-aggregate
+every row once per zscore_rebuild_every ticks.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+jnp = pytest.importorskip("jax.numpy")
+
+from apmbackend_tpu.pipeline import (  # noqa: E402
+    RebuildScheduler,
+    engine_ingest,
+    fused_copy_bytes,
+    make_demo_engine,
+    make_engine_step,
+    make_fused_step,
+    make_megatick,
+    resolve_tick_executor,
+)
+
+CAP = 24
+LAGS = [(6, 3.0, 0.1), (12, 2.5, 0.0)]
+BASE = 170_000_000
+
+
+def _engine(rebuild_every=16):
+    cfg, state, params = make_demo_engine(CAP, 8, LAGS)
+    return cfg._replace(zscore_rebuild_every=rebuild_every), state, params
+
+
+def _batch(rng, lbl, n=64):
+    return (
+        rng.randint(0, CAP, n).astype(np.int32),
+        np.full(n, lbl, np.int32),
+        (200 + 50 * rng.rand(n)).astype(np.float32),
+        np.ones(n, bool),
+    )
+
+
+def _labels(n):
+    # +1 ticks with a jump every 9th — evictions (lag 6/12 << n) and
+    # advance_span's multi-slot clear both exercised
+    label, out = BASE, []
+    for k in range(n):
+        label += 1 if k % 9 else 3
+        out.append(label)
+    return out
+
+
+def _run_staged_prerebuild(n_ticks):
+    """Reference stream: staged executor with the scheduler stepped BEFORE
+    each tick (matches the fused integrated rebuild's phase), XLA slice
+    rebuild (allow_native=False => bitwise-identical math to the fused
+    in-program slice)."""
+    cfg, state, params = _engine()
+    os.environ["APM_TICK_EXECUTOR"] = "staged"
+    try:
+        step = make_engine_step(cfg)
+    finally:
+        os.environ.pop("APM_TICK_EXECUTOR", None)
+    assert step.kind == "staged"
+    sched = RebuildScheduler(cfg, allow_native=False)
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    rng = np.random.RandomState(7)
+    ems = []
+    for lbl in _labels(n_ticks):
+        state = sched.step(state)
+        em, state = step(state, lbl, params)
+        ems.append(jax.tree.map(np.asarray, em))
+        state = ingest(state, cfg, *_batch(rng, lbl))
+    return ems
+
+
+def _assert_emissions_equal(a_list, b_list, *, exact=True):
+    """exact=True: bit-identical. exact=False: int/bool leaves (signals,
+    triggers, counts, cause bits) still bit-identical, float leaves within
+    2e-6 relative — the DOCUMENTED tolerance for pairings whose f32 reduces
+    live at different XLA program boundaries (e.g. the rebuild-slice pass
+    standalone vs fused into the tick program: XLA:CPU may reassociate a
+    fused reduce, shifting window means by ulps; detection decisions are the
+    integer leaves, and those must never differ)."""
+    assert len(a_list) == len(b_list) and len(a_list) > 0
+    for t, (a, b) in enumerate(zip(a_list, b_list)):
+        for x, y in zip(jax.tree.flatten(a)[0], jax.tree.flatten(b)[0]):
+            x, y = np.asarray(x), np.asarray(y)
+            if exact or x.dtype.kind != "f":
+                assert np.array_equal(
+                    np.nan_to_num(x, nan=-123.0), np.nan_to_num(y, nan=-123.0)
+                ), f"tick {t}: {x.dtype}{x.shape} emission leaf diverged"
+            else:
+                np.testing.assert_allclose(
+                    np.nan_to_num(x, nan=-123.0), np.nan_to_num(y, nan=-123.0),
+                    rtol=2e-6, atol=1e-4,
+                    err_msg=f"tick {t}: {x.dtype}{x.shape} beyond ulp tolerance",
+                )
+
+
+@pytest.mark.parametrize("force_all", [False, True])
+def test_fused_matches_staged_bitwise(force_all, monkeypatch):
+    """Both fused forms — the two-program native-percentile split and the
+    everything-in-one-program fused-all — match the staged engine over 72
+    ticks with jumps, evictions and 4+ full rebuild rotations. The
+    production pairing (native percentiles both sides) is BITWISE; the
+    forced fused-all pairing allows the documented ulp tolerance on float
+    leaves (_assert_emissions_equal) because its in-program rebuild reduce
+    sits at a different fusion boundary than the reference scheduler's
+    standalone program."""
+    if force_all:
+        # force the fused-all form even where the native kernel exists
+        import apmbackend_tpu.pipeline as P
+
+        monkeypatch.setattr(P, "_use_native_percentiles", lambda cfg: False)
+    ref = _run_staged_prerebuild(72)
+
+    cfg, state, params = _engine()
+    step = make_fused_step(cfg)
+    assert step.rebuild_integrated
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    rng = np.random.RandomState(7)
+    ems = []
+    for lbl in _labels(72):
+        em, state = step(state, lbl, params)
+        ems.append(jax.tree.map(np.asarray, em))
+        state = ingest(state, cfg, *_batch(rng, lbl))
+    _assert_emissions_equal(ref, ems, exact=not force_all)
+
+
+def test_megatick_matches_per_tick(monkeypatch):
+    """The K-slot lax.scan megatick replays the same (tick, ingest) stream
+    bit-identically to the per-tick fused path, across 3 megatick dispatches
+    including ingest-only slots."""
+    import apmbackend_tpu.pipeline as P
+
+    # both sides in-program percentiles (the scan cannot host the kernel)
+    monkeypatch.setattr(P, "_use_native_percentiles", lambda cfg: False)
+    K, B = 12, 32
+    cfg, state, params = _engine(rebuild_every=8)
+    mega = make_megatick(cfg, K, B)
+    rng = np.random.RandomState(3)
+
+    def slots(off):
+        nls = np.zeros(K, np.int32)
+        do = np.zeros(K, bool)
+        rows = np.zeros((K, B), np.int32)
+        labels = np.zeros((K, B), np.int32)
+        elaps = np.zeros((K, B), np.float32)
+        valid = np.zeros((K, B), bool)
+        recs = []
+        for k in range(K):
+            lbl = BASE + off + k
+            do[k] = k > 0 or off > 0  # first-ever slot: ingest only
+            nls[k] = lbl
+            n = int(rng.randint(4, B))
+            r = rng.randint(0, CAP, n)
+            e = (200 + 50 * rng.rand(n)).astype(np.float32)
+            rows[k, :n] = r
+            labels[k, :n] = lbl
+            elaps[k, :n] = e
+            valid[k, :n] = True
+            recs.append((lbl, r, e, n, bool(do[k])))
+        return (nls, do, rows, labels, elaps, valid), recs
+
+    all_recs, ems_mega = [], []
+    for off in (0, K, 2 * K):
+        xs, recs = slots(off)
+        all_recs.extend(recs)
+        em, state = mega(state, params, *xs)
+        ems_mega.append(jax.tree.map(np.asarray, em))
+
+    # reference: the per-tick fused-all executor over the identical stream
+    cfg2, st2, params2 = _engine(rebuild_every=8)
+    step = make_fused_step(cfg2)
+    ingest = jax.jit(engine_ingest, static_argnums=1, donate_argnums=(0,))
+    ems_ref = []
+    for lbl, r, e, n, do in all_recs:
+        if do:
+            em, st2 = step(st2, lbl, params2)
+            ems_ref.append(jax.tree.map(np.asarray, em))
+        rows = np.zeros(B, np.int32)
+        labels = np.zeros(B, np.int32)
+        elaps = np.zeros(B, np.float32)
+        valid = np.zeros(B, bool)
+        rows[:n], labels[:n], elaps[:n], valid[:n] = r, lbl, e, True
+        st2 = ingest(st2, cfg2, rows, labels, elaps, valid)
+
+    flat_mega = []
+    for g, em in enumerate(ems_mega):
+        leaves = jax.tree.flatten(em)[0]
+        for k in range(K):
+            if all_recs[g * K + k][4]:
+                flat_mega.append([lf[k] for lf in leaves])
+    assert len(flat_mega) == len(ems_ref)
+    # same tolerance contract as _assert_emissions_equal(exact=False): the
+    # scan body is yet another fusion boundary for the f32 reduces; integer
+    # decision leaves must still be bit-identical
+    for t, (a, b) in enumerate(zip(flat_mega, ems_ref)):
+        for x, y in zip(a, jax.tree.flatten(b)[0]):
+            x, y = np.asarray(x), np.asarray(y)
+            if x.dtype.kind != "f":
+                assert np.array_equal(x, y), (
+                    f"megatick slot {t}: integer emission leaf diverged"
+                )
+            else:
+                np.testing.assert_allclose(
+                    np.nan_to_num(x, nan=-9.0), np.nan_to_num(y, nan=-9.0),
+                    rtol=2e-6, atol=1e-4,
+                    err_msg=f"megatick slot {t} beyond ulp tolerance",
+                )
+
+
+def test_executor_resolution_and_gate(monkeypatch):
+    """auto = fused under the byte budget, staged above it; explicit config
+    and the env override pin either; the driver follows the resolution."""
+    cfg, _, _ = _engine()
+    assert resolve_tick_executor(cfg) == "fused"  # ~200 KB of state
+    assert resolve_tick_executor(cfg._replace(tick_executor="staged")) == "staged"
+    monkeypatch.setenv("APM_FUSED_MAX_BYTES", "1")
+    assert resolve_tick_executor(cfg) == "staged"  # budget forces staged
+    monkeypatch.setenv("APM_TICK_EXECUTOR", "fused")
+    assert resolve_tick_executor(cfg) == "fused"  # env overrides everything
+    monkeypatch.delenv("APM_TICK_EXECUTOR")
+    monkeypatch.delenv("APM_FUSED_MAX_BYTES")
+    assert fused_copy_bytes(cfg) > 0
+    with pytest.raises(ValueError):
+        resolve_tick_executor(cfg._replace(tick_executor="warp"))
+
+
+def test_driver_async_emission_same_outputs(monkeypatch):
+    """asyncEmission=true delivers the identical StatEntry/FullStatEntry
+    stream (one tick late internally, flushed at the end) — catch-up mode
+    must change latency, never content."""
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    def cfgd():
+        c = default_config()
+        c["tpuEngine"]["serviceCapacity"] = 16
+        c["tpuEngine"]["samplesPerBucket"] = 8
+        c["streamCalcZScore"]["defaults"] = [
+            {"LAG": 4, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
+        ]
+        return c
+
+    def run(async_emission):
+        stats, fs = [], []
+        drv = PipelineDriver(
+            cfgd(),
+            on_stat=lambda s: stats.append(s.to_csv()),
+            on_fullstat=lambda f: fs.append(f.to_csv()),
+            async_emission=async_emission,
+        )
+        base = BASE
+        lines = []
+        rng = np.random.RandomState(5)
+        for i in range(10):
+            lbl = base + i
+            for j in range(int(rng.randint(2, 6))):
+                e = int(rng.randint(100, 900))
+                lines.append(
+                    f"tx|jvm0|S:svc{j % 3}|l{i}{j}|1|{lbl * 10000 - e}|{lbl * 10000 + j}|{e}|Y"
+                )
+        drv.feed_csv_batch(lines)
+        drv.flush()
+        return stats, fs
+
+    s_sync, f_sync = run(False)
+    s_async, f_async = run(True)
+    assert s_sync == s_async and f_sync == f_async and len(f_sync) > 0
+
+
+def test_advance_span_matches_advance_one_loop():
+    """advance_span (the fused in-program label advance) == the staged host
+    loop of advance_one, for +1 ticks, multi-label jumps, jumps past NB, and
+    the stale-label clamp."""
+    from apmbackend_tpu.ops import stats as dstats
+
+    cfg = dstats.StatsConfig(capacity=5, window_sz=6, buffer_sz=2,
+                             samples_per_bucket=4)
+    NB = cfg.num_buckets
+    rng = np.random.RandomState(0)
+    st_a = dstats.init_state(cfg)
+    st_b = dstats.init_state(cfg)
+    span = jax.jit(dstats.advance_span, static_argnums=1)
+    one = jax.jit(dstats.advance_one, static_argnums=1)
+    label = 100
+    # seed a first tick + some data, then exercise jump shapes
+    for jump in [1, 1, 2, NB - 1, NB, NB + 3, 1, 0, -2, 1]:
+        label = label + jump
+        st_a = span(st_a, cfg, jnp.int32(label))
+        latest = int(st_b.latest_bucket)
+        nl = max(latest, label)
+        for lbl in range(max(latest + 1, nl - NB + 1), nl + 1):
+            st_b = one(st_b, cfg, lbl)
+        if int(st_b.latest_bucket) != nl:  # stale tick: clamp like tick()
+            st_b = st_b._replace(latest_bucket=jnp.int32(nl))
+        label = nl
+        for x, y in zip(jax.tree.flatten(st_a)[0], jax.tree.flatten(st_b)[0]):
+            assert np.array_equal(
+                np.nan_to_num(np.asarray(x), nan=-1.0),
+                np.nan_to_num(np.asarray(y), nan=-1.0),
+            )
+        # scatter some data so cleared-slot content matters
+        n = 8
+        rows = rng.randint(0, 5, n).astype(np.int32)
+        labels = np.full(n, label, np.int32)
+        elaps = rng.rand(n).astype(np.float32) * 100
+        valid = np.ones(n, bool)
+        st_a = dstats.ingest(st_a, cfg, rows, labels, elaps, valid)
+        st_b = dstats.ingest(st_b, cfg, rows, labels, elaps, valid)
+
+
+def test_radix_selection_exactness():
+    """The dense-window radix path of the native percentile kernel returns
+    the exact reference order statistics — cross-checked against the jitted
+    sorted-path oracle on adversarial rows (ties, NaN holes, near-boundary
+    ranks) straddling the RADIX_MIN=256 regime switch."""
+    from apmbackend_tpu import native as _native
+
+    if not _native.have_native_percentiles():
+        pytest.skip("native toolchain unavailable")
+    from apmbackend_tpu.ops import stats as dstats
+
+    rng = np.random.RandomState(11)
+    S, NB, CAPS = 12, 9, 64
+    samples = np.full((S, NB, CAPS), np.nan, np.float32)
+    counts = np.zeros((S, NB), np.int32)
+    per_row = [0, 40, 200, 255, 256, 300, 420, 576, 576, 576, 576, 130]
+    for s in range(S):
+        n = per_row[s]
+        per_bucket = -(-n // NB) if n else 0
+        left = n
+        for b in range(NB):
+            m = min(per_bucket, left, CAPS)
+            if m <= 0:
+                break
+            if s == 7:
+                vals = np.full(m, 42.0, np.float32)  # massive ties
+            elif s == 8:
+                vals = rng.choice([1.0, 2.0, 3.0], m).astype(np.float32)
+            elif s == 9:
+                vals = (rng.rand(m) * 1e6).astype(np.float32)
+            elif s == 10:
+                vals = -rng.rand(m).astype(np.float32) * 50  # negatives
+            else:
+                vals = (50 + 900 * rng.rand(m)).astype(np.float32)
+            samples[s, b, :m] = vals
+            counts[s, b] = m
+            left -= m
+    mask = np.ones(NB, bool)
+    mask[3] = False  # one excluded bucket
+    counts_masked = counts.copy()
+    got = _native.window_percentiles_native(samples, mask, (75, 95), counts_masked)
+
+    # oracle: exact reference math over the gathered window samples
+    for s in range(S):
+        window = samples[s, mask, :].ravel()
+        window = window[~np.isnan(window)]
+        n = len(window)
+        if n == 0:
+            assert np.isnan(got[s]).all()
+            continue
+        sorted_vals = jnp.asarray(np.sort(window))[None, :]
+        for pi, p in enumerate((75, 95)):
+            want = float(
+                dstats.reference_percentile_sorted(
+                    sorted_vals, jnp.asarray([n], jnp.int32), p
+                )[0]
+            )
+            assert got[s, pi] == np.float32(want), (
+                f"row {s} (n={n}) p{p}: native {got[s, pi]} != oracle {want}"
+            )

@@ -223,8 +223,13 @@ def test_native_kernel_against_numpy_oracle():
     valid = ~np.isnan(ring)
     assert np.array_equal(cnt, valid.sum(2).astype(np.int32))
     d = np.where(valid, ring.astype(np.float64) - anchor[:, :, None], 0.0)
-    np.testing.assert_allclose(vsum, d.sum(2), rtol=1e-6, atol=1e-3)
-    np.testing.assert_allclose(vsumsq, (d * d).sum(2), rtol=1e-6, atol=1e-2)
+    # tolerance = the f32 accumulation bound, NOT a machine-tuned constant:
+    # the kernel's reduction order depends on the build's SIMD width
+    # (-march=native), so worst-case error is ~n * eps_f32 * sum|terms|
+    # (~513 * 6e-8 * 9e4 ≈ 3 for vsumsq here); rtol 5e-5 covers every
+    # vector width, and the merge consumers only need f32-level accuracy
+    np.testing.assert_allclose(vsum, d.sum(2), rtol=5e-5, atol=5e-3)
+    np.testing.assert_allclose(vsumsq, (d * d).sum(2), rtol=5e-5, atol=1e-2)
     has = cnt > 0
     assert np.array_equal(vmin[has], np.nanmin(ring, 2)[has])
     assert np.array_equal(vmax[has], np.nanmax(ring, 2)[has])
@@ -267,9 +272,18 @@ def test_driver_runs_staggered_rebuild_every_tick():
     cfg["tpuEngine"]["serviceCapacity"] = 32
     cfg["tpuEngine"]["samplesPerBucket"] = 8
     drv = PipelineDriver(cfg)
-    sched = drv._rebuild_sched
-    assert sched.active
-    before = sched._i
+    if drv._step.rebuild_integrated:
+        # fused executor: the chunk rides the tick program itself; the
+        # executor's rotation counter is the observable contract
+        rot = drv._step.rebuild_rot
+        assert drv._rebuild_sched is None
+        before = rot["i"]
+        n_chunks = len(drv._step.rebuild_starts)
+    else:
+        sched = drv._rebuild_sched
+        assert sched.active
+        before = sched._i
+        n_chunks = sched.n_chunks
     base = 170_000_000
     lines = [
         f"tx|jvm0|S:svc{r:03d}|l{i}|1|{base * 10000 - 100}|{base * 10000 + i}|{100 + i}|Y"
@@ -282,7 +296,10 @@ def test_driver_runs_staggered_rebuild_every_tick():
             for i in range(4)
         ]
     )
-    assert sched._i != before or sched.n_chunks == 1
+    after = (
+        drv._step.rebuild_rot["i"] if drv._step.rebuild_integrated else drv._rebuild_sched._i
+    )
+    assert after != before or n_chunks == 1
 
 
 def test_scheduler_inactive_for_robust_and_f64():
@@ -317,8 +334,15 @@ def test_driver_grow_recreates_scheduler():
         {"LAG": 4, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
     ]
     drv = PipelineDriver(cfg, micro_batch_size=64)
-    s0 = drv._rebuild_sched
-    assert s0.active and s0.chunk == dz.rebuild_chunk_rows(8, drv.cfg.zscore_rebuild_every)
+    integrated = drv._step.rebuild_integrated
+
+    def chunk_of(d):
+        return d._step.rebuild_chunk if integrated else d._rebuild_sched.chunk
+
+    s0 = drv._step if integrated else drv._rebuild_sched
+    if not integrated:
+        assert s0.active
+    assert chunk_of(drv) == dz.rebuild_chunk_rows(8, drv.cfg.zscore_rebuild_every)
     base = 170_000_000
     # register more keys than capacity to force growth (8 -> 16)
     lines = [
@@ -327,18 +351,20 @@ def test_driver_grow_recreates_scheduler():
     ]
     drv.feed_csv_batch(lines)
     assert drv.cfg.capacity >= 12
-    s1 = drv._rebuild_sched
-    assert s1 is not s0, "growth must rebuild the scheduler for the new capacity"
-    assert s1.chunk == dz.rebuild_chunk_rows(drv.cfg.capacity, drv.cfg.zscore_rebuild_every)
-    # and ticking advances the NEW scheduler's rotation (a stale reference
-    # or a post-growth stop would leave s1._i at 0)
-    before = s1._i
+    s1 = drv._step if integrated else drv._rebuild_sched
+    assert s1 is not s0, "growth must rebuild the executor/scheduler for the new capacity"
+    assert chunk_of(drv) == dz.rebuild_chunk_rows(drv.cfg.capacity, drv.cfg.zscore_rebuild_every)
+    # and ticking advances the NEW rotation (a stale reference or a
+    # post-growth stop would leave it at 0)
+    before = s1.rebuild_rot["i"] if integrated else s1._i
+    n_chunks = len(s1.rebuild_starts) if integrated else s1.n_chunks
     drv.feed_csv_batch([
         f"tx|jvm0|S:svc000|m{i}|1|{(base + 1) * 10000 - 100}|{(base + 1) * 10000 + i}|{100 + i}|Y"
         for i in range(4)
     ])
-    assert drv._rebuild_sched is s1
-    assert s1._i == (before + 1) % s1.n_chunks
+    assert (drv._step if integrated else drv._rebuild_sched) is s1
+    after = s1.rebuild_rot["i"] if integrated else s1._i
+    assert after == (before + 1) % n_chunks
 
 
 def test_incremental_drift_bound_and_rebuild_margin():
