@@ -240,6 +240,15 @@ _DEFAULT_CONFIG: dict = {
     "logDir": "logs",
     "statLogIntervalInSeconds": 60,
     "dbInsertQueue": "db_insert",
+    # Telemetry plane (apmbackend_tpu.obs): per-stage tick tracing, queue/
+    # parser/DB counters, and — when a module section sets "metricsPort"
+    # (0 = ephemeral) — a per-module HTTP exporter serving Prometheus
+    # /metrics, JSON /healthz, and on-demand /profile. "enabled": false
+    # removes every instrument from the hot paths.
+    "observability": {
+        "enabled": True,
+        "metricsHost": "127.0.0.1",
+    },
     "statistics": [
         {"type": "average"},
         {"type": "percentile", "percentileValue": 75},
@@ -263,12 +272,15 @@ _DEFAULT_CONFIG: dict = {
         "sendAlertOnUnexpectedScriptEnd": True,
         "triggerGCThreshold": 500,
         "appLogRetentionDays": 7,
+        # per-child "metricsPort" makes the child a /fleet scrape target of
+        # the manager's exporter (see tools.qstat --metrics-url, DESIGN.md)
         "moduleSettings": [
             {"module": "apmbackend_tpu.ingest.parser_main"},
             {"module": "apmbackend_tpu.runtime.worker", "moduleMemoryAlertThreshold": 700},
             {"module": "apmbackend_tpu.sinks.insert_db_main"},
             {"module": "apmbackend_tpu.ingest.jmx_main"},
         ],
+        "metricsPort": None,  # the manager's own /metrics + /fleet exporter
     },
     "streamParseTransactions": {
         "logFilePrefix": "stream_parse_transactions",
@@ -288,6 +300,7 @@ _DEFAULT_CONFIG: dict = {
         # spawns it per file (perl_tail.pl role); an explicit path uses that
         # binary; None uses in-process Python tail threads
         "nativeTailBinary": "auto",
+        "metricsPort": None,  # telemetry exporter port (0 = ephemeral)
     },
     "streamCalcStats": {
         "logFilePrefix": "stream_calc_stats",
@@ -362,6 +375,7 @@ _DEFAULT_CONFIG: dict = {
         "dbJmxTable": "jmx",
         "dbInsertBufferLimit": 1000,
         "dbMaxTimeBetweenInsertsMs": 5000,
+        "metricsPort": None,  # telemetry exporter port (0 = ephemeral)
     },
     "pullJvmStats": {
         "logFilePrefix": "pull_jvm_stats",
@@ -374,6 +388,7 @@ _DEFAULT_CONFIG: dict = {
         "jmxPort": 9990,
         "clientTimeoutMs": 2000,
         "pollingIntervalSeconds": 60,
+        "metricsPort": None,  # telemetry exporter port (0 = ephemeral)
         # resource label -> jboss-cli command; order defines blob labeling
         # (config/apm_config.json:246-254)
         "statCmdMap": {
@@ -440,6 +455,7 @@ _DEFAULT_CONFIG: dict = {
         # 'z_score' queues for per-stage inspection and interop (SURVEY.md §4)
         "emitStatsQueue": False,
         "emitZScoreQueue": False,
+        "metricsPort": None,  # telemetry exporter port (0 = ephemeral)
         # Multi-window EWMA/seasonal baselining channels beside the lag
         # windows (no reference equivalent; SURVEY.md §7.2 step 10). Keys are
         # uppercase like streamCalcZScore.defaults. SEASON_SLOTS=24 +

@@ -156,6 +156,16 @@ class TransactionParser:
     ):
         self.on_record = on_record
         self.logger = logger
+        # stage counters (ROADMAP "replay is parser-bound" quantification;
+        # exported by obs.views.register_parser, surfaced by bench_replay):
+        # plain dict ints — this is the per-line hot loop, registry
+        # instruments stay out of it
+        self.counters = {
+            "lines_in": 0,      # raw lines through read_line
+            "tx_out": 0,        # complete TxEntry records emitted
+            "db_direct_out": 0, # records routed straight to the DB queue
+            "parse_ns": 0,      # wall ns inside _read_line
+        }
         self.server_from_path = server_from_path or (lambda fp: fp.split("/")[2] if len(fp.split("/")) > 2 else fp)
         # per-file dispatch cache: (kind, server) resolved ONCE per file
         # path, not per line — the filename classification and server
@@ -220,6 +230,10 @@ class TransactionParser:
                 start_ms = ""
         top = "Y" if _TOPLEVEL_RE.match(service) else "N"
         tx = TxEntry(server, service, log_id, acct_num, start_ms, end_ms, elapsed, top)
+        c = self.counters
+        c["tx_out"] += 1
+        if insert_to_db:
+            c["db_direct_out"] += 1
         try:
             self.on_record(tx, insert_to_db)
         except Exception as e:
@@ -466,6 +480,9 @@ class TransactionParser:
 
         fatal (JS's out-of-range indexing yields undefined where Python would
         raise — fail-open is the equivalent robustness)."""
+        c = self.counters
+        c["lines_in"] += 1
+        t0 = time.perf_counter_ns()
         try:
             self._read_line(file_path, line)
         except ConsumerError as e:
@@ -478,6 +495,8 @@ class TransactionParser:
         except Exception as e:
             if self.logger:
                 self.logger.error(f"Unparseable log line in {file_path}: {e}: {line[:200]!r}")
+        finally:
+            c["parse_ns"] += time.perf_counter_ns() - t0
 
     def _read_line(self, file_path: str, line: str) -> None:
         if not line:

@@ -68,6 +68,14 @@ def build(runtime, *, tail: bool = True):
     parser = TransactionParser(
         on_record, logger=runtime.logger, server_from_path=server_extractor(cfg)
     )
+    # parser-stage counters as a /metrics view, gated like the worker's
+    # collector so throwaway test runtimes do not pile up dead collectors
+    from ..obs import telemetry_active
+
+    if getattr(runtime, "telemetry", None) is not None or telemetry_active():
+        from ..obs.views import register_parser
+
+        register_parser(parser, "streamParseTransactions")
 
     manager = None
     if tail:

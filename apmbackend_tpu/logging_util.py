@@ -24,10 +24,27 @@ _RESET = "\x1b[0m"
 
 
 class _ColorFormatter(logging.Formatter):
+    """Colorizes by the OWNING HANDLER's stream, not sys.stderr: a handler
+    writing to a pipe/file must emit plain text even when stderr is a tty
+    (and vice versa under 2>file redirection). The handler is read live so a
+    rebound ``handler.stream`` keeps the decision correct."""
+
+    def __init__(self, fmt: Optional[str] = None, *, handler: Optional[logging.StreamHandler] = None):
+        super().__init__(fmt)
+        self._handler = handler
+
+    def _is_tty(self) -> bool:
+        stream = getattr(self._handler, "stream", None) if self._handler is not None else sys.stderr
+        isatty = getattr(stream, "isatty", None)
+        try:
+            return bool(isatty()) if isatty else False
+        except ValueError:  # closed stream (interpreter teardown)
+            return False
+
     def format(self, record: logging.LogRecord) -> str:
         base = super().format(record)
         color = _COLORS.get(record.levelname)
-        if color and sys.stderr.isatty():
+        if color and self._is_tty():
             return f"{color}{base}{_RESET}"
         return base
 
@@ -107,6 +124,6 @@ def get_logger(
 
     if console and not any(isinstance(h, logging.StreamHandler) and not isinstance(h, DateRotatingFileHandler) for h in logger.handlers):
         sh = logging.StreamHandler(sys.stderr)
-        sh.setFormatter(_ColorFormatter(_FMT))
+        sh.setFormatter(_ColorFormatter(_FMT, handler=sh))
         logger.addHandler(sh)
     return logger

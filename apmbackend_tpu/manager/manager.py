@@ -316,6 +316,40 @@ class ManagerApp:
             for ms in self.mconfig.get("moduleSettings", [])
         ]
 
+        # -- telemetry: restart/GC/exit event counters + the fleet scrape ----
+        # Counters exist regardless of an exporter (they also feed /healthz);
+        # the /fleet route mounts only when the manager runtime serves one.
+        from ..obs import get_registry
+
+        reg = get_registry()
+        self._m_restarts = {
+            mod.module: reg.counter(
+                "apm_manager_child_restarts_total",
+                "Child module restarts by the supervisor",
+                labels={"module": mod.name},
+            )
+            for mod in self.modules
+        }
+        self._m_exits = {
+            mod.module: reg.counter(
+                "apm_manager_child_exits_total",
+                "Child module exits observed by the supervisor",
+                labels={"module": mod.name},
+            )
+            for mod in self.modules
+        }
+        self._m_gcs = {
+            mod.module: reg.counter(
+                "apm_manager_gc_requests_total",
+                "GC requests (SIGUSR1) sent to the child",
+                labels={"module": mod.name},
+            )
+            for mod in self.modules
+        }
+        if getattr(runtime, "telemetry", None) is not None:
+            runtime.telemetry.add_route("/fleet", self._fleet_route)
+            runtime.telemetry.add_health("fleet", self._fleet_health)
+
         if spawn_children:
             self.annotate("Restarting all modules")
             for mod in self.modules:
@@ -358,9 +392,12 @@ class ManagerApp:
         for mod in self.modules:
             event = mod.tick()
             if event == "restarted":
+                self._m_restarts[mod.module].inc()
                 self.alerts.send_email(
                     "APM manager alert", f"Process restarted via startProcess: {mod.module}"
                 )
+            elif event == "exited":
+                self._m_exits[mod.module].inc()
 
     def module_setting(self, mod: ModuleProc, name: str):
         """Per-module override falling back to the manager default
@@ -400,7 +437,77 @@ class ManagerApp:
                 trigger_gc = True
             if trigger_gc:
                 self.runtime.logger.info(f"Sending garbage collection request to module: {mod.module}")
+                self._m_gcs[mod.module].inc()
                 mod.request_gc()
+
+    # -- fleet telemetry aggregation ------------------------------------------
+    def _child_metrics_targets(self) -> List[tuple]:
+        """[(name, url)] for children whose moduleSettings carry a
+        ``metricsPort`` — the scrape inventory of this supervisor."""
+        host = str(self.runtime.config.get("observability", {}).get("metricsHost", "127.0.0.1"))
+        out = []
+        for mod in self.modules:
+            port = mod.setting.get("metricsPort")
+            if port:
+                out.append((mod.name, f"http://{host}:{int(port)}"))
+        return out
+
+    def scrape_fleet(self, timeout_s: float = 2.0) -> str:
+        """GET every child's /metrics, stamp ``module=<name>`` into each
+        series, and concatenate — one exposition for the whole fleet (what
+        the reference's per-dashboard rabbitmqctl/ps scraping becomes). A
+        down child contributes an ``apm_fleet_child_up 0`` marker instead of
+        failing the whole scrape."""
+        import urllib.request
+
+        from ..obs import relabel_metrics
+
+        parts = []
+        for name, url in self._child_metrics_targets():
+            up = 1
+            try:
+                with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+                parts.append(relabel_metrics(body, {"module": name}))
+            except Exception:
+                up = 0
+            parts.append(
+                f'# TYPE apm_fleet_child_up gauge\napm_fleet_child_up{{module="{name}"}} {up}\n'
+            )
+        return "".join(parts)
+
+    def _fleet_route(self, _query):
+        from ..obs.exporter import PROM_CONTENT_TYPE
+
+        return 200, PROM_CONTENT_TYPE, self.scrape_fleet()
+
+    def _fleet_health(self) -> dict:
+        """Aggregated child liveness for the manager's own /healthz: process
+        up/down per child plus each child's /healthz status when it serves
+        one (restart-pending children degrade the fleet)."""
+        import json as _json
+        import urllib.request
+
+        from .pid_stats import pid_exists
+
+        targets = dict(self._child_metrics_targets())
+        children = {}
+        ok = True
+        for mod in self.modules:
+            alive = mod.pid is not None and pid_exists(mod.pid)
+            info = {"up": alive, "pid": mod.pid}
+            if not alive:
+                ok = False
+                info["restart_pending"] = bool(mod.restart_pending_until)
+            url = targets.get(mod.name)
+            if alive and url:
+                try:
+                    with urllib.request.urlopen(f"{url}/healthz", timeout=2.0) as resp:
+                        info["healthz"] = _json.loads(resp.read().decode("utf-8")).get("status")
+                except Exception as e:
+                    info["healthz_error"] = repr(e)
+            children[mod.name] = info
+        return {"ok": ok, "children": children}
 
     # -- host monitors --------------------------------------------------------
     def inspect_disk_space(self) -> None:

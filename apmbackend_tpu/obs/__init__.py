@@ -1,0 +1,45 @@
+"""Process-wide telemetry plane (SURVEY.md §5.5 made first-class).
+
+The reference APM backend watched a 70-JVM fleet but was itself nearly
+blind: its self-telemetry was log-and-reset strings (QueueStats/DBStats)
+and on-demand heap dumps. This package gives every module the measurement
+discipline the stream-processing literature treats as prerequisite to
+optimization (PAPERS.md: arxiv 1712.08285 per-stage timing, arxiv
+2511.14894 streaming-DAQ monitoring):
+
+- :mod:`.registry` — a thread-safe metrics registry (counters, gauges,
+  fixed-bucket histograms, collector views) rendering Prometheus text
+  format; one process-global instance via :func:`get_registry`.
+- :mod:`.exporter` — a stdlib-HTTP exporter thread per module serving
+  ``/metrics``, ``/healthz`` and on-demand ``/profile`` capture.
+- :mod:`.tracing` — the per-tick span ring + stage histograms the
+  PipelineDriver records so "where did this tick's 0.56 ms go" is
+  answerable in production, not just in bench_dispatch.py.
+
+Everything here is stdlib-only and import-light: no jax at import time
+(the /profile route imports it lazily), no hard dependency from any hot
+path — a driver with telemetry disabled never touches this package.
+"""
+
+from .exporter import TelemetryServer, telemetry_active
+from .registry import (
+    MetricsRegistry,
+    Sample,
+    get_registry,
+    parse_prom_text,
+    relabel_metrics,
+    set_registry,
+)
+from .tracing import TickTracer
+
+__all__ = [
+    "MetricsRegistry",
+    "Sample",
+    "TelemetryServer",
+    "TickTracer",
+    "get_registry",
+    "parse_prom_text",
+    "relabel_metrics",
+    "set_registry",
+    "telemetry_active",
+]

@@ -1,0 +1,200 @@
+"""Per-module telemetry HTTP server: /metrics, /healthz, /profile.
+
+One daemon thread per module process (stdlib ThreadingHTTPServer — the
+same no-new-deps discipline as the rest of the transport), started by
+ModuleRuntime when the module's config carries a ``metricsPort``
+(0 = ephemeral, for tests and colocated fleets; the bound port is
+exposed as :attr:`TelemetryServer.port`). Routes:
+
+- ``GET /metrics`` — the process registry in Prometheus text format
+  (content type ``text/plain; version=0.0.4``): Grafana/Prometheus get a
+  scrape target exactly like the reference's dashboards had.
+- ``GET /healthz`` — JSON from registered health providers (tick
+  liveness, emission backlog, device presence, child fleet state...).
+  200 when every provider reports ``ok``, 503 otherwise — load-balancer
+  and supervisor friendly.
+- ``GET /profile?ms=500`` — on-demand capture: a jax.profiler trace of
+  ``ms`` milliseconds into a timestamped directory (TensorBoard/perfetto
+  readable) plus a heap snapshot via utils.profiling — the live
+  "attach the inspector" affordance, now one curl away.
+- extra routes via :meth:`add_route` (the manager mounts ``/fleet``).
+
+Health providers and routes are plain callables so modules register
+without this module importing them (no cycle into pipeline/runtime).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .registry import MetricsRegistry, get_registry
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# live exporter count: single-process topologies (standalone) start ONE
+# exporter on the lead runtime while satellites share the process registry —
+# they gate their collector registration on this instead of owning a server
+_active = 0
+_active_lock = threading.Lock()
+
+
+def telemetry_active() -> bool:
+    """True while any TelemetryServer in this process is serving."""
+    return _active > 0
+
+
+class TelemetryServer:
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        profile_dir: str = "logs",
+        module: str = "apm",
+        logger=None,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self._requested_port = port
+        self.host = host
+        self.profile_dir = profile_dir
+        self.module = module
+        self.logger = logger
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._health: Dict[str, Callable[[], dict]] = {}
+        self._routes: Dict[str, Callable[[dict], Tuple[int, str, str]]] = {}
+        self._profile_lock = threading.Lock()
+
+    # -- registration ---------------------------------------------------------
+    def add_health(self, name: str, fn: Callable[[], dict]) -> None:
+        """``fn() -> dict``; an ``"ok": False`` key degrades /healthz to 503."""
+        self._health[name] = fn
+
+    def add_route(self, path: str, fn: Callable[[dict], Tuple[int, str, str]]) -> None:
+        """``fn(query) -> (status, content_type, body)`` for extra GET paths."""
+        self._routes[path] = fn
+
+    # -- handlers -------------------------------------------------------------
+    def _handle_metrics(self, _query) -> Tuple[int, str, str]:
+        return 200, PROM_CONTENT_TYPE, self.registry.render()
+
+    def _handle_healthz(self, _query) -> Tuple[int, str, str]:
+        body = {"module": self.module, "ts": time.time()}
+        ok = True
+        for name, fn in list(self._health.items()):
+            try:
+                section = fn() or {}
+            except Exception as e:  # a broken probe IS a health failure
+                section = {"ok": False, "error": repr(e)}
+            if section.get("ok") is False:
+                ok = False
+            body[name] = section
+        body["status"] = "ok" if ok else "degraded"
+        return (200 if ok else 503), "application/json", json.dumps(body, indent=1)
+
+    def _handle_profile(self, query) -> Tuple[int, str, str]:
+        """Capture a bounded device trace + heap snapshot; serialized so two
+        concurrent curls cannot interleave jax.profiler start/stop."""
+        try:
+            ms = max(1, min(int(query.get("ms", ["500"])[0]), 60_000))
+        except (TypeError, ValueError):
+            return 400, "application/json", json.dumps({"error": "bad ms parameter"})
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, "application/json", json.dumps({"error": "profile capture already running"})
+        try:
+            import os
+
+            from ..utils.profiling import heap_snapshot
+
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            trace_dir = os.path.join(self.profile_dir, f"profile-{self.module}-{stamp}")
+            result = {"module": self.module, "ms": ms}
+            try:
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+                time.sleep(ms / 1000.0)
+                jax.profiler.stop_trace()
+                result["trace_dir"] = trace_dir
+            except Exception as e:  # no device / profiler unavailable: still
+                # return the heap side — diagnostics degrade, never 500
+                result["trace_error"] = repr(e)
+            result["heap_snapshot"] = heap_snapshot(
+                self.profile_dir, f"{self.module}-profile", logger=self.logger
+            )
+            status = 200 if ("trace_dir" in result or result["heap_snapshot"]) else 503
+            return status, "application/json", json.dumps(result, indent=1)
+        finally:
+            self._profile_lock.release()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> int:
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                parsed = urlparse(self.path)
+                route = {
+                    "/metrics": outer._handle_metrics,
+                    "/healthz": outer._handle_healthz,
+                    "/profile": outer._handle_profile,
+                    **outer._routes,
+                }.get(parsed.path)
+                if route is None:
+                    self.send_error(404)
+                    return
+                try:
+                    status, ctype, body = route(parse_qs(parsed.query))
+                except Exception as e:
+                    status, ctype, body = 500, "text/plain", f"handler error: {e!r}"
+                data = body.encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *_args):  # scrapes must not spam the module log
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self._requested_port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"telemetry-{self.module}",
+            daemon=True,
+        )
+        self._thread.start()
+        global _active
+        with _active_lock:
+            _active += 1
+        if self.logger:
+            self.logger.info(
+                f"Telemetry exporter listening on http://{self.host}:{self.port} "
+                f"(/metrics /healthz /profile)"
+            )
+        return self.port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        global _active
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            with _active_lock:
+                _active -= 1
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

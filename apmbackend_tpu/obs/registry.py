@@ -1,0 +1,336 @@
+"""Thread-safe metrics registry rendering Prometheus text format 0.0.4.
+
+Three instrument kinds (counter, gauge, fixed-bucket histogram) plus
+*collectors* — callables sampled at scrape time — which is how mutable
+pre-existing telemetry (QueueStats/DBStats interval counters, the memory
+broker's queue depths, parser cache stats) is absorbed as views without
+changing its log-and-reset behavior.
+
+Design constraints, in order:
+
+1. **Hot-path cost.** ``Counter.inc``/``Histogram.observe`` run inside the
+   per-tick loop (~0.5 ms budget) and the per-line parser loop; they are a
+   lock acquire + a float add / bisect. No string formatting, no label
+   dict hashing per call — instruments are resolved once at wire-up and
+   held by the caller.
+2. **Idempotent wire-up.** ``registry.counter(name, ..., labels=...)`` is
+   get-or-create keyed on (name, sorted label items): two PipelineDrivers
+   in one process share the same series (process totals), matching
+   Prometheus client semantics.
+3. **stdlib only.**
+"""
+
+from __future__ import annotations
+
+import bisect
+import re
+import threading
+from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+# latency buckets in SECONDS: 100 µs .. 10 s, tuned so the ~0.5 ms tick
+# floor and the 10 s interval cadence both land mid-range
+DEFAULT_TIME_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+# count-shaped buckets (catch-up depth, batch sizes)
+DEFAULT_COUNT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 1000, 10000)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)")
+
+
+class Sample(NamedTuple):
+    """One scrape-time sample emitted by a collector view."""
+
+    name: str
+    labels: Dict[str, str]
+    value: float
+    mtype: str = "gauge"  # "counter" | "gauge"
+    help: str = ""
+
+
+def _escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(str(v))}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
+
+
+class Counter:
+    __slots__ = ("labels", "_value", "_lock")
+
+    def __init__(self, labels: Dict[str, str]):
+        self.labels = labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("labels", "_value", "_fn", "_lock")
+
+    def __init__(self, labels: Dict[str, str]):
+        self.labels = labels
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            self._fn = None
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        """Sample ``fn`` at scrape time (live views: ring bytes, RSS, ...)."""
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:
+                return float("nan")  # a broken view must not kill the scrape
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative render, prometheus semantics)."""
+
+    __slots__ = ("labels", "bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, labels: Dict[str, str], buckets: Tuple[float, ...]):
+        self.labels = labels
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)  # +Inf tail
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+
+class _Family:
+    __slots__ = ("name", "mtype", "help", "metrics", "buckets")
+
+    def __init__(self, name: str, mtype: str, help: str, buckets=None):
+        self.name = name
+        self.mtype = mtype
+        self.help = help
+        self.buckets = buckets
+        self.metrics: Dict[Tuple[Tuple[str, str], ...], object] = {}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._collectors: List[Callable[[], Iterable[Sample]]] = []
+
+    # -- instrument wire-up (get-or-create) ----------------------------------
+    def _get(self, name: str, mtype: str, help: str, labels, factory):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        labels = dict(labels or {})
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, mtype, help)
+                self._families[name] = fam
+            elif fam.mtype != mtype:
+                raise ValueError(
+                    f"metric {name} already registered as {fam.mtype}, not {mtype}"
+                )
+            inst = fam.metrics.get(key)
+            if inst is None:
+                inst = factory(labels)
+                fam.metrics[key] = inst
+            return inst
+
+    def counter(self, name: str, help: str = "", labels: Optional[dict] = None) -> Counter:
+        return self._get(name, "counter", help, labels, Counter)
+
+    def gauge(self, name: str, help: str = "", labels: Optional[dict] = None) -> Gauge:
+        return self._get(name, "gauge", help, labels, Gauge)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labels: Optional[dict] = None,
+        buckets: Tuple[float, ...] = DEFAULT_TIME_BUCKETS,
+    ) -> Histogram:
+        return self._get(
+            name, "histogram", help, labels, lambda lb: Histogram(lb, buckets)
+        )
+
+    def add_collector(self, fn: Callable[[], Iterable[Sample]]) -> None:
+        """Register a scrape-time view; ``fn`` returns Samples. Exceptions
+        are swallowed per-collector — a broken view must not 500 /metrics."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    # -- introspection (tests) -----------------------------------------------
+    def get_sample(self, name: str, labels: Optional[dict] = None):
+        """Instrument lookup without creation; None when absent."""
+        labels = dict(labels or {})
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            return fam.metrics.get(key) if fam else None
+
+    # -- render --------------------------------------------------------------
+    def render(self) -> str:
+        out: List[str] = []
+        with self._lock:
+            families = list(self._families.values())
+            collectors = list(self._collectors)
+        for fam in families:
+            if not fam.metrics:
+                continue
+            if fam.help:
+                out.append(f"# HELP {fam.name} {fam.help}")
+            out.append(f"# TYPE {fam.name} {fam.mtype}")
+            for inst in fam.metrics.values():
+                if isinstance(inst, Histogram):
+                    counts, total, count = inst.snapshot()
+                    cum = 0
+                    for bound, c in zip(inst.bounds, counts):
+                        cum += c
+                        lb = dict(inst.labels)
+                        lb["le"] = _fmt_value(bound)
+                        out.append(f"{fam.name}_bucket{_fmt_labels(lb)} {cum}")
+                    lb = dict(inst.labels)
+                    lb["le"] = "+Inf"
+                    out.append(f"{fam.name}_bucket{_fmt_labels(lb)} {count}")
+                    out.append(
+                        f"{fam.name}_sum{_fmt_labels(inst.labels)} {_fmt_value(total)}"
+                    )
+                    out.append(f"{fam.name}_count{_fmt_labels(inst.labels)} {count}")
+                else:
+                    out.append(
+                        f"{fam.name}{_fmt_labels(inst.labels)} {_fmt_value(inst.value)}"
+                    )
+        seen_types: Dict[str, str] = {}
+        for fn in collectors:
+            try:
+                samples = list(fn())
+            except Exception:
+                continue
+            for s in samples:
+                if s.name not in seen_types and s.name not in self._families:
+                    if s.help:
+                        out.append(f"# HELP {s.name} {s.help}")
+                    out.append(f"# TYPE {s.name} {s.mtype}")
+                    seen_types[s.name] = s.mtype
+                out.append(f"{s.name}{_fmt_labels(s.labels)} {_fmt_value(s.value)}")
+        return "\n".join(out) + "\n"
+
+
+# -- text-format helpers (qstat --metrics-url, manager fleet merge, tests) ----
+
+def parse_prom_text(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Prometheus text -> [(name, labels, value)]. Lenient: unparseable
+    lines are skipped (a CLI reading a live endpoint must not crash on a
+    format corner)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, _, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            for lk, lv in _LABEL_RE.findall(labelstr):
+                labels[lk] = lv.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+        try:
+            out.append((name, labels, float(value)))
+        except ValueError:
+            continue
+    return out
+
+
+def relabel_metrics(text: str, extra_labels: Dict[str, str]) -> str:
+    """Inject labels into every sample line of a Prometheus text body —
+    the manager's fleet aggregation stamps ``module=<child>`` so scraped
+    children merge into one exposition without series collisions."""
+    if not extra_labels:
+        return text
+    inject = ",".join(f'{k}="{_escape(str(v))}"' for k, v in sorted(extra_labels.items()))
+    out = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = _SAMPLE_RE.match(stripped) if stripped and not stripped.startswith("#") else None
+        if not m:
+            out.append(line)
+            continue
+        name, braced, labelstr, _value = m.groups()
+        rest = stripped[m.end(2) if braced else m.end(1):]
+        if braced:
+            merged = f"{{{labelstr},{inject}}}" if labelstr else f"{{{inject}}}"
+            out.append(f"{name}{merged}{rest}")
+        else:
+            out.append(f"{name}{{{inject}}}{rest}")
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
+# -- the process-global registry ---------------------------------------------
+
+_global_registry = MetricsRegistry()
+_global_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry every module wires into."""
+    return _global_registry
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (test isolation); returns the old."""
+    global _global_registry
+    with _global_lock:
+        old, _global_registry = _global_registry, registry
+    return old

@@ -1,0 +1,130 @@
+"""Per-tick span tracing for the host loop around the fused device step.
+
+The r6 dispatch-floor work (CHANGES.md) proved the tick budget is won or
+lost in fixed per-tick overhead; this module keeps that measurable in
+production instead of only in ``benchmarks/bench_dispatch.py``. The
+PipelineDriver calls :meth:`TickTracer.record` once per tick with the
+stage durations it already has the boundaries for — NO new device syncs
+are added (the cost model of DESIGN.md §4 is sacred):
+
+- ``dispatch``: the executor call — program enqueue + any in-step host
+  work (the native percentile kernel's dlpack views block here, so on the
+  fused-native path this includes the device wait for program A),
+- ``rebuild``: the separate staggered-rebuild scheduler step (0 when the
+  fused executor folds the chunk into the tick program),
+- ``tx_drain``: the ordered-tx heap/backlog drain to the DB queue,
+- ``emit``: emission readback + host fan-out (``np.asarray`` of the
+  emission blocks on the remaining device compute — the blocking sync
+  point we already pay; in async-emission mode this is the PREVIOUS
+  tick's drain, making pipelining overlap directly visible as
+  emit << dispatch+compute).
+
+Each tick also lands in a host-side ring of recent spans (the flight
+recorder the /healthz handler and post-mortems read) and feeds the
+``apm_tick_stage_seconds`` histograms plus catch-up depth (labels
+advanced per tick — the megatick/backfill signal).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .registry import DEFAULT_COUNT_BUCKETS, MetricsRegistry
+
+STAGES = ("dispatch", "rebuild", "tx_drain", "emit")
+
+
+class TickTracer:
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        ring_size: int = 256,
+    ):
+        self.ring: deque = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._ticks = registry.counter(
+            "apm_ticks_total", "Detection ticks executed by this process"
+        )
+        self._last_tick = registry.gauge(
+            "apm_tick_last_unixtime", "Wall time of the most recent tick"
+        )
+        self._tick_seconds = registry.histogram(
+            "apm_tick_seconds", "Whole-tick host wall time (all stages)"
+        )
+        self._stage = {
+            s: registry.histogram(
+                "apm_tick_stage_seconds",
+                "Per-stage tick wall time (see obs.tracing docstring)",
+                labels={"stage": s},
+            )
+            for s in STAGES
+        }
+        self._catchup = registry.histogram(
+            "apm_tick_catchup_labels",
+            "Interval labels advanced per tick (>1 = catch-up/backfill)",
+            buckets=DEFAULT_COUNT_BUCKETS,
+        )
+
+    def record(
+        self,
+        label: int,
+        stages: Dict[str, float],
+        *,
+        catchup_labels: Optional[int] = None,
+    ) -> None:
+        now = time.time()
+        total = 0.0
+        for name, dur in stages.items():
+            hist = self._stage.get(name)
+            if hist is not None:
+                hist.observe(dur)
+            total += dur
+        self._tick_seconds.observe(total)
+        self._ticks.inc()
+        self._last_tick.set(now)
+        if catchup_labels is not None and catchup_labels > 0:
+            self._catchup.observe(catchup_labels)
+        with self._lock:
+            self.ring.append(
+                {"label": int(label), "wall_ts": now, "stages": dict(stages)}
+            )
+
+    # -- introspection (healthz, post-mortems) --------------------------------
+    @property
+    def ticks_total(self) -> int:
+        return int(self._ticks.value)
+
+    @property
+    def last_tick_ts(self) -> float:
+        return self._last_tick.value
+
+    def recent(self, n: int = 16) -> list:
+        with self._lock:
+            items = list(self.ring)
+        return items[-n:]
+
+    def summary(self) -> dict:
+        """Healthz-sized digest: tick count, age of the last tick, and the
+        mean of each stage over the span ring."""
+        with self._lock:
+            items = list(self.ring)
+        out = {
+            "ticks_total": self.ticks_total,
+            "last_tick_age_s": (
+                round(time.time() - self.last_tick_ts, 3) if items else None
+            ),
+            "ring_depth": len(items),
+        }
+        if items:
+            means: Dict[str, float] = {}
+            for span in items:
+                for k, v in span["stages"].items():
+                    means[k] = means.get(k, 0.0) + v
+            out["stage_mean_ms"] = {
+                k: round(v / len(items) * 1000, 4) for k, v in means.items()
+            }
+        return out

@@ -1230,6 +1230,7 @@ class PipelineDriver:
         logger=None,
         micro_batch_size: int = 8192,
         async_emission: Optional[bool] = None,
+        metrics_registry=None,
     ):
         self.apm_config = apm_config
         self.cfg = build_engine_config(apm_config, capacity)
@@ -1280,6 +1281,56 @@ class PipelineDriver:
         self._native_dec = None
         self._native_dec_tried = False
         self._reset_decode_map()
+        # -- telemetry plane (obs/): per-stage tick tracing + e2e latency ----
+        # Host-side perf_counter boundaries ONLY — no new device syncs (the
+        # emit stage's np.asarray readback is the blocking sync point we
+        # already pay; DESIGN.md §4). Cost is ~5 histogram observes per TICK
+        # (microseconds against the ~0.5 ms tick floor); observability.enabled
+        # = false removes even that.
+        self._telemetry = bool(apm_config.get("observability", {}).get("enabled", True))
+        self._intake_oldest_ts: Optional[float] = None  # oldest undelivered ingest stamp
+        self._emitting_intake_ts: Optional[float] = None
+        if self._telemetry:
+            from .obs import get_registry
+            from .obs.registry import DEFAULT_COUNT_BUCKETS
+            from .obs.tracing import TickTracer
+
+            reg = metrics_registry if metrics_registry is not None else get_registry()
+            self._tracer = TickTracer(reg)
+            self._m_capacity = reg.gauge(
+                "apm_engine_capacity", "Device state rows allocated [S]"
+            )
+            self._m_services = reg.gauge(
+                "apm_engine_services", "Registered (server, service) rows"
+            )
+            self._m_tx = reg.counter(
+                "apm_engine_tx_ingested_total", "Transactions scattered into device state"
+            )
+            self._m_overflow_rows = reg.counter(
+                "apm_engine_overflow_row_ticks_total",
+                "Row-ticks whose percentile fell back to the reservoir estimate",
+            )
+            self._m_grows = reg.counter(
+                "apm_engine_capacity_grows_total", "Capacity-doubling recompiles"
+            )
+            self._m_emit_lat = reg.histogram(
+                "apm_e2e_ingest_to_emit_seconds",
+                "Transport ingest stamp -> tick emission fan-out (oldest record)",
+            )
+            self._m_alert_lat = reg.histogram(
+                "apm_e2e_ingest_to_alert_seconds",
+                "Transport ingest stamp -> alert dispatch (oldest record)",
+            )
+            self._m_alerts = reg.counter(
+                "apm_alerts_total", "Alert triggers dispatched by the driver"
+            )
+            self._m_pending_batch = reg.histogram(
+                "apm_engine_flush_batch_size",
+                "Records per ingest scatter",
+                buckets=DEFAULT_COUNT_BUCKETS,
+            )
+        else:
+            self._tracer = None
         self._refresh_params()
         # emission pipelining (tpuEngine.asyncEmission / the async_emission
         # kwarg; default OFF): hold each tick's TickEmission and fetch it
@@ -1329,6 +1380,21 @@ class PipelineDriver:
             ),
         )
         self._params_registry_count = self.registry.count
+        if self._tracer is not None:
+            self._m_capacity.set(self.cfg.capacity)
+            self._m_services.set(self.registry.count)
+
+    def note_intake_time(self, ingest_ts: Optional[float]) -> None:
+        """Record a message's transport ingest stamp (header ``ingest_ts``);
+        the oldest outstanding stamp anchors the ingest->emit/alert latency
+        observed at the next emission. Benign-racy min (GIL-atomic reads):
+        called from the broker consumer thread while the device thread
+        resets it."""
+        if ingest_ts is None or self._tracer is None:
+            return
+        cur = self._intake_oldest_ts
+        if cur is None or ingest_ts < cur:
+            self._intake_oldest_ts = ingest_ts
 
     def apply_config(self, apm_config: dict) -> None:
         """Hot-reload hook: re-derive per-row params (thresholds, overrides,
@@ -1365,6 +1431,8 @@ class PipelineDriver:
         self._rebuild_sched = (
             None if self._step.rebuild_integrated else RebuildScheduler(self.cfg)
         )
+        if self._tracer is not None:
+            self._m_grows.inc()
         self._refresh_params()
 
     def _row_for(self, server: str, service: str) -> int:
@@ -1697,6 +1765,9 @@ class PipelineDriver:
             e[:m] = elaps[i : i + m]
             v[:m] = True
             self.state = self._ingest(self.state, self.cfg, r, l, e, v)
+            if self._tracer is not None:
+                self._m_tx.inc(m)
+                self._m_pending_batch.observe(m)
 
     def flush(self) -> None:
         self._flush_pending()
@@ -1735,23 +1806,34 @@ class PipelineDriver:
         valid[:n] = True
         self._pending.clear()
         self.state = ingest(self.state, self.cfg, rows, labels, elaps, valid)
+        if self._tracer is not None:
+            self._m_tx.inc(n)
+            self._m_pending_batch.observe(n)
 
     def _np_dtype(self):
         return np.float64 if self.cfg.stats.dtype == jnp.float64 else np.float32
 
     # -- tick ----------------------------------------------------------------
     def _run_tick(self, new_label: int) -> None:
+        tr = self._tracer
+        if tr is not None:
+            # catch-up depth: labels advanced by this tick (1 = steady state;
+            # >1 = replay/backfill jump — the megatick-candidate signal)
+            catchup = new_label - self._latest_label if self._latest_label else 1
+            t0 = time.perf_counter()
         if self.registry.count != self._params_registry_count:
             # newly registered services activate (z-score warm-up starts) at
             # the next tick boundary — the reference's per-key list creation
             self._refresh_params()
         emission, self.state = self._step(self.state, new_label, self.params)
+        t1 = time.perf_counter() if tr is not None else 0.0
         if self._rebuild_sched is not None:
             # staggered exact rebuild of the sliding z-score aggregates: one
             # row chunk per tick on a rotating schedule (RebuildScheduler) —
             # the staged executor's companion; the fused executor folds the
             # chunk into the tick program instead (rebuild_integrated).
             self.state = self._rebuild_sched.step(self.state)
+        t2 = time.perf_counter() if tr is not None else 0.0
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
 
         # ordered tx drain to DB (heap pop up to edge timestamp)
@@ -1771,6 +1853,7 @@ class PipelineDriver:
                 for _ts, line in due:
                     self.on_ordered_csv(line)
 
+        t3 = time.perf_counter() if tr is not None else 0.0
         if self._async_emission:
             # double-buffered readback: hold this tick's emission; deliver
             # the PREVIOUS one now, while this tick's programs are still in
@@ -1787,6 +1870,17 @@ class PipelineDriver:
                 self._process_emission(*prev)
         else:
             self._process_emission(new_label, emission, self.registry.count)
+        if tr is not None:
+            tr.record(
+                new_label,
+                {
+                    "dispatch": t1 - t0,
+                    "rebuild": t2 - t1,
+                    "tx_drain": t3 - t2,
+                    "emit": time.perf_counter() - t3,
+                },
+                catchup_labels=catchup,
+            )
 
     def _process_emission(self, new_label: int, emission: TickEmission, count: int) -> None:
         """Device->host readback + host fan-out of one tick's emission
@@ -1795,6 +1889,12 @@ class PipelineDriver:
         edge_ts = dstats.edge_ts_ms(new_label, self.cfg.stats)
         if count == 0:
             return
+        # claim the oldest outstanding transport stamp for THIS emission
+        # (async mode delivers one tick late, so the stamp honestly includes
+        # the pipelining delay the operator is paying); claimed only by an
+        # emission that actually fans out — a zero-row tick leaves it for
+        # the first real one
+        self._emitting_intake_ts, self._intake_oldest_ts = self._intake_oldest_ts, None
         # np.asarray(whole)[:count], never np.asarray(x[:count]): slicing a
         # jax array dispatches a compiled gather per call (~1.2 ms each on
         # CPU), and this path runs 3 + 6*channels of them per tick — the
@@ -1802,10 +1902,17 @@ class PipelineDriver:
         tpm = np.asarray(emission.tpm)[:count]
         metrics = np.asarray(emission.average)[:count]  # [count, 3]
 
+        if self._tracer is not None and self._emitting_intake_ts is not None:
+            # the readback above (np.asarray of the emission) has landed: the
+            # tick's results are host-visible — the "emit" moment
+            self._m_emit_lat.observe(time.time() - self._emitting_intake_ts)
+
         n_overflowed = int(np.asarray(emission.overflowed)[:count].sum())
         if n_overflowed:
             self.overflow_rows_total += n_overflowed
             self.overflow_ticks += 1
+            if self._tracer is not None:
+                self._m_overflow_rows.inc(n_overflowed)
             if self.on_overflow is not None:
                 self.on_overflow(new_label, n_overflowed)
             if self.logger and self.overflow_ticks - self._overflow_last_logged_tick >= 30:
@@ -1879,6 +1986,10 @@ class PipelineDriver:
                     self._dispatch_alert(make_fs(int(row)), int(bits[row]))
 
     def _dispatch_alert(self, fs: FullStatEntry, bits: int) -> None:
+        if self._tracer is not None:
+            self._m_alerts.inc()
+            if self._emitting_intake_ts is not None:
+                self._m_alert_lat.observe(time.time() - self._emitting_intake_ts)
         if self.alerts_manager is not None:
             alert = self.alerts_manager.process_trigger(fs, bits)
             if alert is not None:

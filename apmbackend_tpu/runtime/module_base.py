@@ -47,6 +47,11 @@ def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBrok
         shared = broker or MemoryBroker()
         if broker is None:
             shared.start_pump_thread()
+        # queue depth/bytes gauges (rabbitmqctl-list_queues role as a
+        # scrape); idempotent per broker object
+        from ..obs.views import register_memory_broker
+
+        register_memory_broker(shared)
         factory = lambda _qtype: MemoryChannel(shared)  # noqa: E731
     elif backend == "amqp":
         from ..transport.amqp import AmqpChannel
@@ -106,6 +111,48 @@ class ModuleRuntime:
         prof_cfg.setdefault("heapSnapshotDir", log_dir or "logs")
         self.profiling = Profiling(prefix, prof_cfg, logger=self.logger)
         self.profiling.install(install_signal=install_signals)
+
+        # telemetry plane (obs/): absorb this module's queue counters into
+        # the process registry, and — when the module config names a
+        # metricsPort (0 = ephemeral) — serve /metrics, /healthz, /profile
+        # from a per-module exporter thread.
+        self.telemetry = None
+        obs_cfg = self.config.get("observability", {})
+        if bool(obs_cfg.get("enabled", True)):
+            from ..obs.views import register_queue_stats
+
+            register_queue_stats(self.qm.queue_stats, section)
+            metrics_port = self.module_config.get("metricsPort")
+            if metrics_port is not None:
+                from ..obs.exporter import TelemetryServer
+
+                self.telemetry = TelemetryServer(
+                    port=int(metrics_port),
+                    host=str(obs_cfg.get("metricsHost", "127.0.0.1")),
+                    profile_dir=log_dir or "logs",
+                    module=prefix,
+                    logger=self.logger,
+                )
+                self.telemetry.add_health("process", self._process_health)
+                self.telemetry.start()
+
+    def _process_health(self) -> dict:
+        """Baseline liveness every module reports: the process is serving,
+        its RSS, and whether a JAX device is attached (import-light: jax is
+        only queried if something already imported it)."""
+        import sys as _sys
+
+        out = {"ok": True, "rss_mb": round(_rss_mb(), 1), "section": self.section}
+        jax_mod = _sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                devs = jax_mod.local_devices()
+                out["devices"] = [str(d) for d in devs]
+                out["ok"] = bool(devs)
+            except Exception as e:
+                out["devices_error"] = repr(e)
+                out["ok"] = False
+        return out
 
     # -- config hot reload (§5.6) --------------------------------------------
     def on_reload(self, handler: Callable[[dict], None]) -> None:
@@ -179,6 +226,12 @@ class ModuleRuntime:
         self._stop.set()
         if self.watcher is not None:
             self.watcher.stop()
+        if self.telemetry is not None:
+            try:
+                self.telemetry.stop()
+            except Exception:
+                pass
+            self.telemetry = None
         try:  # QueueStats runs its own timer thread, not a runtime.every one
             self.qm.queue_stats.stop()
         except Exception:

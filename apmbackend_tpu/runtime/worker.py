@@ -139,6 +139,14 @@ class WorkerApp:
 
         self._overflow: collections.deque = collections.deque()
         self._overflow_lock = threading.Lock()
+        # transport ingest stamps (header ingest_ts) of consumed-but-not-yet-
+        # fed lines, FIFO like the ring: handed to the driver at FEED time so
+        # an emission only ever claims stamps of lines actually in flight to
+        # the device (a consume-time handoff let the first tick of a bulk
+        # replay claim — and lose — every stamp while the ring still held
+        # the lines). deque append/popleft are thread-safe (pump thread
+        # appends, device loop pops).
+        self._intake_ts_fifo: collections.deque = collections.deque()
         self._overflow_max = int(eng_cfg.get("intakeOverflowMaxLines", 200_000))
         self.intake_dropped = 0
         self._ring_spin_s = float(eng_cfg.get("ringFullMaxBlockSeconds", 2.0))
@@ -200,6 +208,68 @@ class WorkerApp:
 
         runtime.on_reload(self._apply_config)
         runtime.on_exit(self.shutdown)
+
+        # -- telemetry -------------------------------------------------------
+        # intake/HBM counters as a scrape view, and the engine healthz
+        # section (tick liveness, emission backlog, device presence) on the
+        # module exporter when one is configured. Collector registration is
+        # gated on an exporter existing (own runtime's, or the lead's in
+        # single-process standalone mode) so short-lived test pipelines do
+        # not accumulate dead collectors in the process registry.
+        from ..obs import get_registry, telemetry_active
+
+        if getattr(runtime, "telemetry", None) is not None or telemetry_active():
+            get_registry().add_collector(self._collect_metrics)
+        if getattr(runtime, "telemetry", None) is not None:
+            runtime.telemetry.add_health("engine", self._health)
+
+    def _collect_metrics(self):
+        from ..obs import Sample
+
+        yield Sample("apm_intake_pushed_total", {}, self._ring_pushed, "counter",
+                     "Lines accepted from the broker into the intake path")
+        yield Sample("apm_intake_fed_total", {}, self._ring_fed, "counter",
+                     "Lines handed to the device driver")
+        yield Sample("apm_intake_dropped_total", {}, self.intake_dropped, "counter",
+                     "Lines dropped past the overflow cap (device loop stalled)")
+        yield Sample("apm_intake_ring_bytes", {},
+                     self._ring.used_bytes if self._ring is not None else 0,
+                     "gauge", "Bytes buffered in the native intake ring")
+        yield Sample("apm_intake_overflow_lines", {}, len(self._overflow), "gauge",
+                     "Lines parked in the ring-full overflow FIFO")
+        yield Sample("apm_hbm_bytes_in_use", {}, self.hbm_bytes_in_use, "gauge",
+                     "Device memory in use (HBM watchdog view)")
+        yield Sample("apm_hbm_bytes_limit", {}, self.hbm_bytes_limit, "gauge",
+                     "Device memory limit (HBM watchdog view)")
+
+    def _health(self) -> dict:
+        """The /healthz engine section: tick liveness, emission/intake
+        backlog, executor identity, device presence."""
+        tracer = self.driver._tracer
+        ring_alive = self._ring_thread is None or self._ring_thread.is_alive()
+        out = {
+            # a dead device loop wedges intake forever — the one internal
+            # state that makes this process unhealthy on its own
+            "ok": ring_alive,
+            "executor": self.driver._step.kind,
+            "services": self.driver.registry.count,
+            "capacity": self.driver.cfg.capacity,
+            "intake_backlog_lines": max(0, self._ring_pushed - self._ring_fed),
+            "intake_dropped": self.intake_dropped,
+            "emission_held": self.driver._pending_emission is not None,
+            "overflow_row_ticks": self.driver.overflow_rows_total,
+            "device_loop_alive": ring_alive,
+        }
+        if tracer is not None:
+            out.update(tracer.summary())
+        try:
+            import jax
+
+            out["devices"] = [str(d) for d in jax.local_devices()]
+        except Exception as e:
+            out["devices_error"] = repr(e)
+            out["ok"] = False
+        return out
 
     # -- callbacks -----------------------------------------------------------
     def _on_fullstat_lines(self, lines) -> None:
@@ -267,7 +337,29 @@ class WorkerApp:
                 f"tpuEngine.samplesPerBucket to restore exactness."
             )
 
-    def _consume(self, line: str) -> None:
+    def _note_intake(self, n: int) -> None:
+        """Hand the oldest of the next ``n`` queued ingest stamps to the
+        driver — called right before feeding n lines so queue + ring wait
+        honestly counts toward the ingest->emit latency."""
+        fifo = self._intake_ts_fifo
+        oldest = None
+        for _ in range(min(n, len(fifo))):
+            try:
+                ts = fifo.popleft()
+            except IndexError:
+                break
+            if oldest is None or ts < oldest:
+                oldest = ts
+        if oldest is not None:
+            self.driver.note_intake_time(oldest)
+
+    def _consume(self, line: str, headers=None) -> None:
+        # transport ingest stamp (ProducerQueue header): queue it for the
+        # feed-time handoff that anchors the ingest->emit/alert series
+        if headers and self.driver._tracer is not None:
+            ts = headers.get("ingest_ts")
+            if ts is not None:
+                self._intake_ts_fifo.append(ts)
         if self._ring is not None and self._ring_thread.is_alive():
             # FIFO: while older overflow lines are pending, new lines must
             # queue behind them, not jump into the ring
@@ -295,6 +387,7 @@ class WorkerApp:
         if entry is None or entry.type != "tx":
             self.runtime.logger.info(f"Not a transactions entry: {line[:200]}")
             return
+        self._note_intake(1)
         with self._driver_lock:
             self.driver.feed(entry)
 
@@ -358,6 +451,7 @@ class WorkerApp:
         self._feed_guarded(lambda: self.driver.feed_csv_batch(lines), len(lines))
 
     def _feed_guarded(self, fn, n: int) -> None:
+        self._note_intake(n)
         try:
             with self._driver_lock:
                 fn()

@@ -26,6 +26,12 @@ def build(runtime) -> DBWriter:
         db_stats=db_stats,
         logger=runtime.logger,
     )
+    from ..obs import telemetry_active
+
+    if getattr(runtime, "telemetry", None) is not None or telemetry_active():
+        from ..obs.views import register_db_stats
+
+        register_db_stats(db_stats, "streamInsertDb")
     resume_path = cfg.get("bufferResumeFileFullPath")
     if resume_path:
         writer.load_resume(resume_path)

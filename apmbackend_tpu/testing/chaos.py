@@ -87,29 +87,37 @@ class ChaosChannel(Channel):
         self._forced_full = False
         self._fire_drain()
 
-    def send(self, name: str, payload: bytes) -> bool:
+    def send(self, name: str, payload: bytes, headers=None) -> bool:
         if self._forced_full:
             self.stats._bump("refused_sends")
             return False
-        ok = self.inner.send(name, payload)
+        ok = self.inner.send(name, payload, headers)
         if ok:
             self.stats._bump("sent")
         return ok
 
     # -- consumer-side faults -------------------------------------------------
     def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
-        def chaotic(payload: bytes) -> None:
+        from ..transport.base import accepts_headers
+
+        wants_headers = accepts_headers(callback)
+
+        def chaotic(payload: bytes, headers=None) -> None:
             # the backend already removed the message (ack-on-receipt): a
             # drop here IS the at-most-once loss window
+            deliver = (
+                (lambda: callback(payload, headers)) if wants_headers
+                else (lambda: callback(payload))
+            )
             if self.drop_p and self._rng.random() < self.drop_p:
                 self.stats._bump("dropped")
                 return
             self.stats._bump("delivered")
-            callback(payload)
+            deliver()
             if self.dup_p and self._rng.random() < self.dup_p:
                 self.stats._bump("duplicated")
                 self.stats._bump("delivered")
-                callback(payload)
+                deliver()
 
         self.inner.consume(name, chaotic, consumer_tag)
 

@@ -1,15 +1,21 @@
 """Queue status: name, depth, memory (qstat.sh:2-5 role).
 
-For the AMQP backend this passively declares each configured queue to read its
-message count; for an in-process memory broker it reads depths directly (the
-path the standalone pipeline and tests use).
+Three sources:
+
+- ``--metrics-url http://host:port`` — scrape a running module's telemetry
+  exporter (/metrics) and render queue depth/bytes plus cumulative in/out
+  message counts. Works WITHOUT broker credentials and is the only way to
+  see inside a memory-broker process from outside it.
+- AMQP backend: passively declare each configured queue to read its message
+  count (needs broker reachability).
+- in-process memory broker: direct depth reads (standalone pipeline, tests).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
-from typing import List, Tuple
+from typing import Dict, List, Tuple
 
 
 def known_queue_names(config: dict) -> List[str]:
@@ -55,6 +61,59 @@ def format_rows(rows: List[Tuple[str, int, float]]) -> str:
     return "\n".join(lines)
 
 
+def metrics_url_stats(url: str, timeout_s: float = 5.0) -> List[Tuple[str, int, float, float, float]]:
+    """Scrape ``<url>/metrics`` -> [(queue, depth, memory MB, in_total,
+    out_total)]. Depth/bytes come from the broker gauges
+    (apm_queue_depth/apm_queue_memory_bytes); throughput from the
+    QueueStats-view counters (apm_queue_messages_total)."""
+    import urllib.request
+
+    from ..obs import parse_prom_text
+
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        text = resp.read().decode("utf-8", "replace")
+    depth: Dict[str, float] = {}
+    mem: Dict[str, float] = {}
+    inc: Dict[str, float] = {}
+    out: Dict[str, float] = {}
+    for name, labels, value in parse_prom_text(text):
+        q = labels.get("queue")
+        if q is None:
+            continue
+        if name == "apm_queue_depth":
+            depth[q] = value
+        elif name == "apm_queue_memory_bytes":
+            mem[q] = value
+        elif name == "apm_queue_messages_total":
+            # counters are per (queue, direction, module); fold modules
+            target = inc if labels.get("direction") == "in" else out
+            target[q] = target.get(q, 0.0) + value
+    queues = sorted(set(depth) | set(mem) | set(inc) | set(out))
+    return [
+        (
+            q,
+            int(depth.get(q, 0)),
+            mem.get(q, 0.0) / (1024.0 * 1024.0),
+            inc.get(q, 0.0),
+            out.get(q, 0.0),
+        )
+        for q in queues
+    ]
+
+
+def format_metrics_rows(rows: List[Tuple[str, int, float, float, float]]) -> str:
+    lines = [
+        f"{'queue':<20} {'messages':>10} {'memory MB':>10} {'in total':>12} {'out total':>12}"
+    ]
+    for name, depth, mb, in_t, out_t in rows:
+        lines.append(
+            f"{name:<20} {depth:>10} {mb:>10.2f} {int(in_t):>12} {int(out_t):>12}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     import os
 
@@ -63,14 +122,27 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description="Show queue depth/memory")
     ap.add_argument("--config", default=os.environ.get(CONFIG_ENV_VAR))
+    ap.add_argument(
+        "--metrics-url",
+        help="scrape a telemetry exporter (http://host:port[/metrics]) instead "
+        "of talking to a broker — no credentials needed",
+    )
     args = ap.parse_args(argv)
+    if args.metrics_url:
+        try:
+            print(format_metrics_rows(metrics_url_stats(args.metrics_url)))
+        except OSError as e:
+            print(f"could not scrape {args.metrics_url}: {e}", file=sys.stderr)
+            return 1
+        return 0
     config = load_config(args.config) if args.config else default_config()
     if config.get("brokerBackend") == "amqp":
         rows = amqp_stats(config.get("amqpConnectionString", "amqp://localhost:5672"),
                           known_queue_names(config))
     else:
-        print("memory broker is process-local; run qstat inside the pipeline process "
-              "or switch brokerBackend to amqp", file=sys.stderr)
+        print("memory broker is process-local; use --metrics-url against the "
+              "pipeline's telemetry exporter, run qstat inside the pipeline "
+              "process, or switch brokerBackend to amqp", file=sys.stderr)
         rows = [(n, 0, 0.0) for n in known_queue_names(config)]
     print(format_rows(rows))
     return 0

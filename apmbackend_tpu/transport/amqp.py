@@ -86,12 +86,13 @@ class AmqpChannel(Channel):
         self._queues: Set[str] = set()
         self._drain_callbacks: List[Callable[[], None]] = []
 
-        # producer side
-        self._out: pyqueue.Queue[Tuple[str, bytes]] = pyqueue.Queue(maxsize=publish_queue_max)
+        # producer side: (queue, payload, headers) triples — headers ride
+        # AMQP message properties so the ingest stamp crosses processes
+        self._out: pyqueue.Queue[Tuple[str, bytes, Optional[dict]]] = pyqueue.Queue(maxsize=publish_queue_max)
         self._low_water = publish_queue_max // 4 if drain_low_water is None else drain_low_water
         self._blocked = False
         self._pressure = False
-        self._pending_pub: Optional[Tuple[str, bytes]] = None  # in-flight publish
+        self._pending_pub: Optional[Tuple[str, bytes, Optional[dict]]] = None  # in-flight publish
 
         # consumer side: pending (op, args) requests + active consumers
         self._consumer_ops: List[Tuple[str, tuple]] = []
@@ -108,7 +109,7 @@ class AmqpChannel(Channel):
         with self._lock:
             self._queues.add(name)
 
-    def send(self, name: str, payload: bytes) -> bool:
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
         if self._direction != "p":
             raise RuntimeError("send() on a consumer-direction channel")
         if self._blocked:
@@ -117,7 +118,7 @@ class AmqpChannel(Channel):
             self._pressure = True
             return False
         try:
-            self._out.put_nowait((name, payload))
+            self._out.put_nowait((name, payload, headers))
             return True
         except pyqueue.Full:
             self._pressure = True
@@ -126,6 +127,11 @@ class AmqpChannel(Channel):
     def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
         if self._direction != "c":
             raise RuntimeError("consume() on a producer-direction channel")
+        from .base import accepts_headers
+
+        if not accepts_headers(callback):
+            inner = callback
+            callback = lambda payload, _headers=None, _cb=inner: _cb(payload)  # noqa: E731
         with self._lock:
             self._queues.add(name)
             self._consumers[consumer_tag] = (name, callback)
@@ -227,7 +233,7 @@ class AmqpChannel(Channel):
                         except pyqueue.Empty:
                             self._maybe_fire_drain()
                             continue
-                    name, payload = self._pending_pub
+                    name, payload, headers = self._pending_pub
                     if name not in declared:
                         ch.queue_declare(queue=name, durable=True)
                         declared.add(name)
@@ -235,7 +241,9 @@ class AmqpChannel(Channel):
                         exchange="",
                         routing_key=name,
                         body=payload,
-                        properties=self._pika.BasicProperties(delivery_mode=2),
+                        properties=self._pika.BasicProperties(
+                            delivery_mode=2, headers=headers
+                        ),
                     )
                     self._pending_pub = None
                     self._maybe_fire_drain()
@@ -274,11 +282,11 @@ class AmqpChannel(Channel):
                                 ch.queue_declare(queue=q, durable=True)
                                 declared.add(q)
 
-                            def _on_message(mch, method, _properties, body, _cb=cb):
+                            def _on_message(mch, method, properties, body, _cb=cb):
                                 # ack-on-receipt: at-most-once past this point
                                 # (queue.js:277-283 semantics)
                                 mch.basic_ack(delivery_tag=method.delivery_tag)
-                                _cb(body)
+                                _cb(body, getattr(properties, "headers", None))
 
                             ch.basic_consume(
                                 queue=q, on_message_callback=_on_message, consumer_tag=tag

@@ -21,11 +21,30 @@ client when available).
 
 from __future__ import annotations
 
+import inspect
 import threading
+import time
 from collections import defaultdict
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.counters import QueueStats
+
+
+def accepts_headers(cb: Callable) -> bool:
+    """True when ``cb`` takes a second positional arg — the transport then
+    delivers ``cb(payload, headers)``; legacy one-arg consumers keep their
+    ``cb(payload)`` shape. Headers are the end-to-end latency channel: the
+    producer stamps ``ingest_ts`` at transport entry and the pipeline
+    measures ingest→emit / ingest→alert from it (obs plane)."""
+    try:
+        params = list(inspect.signature(cb).parameters.values())
+    except (TypeError, ValueError):  # builtins/C callables: stay conservative
+        return False
+    positional = [
+        p for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    return len(positional) >= 2 or any(p.kind == p.VAR_POSITIONAL for p in params)
 
 
 class EventEmitter:
@@ -48,8 +67,10 @@ class Channel:
     def assert_queue(self, name: str) -> None:
         raise NotImplementedError
 
-    def send(self, name: str, payload: bytes) -> bool:
-        """Returns False when the channel/queue is full (backpressure)."""
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        """Returns False when the channel/queue is full (backpressure).
+        ``headers`` is best-effort message metadata (``ingest_ts`` wall
+        stamp); a backend that cannot carry it may drop it."""
         raise NotImplementedError
 
     def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
@@ -72,7 +93,9 @@ class ProducerQueue(EventEmitter):
         self.channel = channel
         self.queue_stats = queue_stats
         self.logger = logger
-        self.buffer: List[str] = []
+        # buffered entries keep their original ingest stamp: a pause episode
+        # must show up as queue-wait latency downstream, not vanish from it
+        self.buffer: List[Tuple[str, Optional[dict]]] = []
         self.paused = False
         self.type = "p"
         self._lock = threading.Lock()
@@ -82,7 +105,9 @@ class ProducerQueue(EventEmitter):
     def buffer_count(self) -> int:
         return len(self.buffer)
 
-    def _send_locked(self, line: str, verbose: bool, requeue_front: bool = False) -> bool:
+    def _send_locked(
+        self, line: str, headers: Optional[dict], verbose: bool, requeue_front: bool = False
+    ) -> bool:
         """Caller holds self._lock. Returns True when a pause was entered.
 
         ``requeue_front`` is set by retry_buffer: a line popped from the front
@@ -92,16 +117,16 @@ class ProducerQueue(EventEmitter):
         """
         if self.paused:
             if requeue_front:
-                self.buffer.insert(0, line)
+                self.buffer.insert(0, (line, headers))
             else:
-                self.buffer.append(line)
+                self.buffer.append((line, headers))
             return False
-        ok = self.channel.send(self.queue_name, line.encode("utf-8"))
+        ok = self.channel.send(self.queue_name, line.encode("utf-8"), headers)
         if not ok:
             if requeue_front:
-                self.buffer.insert(0, line)
+                self.buffer.insert(0, (line, headers))
             else:
-                self.buffer.append(line)
+                self.buffer.append((line, headers))
             self.paused = True
             return True
         if verbose and self.logger:
@@ -110,8 +135,11 @@ class ProducerQueue(EventEmitter):
         return False
 
     def write_line(self, line: str, verbose: bool = False) -> None:
+        # the transport-entry stamp: every message carries when it entered
+        # the fabric, the anchor of the ingest->emit/alert latency series
+        headers = {"ingest_ts": time.time()}
         with self._lock:
-            entered_pause = self._send_locked(line, verbose)
+            entered_pause = self._send_locked(line, headers, verbose)
         if entered_pause:
             if self.logger:
                 self.logger.info(
@@ -127,8 +155,8 @@ class ProducerQueue(EventEmitter):
         with self._lock:
             self.paused = False
             while self.buffer and not self.paused:
-                line = self.buffer.pop(0)
-                self._send_locked(line, False, requeue_front=True)
+                line, headers = self.buffer.pop(0)
+                self._send_locked(line, headers, False, requeue_front=True)
             remaining = len(self.buffer)
         if remaining and self.logger:
             self.logger.info(
@@ -156,13 +184,30 @@ class ConsumerQueue(EventEmitter):
         self.is_consuming = False
         self.type = "c"
         self.queue_stats.add_counter(queue_name, "c")
+        # resolved ONCE (this runs per message): does the consumer want the
+        # transport headers, and the queue-wait histogram instrument
+        self._cb_headers = accepts_headers(consume_cb)
+        from ..obs import get_registry
+
+        self._wait_hist = get_registry().histogram(
+            "apm_queue_wait_seconds",
+            "Transport latency: producer ingest stamp -> consumer delivery",
+            labels={"queue": queue_name},
+        )
         channel.assert_queue(queue_name)
 
-    def _wrapped(self, payload: bytes) -> None:
+    def _wrapped(self, payload: bytes, headers: Optional[dict] = None) -> None:
         # Ack-on-receipt semantics: the backend has already removed the message
         # by the time we see it (queue.js:277-283).
         self.queue_stats.incr(self.queue_name)
-        self.consume_cb(payload.decode("utf-8"))
+        if headers:
+            ts = headers.get("ingest_ts")
+            if ts is not None:
+                self._wait_hist.observe(time.time() - ts)
+        if self._cb_headers:
+            self.consume_cb(payload.decode("utf-8"), headers)
+        else:
+            self.consume_cb(payload.decode("utf-8"))
 
     def start_consume(self) -> None:
         if not self.is_consuming:

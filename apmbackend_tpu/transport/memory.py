@@ -17,14 +17,17 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .base import Channel
+from .base import Channel, accepts_headers
 
 
 class _NamedQueue:
     def __init__(self, capacity: int):
         self.capacity = capacity
+        # (payload, headers) pairs — headers carry the transport-entry
+        # ingest_ts stamp through the fake broker like AMQP properties would
         self.items: deque = deque()
-        self.consumers: List[Tuple[str, Callable[[bytes], None]]] = []
+        # (tag, callback, wants_headers)
+        self.consumers: List[Tuple[str, Callable, bool]] = []
 
 
 class MemoryBroker:
@@ -59,16 +62,16 @@ class MemoryBroker:
     def queue_memory_bytes(self, name: str) -> int:
         with self._lock:
             q = self._queues.get(name)
-            return sum(len(p) for p in q.items) if q else 0
+            return sum(len(p) for p, _h in q.items) if q else 0
 
     # -- producer side -------------------------------------------------------
-    def send(self, name: str, payload: bytes) -> bool:
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
         with self._lock:
             q = self._queues[name]
             if len(q.items) >= q.capacity:
                 self._was_full = True
                 return False
-            q.items.append(payload)
+            q.items.append((payload, headers))
         self._work.set()
         return True
 
@@ -80,14 +83,14 @@ class MemoryBroker:
     def consume(self, name: str, callback: Callable[[bytes], None], tag: str) -> None:
         with self._lock:
             q = self._queues[name]
-            if not any(t == tag for t, _ in q.consumers):
-                q.consumers.append((tag, callback))
+            if not any(t == tag for t, _cb, _h in q.consumers):
+                q.consumers.append((tag, callback, accepts_headers(callback)))
         self._work.set()
 
     def cancel(self, tag: str) -> None:
         with self._lock:
             for q in self._queues.values():
-                q.consumers = [(t, cb) for t, cb in q.consumers if t != tag]
+                q.consumers = [c for c in q.consumers if c[0] != tag]
 
     # -- delivery ------------------------------------------------------------
     def pump(self, max_messages: Optional[int] = None) -> int:
@@ -104,12 +107,16 @@ class MemoryBroker:
                     if budget is not None and len(batch) >= budget:
                         break
                     if q.consumers and q.items:
-                        payload = q.items.popleft()
-                        batch.append((q.consumers[0][1], payload))
+                        payload, headers = q.items.popleft()
+                        _tag, cb, wants_headers = q.consumers[0]
+                        batch.append((cb, payload, headers, wants_headers))
                 if not batch:
                     break
-            for cb, payload in batch:
-                cb(payload)
+            for cb, payload, headers, wants_headers in batch:
+                if wants_headers:
+                    cb(payload, headers)
+                else:
+                    cb(payload)
                 delivered += 1
             self._maybe_drain()
         self._maybe_drain()
@@ -156,8 +163,8 @@ class MemoryChannel(Channel):
     def assert_queue(self, name: str) -> None:
         self.broker.assert_queue(name)
 
-    def send(self, name: str, payload: bytes) -> bool:
-        return self.broker.send(name, payload)
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        return self.broker.send(name, payload, headers)
 
     def consume(self, name: str, callback, consumer_tag: str) -> None:
         self.broker.consume(name, callback, consumer_tag)

@@ -29,7 +29,7 @@ class QueueStats:
 
     def add_counter(self, name: str, ctype: str, init_val: int = 0) -> None:
         with self._lock:
-            self._counters[name] = {"type": ctype, "cnt": init_val}
+            self._counters[name] = {"type": ctype, "cnt": init_val, "total": init_val}
             need_timer = self._timer is None and not self._stopped
         if need_timer:
             self._schedule()
@@ -37,7 +37,19 @@ class QueueStats:
     def incr(self, name: str, val: int = 1) -> None:
         with self._lock:
             if name in self._counters:
-                self._counters[name]["cnt"] += val
+                obj = self._counters[name]
+                obj["cnt"] += val
+                obj["total"] += val
+
+    def totals(self) -> list:
+        """[(name, type, cumulative_total)] — the monotonic series the
+        metrics registry exports (obs.views.register_queue_stats), never
+        reset by the interval logger."""
+        with self._lock:
+            return [
+                (name, obj["type"], obj["total"])
+                for name, obj in self._counters.items()
+            ]
 
     def snapshot_and_reset(self) -> str:
         parts = []
@@ -88,15 +100,26 @@ class DBStats:
     def __init__(self):
         self.rec_ins_counter = 0
         self.ins_elap_total_ms = 0.0
+        # cumulative (never reset): the registry-view series
+        self.rec_ins_total = 0
+        self.ins_elap_cum_ms = 0.0
         self._lock = threading.Lock()
 
     def add_inserted(self, count: int) -> None:
         with self._lock:
             self.rec_ins_counter += count
+            self.rec_ins_total += count
 
     def add_elapsed_ms(self, ms: float) -> None:
         with self._lock:
             self.ins_elap_total_ms += ms
+            self.ins_elap_cum_ms += ms
+
+    def totals(self) -> tuple:
+        """(rows_inserted_total, insert_ms_total) — cumulative, monotonic
+        (obs.views.register_db_stats view)."""
+        with self._lock:
+            return self.rec_ins_total, self.ins_elap_cum_ms
 
     def snapshot_and_reset(self) -> str:
         with self._lock:

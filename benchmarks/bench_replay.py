@@ -100,6 +100,21 @@ def _measure(n_transactions: int, n_services: int, tx_per_bucket) -> dict:
         bare_replay.finish()
         parse_elapsed = time.perf_counter() - t0
 
+    # parser-stage counters (the ROADMAP "replay is parser-bound" item,
+    # quantified): where the lines go, how much wall time the parser itself
+    # burns, and whether the correlation caches are hitting
+    pc = bare.counters
+    cs = bare.cache_stats()
+    parser_stage = {
+        "lines_in": pc["lines_in"],
+        "tx_matched": pc["tx_out"],
+        "db_direct": pc["db_direct_out"],
+        "parse_s": round(pc["parse_ns"] / 1e9, 3),
+        "parse_us_per_line": round(pc["parse_ns"] / max(pc["lines_in"], 1) / 1000.0, 3),
+        "parse_share_of_wall": round(pc["parse_ns"] / 1e9 / max(parse_elapsed, 1e-9), 3),
+        "corr_cache": {k: {"hits": v["hits"], "misses": v["misses"]} for k, v in cs.items()},
+    }
+
     return {
         "tx_per_sec": tx_count[0] / elapsed,
         "lines": lines,
@@ -112,6 +127,7 @@ def _measure(n_transactions: int, n_services: int, tx_per_bucket) -> dict:
         "executor": driver._step.kind,
         "parser_only_tx_per_sec": round(parse_count[0] / parse_elapsed, 1),
         "parser_only_lines_per_sec": round(bare_lines / parse_elapsed, 1),
+        "parser_stage": parser_stage,
     }
 
 

@@ -8,6 +8,25 @@
 # TPU relay at interpreter start; tests must not depend on (or block on) the
 # tunnel. conftest.py additionally pins JAX_PLATFORMS=cpu and 8 host devices.
 cd "$(dirname "$0")"
+
+# --lint: byte-compile the whole package (hard fail on any syntax error)
+# and run pyflakes when the environment has it (soft-skip otherwise — the
+# container image does not bake it in). Consumed standalone (CI lint stage)
+# or before the suite: ./run_tests.sh --lint [pytest args...].
+if [ "$1" = "--lint" ]; then
+    shift
+    echo "lint: python -m compileall apmbackend_tpu benchmarks tests"
+    python -m compileall -q apmbackend_tpu benchmarks tests || exit 1
+    if python -c "import pyflakes" 2>/dev/null; then
+        echo "lint: python -m pyflakes apmbackend_tpu"
+        python -m pyflakes apmbackend_tpu || exit 1
+    else
+        echo "lint: pyflakes unavailable, skipping (soft)"
+    fi
+    # --lint alone: stop after linting; with more args fall through to pytest
+    [ $# -eq 0 ] && exit 0
+fi
+
 exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -m "soak or not soak" "$@"

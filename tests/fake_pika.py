@@ -67,20 +67,21 @@ class FakeBroker:
                 conn._notify_unblocked()
 
     # -- broker ops ----------------------------------------------------------
-    def publish(self, routing_key: str, body: bytes) -> None:
+    def publish(self, routing_key: str, body: bytes, properties=None) -> None:
         with self.lock:
-            self.queues[routing_key].append(body)
+            self.queues[routing_key].append((body, properties))
             self.publish_count += 1
             self._update_alarm_locked()
 
-    def pop(self, queue_name: str) -> Optional[bytes]:
+    def pop(self, queue_name: str) -> Optional[tuple]:
+        """(body, properties) of the oldest message, or None."""
         with self.lock:
             q = self.queues.get(queue_name)
             if not q:
                 return None
-            body = q.popleft()
+            item = q.popleft()
             self._update_alarm_locked()
-            return body
+            return item
 
     def depth(self, queue_name: str) -> int:
         with self.lock:
@@ -116,7 +117,7 @@ class FakeChannel:
 
     def basic_publish(self, exchange: str, routing_key: str, body: bytes, properties=None) -> None:
         self._check()
-        self._conn._broker.publish(routing_key, body)
+        self._conn._broker.publish(routing_key, body, properties)
 
     def basic_consume(self, queue: str, on_message_callback: Callable, consumer_tag: str) -> str:
         self._check()
@@ -183,12 +184,13 @@ class FakeBlockingConnection:
         delivered = 0
         for tag, (queue_name, on_message, ch) in list(self._consumers.items()):
             while True:
-                body = self._broker.pop(queue_name)
-                if body is None:
+                item = self._broker.pop(queue_name)
+                if item is None:
                     break
+                body, properties = item
                 self._delivery_tag += 1
                 method = SimpleNamespace(delivery_tag=self._delivery_tag, consumer_tag=tag)
-                on_message(ch, method, SimpleNamespace(), body)
+                on_message(ch, method, properties or SimpleNamespace(), body)
                 delivered += 1
         if delivered == 0 and time_limit:
             time.sleep(min(time_limit, 0.005))
