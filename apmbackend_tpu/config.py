@@ -237,6 +237,10 @@ _DEFAULT_CONFIG: dict = {
     "appDirectory": ".",
     "amqpConnectionString": "amqp://localhost:5672",
     "brokerBackend": "memory",  # "memory" | "amqp"
+    # consumer prefetch for at-least-once (manual-ack) AMQP consumers: the
+    # broker bound on in-flight unacked deliveries per connection — also the
+    # worst-case redelivery span a dedup window must cover
+    "amqpPrefetchCount": 1000,
     "logDir": "logs",
     "statLogIntervalInSeconds": 60,
     "dbInsertQueue": "db_insert",
@@ -269,6 +273,12 @@ _DEFAULT_CONFIG: dict = {
         "diskSpaceGBAvailableThreshold": 100,
         "diskSpacePercentageUsedThreshold": 80,
         "inspectionFrequencySeconds": 60,
+        # hung-tick watchdog: a child whose /healthz answers 503 (or times
+        # out) this many consecutive inspection cycles is force-restarted
+        # through the crash-loop-damped path (0 disables; only children with
+        # a metricsPort are watchable)
+        "healthzFailureThreshold": 3,
+        "healthzTimeoutSeconds": 2,
         "sendAlertOnUnexpectedScriptEnd": True,
         "triggerGCThreshold": 500,
         "appLogRetentionDays": 7,
@@ -451,6 +461,14 @@ _DEFAULT_CONFIG: dict = {
         "checkpointDir": "save/tpu_engine",
         "resumeFileFullPath": "save/tpu_engine.resume.npz",
         "microBatchSize": 65536,
+        # Delivery guarantee (DESIGN.md §7): "atMostOnce" = reference parity,
+        # ack on receipt, in-flight loss bounded by the resume cadence.
+        # "atLeastOnce" = manual acks committed only after the engine
+        # checkpoint that absorbed them (epoch cycle, runtime/worker.py);
+        # redeliveries deduped by msg_id against a window of this many
+        # recently absorbed ids persisted inside every snapshot.
+        "deliveryMode": "atMostOnce",
+        "dedupWindowSize": 65536,
         # mirror StatEntry/FullStatEntry lines onto the reference's 'stats' /
         # 'z_score' queues for per-stage inspection and interop (SURVEY.md §4)
         "emitStatsQueue": False,

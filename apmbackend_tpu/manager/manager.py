@@ -274,6 +274,25 @@ class ModuleProc:
             except OSError:
                 pass
 
+    def force_restart(self, *, kill_timeout_s: float = 10.0) -> None:
+        """Kill a wedged-but-alive child and route it through the SAME
+        crash-loop-damped restart path a self-exit takes (handle_exit): a
+        child that wedges immediately after every restart gets the 60 s
+        damping instead of a tight kill/restart loop."""
+        if self.proc is None:
+            return
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=kill_timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                pass
+        code = self.proc.returncode if self.proc.returncode is not None else -9
+        self.handle_exit(code)
+
     def stop(self, *, kill_timeout_s: float = 10.0) -> None:
         if self.proc is None:
             return
@@ -346,6 +365,17 @@ class ManagerApp:
             )
             for mod in self.modules
         }
+        self._m_watchdog = {
+            mod.module: reg.counter(
+                "apm_manager_watchdog_restarts_total",
+                "Wedged-but-alive children force-restarted by the healthz watchdog",
+                labels={"module": mod.name},
+            )
+            for mod in self.modules
+        }
+        # hung-tick watchdog bookkeeping: consecutive failed /healthz probes
+        # per module (reset on success, on restart, and while no process)
+        self._health_streaks = {mod.module: 0 for mod in self.modules}
         if getattr(runtime, "telemetry", None) is not None:
             runtime.telemetry.add_route("/fleet", self._fleet_route)
             runtime.telemetry.add_health("fleet", self._fleet_health)
@@ -439,6 +469,54 @@ class ManagerApp:
                 self.runtime.logger.info(f"Sending garbage collection request to module: {mod.module}")
                 self._m_gcs[mod.module].inc()
                 mod.request_gc()
+
+    def _probe_child_health(self, url: str, timeout_s: float) -> bool:
+        """One /healthz probe; True = healthy (HTTP 200). 503, timeout, or a
+        refused connection all count as unhealthy. Separate method so tests
+        inject probe outcomes without an HTTP server."""
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(f"{url}/healthz", timeout=timeout_s) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    def inspect_module_health(self) -> None:
+        """Hung-tick watchdog: a child that is ALIVE but answers /healthz
+        with a sustained 503/timeout streak is force-restarted through the
+        crash-loop-damped path. A dead device loop (or wedged tick thread)
+        leaves the process running — poll_exit never fires — so without this
+        probe a wedged child consumes its queue's messages never again."""
+        threshold = int(self.mconfig.get("healthzFailureThreshold", 3) or 0)
+        if threshold <= 0:
+            return
+        timeout_s = float(self.mconfig.get("healthzTimeoutSeconds", 2))
+        targets = dict(self._child_metrics_targets())
+        from .pid_stats import pid_exists
+
+        for mod in self.modules:
+            url = targets.get(mod.name)
+            if url is None or mod.pid is None or not pid_exists(mod.pid):
+                self._health_streaks[mod.module] = 0  # exit path handles it
+                continue
+            if self._probe_child_health(url, timeout_s):
+                self._health_streaks[mod.module] = 0
+                continue
+            self._health_streaks[mod.module] += 1
+            streak = self._health_streaks[mod.module]
+            if streak < threshold:
+                continue
+            self._health_streaks[mod.module] = 0
+            msg = (
+                f"Child module wedged (healthz failed {streak} consecutive "
+                f"inspections) - restarting through damped path: {mod.module}"
+            )
+            self.annotate(msg)
+            self.alerts.add(msg)
+            self._m_watchdog[mod.module].inc()
+            mod.force_restart()
 
     # -- fleet telemetry aggregation ------------------------------------------
     def _child_metrics_targets(self) -> List[tuple]:
@@ -600,6 +678,7 @@ class ManagerApp:
         self.inspect_disk_space()
         self.inspect_queues()
         self.inspect_modules()
+        self.inspect_module_health()
 
     # -- log retention (apm_manager.js:532-571) -------------------------------
     def cleanup_logs(self) -> int:

@@ -64,10 +64,15 @@ def _shape_signature(cfg: EngineConfig) -> dict:
 
 
 class ShardedCheckpointer:
-    """Save/restore a sharded EngineState + registry keys under ``directory``."""
+    """Save/restore a sharded EngineState + registry keys under ``directory``.
+
+    ``last_delivery`` holds the delivery tree (epoch watermark + dedup
+    window) of the snapshot the most recent :meth:`restore` returned — None
+    when the snapshot predates at-least-once mode."""
 
     def __init__(self, directory: str, *, keep: int = 2):
         self.directory = os.path.abspath(directory)
+        self.last_delivery: Optional[dict] = None
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
@@ -79,11 +84,17 @@ class ShardedCheckpointer:
         state: EngineState,
         cfg: EngineConfig,
         registry_keys: Tuple[Tuple[str, str], ...],
+        delivery: Optional[dict] = None,
     ) -> None:
         meta = {
             "signature": _shape_signature(cfg),
             "registry": ["\x00".join(k) for k in registry_keys],
         }
+        if delivery is not None:
+            # at-least-once coupling (pipeline.save_resume contract at pod
+            # scale): the per-queue epoch watermark + dedup window commits in
+            # the same atomic checkpoint as the sharded state it describes
+            meta["delivery"] = delivery
         # async: the write overlaps the driver's tick/ingest loop; orbax
         # finalizes the previous save on the next save(), and wait()/close()
         # (and restore/latest_step) synchronize explicitly
@@ -145,6 +156,7 @@ class ShardedCheckpointer:
                 if state is None:
                     continue
             registry = tuple(tuple(k.split("\x00", 1)) for k in meta["registry"])
+            self.last_delivery = meta.get("delivery")
             return engine_derive_aggs(state, cfg), registry, step
         return None
 

@@ -1269,6 +1269,11 @@ class PipelineDriver:
         self.overflow_ticks = 0
         self._overflow_last_logged_tick = -1000
         self.micro_batch_size = micro_batch_size
+        # at-least-once delivery coupling (runtime/worker.py epoch cycle):
+        # the per-queue {"epoch": n, "dedup": [msg ids], ...} tree the last
+        # save_resume carried / load_resume recovered. None = snapshot
+        # predates the feature or the worker runs at-most-once.
+        self.delivery_state: Optional[dict] = None
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
         self._latest_label = 0  # host mirror of stats.latest_bucket (hot path)
@@ -2031,9 +2036,16 @@ class PipelineDriver:
         return lines
 
     # -- checkpoint / resume (§5.4) ------------------------------------------
-    def save_resume(self, path: str) -> None:
+    def save_resume(self, path: str, *, delivery: Optional[dict] = None) -> None:
         """Atomic snapshot (tmp + rename); `path` is used verbatim — no .npz
-        suffix magic — so load_resume(path) always finds what was saved."""
+        suffix magic — so load_resume(path) always finds what was saved.
+
+        ``delivery`` couples the snapshot to queue position (the at-least-once
+        epoch contract): a per-queue dict of {"epoch": watermark, "dedup":
+        [recently absorbed msg ids], ...} saved ATOMICALLY WITH the engine
+        state that absorbed those messages — the invariant the worker's
+        ack-after-checkpoint cycle rests on (a message id is in the saved
+        window iff its effect is in the saved tensors)."""
         # a held emission describes a tick already IN the snapshot state; it
         # must reach its consumers now or a restore would silently drop it
         self.drain_emission()
@@ -2075,6 +2087,15 @@ class PipelineDriver:
         pending = [tx.to_csv() for tx in self.heap.items()]
         pending += [line for _ts, line in self._tx_backlog]
         arrays["pending_tx"] = np.array(pending, dtype=object)
+        if delivery is None:
+            delivery = self.delivery_state
+        if delivery is not None:
+            # JSON in a 0-d object array: schema-stable regardless of the
+            # dedup window's shape, absent entirely for at-most-once workers
+            import json as _json
+
+            arrays["delivery_state"] = np.array(_json.dumps(delivery), dtype=object)
+            self.delivery_state = delivery
         import tempfile
 
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)), suffix=".tmp")
@@ -2197,6 +2218,20 @@ class PipelineDriver:
             self.cfg,
         )
         self._latest_label = int(data["latest_bucket"])
+        self.delivery_state = None
+        if "delivery_state" in data:  # optional: absent for at-most-once
+            import json as _json
+
+            try:
+                self.delivery_state = _json.loads(data["delivery_state"].item())
+            except Exception:
+                # a mangled delivery record must not reject the engine
+                # snapshot: worst case the dedup window starts empty and a
+                # redelivery double-counts — the at-most-once baseline
+                if self.logger:
+                    self.logger.error(
+                        f"Resume snapshot delivery state unreadable (ignored): {path}"
+                    )
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._tx_backlog = []
         if "pending_tx" in data:  # optional: absent in older snapshots

@@ -57,7 +57,10 @@ def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBrok
         from ..transport.amqp import AmqpChannel
 
         conn_str = config.get("amqpConnectionString", "amqp://localhost:5672")
-        factory = lambda qtype: AmqpChannel(conn_str, direction=qtype, logger=logger)  # noqa: E731
+        prefetch = int(config.get("amqpPrefetchCount", 1000))
+        factory = lambda qtype: AmqpChannel(  # noqa: E731
+            conn_str, direction=qtype, logger=logger, prefetch_count=prefetch
+        )
     else:
         raise ValueError(f"Unknown brokerBackend: {backend!r}")
     qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger)

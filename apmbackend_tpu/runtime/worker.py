@@ -17,6 +17,23 @@ the whole service fleet. The process:
 - snapshots device + alert state on an interval and on shutdown, restoring on
   boot (§5.4 semantics),
 - honors pause/resume backpressure by cancelling/restarting consumption.
+
+**Delivery modes** (``tpuEngine.deliveryMode``):
+
+- ``atMostOnce`` (default, reference parity): the transport acks on receipt;
+  anything in flight at a crash is lost, bounded by the resume cadence.
+- ``atLeastOnce``: the worker drives an **epoch cycle** — feed → tick →
+  checkpoint → ack. Messages are consumed manual-ack (tokens stay on the
+  broker's unacked ledger), absorbed into the device state under the driver
+  lock, and acked only AFTER the engine snapshot that contains their effects
+  has been atomically written (ack-after-checkpoint). The snapshot carries a
+  bounded dedup window of recently absorbed ``msg_id`` headers, so broker
+  redeliveries after a crash (or duplicates injected in flight) are detected
+  and skipped instead of double-counted: a restart is equivalent to the
+  crash-free run for every fully-acked epoch, modulo the dedup window size.
+  The native intake ring is bypassed in this mode (direct per-message feed
+  keeps message↔state accounting exact — the ring's drop-oldest overflow
+  escape hatch would break the token↔effect alignment the ack depends on).
 """
 
 from __future__ import annotations
@@ -45,6 +62,27 @@ class WorkerApp:
         alerts_cfg = config.get("streamProcessAlerts", {})
         stats_cfg = config.get("streamCalcStats", {})
         logger = runtime.logger
+
+        # -- delivery mode ---------------------------------------------------
+        mode = str(eng_cfg.get("deliveryMode", "atMostOnce"))
+        if mode not in ("atMostOnce", "atLeastOnce"):
+            raise ValueError(
+                f"tpuEngine.deliveryMode must be atMostOnce|atLeastOnce, got {mode!r}"
+            )
+        self._at_least_once = mode == "atLeastOnce"
+        in_queue_name = stats_cfg.get("inQueue", "transactions")
+        import collections
+
+        # bounded dedup window: ids of recently ABSORBED messages (persisted
+        # with every checkpoint; membership = "this message's effect is
+        # already in durable state, skip it"). Sized to cover the broker's
+        # redelivery span (<= prefetch) plus injected duplicates.
+        self._dedup_max = int(eng_cfg.get("dedupWindowSize", 65536))
+        self._dedup_set: set = set()
+        self._dedup_fifo: collections.deque = collections.deque()
+        self._epoch_tokens: list = []  # absorbed, unacked delivery tokens
+        self._delivery_epoch = 0
+        self._deduped_total = 0  # apm_redelivered_deduped_total
 
         # -- outbound queues -------------------------------------------------
         qm = runtime.qm
@@ -150,7 +188,16 @@ class WorkerApp:
         self._overflow_max = int(eng_cfg.get("intakeOverflowMaxLines", 200_000))
         self.intake_dropped = 0
         self._ring_spin_s = float(eng_cfg.get("ringFullMaxBlockSeconds", 2.0))
-        if eng_cfg.get("useNativeRing", True):
+        if self._at_least_once:
+            # exact token<->effect accounting requires the direct feed path:
+            # the ring batches lines detached from their delivery tokens and
+            # its overflow cap drops oldest lines, either of which would let
+            # an ack cover a message whose effect never reached the state
+            logger.info(
+                "Delivery mode atLeastOnce: native intake ring bypassed "
+                "(direct per-message feed; epoch ack-after-checkpoint active)"
+            )
+        elif eng_cfg.get("useNativeRing", True):
             try:
                 from ..native import LineRing
 
@@ -168,10 +215,26 @@ class WorkerApp:
         self.alerts_resume = alerts_cfg.get("alertsResumeFileFullPath")
         if self.engine_resume and self.driver.load_resume(self.engine_resume):
             logger.info(f"Engine state resumed from {self.engine_resume}")
+            dstate = (self.driver.delivery_state or {}).get(in_queue_name)
+            if self._at_least_once and dstate:
+                # seed the dedup window from the checkpoint: redeliveries of
+                # messages this snapshot already absorbed are skipped
+                self._delivery_epoch = int(dstate.get("epoch", 0))
+                self._deduped_total = int(dstate.get("deduped_total", 0))
+                for mid in dstate.get("dedup", []):
+                    if mid not in self._dedup_set:
+                        self._dedup_set.add(mid)
+                        self._dedup_fifo.append(mid)
+                logger.info(
+                    f"Delivery state resumed: epoch {self._delivery_epoch}, "
+                    f"dedup window {len(self._dedup_fifo)} ids"
+                )
         if self.alerts_resume:
             self.alerts_manager.load_resume(self.alerts_resume)
 
-        save_s = int(stats_cfg.get("resumeFileSaveFrequencyInSeconds", 60))
+        # float + floor: the chaos tier runs sub-second epoch cadences, and
+        # int() would truncate 0.4 to a zero-interval busy loop
+        save_s = max(0.05, float(stats_cfg.get("resumeFileSaveFrequencyInSeconds", 60)))
         runtime.every(save_s, self.save_state, name="resume-save")
 
         # interval-aligned intake counters, same style as QueueStats/DBStats
@@ -194,8 +257,9 @@ class WorkerApp:
 
         # -- intake ----------------------------------------------------------
         self._factory = EntryFactory()
-        in_queue_name = stats_cfg.get("inQueue", "transactions")
-        self.in_queue = qm.get_queue(in_queue_name, "c", self._consume)
+        self.in_queue = qm.get_queue(
+            in_queue_name, "c", self._consume, manual_ack=self._at_least_once
+        )
         self._consume_enabled = bool(stats_cfg.get("consumeQueue", True))
         if self._consume_enabled:
             self.in_queue.start_consume()
@@ -241,6 +305,14 @@ class WorkerApp:
                      "Device memory in use (HBM watchdog view)")
         yield Sample("apm_hbm_bytes_limit", {}, self.hbm_bytes_limit, "gauge",
                      "Device memory limit (HBM watchdog view)")
+        if self._at_least_once:
+            yield Sample("apm_delivery_epoch", {}, self._delivery_epoch, "gauge",
+                         "At-least-once epoch watermark (checkpoints committed)")
+            yield Sample("apm_redelivered_deduped_total", {}, self._deduped_total,
+                         "counter",
+                         "Redelivered/duplicate messages skipped by the dedup window")
+            yield Sample("apm_delivery_unacked", {}, len(self._epoch_tokens), "gauge",
+                         "Absorbed-but-unacked deliveries in the open epoch")
 
     def _health(self) -> dict:
         """The /healthz engine section: tick liveness, emission/intake
@@ -260,6 +332,14 @@ class WorkerApp:
             "overflow_row_ticks": self.driver.overflow_rows_total,
             "device_loop_alive": ring_alive,
         }
+        if self._at_least_once:
+            out["delivery"] = {
+                "mode": "atLeastOnce",
+                "epoch": self._delivery_epoch,
+                "unacked": len(self._epoch_tokens),
+                "deduped_total": self._deduped_total,
+                "dedup_window": len(self._dedup_fifo),
+            }
         if tracer is not None:
             out.update(tracer.summary())
         try:
@@ -353,7 +433,10 @@ class WorkerApp:
         if oldest is not None:
             self.driver.note_intake_time(oldest)
 
-    def _consume(self, line: str, headers=None) -> None:
+    def _consume(self, line: str, headers=None, token=None) -> None:
+        if self._at_least_once:
+            self._consume_at_least_once(line, headers, token)
+            return
         # transport ingest stamp (ProducerQueue header): queue it for the
         # feed-time handoff that anchors the ingest->emit/alert series
         if headers and self.driver._tracer is not None:
@@ -390,6 +473,50 @@ class WorkerApp:
         self._note_intake(1)
         with self._driver_lock:
             self.driver.feed(entry)
+
+    def _consume_at_least_once(self, line: str, headers, token) -> None:
+        """One manual-ack delivery: dedup, absorb, remember the token.
+
+        Everything happens under the driver lock so the epoch commit
+        (save_state) sees a consistent pair: the dedup window it snapshots
+        lists exactly the messages whose effects are in the state it saves —
+        the invariant that makes a crash between checkpoint and ack safe
+        (redelivery → skip) AND a crash before checkpoint safe (redelivery →
+        reprocess against the pre-epoch state)."""
+        msg_id = (headers or {}).get("msg_id")
+        with self._driver_lock:
+            if msg_id is not None and msg_id in self._dedup_set:
+                # already absorbed: a broker redelivery or an in-flight
+                # duplicate. Skip the feed, count it — but do NOT ack now:
+                # an in-flight dup of a message absorbed in the CURRENT
+                # (uncommitted) epoch shares the original's broker ledger
+                # entry, and acking it here would advance the cursor past an
+                # effect that is not yet durable (found by the kill−9
+                # harness: one message lost per dup-then-crash). The token
+                # joins the epoch and commits with everyone else.
+                self._deduped_total += 1
+                if token is not None:
+                    self._epoch_tokens.append(token)
+            else:
+                if msg_id is not None:
+                    self._dedup_set.add(msg_id)
+                    self._dedup_fifo.append(msg_id)
+                    if len(self._dedup_fifo) > self._dedup_max:
+                        self._dedup_set.discard(self._dedup_fifo.popleft())
+                entry = self._factory.from_csv(line)
+                if entry is not None and entry.type == "tx":
+                    if headers and self.driver._tracer is not None:
+                        ts = headers.get("ingest_ts")
+                        if ts is not None:
+                            self.driver.note_intake_time(ts)
+                    self.driver.feed(entry)
+                else:
+                    self.runtime.logger.info(f"Not a transactions entry: {line[:200]}")
+                # malformed lines are still "absorbed" (logged + dropped by
+                # policy): their token joins the epoch so they are acked,
+                # never redelivered forever
+                if token is not None:
+                    self._epoch_tokens.append(token)
 
     def _enqueue_overflow(self, line: str) -> None:
         with self._overflow_lock:
@@ -532,10 +659,42 @@ class WorkerApp:
 
     # -- state ---------------------------------------------------------------
     def save_state(self) -> None:
+        """Snapshot device + alert state; in at-least-once mode this IS the
+        epoch commit: flush → checkpoint (with the dedup window) → ack. The
+        tokens are cleared only after the snapshot lands, so a failed save
+        leaves them unacked (the broker redelivers; dedup absorbs)."""
+        # the resume-save interval fires once at registration, which is
+        # before the intake wiring exists: plain snapshot, no epoch to commit
+        in_queue = getattr(self, "in_queue", None)
+        tokens: list = []
         with self._driver_lock:
             self.driver.flush()
-            if self.engine_resume:
+            if self._at_least_once and in_queue is not None:
+                tokens = self._epoch_tokens
+                if self.engine_resume:
+                    self._delivery_epoch += 1
+                    self.driver.save_resume(
+                        self.engine_resume,
+                        delivery={
+                            in_queue.queue_name: {
+                                "epoch": self._delivery_epoch,
+                                "dedup": list(self._dedup_fifo),
+                                "deduped_total": self._deduped_total,
+                            }
+                        },
+                    )
+                # no resume path configured: the "checkpoint" is process
+                # memory — still ack per epoch (commit-to-memory batching)
+                self._epoch_tokens = []
+            elif self.engine_resume:
                 self.driver.save_resume(self.engine_resume)
+        if tokens:
+            try:
+                in_queue.ack(tokens)
+            except Exception as e:
+                # unacked => redelivered later; the saved dedup window makes
+                # that a skip, not a double count
+                self.runtime.logger.error(f"Epoch ack failed (will redeliver): {e}")
         if self.alerts_resume:
             self.alerts_manager.save_resume(self.alerts_resume)
 

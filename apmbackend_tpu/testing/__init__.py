@@ -1,3 +1,9 @@
-"""Test affordances: fault injection for the transport fabric."""
+"""Test affordances: fault injection + crash chaos for the transport fabric."""
 
-from .chaos import ChaosChannel, ChaosStats  # noqa: F401
+from .chaos import (  # noqa: F401
+    ChaosChannel,
+    ChaosStats,
+    ChaosWorkerHarness,
+    SpoolChannel,
+    read_spool_cursor,
+)

@@ -26,14 +26,27 @@ wrapping the backend factory handed to ``QueueManager``::
     broker = MemoryBroker()
     chaos = ChaosChannel(MemoryChannel(broker), drop_p=0.1, seed=7)
     qm = QueueManager(lambda direction: chaos if direction == "p" else ...)
+
+**Process-level harness** (the kill−9 tier): :class:`SpoolChannel` is a
+durable file-backed broker whose consumer cursor only advances on ``ack()``
+— at-least-once semantics that survive SIGKILL of the consumer process —
+and :class:`ChaosWorkerHarness` spawns a REAL worker subprocess (the
+production ``WorkerApp`` epoch cycle, ``deliveryMode: atLeastOnce``) over
+such a spool, kills it −9 mid-stream, restarts it, and exposes the final
+engine snapshot + delivery stats so tests can assert the recovered run is
+EQUAL to a crash-free golden run. The chaos seams compose: the harness can
+inject duplicate deliveries (``dup_p``) on top of the kill/restart cycle.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import random
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Callable, List
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..transport.base import Channel
 
@@ -97,8 +110,31 @@ class ChaosChannel(Channel):
         return ok
 
     # -- consumer-side faults -------------------------------------------------
-    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str,
+                manual_ack: bool = False) -> None:
         from ..transport.base import accepts_headers
+
+        if manual_ack:
+
+            def chaotic(payload: bytes, headers=None, token=None) -> None:
+                # manual-ack semantics under chaos: a DROP leaves the token
+                # unacked on the broker ledger (delivery loss before
+                # processing — redelivered on close/restart, nothing is
+                # silently gone); a DUP replays the same payload+msg_id+token
+                # (the consumer's dedup window must catch it; double-acking
+                # one token is idempotent by the Channel contract)
+                if self.drop_p and self._rng.random() < self.drop_p:
+                    self.stats._bump("dropped")
+                    return
+                self.stats._bump("delivered")
+                callback(payload, headers, token)
+                if self.dup_p and self._rng.random() < self.dup_p:
+                    self.stats._bump("duplicated")
+                    self.stats._bump("delivered")
+                    callback(payload, headers, token)
+
+            self.inner.consume(name, chaotic, consumer_tag, manual_ack=True)
+            return
 
         wants_headers = accepts_headers(callback)
 
@@ -122,6 +158,9 @@ class ChaosChannel(Channel):
         self.inner.consume(name, chaotic, consumer_tag)
 
     # -- passthrough ----------------------------------------------------------
+    def ack(self, tokens) -> None:
+        self.inner.ack(tokens)
+
     def assert_queue(self, name: str) -> None:
         self.inner.assert_queue(name)
 
@@ -137,3 +176,459 @@ class ChaosChannel(Channel):
     def _fire_drain(self) -> None:
         for cb in list(self._drain_cbs):
             cb()
+
+
+# ---------------------------------------------------------------------------
+# Process-level harness: durable spool broker + kill−9 worker driver
+# ---------------------------------------------------------------------------
+
+
+class _SpoolQueue:
+    """Consumer-side view of one spool file: incremental record parsing plus
+    the acked-cursor bookkeeping."""
+
+    def __init__(self, directory: str, name: str):
+        self.path = os.path.join(directory, f"{name}.spool")
+        self.cursor_path = os.path.join(directory, f"{name}.cursor")
+        self.records: List[Tuple[bytes, Optional[dict]]] = []
+        self._buf = b""
+        self._read_off = 0
+        self.acked_upto = 0  # records [0, acked_upto) are committed
+        self._acked_set: set = set()
+        self.next_deliver = 0
+        if os.path.exists(self.cursor_path):
+            try:
+                with open(self.cursor_path, "r", encoding="utf-8") as fh:
+                    self.acked_upto = int(json.load(fh)["acked"])
+            except Exception:
+                self.acked_upto = 0  # torn cursor: redeliver from zero (safe)
+        self.next_deliver = self.acked_upto
+
+    def poll(self) -> None:
+        """Parse any newly appended COMPLETE records (a concurrently writing
+        producer may leave a partial trailing line — it stays buffered)."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            fh.seek(self._read_off)
+            chunk = fh.read()
+        if not chunk:
+            return
+        self._read_off += len(chunk)
+        self._buf += chunk
+        *lines, self._buf = self._buf.split(b"\n")
+        for line in lines:
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                self.records.append((rec["p"].encode("utf-8"), rec.get("h")))
+            except Exception:
+                # a mangled record is a poison message: skip it rather than
+                # wedging the queue forever
+                self.records.append((b"", None))
+
+    def ack(self, index: int) -> bool:
+        """Mark one record committed; returns True when the contiguous
+        cursor advanced (caller persists it)."""
+        if index < self.acked_upto:
+            return False  # idempotent re-ack
+        self._acked_set.add(index)
+        advanced = False
+        while self.acked_upto in self._acked_set:
+            self._acked_set.discard(self.acked_upto)
+            self.acked_upto += 1
+            advanced = True
+        return advanced
+
+    def persist_cursor(self) -> None:
+        tmp = self.cursor_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"acked": self.acked_upto}, fh)
+        os.replace(tmp, self.cursor_path)
+
+
+class SpoolChannel(Channel):
+    """Durable file-backed broker channel — the kill−9 fabric.
+
+    One append-only JSON-lines spool per queue under ``directory``; the
+    consumer's committed cursor lives in ``<queue>.cursor`` and is advanced
+    ONLY by ``ack()`` (atomic tmp+rename). SIGKILL the consumer process at
+    any instant and a fresh SpoolChannel resumes delivery from the last
+    committed cursor — everything delivered-but-unacked is redelivered, the
+    exact contract a durable AMQP queue with manual acks provides, minus the
+    network. ``send`` appends with flush (the producer/harness process
+    survives the chaos, so line-buffered append is durable enough).
+
+    Delivery is pumped (``deliver()`` / ``start_pump_thread``) like the
+    memory broker. Ack-on-receipt consumers advance the cursor at delivery;
+    manual-ack consumers receive ``(queue, index)`` tokens.
+    """
+
+    def __init__(self, directory: str, *, prefetch: int = 100000):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.prefetch = prefetch
+        self._queues: Dict[str, _SpoolQueue] = {}
+        # (tag, callback, manual) per queue
+        self._consumers: Dict[str, Tuple[str, Callable, bool]] = {}
+        self._send_fhs: Dict[str, object] = {}
+        self._lock = threading.RLock()
+        self._drain_cbs: List[Callable[[], None]] = []
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- Channel contract ----------------------------------------------------
+    def assert_queue(self, name: str) -> None:
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = _SpoolQueue(self.directory, name)
+
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        with self._lock:
+            self.assert_queue(name)
+            fh = self._send_fhs.get(name)
+            if fh is None:
+                fh = open(os.path.join(self.directory, f"{name}.spool"), "ab")
+                self._send_fhs[name] = fh
+            rec = json.dumps({"p": payload.decode("utf-8"), "h": headers})
+            fh.write(rec.encode("utf-8") + b"\n")
+            fh.flush()
+        return True
+
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str,
+                manual_ack: bool = False) -> None:
+        from ..transport.base import accepts_headers
+
+        if not manual_ack and not accepts_headers(callback):
+            inner = callback
+            callback = lambda payload, _h=None, _cb=inner: _cb(payload)  # noqa: E731
+        with self._lock:
+            self.assert_queue(name)
+            self._consumers[name] = (consumer_tag, callback, manual_ack)
+
+    def cancel(self, consumer_tag: str) -> None:
+        with self._lock:
+            self._consumers = {
+                q: c for q, c in self._consumers.items() if c[0] != consumer_tag
+            }
+
+    def ack(self, tokens) -> None:
+        with self._lock:
+            advanced: set = set()
+            for name, index in tokens:
+                q = self._queues.get(name)
+                if q is not None and q.ack(index):
+                    advanced.add(name)
+            for name in advanced:
+                self._queues[name].persist_cursor()
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        self._drain_cbs.append(callback)
+
+    def close(self) -> None:
+        self.stop()
+        with self._lock:
+            for fh in self._send_fhs.values():
+                try:
+                    fh.close()
+                except Exception:
+                    pass
+            self._send_fhs.clear()
+
+    # -- delivery ------------------------------------------------------------
+    def deliver(self, max_messages: Optional[int] = None) -> int:
+        delivered = 0
+        while max_messages is None or delivered < max_messages:
+            batch = []
+            with self._lock:
+                for name, (tag, cb, manual) in self._consumers.items():
+                    q = self._queues[name]
+                    q.poll()
+                    if q.next_deliver >= len(q.records):
+                        continue
+                    if manual and q.next_deliver - q.acked_upto >= self.prefetch:
+                        continue  # unacked ledger at the prefetch bound
+                    payload, headers = q.records[q.next_deliver]
+                    index = q.next_deliver
+                    q.next_deliver += 1
+                    if not manual and q.ack(index):
+                        q.persist_cursor()
+                    batch.append((cb, payload, headers, manual, (name, index)))
+            if not batch:
+                break
+            for cb, payload, headers, manual, token in batch:
+                if manual:
+                    cb(payload, headers, token)
+                else:
+                    cb(payload, headers)
+                delivered += 1
+        return delivered
+
+    def acked_count(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return q.acked_upto if q else 0
+
+    def delivered_count(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return q.next_deliver if q else 0
+
+    def start_pump_thread(self, poll_s: float = 0.005) -> None:
+        if self._pump_thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.is_set():
+                if self.deliver() == 0:
+                    self._stop.wait(poll_s)
+
+        self._pump_thread = threading.Thread(target=_loop, name="spool-pump", daemon=True)
+        self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+            self._pump_thread = None
+
+
+def read_spool_cursor(directory: str, queue: str) -> int:
+    """Committed (acked) record count for ``queue`` — the harness's view of
+    a (possibly dead) worker's progress, read straight off disk."""
+    path = os.path.join(os.path.abspath(directory), f"{queue}.cursor")
+    if not os.path.exists(path):
+        return 0
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return int(json.load(fh)["acked"])
+    except Exception:
+        return 0
+
+
+class ChaosWorkerHarness:
+    """Drive a REAL worker subprocess through kill−9 chaos.
+
+    The child is the production stack — ``WorkerApp`` with ``deliveryMode:
+    atLeastOnce`` over a :class:`SpoolChannel` (optionally chaos-wrapped with
+    duplicate injection) — launched via ``python -m
+    apmbackend_tpu.testing.chaos --child``. The harness appends tx lines to
+    the durable spool, watches the committed cursor, SIGKILLs / restarts the
+    child at will, and collects the final engine snapshot + delivery stats.
+
+    Crash-equivalence protocol (tests/test_chaos_harness.py): run one
+    harness to completion with no kills (golden), another over the same line
+    stream with kills + dup chaos, then compare the two final resume
+    snapshots array-for-array.
+    """
+
+    QUEUE = "transactions"
+
+    def __init__(self, workdir: str, *, dup_p: float = 0.0, seed: int = 0,
+                 capacity: int = 64, save_every_s: float = 0.4):
+        import sys
+
+        self.workdir = os.path.abspath(workdir)
+        self.spool_dir = os.path.join(self.workdir, "spool")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        self.resume_path = os.path.join(self.workdir, "engine.resume.npz")
+        self.stats_path = os.path.join(self.workdir, "stats.json")
+        self.done_path = os.path.join(self.workdir, "DONE")
+        self.log_path = os.path.join(self.workdir, "child.log")
+        self.dup_p = dup_p
+        self.seed = seed
+        self.capacity = capacity
+        self.save_every_s = save_every_s
+        self.python = sys.executable
+        self.proc = None
+        self.generation = 0
+        self._seq = 0
+        self._producer = SpoolChannel(self.spool_dir)
+
+    # -- stream --------------------------------------------------------------
+    def send_line(self, line: str) -> None:
+        self._seq += 1
+        self._producer.send(
+            self.QUEUE, line.encode("utf-8"),
+            {"ingest_ts": time.time(), "msg_id": f"h-{self._seq}"},
+        )
+
+    @property
+    def sent(self) -> int:
+        return self._seq
+
+    # -- child lifecycle -----------------------------------------------------
+    def start(self):
+        import subprocess
+
+        assert self.proc is None or self.proc.poll() is not None
+        self.generation += 1
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PYTHONPATH", None)  # no TPU-relay sitecustomize in children
+        log_fh = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [
+                self.python, "-m", "apmbackend_tpu.testing.chaos", "--child",
+                "--spool-dir", self.spool_dir,
+                "--resume", self.resume_path,
+                "--queue", self.QUEUE,
+                "--stats-out", self.stats_path,
+                "--done-file", self.done_path,
+                "--capacity", str(self.capacity),
+                "--save-every-s", str(self.save_every_s),
+                "--dup-p", str(self.dup_p),
+                "--seed", str(self.seed + self.generation),
+            ],
+            stdout=log_fh, stderr=log_fh, stdin=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            env=env,
+        )
+        log_fh.close()
+        return self.proc
+
+    def kill9(self) -> None:
+        """SIGKILL: no atexit, no signal handler, no flush — the real thing."""
+        import signal as _signal
+
+        if self.proc is not None and self.proc.poll() is None:
+            os.kill(self.proc.pid, _signal.SIGKILL)
+            self.proc.wait(timeout=30)
+
+    def acked(self) -> int:
+        return read_spool_cursor(self.spool_dir, self.QUEUE)
+
+    def wait_acked(self, n: int, timeout_s: float = 120.0) -> int:
+        """Block until the committed cursor reaches ``n`` (or timeout); the
+        kill-point selector for mid-stream SIGKILLs."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = self.acked()
+            if got >= n:
+                return got
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"chaos child exited rc={self.proc.returncode} before acking {n} "
+                    f"(got {got}); see {self.log_path}"
+                )
+            time.sleep(0.02)
+        raise TimeoutError(f"cursor stuck at {self.acked()} < {n}; see {self.log_path}")
+
+    def finish(self, timeout_s: float = 180.0) -> dict:
+        """Signal end-of-stream, wait for the child's final epoch commit and
+        graceful exit, and return its stats JSON."""
+        tmp = self.done_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"total": self._seq}, fh)
+        os.replace(tmp, self.done_path)
+        rc = self.proc.wait(timeout=timeout_s)
+        if rc != 0:
+            raise RuntimeError(f"chaos child exit rc={rc}; see {self.log_path}")
+        with open(self.stats_path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+
+    def close(self) -> None:
+        self.kill9()
+        self._producer.close()
+
+
+def _child_main(argv=None) -> int:
+    """The harness child: the production worker epoch cycle over a spool.
+
+    Everything between SpoolChannel and the engine snapshot is the REAL
+    production code path — WorkerApp's at-least-once consume/dedup/epoch
+    logic, PipelineDriver's checkpoint — not a test double. The only
+    harness-specific parts are the spool transport and the DONE/stats
+    files."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="apmbackend_tpu.testing.chaos --child")
+    ap.add_argument("--spool-dir", required=True)
+    ap.add_argument("--resume", required=True)
+    ap.add_argument("--queue", default="transactions")
+    ap.add_argument("--stats-out", required=True)
+    ap.add_argument("--done-file", required=True)
+    ap.add_argument("--capacity", type=int, default=64)
+    ap.add_argument("--save-every-s", type=float, default=0.4)
+    ap.add_argument("--dup-p", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from ..config import default_config
+    from ..runtime.module_base import ModuleRuntime
+    from ..runtime.worker import WorkerApp
+    from ..transport.base import QueueManager
+
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = args.capacity
+    eng["samplesPerBucket"] = 64
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["resumeFileFullPath"] = args.resume
+    eng["metricsPort"] = None
+    cfg["streamCalcZScore"]["defaults"] = [{"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["inQueue"] = args.queue
+    # the resume-save timer IS the epoch cadence: short, so SIGKILLs land at
+    # arbitrary points relative to commits
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = args.save_every_s
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+
+    runtime = ModuleRuntime(
+        "tpuEngine", config=cfg, install_signals=True, console_log=True
+    )
+    spools = {}
+
+    def factory(direction: str):
+        ch = SpoolChannel(args.spool_dir)
+        spools[direction] = ch
+        if direction == "c" and args.dup_p > 0:
+            return ChaosChannel(ch, dup_p=args.dup_p, seed=args.seed)
+        return ch
+
+    runtime.qm = QueueManager(factory, 3600, logger=runtime.logger)
+    worker = WorkerApp(runtime)
+    consumer = spools["c"]
+    consumer.start_pump_thread()
+
+    total = None
+    while True:
+        if total is None and os.path.exists(args.done_file):
+            try:
+                with open(args.done_file, "r", encoding="utf-8") as fh:
+                    total = int(json.load(fh)["total"])
+            except Exception:
+                total = None
+        if total is not None and consumer.delivered_count(args.queue) >= total:
+            # stream fully delivered: force the final epoch commit and stop
+            # once every record is acked (committed)
+            worker.save_state()
+            if consumer.acked_count(args.queue) >= total:
+                break
+        time.sleep(0.02)
+
+    consumer.stop()
+    worker.shutdown()  # final save_state + ack inside
+    stats = {
+        "epoch": worker._delivery_epoch,
+        "deduped_total": worker._deduped_total,
+        "unacked": len(worker._epoch_tokens),
+        "acked": consumer.acked_count(args.queue),
+        "services": worker.driver.registry.count,
+        "latest_label": worker.driver._latest_label,
+    }
+    tmp = args.stats_out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(stats, fh)
+    os.replace(tmp, args.stats_out)
+    runtime.stop_timers()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--child" in sys.argv:
+        sys.argv.remove("--child")
+        sys.exit(_child_main(sys.argv[1:]))
+    raise SystemExit("usage: python -m apmbackend_tpu.testing.chaos --child ...")

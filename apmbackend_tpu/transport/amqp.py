@@ -23,9 +23,23 @@ Node's channel ``write`` return + ``drain`` event for flow control
   producer buffer and emits ``resume`` once all are empty.
 - **Publisher confirms.** The publish channel runs in confirm mode; a
   nacked/unroutable publish re-queues the line rather than losing it.
-- **Reconnect.** Either thread rebuilds its connection with exponential
-  backoff after an AMQP failure, re-declaring queues and re-installing
-  consumers (crash-only design, like the supervisor restarting a module).
+- **At-least-once consumption.** ``consume(..., manual_ack=True)`` installs
+  the consumer without the ack-on-receipt shortcut: the channel runs
+  ``basic_qos(prefetch_count)`` so the broker bounds in-flight deliveries,
+  the callback receives a ``(generation, delivery_tag)`` token, and
+  ``ack(tokens)`` marshals ``basic_ack`` onto the consumer thread (pika is
+  not thread-safe). Tokens from a previous connection generation are
+  silently dropped — the broker already requeued those deliveries when the
+  old connection died, which is exactly the redelivery the consumer's
+  msg_id dedup absorbs. ``headers["redelivered"]`` is set from the AMQP
+  redelivered flag.
+- **Reconnect.** Either thread rebuilds its connection after an AMQP
+  failure with *decorrelated-jitter* backoff (sleep ~ U(base, 3·prev),
+  capped): a restarted broker facing ~10 reconnecting modules must not be
+  thundering-herded in lockstep, which deterministic doubling from the
+  same 0.5 s base guarantees. Queues are re-declared and consumers
+  re-installed (crash-only design, like the supervisor restarting a
+  module).
 
 Wire format on the queues is identical (UTF-8 pipe-CSV), so a deployment with
 RabbitMQ interoperates with reference modules consuming the same queues.
@@ -38,6 +52,7 @@ pause->buffer->drain->resume stack against a faithful in-process fake broker
 from __future__ import annotations
 
 import queue as pyqueue
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -67,6 +82,9 @@ class AmqpChannel(Channel):
         drain_low_water: Optional[int] = None,
         poll_interval_s: float = 0.05,
         reconnect_max_backoff_s: float = 10.0,
+        reconnect_base_backoff_s: float = 0.5,
+        prefetch_count: int = 1000,
+        jitter_rng: Optional[random.Random] = None,
     ):
         self._pika = pika_module if pika_module is not None else pika
         if self._pika is None:
@@ -81,6 +99,9 @@ class AmqpChannel(Channel):
         self._logger = logger
         self._poll_s = poll_interval_s
         self._max_backoff_s = reconnect_max_backoff_s
+        self._base_backoff_s = reconnect_base_backoff_s
+        self._jitter = jitter_rng if jitter_rng is not None else random.Random()
+        self._prefetch = int(prefetch_count)
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._queues: Set[str] = set()
@@ -95,8 +116,12 @@ class AmqpChannel(Channel):
         self._pending_pub: Optional[Tuple[str, bytes, Optional[dict]]] = None  # in-flight publish
 
         # consumer side: pending (op, args) requests + active consumers
+        # (queue, callback, manual_ack). _conn_gen stamps every manual-ack
+        # token so acks for a dead connection's delivery tags are dropped
+        # instead of poisoning the new channel's tag space.
         self._consumer_ops: List[Tuple[str, tuple]] = []
-        self._consumers: Dict[str, Tuple[str, Callable[[bytes], None]]] = {}
+        self._consumers: Dict[str, Tuple[str, Callable[[bytes], None], bool]] = {}
+        self._conn_gen = 0
 
         target = self._publisher_loop if direction == "p" else self._consumer_loop
         self._thread = threading.Thread(
@@ -124,18 +149,31 @@ class AmqpChannel(Channel):
             self._pressure = True
             return False
 
-    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str,
+                manual_ack: bool = False) -> None:
         if self._direction != "c":
             raise RuntimeError("consume() on a producer-direction channel")
         from .base import accepts_headers
 
-        if not accepts_headers(callback):
+        if not manual_ack and not accepts_headers(callback):
             inner = callback
             callback = lambda payload, _headers=None, _cb=inner: _cb(payload)  # noqa: E731
         with self._lock:
             self._queues.add(name)
-            self._consumers[consumer_tag] = (name, callback)
-            self._consumer_ops.append(("consume", (name, callback, consumer_tag)))
+            self._consumers[consumer_tag] = (name, callback, manual_ack)
+            self._consumer_ops.append(("consume", (name, callback, consumer_tag, manual_ack)))
+
+    def ack(self, tokens) -> None:
+        """Commit manual-ack deliveries: marshalled onto the consumer thread
+        (pika is not thread-safe). Stale-generation tokens are dropped — the
+        broker requeued those deliveries when their connection died."""
+        if self._direction != "c":
+            raise RuntimeError("ack() on a producer-direction channel")
+        toks = list(tokens)
+        if not toks:
+            return
+        with self._lock:
+            self._consumer_ops.append(("ack", (toks,)))
 
     def cancel(self, consumer_tag: str) -> None:
         with self._lock:
@@ -194,6 +232,18 @@ class AmqpChannel(Channel):
                     if self._logger:
                         self._logger.error(f"AMQP drain callback error: {e}")
 
+    def _next_backoff(self, prev: float) -> float:
+        """Decorrelated-jitter reconnect delay: ~U(base, 3·prev), capped.
+
+        Pure doubling from the shared 0.5 s base marches every module's
+        reconnect attempt in lockstep — a restarted broker then takes the
+        whole fleet's connection storm on the same beat. Jitter decorrelates
+        the herd while keeping the exponential envelope."""
+        return min(
+            self._max_backoff_s,
+            self._jitter.uniform(self._base_backoff_s, max(prev * 3.0, self._base_backoff_s)),
+        )
+
     def _connect(self):
         conn = self._pika.BlockingConnection(self._pika.URLParameters(self._url))
         ch = conn.channel()
@@ -207,7 +257,7 @@ class AmqpChannel(Channel):
             declared.add(q)
 
     def _publisher_loop(self) -> None:
-        backoff = 0.5
+        backoff = self._base_backoff_s
         while not self._stop.is_set():
             conn = None
             try:
@@ -216,7 +266,7 @@ class AmqpChannel(Channel):
                 conn.add_on_connection_blocked_callback(self._on_blocked)
                 conn.add_on_connection_unblocked_callback(self._on_unblocked)
                 self._blocked = False
-                backoff = 0.5
+                backoff = self._base_backoff_s
                 declared: Set[str] = set()
                 while not self._stop.is_set():
                     self._declare_new(ch, declared)
@@ -252,45 +302,86 @@ class AmqpChannel(Channel):
                     break
                 if self._logger:
                     self._logger.error(f"AMQP publisher connection error (reconnecting): {e}")
+                backoff = self._next_backoff(backoff)
                 time.sleep(backoff)
-                backoff = min(backoff * 2, self._max_backoff_s)
             finally:
                 self._close_quietly(conn)
 
     # -- consumer thread -----------------------------------------------------
     def _consumer_loop(self) -> None:
-        backoff = 0.5
+        backoff = self._base_backoff_s
         while not self._stop.is_set():
             conn = None
             try:
                 conn, ch = self._connect()
-                backoff = 0.5
+                backoff = self._base_backoff_s
                 declared: Set[str] = set()
-                # re-install consumers that survived a reconnect
+                # every (re)connect starts a new token generation; the broker
+                # bounds manual-ack in-flight via prefetch (without it a slow
+                # epoch would pile the whole queue into process memory)
                 with self._lock:
-                    ops = [("consume", (q, cb, tag)) for tag, (q, cb) in self._consumers.items()]
+                    self._conn_gen += 1
+                    gen = self._conn_gen
+                    # re-install consumers that survived a reconnect
+                    ops = [
+                        ("consume", (q, cb, tag, manual))
+                        for tag, (q, cb, manual) in self._consumers.items()
+                    ]
                     self._consumer_ops = [
                         op for op in self._consumer_ops if op[0] != "consume"
                     ] + ops
+                    any_manual = any(m for _q, _cb, m in self._consumers.values())
+                if any_manual and hasattr(ch, "basic_qos"):
+                    ch.basic_qos(prefetch_count=self._prefetch)
+                qos_set = any_manual
                 while not self._stop.is_set():
                     with self._lock:
                         ops, self._consumer_ops = self._consumer_ops, []
                     for op, args in ops:
                         if op == "consume":
-                            q, cb, tag = args
+                            q, cb, tag, manual = args
+                            if manual and not qos_set and hasattr(ch, "basic_qos"):
+                                ch.basic_qos(prefetch_count=self._prefetch)
+                                qos_set = True
                             if q not in declared:
                                 ch.queue_declare(queue=q, durable=True)
                                 declared.add(q)
 
-                            def _on_message(mch, method, properties, body, _cb=cb):
-                                # ack-on-receipt: at-most-once past this point
-                                # (queue.js:277-283 semantics)
-                                mch.basic_ack(delivery_tag=method.delivery_tag)
-                                _cb(body, getattr(properties, "headers", None))
+                            if manual:
+
+                                def _on_message(mch, method, properties, body,
+                                                _cb=cb, _gen=gen):
+                                    # at-least-once: NO ack here — the token
+                                    # rides to the consumer, which commits it
+                                    # after its checkpoint (epoch ack)
+                                    headers = getattr(properties, "headers", None)
+                                    if getattr(method, "redelivered", False):
+                                        headers = dict(headers or {})
+                                        headers["redelivered"] = True
+                                    _cb(body, headers, (_gen, method.delivery_tag))
+
+                            else:
+
+                                def _on_message(mch, method, properties, body, _cb=cb):
+                                    # ack-on-receipt: at-most-once past this
+                                    # point (queue.js:277-283 semantics)
+                                    mch.basic_ack(delivery_tag=method.delivery_tag)
+                                    _cb(body, getattr(properties, "headers", None))
 
                             ch.basic_consume(
                                 queue=q, on_message_callback=_on_message, consumer_tag=tag
                             )
+                        elif op == "ack":
+                            (toks,) = args
+                            for tok in toks:
+                                tgen, dtag = tok
+                                if tgen != gen:
+                                    continue  # dead connection: broker requeued it
+                                try:
+                                    ch.basic_ack(delivery_tag=dtag)
+                                except Exception as e:
+                                    if self._logger:
+                                        self._logger.error(f"AMQP basic_ack error: {e}")
                         else:  # cancel
                             (tag,) = args
                             try:
@@ -304,8 +395,8 @@ class AmqpChannel(Channel):
                     break
                 if self._logger:
                     self._logger.error(f"AMQP consumer connection error (reconnecting): {e}")
+                backoff = self._next_backoff(backoff)
                 time.sleep(backoff)
-                backoff = min(backoff * 2, self._max_backoff_s)
             finally:
                 self._close_quietly(conn)
 

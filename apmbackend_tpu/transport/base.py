@@ -10,9 +10,19 @@ Contract parity with queue.js:
   the parser additionally creates the tail pause file.
 - On drain, the manager retries every producer buffer; once ALL buffers are
   empty a global ``resume`` event fires (queue.js:88-106).
-- ``ConsumerQueue``: messages are acked on receipt, before processing
-  (at-most-once past the ack, queue.js:277-283). ``start_consume`` /
-  ``stop_consume`` toggle delivery.
+- ``ConsumerQueue``: by default messages are acked on receipt, before
+  processing (at-most-once past the ack, queue.js:277-283). ``start_consume``
+  / ``stop_consume`` toggle delivery.
+
+**At-least-once mode** (``manual_ack=True``, no reference equivalent): the
+backend defers the ack until the consumer explicitly commits it. The callback
+receives ``cb(payload, headers, token)`` and the consumer calls
+``ConsumerQueue.ack(tokens)`` once the processing layer has made the
+messages' effects durable (the worker's ack-after-checkpoint epoch cycle,
+runtime/worker.py). Unacked messages are redelivered — on channel close /
+broker bounce for the memory backend, by the broker itself for AMQP — with
+``headers["redelivered"]`` set when the backend knows; consumers dedup
+redeliveries by the producer-stamped ``msg_id`` header.
 
 Backends: :mod:`.memory` (bounded in-process queues — the fake broker the
 reference never had, SURVEY.md §4) and :mod:`.amqp` (RabbitMQ via an AMQP
@@ -22,6 +32,7 @@ client when available).
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 import time
 from collections import defaultdict
@@ -73,7 +84,22 @@ class Channel:
         stamp); a backend that cannot carry it may drop it."""
         raise NotImplementedError
 
-    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str) -> None:
+    def consume(
+        self,
+        name: str,
+        callback: Callable[[bytes], None],
+        consumer_tag: str,
+        manual_ack: bool = False,
+    ) -> None:
+        """``manual_ack=True`` switches the queue to at-least-once delivery:
+        the callback is invoked ``cb(payload, headers, token)`` and the
+        message stays on the broker's unacked ledger until ``ack([token])``.
+        Backends that cannot defer acks raise."""
+        raise NotImplementedError
+
+    def ack(self, tokens) -> None:
+        """Commit manual-ack deliveries (idempotent; unknown/stale tokens are
+        ignored — the broker will redeliver whatever was never acked)."""
         raise NotImplementedError
 
     def cancel(self, consumer_tag: str) -> None:
@@ -99,6 +125,12 @@ class ProducerQueue(EventEmitter):
         self.paused = False
         self.type = "p"
         self._lock = threading.Lock()
+        # message-id stamp for at-least-once consumers: unique across
+        # producers and producer restarts (redelivered messages carry the
+        # ORIGINAL id — the broker retains headers — so consumers dedup on
+        # it). One string concat per line; at-most-once consumers ignore it.
+        self._msg_prefix = f"{os.getpid():x}-{os.urandom(4).hex()}-"
+        self._msg_seq = 0
         self.queue_stats.add_counter(queue_name, "p")
         channel.assert_queue(queue_name)
 
@@ -136,8 +168,10 @@ class ProducerQueue(EventEmitter):
 
     def write_line(self, line: str, verbose: bool = False) -> None:
         # the transport-entry stamp: every message carries when it entered
-        # the fabric, the anchor of the ingest->emit/alert latency series
-        headers = {"ingest_ts": time.time()}
+        # the fabric, the anchor of the ingest->emit/alert latency series —
+        # plus the unique msg_id at-least-once consumers dedup redeliveries by
+        self._msg_seq += 1
+        headers = {"ingest_ts": time.time(), "msg_id": self._msg_prefix + str(self._msg_seq)}
         with self._lock:
             entered_pause = self._send_locked(line, headers, verbose)
         if entered_pause:
@@ -173,6 +207,7 @@ class ConsumerQueue(EventEmitter):
         queue_stats: QueueStats,
         consume_cb: Callable[[str], None],
         logger=None,
+        manual_ack: bool = False,
     ):
         super().__init__()
         self.queue_name = queue_name
@@ -183,6 +218,10 @@ class ConsumerQueue(EventEmitter):
         self.consumer_tag = f"xConsumerTagx-{queue_name}"
         self.is_consuming = False
         self.type = "c"
+        # at-least-once mode: deliveries stay unacked on the broker until the
+        # consumer commits them via ack(tokens); consume_cb must then take
+        # (line, headers, token)
+        self.manual_ack = manual_ack
         self.queue_stats.add_counter(queue_name, "c")
         # resolved ONCE (this runs per message): does the consumer want the
         # transport headers, and the queue-wait histogram instrument
@@ -209,10 +248,30 @@ class ConsumerQueue(EventEmitter):
         else:
             self.consume_cb(payload.decode("utf-8"))
 
+    def _wrapped_manual(self, payload: bytes, headers: Optional[dict], token) -> None:
+        # At-least-once: the broker still holds this message on its unacked
+        # ledger; the consumer owes ack([token]) after its effect is durable.
+        self.queue_stats.incr(self.queue_name)
+        if headers:
+            ts = headers.get("ingest_ts")
+            if ts is not None:
+                self._wait_hist.observe(time.time() - ts)
+        self.consume_cb(payload.decode("utf-8"), headers, token)
+
+    def ack(self, tokens) -> None:
+        """Commit manual-ack deliveries (the epoch-commit hook)."""
+        self.channel.ack(tokens)
+
     def start_consume(self) -> None:
         if not self.is_consuming:
             self.is_consuming = True
-            self.channel.consume(self.queue_name, self._wrapped, self.consumer_tag)
+            if self.manual_ack:
+                self.channel.consume(
+                    self.queue_name, self._wrapped_manual, self.consumer_tag,
+                    manual_ack=True,
+                )
+            else:
+                self.channel.consume(self.queue_name, self._wrapped, self.consumer_tag)
 
     def stop_consume(self) -> None:
         self.is_consuming = False
@@ -248,7 +307,7 @@ class QueueManager(EventEmitter):
         if total == 0:
             self.emit("resume")
 
-    def get_queue(self, queue_name: str, qtype: str, consume_cb=None):
+    def get_queue(self, queue_name: str, qtype: str, consume_cb=None, *, manual_ack: bool = False):
         if queue_name in self.queue_map:
             return self.queue_map[queue_name]
         if qtype not in ("p", "c"):
@@ -265,7 +324,10 @@ class QueueManager(EventEmitter):
         else:
             if self.consumer_channel is None:
                 self.consumer_channel = self._backend_factory("c")
-            queue = ConsumerQueue(queue_name, self.consumer_channel, self.queue_stats, consume_cb, self.logger)
+            queue = ConsumerQueue(
+                queue_name, self.consumer_channel, self.queue_stats, consume_cb,
+                self.logger, manual_ack=manual_ack,
+            )
         self.queue_map[queue_name] = queue
         return queue
 

@@ -9,6 +9,14 @@ by a background thread (live mode).
 The broker object is shareable between modules in one process, standing in for
 the external RabbitMQ server; queue depth/memory introspection mirrors what
 ``rabbitmqctl list_queues`` provided the manager (apm_manager.js:429-453).
+
+At-least-once (``manual_ack``) consumers get RabbitMQ's unacked-ledger
+semantics: a delivered message moves to the broker's unacked map instead of
+vanishing, ``ack(tokens)`` discards it, and anything still unacked when the
+consumer channel closes — or when :meth:`MemoryBroker.bounce` simulates a
+broker restart — is requeued at the FRONT of its queue with
+``headers["redelivered"]`` set, exactly what a real broker does after a
+consumer dies mid-flight.
 """
 
 from __future__ import annotations
@@ -26,8 +34,8 @@ class _NamedQueue:
         # (payload, headers) pairs — headers carry the transport-entry
         # ingest_ts stamp through the fake broker like AMQP properties would
         self.items: deque = deque()
-        # (tag, callback, wants_headers)
-        self.consumers: List[Tuple[str, Callable, bool]] = []
+        # (tag, callback, wants_headers, manual_ack)
+        self.consumers: List[Tuple[str, Callable, bool, bool]] = []
 
 
 class MemoryBroker:
@@ -43,6 +51,11 @@ class MemoryBroker:
         self._pump_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._work = threading.Event()
+        # manual-ack ledger: token -> (queue_name, payload, headers), in
+        # delivery order (dict preserves insertion order — requeue walks it
+        # newest-last so redelivery keeps the original FIFO)
+        self._unacked: Dict[int, Tuple[str, bytes, Optional[dict]]] = {}
+        self._next_token = 0
 
     # -- queue admin ---------------------------------------------------------
     def assert_queue(self, name: str) -> None:
@@ -80,40 +93,84 @@ class MemoryBroker:
             self._drain_callbacks.append(callback)
 
     # -- consumer side -------------------------------------------------------
-    def consume(self, name: str, callback: Callable[[bytes], None], tag: str) -> None:
+    def consume(self, name: str, callback: Callable[[bytes], None], tag: str,
+                manual_ack: bool = False) -> None:
         with self._lock:
             q = self._queues[name]
-            if not any(t == tag for t, _cb, _h in q.consumers):
-                q.consumers.append((tag, callback, accepts_headers(callback)))
+            if not any(t == tag for t, _cb, _h, _m in q.consumers):
+                q.consumers.append((tag, callback, accepts_headers(callback), manual_ack))
         self._work.set()
 
     def cancel(self, tag: str) -> None:
+        # cancel does NOT requeue unacked deliveries: pause/resume cycles
+        # cancel and the in-flight epoch must keep its tokens ackable
         with self._lock:
             for q in self._queues.values():
                 q.consumers = [c for c in q.consumers if c[0] != tag]
+
+    def ack(self, tokens) -> None:
+        """Discard manual-ack deliveries (idempotent; stale tokens ignored)."""
+        with self._lock:
+            for t in tokens:
+                self._unacked.pop(t, None)
+
+    def unacked_count(self, name: Optional[str] = None) -> int:
+        with self._lock:
+            if name is None:
+                return len(self._unacked)
+            return sum(1 for q, _p, _h in self._unacked.values() if q == name)
+
+    def requeue_unacked(self) -> int:
+        """Requeue every unacked delivery at the FRONT of its queue (original
+        order preserved), marking ``headers["redelivered"]`` — what RabbitMQ
+        does when a consumer connection dies. Returns the requeue count."""
+        with self._lock:
+            pending = list(self._unacked.items())
+            self._unacked.clear()
+            for _tok, (name, payload, headers) in reversed(pending):
+                headers = dict(headers or {})
+                headers["redelivered"] = True
+                self._queues[name].items.appendleft((payload, headers))
+        if pending:
+            self._work.set()
+        return len(pending)
+
+    def bounce(self) -> int:
+        """Simulate a broker restart for chaos tests: redeliver everything
+        unacked. (Acked messages were already removed — durability holds.)"""
+        return self.requeue_unacked()
 
     # -- delivery ------------------------------------------------------------
     def pump(self, max_messages: Optional[int] = None) -> int:
         """Deliver pending messages to registered consumers; returns count.
 
-        Messages are removed before the callback runs (ack-on-receipt).
+        Ack-on-receipt consumers get the message removed before the callback
+        runs; manual-ack consumers get it moved to the unacked ledger and a
+        token as third callback arg.
         """
         delivered = 0
         while max_messages is None or delivered < max_messages:
             with self._lock:
                 batch = []
                 budget = None if max_messages is None else max_messages - delivered
-                for q in self._queues.values():
+                for qname, q in self._queues.items():
                     if budget is not None and len(batch) >= budget:
                         break
                     if q.consumers and q.items:
                         payload, headers = q.items.popleft()
-                        _tag, cb, wants_headers = q.consumers[0]
-                        batch.append((cb, payload, headers, wants_headers))
+                        _tag, cb, wants_headers, manual = q.consumers[0]
+                        token = None
+                        if manual:
+                            self._next_token += 1
+                            token = self._next_token
+                            self._unacked[token] = (qname, payload, headers)
+                        batch.append((cb, payload, headers, wants_headers, manual, token))
                 if not batch:
                     break
-            for cb, payload, headers, wants_headers in batch:
-                if wants_headers:
+            for cb, payload, headers, wants_headers, manual, token in batch:
+                if manual:
+                    cb(payload, headers, token)
+                elif wants_headers:
                     cb(payload, headers)
                 else:
                     cb(payload)
@@ -166,11 +223,21 @@ class MemoryChannel(Channel):
     def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
         return self.broker.send(name, payload, headers)
 
-    def consume(self, name: str, callback, consumer_tag: str) -> None:
-        self.broker.consume(name, callback, consumer_tag)
+    def consume(self, name: str, callback, consumer_tag: str, manual_ack: bool = False) -> None:
+        self.broker.consume(name, callback, consumer_tag, manual_ack=manual_ack)
+
+    def ack(self, tokens) -> None:
+        self.broker.ack(tokens)
 
     def cancel(self, consumer_tag: str) -> None:
         self.broker.cancel(consumer_tag)
 
     def on_drain(self, callback) -> None:
         self.broker.on_drain(callback)
+
+    def close(self) -> None:
+        # redelivery-on-close: a closing consumer channel abandons its
+        # unacked deliveries back to the queues (RabbitMQ connection-death
+        # semantics) so the next consumer — or the restarted process on a
+        # shared broker — sees them again
+        self.broker.requeue_unacked()

@@ -66,7 +66,10 @@ def load_resume_file(path: str, *, logger=None) -> Optional[Any]:
     try:
         with open(path, "r", encoding="utf-8") as fh:
             return _revive(json.load(fh))
-    except (json.JSONDecodeError, OSError):
+    except (ValueError, OSError):
+        # ValueError covers JSONDecodeError AND UnicodeDecodeError: a torn
+        # write can truncate mid-multibyte-sequence, which fails the utf-8
+        # decode before the JSON parser ever runs — both mean "start fresh"
         if logger:
             logger.error(f"Could not parse JSON content from resume file: {path}")
         return None

@@ -128,6 +128,120 @@ def _measure(ticks: int, tx_per_tick: int, services: int, capacity: int, telemet
     }
 
 
+def _measure_delivery(quick: bool) -> dict:
+    """ISSUE 3 acceptance: at-least-once epoch cadence ON vs OFF.
+
+    The same transport->driver loop twice at the reference's real density —
+    at-most-once (ack-on-receipt, no commits) vs at-least-once (manual-ack
+    consumer, msg_id dedup window, and every 6 ticks the full epoch commit:
+    flush -> atomic npz checkpoint with the delivery tree -> batch ack).
+    Reports lines/s both ways; the delta IS the durability price."""
+    import os
+    import shutil
+    import tempfile
+    from collections import deque
+
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import EntryFactory
+    from apmbackend_tpu.pipeline import PipelineDriver
+    from apmbackend_tpu.transport.base import QueueManager
+    from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+    ticks = 8 if quick else 48
+    per_tick = 128  # ~reference density over ~100 services
+    commit_every = 6
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 128
+    cfg["tpuEngine"]["samplesPerBucket"] = 64
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1}
+    ]
+    base = 170_100_000
+    rng = np.random.RandomState(1)
+    stream = []
+    for t in range(ticks + 2):
+        for i in range(per_tick):
+            e = int(rng.randint(50, 900))
+            stream.append(
+                f"tx|jvm{i % 4}|svc{i % 100:03d}|b{t}-{i}|1|{(base + t) * 10000 - e}|"
+                f"{(base + t) * 10000 + i}|{e}|Y"
+            )
+
+    def one(mode: str) -> float:
+        tmpd = tempfile.mkdtemp(prefix="bench_alo_")
+        resume = os.path.join(tmpd, "engine.npz")
+        drv = PipelineDriver(cfg, capacity=128)
+        fac = EntryFactory()
+        broker = MemoryBroker()
+        prod = QueueManager(lambda d: MemoryChannel(broker), 3600).get_queue("transactions", "p")
+        qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        epochs = 0
+        if mode == "alo":
+            dedup: set = set()
+            fifo: deque = deque()
+            tokens: list = []
+
+            def cb(line, h, tok):
+                mid = (h or {}).get("msg_id")
+                if mid in dedup:
+                    return
+                dedup.add(mid)
+                fifo.append(mid)
+                if len(fifo) > 65536:
+                    dedup.discard(fifo.popleft())
+                drv.feed(fac.from_csv(line))
+                tokens.append(tok)
+
+            cons = qm_c.get_queue("transactions", "c", cb, manual_ack=True)
+        else:
+            cons = qm_c.get_queue("transactions", "c", lambda line: drv.feed(fac.from_csv(line)))
+        cons.start_consume()
+
+        def commit():
+            nonlocal epochs, tokens
+            epochs += 1
+            drv.flush()
+            drv.save_resume(
+                resume,
+                delivery={"transactions": {"epoch": epochs, "dedup": list(fifo)}},
+            )
+            cons.ack(tokens)
+            tokens = []
+
+        # warmup (compile) on the first 2 ticks, measured loop after
+        for line in stream[: 2 * per_tick]:
+            prod.write_line(line)
+        broker.pump()
+        if mode == "alo":
+            commit()
+        t0 = time.perf_counter()
+        for t in range(ticks):
+            lo = (t + 2) * per_tick
+            for line in stream[lo : lo + per_tick]:
+                prod.write_line(line)
+            broker.pump()
+            if mode == "alo" and (t + 1) % commit_every == 0:
+                commit()
+        if mode == "alo":
+            commit()  # tail epoch: nothing unacked at the end
+        wall = time.perf_counter() - t0
+        if mode == "alo":
+            assert broker.unacked_count() == 0
+        shutil.rmtree(tmpd, ignore_errors=True)
+        return ticks * per_tick / wall
+
+    amo = one("amo")
+    alo = one("alo")
+    return {
+        "lines_per_s_at_most_once": round(amo, 1),
+        "lines_per_s_at_least_once": round(alo, 1),
+        "overhead_pct": round((amo - alo) / amo * 100.0, 2),
+        "commit_every_ticks": commit_every,
+        "ticks": ticks,
+        "tx_per_tick": per_tick,
+    }
+
+
 def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tick: int = 4096) -> dict:
     import jax
 
@@ -138,6 +252,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
     bare = _measure(ticks, tx_per_tick, services, capacity, telemetry=False)
     teleme = _measure(ticks, tx_per_tick, services, capacity, telemetry=True)
     overhead_pct = (bare["throughput"] - teleme["throughput"]) / bare["throughput"] * 100.0
+    delivery = _measure_delivery(quick)
 
     tick, sched, lat, rebuilds = bare["tick"], bare["sched"], bare["lat"], bare["rebuilds"]
     return result(
@@ -170,5 +285,8 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
                 "scrapes_during_run": teleme["scrapes"],
                 "tick_latency_on": latency_stats_ms(teleme["lat"]),
             },
+            # ISSUE 3 acceptance: at-least-once epoch checkpoint+ack cadence
+            # vs the at-most-once default, same stream same process
+            "delivery": delivery,
         },
     )

@@ -27,6 +27,17 @@ if [ "$1" = "--lint" ]; then
     [ $# -eq 0 ] && exit 0
 fi
 
+# --chaos: the crash-consistency tier explicitly — the kill−9/restart
+# subprocess scenarios (marked `slow`) plus every fast chaos/at-least-once
+# test. Tier-1 runs the fast subset; this runs everything chaos.
+if [ "$1" = "--chaos" ]; then
+    shift
+    exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_chaos.py tests/test_chaos_harness.py \
+        tests/test_at_least_once.py -m "slow or not slow" "$@"
+fi
+
 exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -m "soak or not soak" "$@"

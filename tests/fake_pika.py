@@ -10,7 +10,12 @@ Models the RabbitMQ behaviors the backpressure stack depends on:
 - ``basic_consume`` delivery with per-connection pumping: messages are
   delivered inside ``process_data_events`` of the connection that registered
   the consumer — exactly where BlockingConnection invokes callbacks;
-- ``basic_ack`` bookkeeping (delivery is ack-on-receipt upstream);
+- ``basic_ack`` with a real per-connection UNACKED ledger: without a
+  ``basic_qos`` prefetch the ledger is unbounded; with one, delivery halts at
+  ``prefetch_count`` in-flight (RabbitMQ consumer-prefetch semantics). A
+  connection dying (kill switch or close) requeues its unacked messages at
+  the queue FRONT with the AMQP ``redelivered`` flag set — the behavior the
+  at-least-once stack's dedup window exists to absorb;
 - connection kill switch (``FakeBroker.kill_connections``) to exercise the
   reconnect path.
 
@@ -69,12 +74,12 @@ class FakeBroker:
     # -- broker ops ----------------------------------------------------------
     def publish(self, routing_key: str, body: bytes, properties=None) -> None:
         with self.lock:
-            self.queues[routing_key].append((body, properties))
+            self.queues[routing_key].append((body, properties, False))
             self.publish_count += 1
             self._update_alarm_locked()
 
     def pop(self, queue_name: str) -> Optional[tuple]:
-        """(body, properties) of the oldest message, or None."""
+        """(body, properties, redelivered) of the oldest message, or None."""
         with self.lock:
             q = self.queues.get(queue_name)
             if not q:
@@ -87,12 +92,24 @@ class FakeBroker:
         with self.lock:
             return len(self.queues.get(queue_name, ()))
 
-    def kill_connections(self) -> None:
-        """Simulate a broker restart: every live connection starts raising."""
+    def requeue(self, queue_name: str, items) -> None:
+        """Return unacked messages to the FRONT of their queue, marked
+        redelivered (connection-death semantics)."""
         with self.lock:
-            for conn in list(self.connections):
+            for body, properties, _r in reversed(list(items)):
+                self.queues[queue_name].appendleft((body, properties, True))
+            self._update_alarm_locked()
+
+    def kill_connections(self) -> None:
+        """Simulate a broker restart: every live connection starts raising,
+        and every connection's unacked deliveries are requeued."""
+        with self.lock:
+            conns = list(self.connections)
+            for conn in conns:
                 conn._killed = True
             self.connections.clear()
+        for conn in conns:
+            conn._requeue_unacked()
 
 
 class FakeChannel:
@@ -115,6 +132,10 @@ class FakeChannel:
         self._check()
         self._confirms = True
 
+    def basic_qos(self, prefetch_count: int = 0) -> None:
+        self._check()
+        self._conn._prefetch = int(prefetch_count)
+
     def basic_publish(self, exchange: str, routing_key: str, body: bytes, properties=None) -> None:
         self._check()
         self._conn._broker.publish(routing_key, body, properties)
@@ -131,6 +152,7 @@ class FakeChannel:
     def basic_ack(self, delivery_tag=None) -> None:
         with self._conn._broker.lock:
             self._conn._broker.ack_count += 1
+            self._conn._unacked.pop(delivery_tag, None)
 
     def close(self) -> None:
         self.is_open = False
@@ -147,6 +169,10 @@ class FakeBlockingConnection:
         self._unblocked_cbs: List[Callable] = []
         self._threadsafe_cbs: List[Callable] = []
         self._delivery_tag = 0
+        # delivery_tag -> (queue, body, properties, redelivered): the unacked
+        # ledger; bounded by basic_qos prefetch, requeued on connection death
+        self._unacked: Dict[int, tuple] = {}
+        self._prefetch: int = 0  # 0 = unbounded (no basic_qos issued)
         with broker.lock:
             broker.connections.append(self)
             # late join while the alarm is up must still learn about it
@@ -184,22 +210,41 @@ class FakeBlockingConnection:
         delivered = 0
         for tag, (queue_name, on_message, ch) in list(self._consumers.items()):
             while True:
+                # consumer prefetch: delivery halts while the unacked ledger
+                # is at the basic_qos bound (auto-ack callbacks ack inline,
+                # so only manual-ack consumers ever hit it)
+                if self._prefetch and len(self._unacked) >= self._prefetch:
+                    break
                 item = self._broker.pop(queue_name)
                 if item is None:
                     break
-                body, properties = item
+                body, properties, redelivered = item
                 self._delivery_tag += 1
-                method = SimpleNamespace(delivery_tag=self._delivery_tag, consumer_tag=tag)
+                self._unacked[self._delivery_tag] = (queue_name, body, properties, redelivered)
+                method = SimpleNamespace(
+                    delivery_tag=self._delivery_tag, consumer_tag=tag,
+                    redelivered=redelivered,
+                )
                 on_message(ch, method, properties or SimpleNamespace(), body)
                 delivered += 1
         if delivered == 0 and time_limit:
             time.sleep(min(time_limit, 0.005))
+
+    def _requeue_unacked(self) -> None:
+        unacked, self._unacked = self._unacked, {}
+        per_queue: Dict[str, list] = {}
+        for tag in sorted(unacked):
+            queue_name, body, properties, _r = unacked[tag]
+            per_queue.setdefault(queue_name, []).append((body, properties, True))
+        for queue_name, items in per_queue.items():
+            self._broker.requeue(queue_name, items)
 
     def close(self) -> None:
         self.is_open = False
         with self._broker.lock:
             if self in self._broker.connections:
                 self._broker.connections.remove(self)
+        self._requeue_unacked()
 
 
 def make_fake_pika(broker: FakeBroker):

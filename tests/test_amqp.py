@@ -196,3 +196,107 @@ class TestChannelContract:
             pytest.skip("pika installed: constructor would dial a real broker")
         with pytest.raises(RuntimeError, match="pika"):
             AmqpChannel("amqp://fake", direction="p")
+
+
+class TestAtLeastOnce:
+    def test_manual_ack_defers_until_commit(self, broker):
+        qm_p, _ = make_qm(broker)
+        qm_c, _ = make_qm(broker)
+        got = []
+        prod = qm_p.get_queue("tx", "p")
+        cons = qm_c.get_queue(
+            "tx", "c", lambda line, h, tok: got.append((line, h, tok)), manual_ack=True
+        )
+        cons.start_consume()
+        try:
+            for i in range(10):
+                prod.write_line(f"m{i}")
+            assert wait_for(lambda: len(got) == 10), len(got)
+            assert broker.ack_count == 0  # nothing acked before the commit
+            cons.ack([t for _l, _h, t in got])
+            assert wait_for(lambda: broker.ack_count == 10), broker.ack_count
+            # every delivery carried the producer's msg_id (the dedup key)
+            assert all(h and h.get("msg_id") for _l, h, _t in got)
+        finally:
+            qm_p.shutdown()
+            qm_c.shutdown()
+
+    def test_prefetch_bounds_inflight_unacked(self, broker):
+        qm_p, _ = make_qm(broker)
+        qm_c, _ = make_qm(broker, prefetch_count=5)
+        got = []
+        prod = qm_p.get_queue("tx", "p")
+        cons = qm_c.get_queue("tx", "c", lambda l, h, t: got.append(t), manual_ack=True)
+        cons.start_consume()
+        try:
+            for i in range(20):
+                prod.write_line(f"m{i}")
+            assert wait_for(lambda: len(got) == 5)
+            time.sleep(0.1)
+            assert len(got) == 5  # delivery halted at the prefetch bound
+            cons.ack(got[:5])
+            assert wait_for(lambda: len(got) == 10), len(got)
+        finally:
+            qm_p.shutdown()
+            qm_c.shutdown()
+
+    def test_broker_bounce_redelivers_unacked_with_flag_and_stale_acks_dropped(self, broker):
+        qm_p, _ = make_qm(broker)
+        qm_c, _ = make_qm(broker)
+        got = []
+        prod = qm_p.get_queue("tx", "p")
+        cons = qm_c.get_queue(
+            "tx", "c", lambda line, h, tok: got.append((line, h, tok)), manual_ack=True
+        )
+        cons.start_consume()
+        try:
+            for i in range(6):
+                prod.write_line(f"m{i}")
+            assert wait_for(lambda: len(got) == 6)
+            first = list(got)
+            first_ids = [h["msg_id"] for _l, h, _t in first]
+            broker.kill_connections()  # unacked requeued, connections die
+            assert wait_for(lambda: len(got) >= 12, timeout=20), len(got)
+            redelivered = got[6:12]
+            # FIFO preserved, redelivered flag set, ORIGINAL msg ids carried
+            assert [l for l, _h, _t in redelivered] == [f"m{i}" for i in range(6)]
+            assert all(h.get("redelivered") for _l, h, _t in redelivered)
+            assert [h["msg_id"] for _l, h, _t in redelivered] == first_ids
+            # stale tokens (dead generation) are silently dropped...
+            pre = broker.ack_count
+            cons.ack([t for _l, _h, t in first])
+            time.sleep(0.2)
+            assert broker.ack_count == pre
+            # ...while current-generation tokens commit
+            cons.ack([t for _l, _h, t in redelivered])
+            assert wait_for(lambda: broker.ack_count == pre + 6), broker.ack_count
+        finally:
+            qm_p.shutdown()
+            qm_c.shutdown()
+
+
+class TestReconnectJitter:
+    def test_decorrelated_jitter_bounds_and_spread(self, broker):
+        import random
+
+        mod = make_fake_pika(broker)
+        ch = AmqpChannel(
+            "amqp://fake", direction="p", pika_module=mod, poll_interval_s=0.005,
+            reconnect_max_backoff_s=10.0, jitter_rng=random.Random(42),
+        )
+        try:
+            prev, draws = 0.5, []
+            for _ in range(200):
+                prev = ch._next_backoff(prev)
+                draws.append(prev)
+                assert 0.5 <= prev <= 10.0  # [base, cap] envelope
+            # decorrelated: not a deterministic doubling ladder (many draws
+            # saturate at the cap, which is fine — the climb must be jittered)
+            assert len({round(d, 6) for d in draws}) > 50
+            # two channels with different rngs do NOT march in lockstep
+            ch2_rng = random.Random(43)
+            ch._jitter = ch2_rng
+            other = [ch._next_backoff(0.5) for _ in range(5)]
+            assert draws[:5] != other
+        finally:
+            ch.close()

@@ -1,0 +1,359 @@
+"""At-least-once delivery: manual acks, redelivery, dedup, epoch commits.
+
+The crash-consistency contract (DESIGN.md §7 delivery matrix): a message is
+acked only after the checkpoint that absorbed it; unacked messages are
+redelivered; redeliveries are skipped via the persisted msg_id dedup window.
+These are the fast in-process proofs — the process-level kill−9 tier lives in
+tests/test_chaos_harness.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.testing.chaos import ChaosChannel, SpoolChannel
+from apmbackend_tpu.transport.base import QueueManager
+from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+
+def _mk_qm(broker):
+    return QueueManager(lambda d: MemoryChannel(broker), stat_log_interval_s=3600)
+
+
+# -- transport layer ----------------------------------------------------------
+
+
+def test_manual_ack_holds_until_commit():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    got = []
+    cons = _mk_qm(broker).get_queue(
+        "q", "c", lambda line, h, tok: got.append((line, h, tok)), manual_ack=True
+    )
+    cons.start_consume()
+    for i in range(5):
+        prod.write_line(f"m{i}")
+    broker.pump()
+    assert [l for l, _h, _t in got] == [f"m{i}" for i in range(5)]
+    assert broker.unacked_count("q") == 5  # delivered, not gone
+    cons.ack([t for _l, _h, t in got[:3]])
+    assert broker.unacked_count("q") == 2
+    cons.ack([t for _l, _h, t in got])  # re-ack is idempotent
+    assert broker.unacked_count("q") == 0
+
+
+def test_unacked_redelivered_on_bounce_with_flag_and_same_msg_id():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    got = []
+    cons = _mk_qm(broker).get_queue(
+        "q", "c", lambda line, h, tok: got.append((line, h, tok)), manual_ack=True
+    )
+    cons.start_consume()
+    for i in range(4):
+        prod.write_line(f"m{i}")
+    broker.pump()
+    first_ids = [h["msg_id"] for _l, h, _t in got]
+    cons.ack([got[0][2]])
+    assert broker.bounce() == 3  # m1..m3 redelivered, m0 committed
+    broker.pump()
+    redelivered = got[4:]
+    assert [l for l, _h, _t in redelivered] == ["m1", "m2", "m3"]  # FIFO kept
+    assert all(h.get("redelivered") for _l, h, _t in redelivered)
+    # redelivery carries the ORIGINAL msg_id — the dedup key
+    assert [h["msg_id"] for _l, h, _t in redelivered] == first_ids[1:]
+
+
+def test_consumer_channel_close_requeues_unacked():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    qm_c = _mk_qm(broker)
+    got = []
+    qm_c.get_queue("q", "c", lambda l, h, t: got.append(t), manual_ack=True).start_consume()
+    prod.write_line("a")
+    broker.pump()
+    assert broker.unacked_count() == 1
+    qm_c.shutdown()  # close -> redelivery-on-close
+    assert broker.unacked_count() == 0
+    assert broker.queue_depth("q") == 1
+
+
+def test_cancel_keeps_unacked_ackable():
+    """stop_consume (pause/resume) must NOT forfeit the open epoch's tokens."""
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    got = []
+    cons = _mk_qm(broker).get_queue("q", "c", lambda l, h, t: got.append(t), manual_ack=True)
+    cons.start_consume()
+    prod.write_line("a")
+    broker.pump()
+    cons.stop_consume()
+    assert broker.unacked_count() == 1
+    cons.ack(got)  # ack after cancel still commits
+    assert broker.unacked_count() == 0
+
+
+def test_chaos_dup_and_drop_compose_with_manual_ack():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    holder = {}
+
+    def factory(direction):
+        ch = MemoryChannel(broker)
+        if direction == "c":
+            holder["chaos"] = ChaosChannel(ch, dup_p=1.0, seed=3)
+            return holder["chaos"]
+        return ch
+
+    got = []
+    qm = QueueManager(factory, stat_log_interval_s=3600)
+    qm.get_queue("q", "c", lambda l, h, t: got.append((l, h["msg_id"], t)), manual_ack=True).start_consume()
+    for i in range(10):
+        prod.write_line(f"m{i}")
+    broker.pump()
+    assert holder["chaos"].stats.duplicated == 10
+    assert len(got) == 20
+    # a dup replays the same msg_id AND token: dedup key + idempotent ack
+    assert got[0][1] == got[1][1] and got[0][2] == got[1][2]
+    qm.queue_map["q"].ack([t for _l, _m, t in got])
+    assert broker.unacked_count() == 0
+
+
+# -- the worker epoch cycle ---------------------------------------------------
+
+
+def _worker_cfg(tmp_path, *, save_s=3600):
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 32
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["resumeFileFullPath"] = str(tmp_path / "engine.resume.npz")
+    cfg["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = save_s
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = str(tmp_path / "alerts.resume")
+    cfg["logDir"] = None
+    return cfg
+
+
+def _mk_worker(cfg, broker):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+
+    rt = ModuleRuntime(
+        "tpuEngine", config=cfg, broker=broker, install_signals=False, console_log=False
+    )
+    return WorkerApp(rt), rt
+
+
+def _tx(t, i, base=170_000_000, server="jvm0", svc=None, elapsed=None):
+    e = 100 + i if elapsed is None else elapsed
+    svc = svc or f"svc{i % 8:02d}"
+    return (
+        f"tx|{server}|{svc}|l{t}-{i}|1|{(base + t) * 10000 - e}|"
+        f"{(base + t) * 10000 + i}|{e}|Y"
+    )
+
+
+def test_worker_epoch_cycle_ack_after_checkpoint(tmp_path):
+    cfg = _worker_cfg(tmp_path)
+    broker = MemoryBroker()
+    worker, rt = _mk_worker(cfg, broker)
+    try:
+        prod = _mk_qm(broker).get_queue("transactions", "p")
+        for t in range(3):
+            for i in range(40):
+                prod.write_line(_tx(t, i))
+        broker.pump()
+        # absorbed into device state but NOT acked: the epoch is open
+        assert broker.unacked_count() == 120
+        assert len(worker._epoch_tokens) == 120
+        worker.save_state()  # feed -> tick -> checkpoint -> ack
+        assert broker.unacked_count() == 0
+        assert worker._delivery_epoch >= 1
+        assert os.path.exists(cfg["tpuEngine"]["resumeFileFullPath"])
+        # the snapshot carries the delivery tree
+        with np.load(cfg["tpuEngine"]["resumeFileFullPath"], allow_pickle=True) as z:
+            assert "delivery_state" in z.files
+    finally:
+        rt.stop_timers()
+
+
+def test_worker_dedups_bounce_redelivery_and_counts_it(tmp_path):
+    cfg = _worker_cfg(tmp_path)
+    broker = MemoryBroker()
+    worker, rt = _mk_worker(cfg, broker)
+    try:
+        prod = _mk_qm(broker).get_queue("transactions", "p")
+        for i in range(25):
+            prod.write_line(_tx(0, i))
+        broker.pump()
+        tx_before = int(np.asarray(worker.driver.state.stats.counts).sum())
+        # broker bounce mid-epoch: everything unacked comes back
+        assert broker.bounce() == 25
+        broker.pump()
+        assert worker._deduped_total == 25  # skipped, not double-counted
+        assert int(np.asarray(worker.driver.state.stats.counts).sum()) == tx_before
+        worker.save_state()
+        assert broker.unacked_count() == 0
+    finally:
+        rt.stop_timers()
+
+
+def test_worker_restart_resumes_epoch_and_dedup_window(tmp_path):
+    cfg = _worker_cfg(tmp_path)
+    broker = MemoryBroker()
+    worker, rt = _mk_worker(cfg, broker)
+    prod_qm = _mk_qm(broker)
+    prod = prod_qm.get_queue("transactions", "p")
+    for i in range(30):
+        prod.write_line(_tx(0, i))
+    broker.pump()
+    worker.save_state()
+    epoch1 = worker._delivery_epoch
+    rt.stop_timers()
+
+    # crash (no shutdown): a fresh worker must resume the window, and a
+    # redelivery of already-committed messages must dedup, not double-count
+    broker2 = MemoryBroker()
+    worker2, rt2 = _mk_worker(cfg, broker2)
+    try:
+        assert worker2._delivery_epoch == epoch1
+        assert len(worker2._dedup_fifo) == 30
+        tx_before = int(np.asarray(worker2.driver.state.stats.counts).sum())
+        prod2 = _mk_qm(broker2).get_queue("transactions", "p")
+        # replay the exact committed stream (same msg ids via raw headers)
+        for _l, mid in zip(range(30), list(worker2._dedup_fifo)):
+            broker2.send("transactions", _tx(0, _l).encode(), {"msg_id": mid})
+        broker2.pump()
+        assert worker2._deduped_total == 30
+        assert int(np.asarray(worker2.driver.state.stats.counts).sum()) == tx_before
+        assert prod2 is not None
+    finally:
+        rt2.stop_timers()
+
+
+def test_dedup_window_is_bounded(tmp_path):
+    cfg = _worker_cfg(tmp_path)
+    cfg["tpuEngine"]["dedupWindowSize"] = 16
+    broker = MemoryBroker()
+    worker, rt = _mk_worker(cfg, broker)
+    try:
+        prod = _mk_qm(broker).get_queue("transactions", "p")
+        for i in range(50):
+            prod.write_line(_tx(0, i))
+        broker.pump()
+        assert len(worker._dedup_fifo) == 16
+        assert len(worker._dedup_set) == 16
+    finally:
+        rt.stop_timers()
+
+
+def test_at_most_once_default_unchanged(tmp_path):
+    """The default mode keeps reference semantics: ack-on-receipt, ring
+    intake allowed, no delivery state in snapshots."""
+    cfg = _worker_cfg(tmp_path)
+    cfg["tpuEngine"]["deliveryMode"] = "atMostOnce"
+    broker = MemoryBroker()
+    worker, rt = _mk_worker(cfg, broker)
+    try:
+        assert not worker._at_least_once
+        prod = _mk_qm(broker).get_queue("transactions", "p")
+        for i in range(10):
+            prod.write_line(_tx(0, i))
+        broker.pump()
+        assert broker.unacked_count() == 0  # acked on receipt
+        worker.drain_intake()
+        worker.save_state()
+        with np.load(cfg["tpuEngine"]["resumeFileFullPath"], allow_pickle=True) as z:
+            assert "delivery_state" not in z.files
+    finally:
+        rt.stop_timers()
+
+
+def test_bad_delivery_mode_rejected(tmp_path):
+    cfg = _worker_cfg(tmp_path)
+    cfg["tpuEngine"]["deliveryMode"] = "exactlyOnce"
+    with pytest.raises(ValueError, match="deliveryMode"):
+        _mk_worker(cfg, MemoryBroker())
+
+
+# -- snapshot plumbing --------------------------------------------------------
+
+
+def test_save_load_resume_delivery_round_trip(tmp_path):
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 8
+    cfg["streamCalcZScore"]["defaults"] = [{"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1}]
+    drv = PipelineDriver(cfg, capacity=8)
+    path = str(tmp_path / "r.npz")
+    delivery = {"transactions": {"epoch": 7, "dedup": ["a-1", "a-2"], "deduped_total": 3}}
+    drv.save_resume(path, delivery=delivery)
+
+    drv2 = PipelineDriver(cfg, capacity=8)
+    assert drv2.load_resume(path)
+    assert drv2.delivery_state == delivery
+    # re-saving without an explicit tree carries the loaded one forward
+    drv2.save_resume(path)
+    drv3 = PipelineDriver(cfg, capacity=8)
+    assert drv3.load_resume(path)
+    assert drv3.delivery_state == delivery
+
+
+def test_sharded_checkpoint_carries_delivery(tmp_path):
+    from apmbackend_tpu.parallel.checkpoint import ShardedCheckpointer
+    from apmbackend_tpu.pipeline import make_demo_engine
+
+    cfg, state, _params = make_demo_engine(8, 16, [(4, 3.0, 0.1)])
+    ckpt = ShardedCheckpointer(str(tmp_path / "ckpt"))
+    delivery = {"transactions": {"epoch": 2, "dedup": ["x-1"], "deduped_total": 0}}
+    ckpt.save(1, state, cfg, (("s", "svc"),), delivery=delivery)
+    ckpt.wait()
+    out = ckpt.restore(cfg)
+    assert out is not None
+    assert ckpt.last_delivery == delivery
+    ckpt.close()
+
+
+# -- spool broker (the kill−9 fabric), in-process semantics -------------------
+
+
+def test_spool_cursor_only_advances_on_ack(tmp_path):
+    spool = SpoolChannel(str(tmp_path / "sp"))
+    for i in range(6):
+        spool.send("q", f"m{i}".encode(), {"msg_id": f"s-{i}"})
+    got = []
+    spool.consume("q", lambda p, h, t: got.append((p.decode(), t)), "tag", manual_ack=True)
+    assert spool.deliver() == 6
+    assert spool.acked_count("q") == 0
+    # out-of-order acks only advance the contiguous prefix
+    spool.ack([got[0][1], got[2][1]])
+    assert spool.acked_count("q") == 1
+    spool.ack([got[1][1]])
+    assert spool.acked_count("q") == 3
+    spool.close()
+
+
+def test_spool_simulated_crash_redelivers_past_cursor(tmp_path):
+    """The fabric the kill−9 tier rests on: a fresh channel (= restarted
+    process) resumes delivery exactly at the committed cursor."""
+    d = str(tmp_path / "sp")
+    spool = SpoolChannel(d)
+    for i in range(10):
+        spool.send("q", f"m{i}".encode(), {"msg_id": f"s-{i}"})
+    got = []
+    spool.consume("q", lambda p, h, t: got.append((p.decode(), t)), "tag", manual_ack=True)
+    spool.deliver()
+    spool.ack([t for _p, t in got[:4]])  # commit m0..m3; m4..m9 in flight
+    spool.close()  # SIGKILL stand-in: no further acks
+
+    spool2 = SpoolChannel(d)
+    got2 = []
+    spool2.consume("q", lambda p, h, t: got2.append(p.decode()), "tag", manual_ack=True)
+    spool2.deliver()
+    assert got2 == [f"m{i}" for i in range(4, 10)]  # redelivered, FIFO
+    spool2.close()

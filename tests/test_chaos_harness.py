@@ -1,0 +1,256 @@
+"""Crash-consistency chaos tier: kill−9 a live worker mid-stream, restart,
+and prove the recovered run EQUALS a crash-free golden run.
+
+Two tiers:
+
+- fast (tier-1): the full worker epoch cycle over the durable spool broker
+  with an in-process "crash" (abandon the worker object without shutdown —
+  no flush, no final save, exactly the state a SIGKILL leaves on disk);
+- ``slow``: the real thing — ``ChaosWorkerHarness`` spawns the production
+  worker as a subprocess, SIGKILLs it twice at cursor-chosen points under
+  duplicate-injection chaos, restarts it, and compares the final resume
+  snapshot array-for-array against the golden run. Run explicitly via
+  ``./run_tests.sh --chaos``.
+
+Equivalence claim proved here (ISSUE 3 acceptance): for every fully-acked
+epoch the recovered windowed stats (TPM/avg/p75/p95 reservoir + z-state)
+are bit-identical to the crash-free run, zero messages are lost, and every
+redelivery is accounted for in the dedup counter.
+"""
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.testing.chaos import ChaosWorkerHarness, SpoolChannel
+from apmbackend_tpu.transport.base import QueueManager
+
+
+def make_stream(n_labels=8, per_label=100, seed=0):
+    base = 170_000_000
+    rng = np.random.RandomState(seed)
+    lines = []
+    for t in range(n_labels):
+        for i in range(per_label):
+            e = int(rng.randint(50, 900))
+            lines.append(
+                f"tx|jvm{i % 3}|svc{i % 12:03d}|l{t}-{i}|1|{(base + t) * 10000 - e}|"
+                f"{(base + t) * 10000 + i}|{e}|Y"
+            )
+    return lines
+
+
+ENGINE_KEYS_IGNORED = {"delivery_state"}  # epoch/window counts legitimately differ
+
+
+def assert_snapshots_equal(path_a, path_b):
+    with np.load(path_a, allow_pickle=True) as za:
+        a = {k: za[k] for k in za.files}
+    with np.load(path_b, allow_pickle=True) as zb:
+        b = {k: zb[k] for k in zb.files}
+    keys_a = set(a) - ENGINE_KEYS_IGNORED
+    keys_b = set(b) - ENGINE_KEYS_IGNORED
+    assert keys_a == keys_b, (keys_a ^ keys_b)
+    for k in sorted(keys_a):
+        x, y = a[k], b[k]
+        if x.dtype.kind == "f":
+            ok = np.array_equal(x, y, equal_nan=True)
+        else:
+            ok = np.array_equal(x, y)
+        assert ok, f"snapshot array {k!r} diverged after crash recovery"
+
+
+# -- fast tier: in-process crash over the durable spool -----------------------
+
+
+def _spool_worker(spool_dir, resume_path, *, dup_p=0.0, seed=0):
+    """The chaos child's wiring, in-process: real WorkerApp, atLeastOnce,
+    spool transport. Returns (worker, runtime, consumer_spool)."""
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+    from apmbackend_tpu.testing.chaos import ChaosChannel
+
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 64
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["resumeFileFullPath"] = resume_path
+    cfg["streamCalcZScore"]["defaults"] = [{"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = 3600  # manual commits
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False, console_log=False)
+    spools = {}
+
+    def factory(direction):
+        ch = SpoolChannel(spool_dir)
+        spools[direction] = ch
+        if direction == "c" and dup_p:
+            return ChaosChannel(ch, dup_p=dup_p, seed=seed)
+        return ch
+
+    rt.qm = QueueManager(factory, 3600, logger=rt.logger)
+    worker = WorkerApp(rt)
+    return worker, rt, spools["c"]
+
+
+def _feed_spool(spool_dir, lines, start_seq=0):
+    import time
+
+    prod = SpoolChannel(spool_dir)
+    for n, line in enumerate(lines, start=start_seq + 1):
+        prod.send(
+            "transactions", line.encode("utf-8"),
+            {"ingest_ts": time.time(), "msg_id": f"h-{n}"},
+        )
+    prod.close()
+
+
+def test_in_process_crash_equivalence_over_spool(tmp_path):
+    lines = make_stream(n_labels=5, per_label=60)
+
+    # golden: absorb everything, one final commit
+    gdir = str(tmp_path / "golden")
+    gres = str(tmp_path / "golden.npz")
+    _feed_spool(gdir, lines)
+    w, rt, spool = _spool_worker(gdir, gres)
+    n = 0
+    while n < len(lines):
+        n += spool.deliver(50)
+    w.save_state()
+    assert spool.acked_count("transactions") == len(lines)
+    rt.stop_timers()
+    spool.stop()
+
+    # chaos: dup injection, commit mid-stream, CRASH (no shutdown), recover
+    cdir = str(tmp_path / "chaos")
+    cres = str(tmp_path / "chaos.npz")
+    _feed_spool(cdir, lines)
+    w1, rt1, spool1 = _spool_worker(cdir, cres, dup_p=0.15, seed=11)
+    delivered = 0
+    while delivered < 120:
+        delivered += spool1.deliver(30)
+        if delivered == 60:
+            w1.save_state()  # one committed epoch
+    committed = spool1.acked_count("transactions")
+    assert committed > 0
+    # SIGKILL stand-in: walk away — no flush, no save, no acks
+    rt1.stop_timers()
+    spool1.stop()
+
+    w2, rt2, spool2 = _spool_worker(cdir, cres, dup_p=0.15, seed=12)
+    assert w2._delivery_epoch >= 1  # resumed the committed epoch watermark
+    n = spool2.delivered_count("transactions")
+    assert n == committed  # redelivery starts AT the cursor: zero loss
+    while n < len(lines):
+        n += spool2.deliver(50)
+    w2.save_state()
+    assert spool2.acked_count("transactions") == len(lines)
+    # messages absorbed by w1 after its commit were redelivered to w2 and
+    # re-absorbed (not deduped: the crash discarded their uncommitted
+    # absorption); in-flight duplicates WERE deduped
+    assert w2._deduped_total >= 0
+    rt2.stop_timers()
+    spool2.stop()
+
+    assert_snapshots_equal(gres, cres)
+
+
+def test_in_process_redelivery_of_committed_epoch_dedups(tmp_path):
+    """Crash BETWEEN checkpoint and ack: the delivered-but-committed slice
+    is redelivered and must be skipped, every skip counted."""
+    lines = make_stream(n_labels=3, per_label=40)
+    d = str(tmp_path / "sp")
+    res = str(tmp_path / "r.npz")
+    _feed_spool(d, lines)
+
+    w1, rt1, spool1 = _spool_worker(d, res)
+    n = 0
+    while n < len(lines):
+        n += spool1.deliver(50)
+    # checkpoint WITHOUT ack = the crash window between save and ack:
+    # hijack by saving the resume directly through the driver
+    with w1._driver_lock:
+        w1.driver.flush()
+        w1.driver.save_resume(
+            res,
+            delivery={
+                "transactions": {
+                    "epoch": 1,
+                    "dedup": list(w1._dedup_fifo),
+                    "deduped_total": 0,
+                }
+            },
+        )
+    rt1.stop_timers()
+    spool1.stop()  # crash: acks never happened, cursor still 0
+
+    w2, rt2, spool2 = _spool_worker(d, res)
+    tx_before = int(np.asarray(w2.driver.state.stats.counts).sum())
+    n = 0
+    while n < len(lines):
+        n += spool2.deliver(50)
+    assert w2._deduped_total == len(lines)  # every redelivery accounted for
+    assert int(np.asarray(w2.driver.state.stats.counts).sum()) == tx_before
+    w2.save_state()
+    assert spool2.acked_count("transactions") == len(lines)  # deduped acks advance the cursor
+    rt2.stop_timers()
+    spool2.stop()
+
+
+# -- slow tier: real SIGKILL subprocesses -------------------------------------
+
+
+@pytest.mark.slow
+def test_kill9_crash_equivalence_subprocess(tmp_path):
+    """THE acceptance scenario: SIGKILL a live worker subprocess twice
+    mid-stream under duplicate-injection chaos, restart from checkpoint, and
+    the final windowed stats equal the crash-free golden run exactly."""
+    lines = make_stream(n_labels=10, per_label=120)
+
+    golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=1)
+    for line in lines:
+        golden.send_line(line)
+    golden.start()
+    stats_g = golden.finish(timeout_s=240)
+    golden.close()
+    assert stats_g["acked"] == len(lines)
+    assert stats_g["deduped_total"] == 0
+
+    chaos = ChaosWorkerHarness(str(tmp_path / "chaos"), dup_p=0.08, seed=7)
+    for line in lines:
+        chaos.send_line(line)
+    chaos.start()
+    chaos.wait_acked(len(lines) // 3)
+    chaos.kill9()
+    first_kill_cursor = chaos.acked()
+    chaos.start()
+    chaos.wait_acked(2 * len(lines) // 3)
+    chaos.kill9()
+    assert chaos.acked() >= first_kill_cursor  # the cursor never regresses
+    chaos.start()
+    stats_c = chaos.finish(timeout_s=240)
+    chaos.close()
+
+    assert stats_c["acked"] == len(lines)  # zero message loss
+    assert stats_c["deduped_total"] > 0  # redeliveries happened AND were caught
+    assert stats_c["services"] == stats_g["services"]
+    assert stats_c["latest_label"] == stats_g["latest_label"]
+    assert_snapshots_equal(golden.resume_path, chaos.resume_path)
+
+
+@pytest.mark.slow
+def test_kill9_immediately_after_start(tmp_path):
+    """Degenerate kill point: before any epoch commits. Restart must begin
+    from scratch with zero committed cursor and still converge."""
+    lines = make_stream(n_labels=4, per_label=60)
+    h = ChaosWorkerHarness(str(tmp_path / "h"), dup_p=0.0, seed=3)
+    for line in lines:
+        h.send_line(line)
+    h.start()
+    h.kill9()  # likely before the first commit — cursor 0 is a valid state
+    h.start()
+    stats = h.finish(timeout_s=240)
+    h.close()
+    assert stats["acked"] == len(lines)

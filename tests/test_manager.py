@@ -229,3 +229,106 @@ def test_cmdline_pattern_matches_both_launch_forms():
     wpat = cmdline_pattern_for("apmbackend_tpu.runtime.worker")
     assert re.search(wpat, "python -m apmbackend_tpu worker --foo")
     assert not re.search(wpat, "python -m apmbackend_tpu manager")
+
+
+# -- hung-tick watchdog (healthz streak -> damped restart) -------------------
+
+class _FakeProc:
+    """Stands in for a wedged-but-alive child: subprocess surface only."""
+
+    def __init__(self, pid=4242):
+        self.pid = pid
+        self.returncode = None
+        self.terminated = False
+
+    def poll(self):
+        return None  # alive forever (that's the point: a wedge never exits)
+
+    def terminate(self):
+        self.terminated = True
+        self.returncode = -15
+
+    def kill(self):
+        self.returncode = -9
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+def make_watchdog_manager(tmp_path, monkeypatch, *, threshold=3, healthy=False):
+    app, rt = make_manager(
+        tmp_path,
+        healthzFailureThreshold=threshold,
+        moduleSettings=[{"module": "wedge_mod", "metricsPort": 19999}],
+    )
+    mod = app.modules[0]
+    mod.proc = _FakeProc()
+    now = [1000.0]
+    mod.clock = lambda: now[0]
+    mod.last_start_time = 0.0
+    # the watchdog only probes ALIVE children
+    monkeypatch.setattr("apmbackend_tpu.manager.pid_stats.pid_exists", lambda pid: True)
+    app._probe_child_health = lambda url, timeout_s: healthy
+    return app, mod, now
+
+
+def test_watchdog_restarts_after_sustained_streak(tmp_path, monkeypatch):
+    app, mod, now = make_watchdog_manager(tmp_path, monkeypatch, threshold=3)
+    now[0] = 1000.0
+    app.inspect_module_health()
+    app.inspect_module_health()
+    assert mod.proc is not None  # streak 2 < 3: still watching
+    assert not mod.proc.terminated
+    proc = mod.proc
+    app.inspect_module_health()  # streak 3: force-restart through damped path
+    assert proc.terminated
+    assert mod.proc is None  # handle_exit reaped it
+    assert mod.restart_pending_until > 0  # restart scheduled, damping applied
+    assert any("wedged" in m for m in app.alerts.buffer)
+    # counted on the watchdog counter
+    assert app._m_watchdog[mod.module].value == 1
+
+
+def test_watchdog_streak_resets_on_healthy_probe(tmp_path, monkeypatch):
+    app, mod, _now = make_watchdog_manager(tmp_path, monkeypatch, threshold=2)
+    app.inspect_module_health()  # fail: streak 1
+    app._probe_child_health = lambda url, timeout_s: True
+    app.inspect_module_health()  # healthy: streak resets
+    app._probe_child_health = lambda url, timeout_s: False
+    app.inspect_module_health()  # fail: streak 1 again — no restart
+    assert mod.proc is not None and not mod.proc.terminated
+
+
+def test_watchdog_respects_crash_loop_damping(tmp_path, monkeypatch):
+    """A child that wedges right after starting gets the 60 s damped
+    restart, exactly like a crash-looping self-exit (the existing path)."""
+    app, mod, now = make_watchdog_manager(tmp_path, monkeypatch, threshold=1)
+    now[0] = 1000.0
+    mod.last_start_time = 998.0  # "started" 2 s ago => crash loop
+    app.inspect_module_health()
+    assert mod.restart_pending_until == pytest.approx(1060.0)
+    # a long-lived child that wedges restarts in 1 s
+    mod.proc = _FakeProc()
+    mod.last_start_time = 500.0
+    app.inspect_module_health()
+    assert mod.restart_pending_until == pytest.approx(1001.0)
+
+
+def test_watchdog_disabled_by_zero_threshold(tmp_path, monkeypatch):
+    app, mod, _now = make_watchdog_manager(tmp_path, monkeypatch, threshold=0)
+    for _ in range(5):
+        app.inspect_module_health()
+    assert mod.proc is not None and not mod.proc.terminated
+
+
+def test_watchdog_skips_children_without_metrics_port(tmp_path, monkeypatch):
+    app, rt = make_manager(
+        tmp_path, healthzFailureThreshold=1,
+        moduleSettings=[{"module": "blind_mod"}],  # no metricsPort: unwatchable
+    )
+    mod = app.modules[0]
+    mod.proc = _FakeProc()
+    monkeypatch.setattr("apmbackend_tpu.manager.pid_stats.pid_exists", lambda pid: True)
+    app._probe_child_health = lambda url, timeout_s: False
+    app.inspect_module_health()
+    assert not mod.proc.terminated

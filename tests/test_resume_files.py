@@ -1,0 +1,98 @@
+"""utils/resume.py failure paths: torn loads fall back cleanly, the Map
+wrapper revives, and the atomic tmp+rename write leaves no droppings when the
+write itself fails mid-flight (the crash-consistency floor every resume
+consumer — alerts, DB buffer, multivariate baseline — stands on)."""
+
+import json
+import os
+
+import pytest
+
+from apmbackend_tpu.utils.resume import load_resume_file, save_resume_file
+
+
+def _tmp_droppings(directory):
+    return [n for n in os.listdir(directory) if n.endswith(".tmp")]
+
+
+def test_missing_file_returns_none(tmp_path):
+    assert load_resume_file(str(tmp_path / "nope.json")) is None
+
+
+def test_torn_json_falls_back_to_none(tmp_path):
+    """A crash mid-write of a NON-atomic writer (the reference's
+    writeFileSync) leaves a torn prefix; the loader must shrug, not raise."""
+    p = tmp_path / "torn.json"
+    p.write_text('{"a": [1, 2, {"b": "unclosed')
+    assert load_resume_file(str(p)) is None
+
+
+def test_truncated_to_empty_falls_back(tmp_path):
+    p = tmp_path / "empty.json"
+    p.write_text("")
+    assert load_resume_file(str(p)) is None
+
+
+def test_binary_garbage_falls_back(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_bytes(b"\x00\xff\xfePK\x03\x04 not json")
+    assert load_resume_file(str(p)) is None
+
+
+def test_map_wrapper_revives_nested(tmp_path):
+    """The reference's Map replacer shape ({"dataType": "Map", "value":
+    [[k, v], ...]}) must revive to plain dicts at ANY nesting depth —
+    interchange compatibility with reference-written resume files."""
+    p = tmp_path / "map.json"
+    wrapper = {
+        "dataType": "Map",
+        "value": [
+            ["svcA", {"dataType": "Map", "value": [["360", {"count": 3}]]}],
+            ["svcB", [1, {"dataType": "Map", "value": [["k", "v"]]}]],
+        ],
+    }
+    p.write_text(json.dumps({"alerts": wrapper, "plain": {"x": 1}}))
+    out = load_resume_file(str(p))
+    assert out == {
+        "alerts": {"svcA": {"360": {"count": 3}}, "svcB": [1, {"k": "v"}]},
+        "plain": {"x": 1},
+    }
+
+
+def test_save_load_round_trip_with_nan_sanitization(tmp_path):
+    p = str(tmp_path / "rt.json")
+    save_resume_file(p, {"v": float("nan"), "w": float("inf"), "k": [1.5, None]})
+    # NaN/Inf become null, like JSON.stringify — loadable by strict parsers
+    assert load_resume_file(p) == {"v": None, "w": None, "k": [1.5, None]}
+
+
+def test_failed_serialization_leaves_no_droppings_and_keeps_original(tmp_path):
+    p = str(tmp_path / "state.json")
+    save_resume_file(p, {"good": 1})
+    with pytest.raises(TypeError):
+        save_resume_file(p, {"bad": {1, 2, 3}})  # sets are not JSON
+    assert _tmp_droppings(str(tmp_path)) == []  # tmp cleaned up
+    assert load_resume_file(p) == {"good": 1}  # original intact
+
+
+def test_failed_rename_leaves_no_droppings(tmp_path, monkeypatch):
+    import apmbackend_tpu.utils.resume as resume_mod
+
+    p = str(tmp_path / "state.json")
+    save_resume_file(p, {"v": 1})
+
+    def boom(src, dst):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(resume_mod.os, "replace", boom)
+    with pytest.raises(OSError):
+        save_resume_file(p, {"v": 2})
+    monkeypatch.undo()
+    assert _tmp_droppings(str(tmp_path)) == []
+    assert load_resume_file(p) == {"v": 1}  # atomic: old content survives
+
+
+def test_save_creates_parent_dirs(tmp_path):
+    p = str(tmp_path / "a" / "b" / "state.json")
+    save_resume_file(p, {"v": 1})
+    assert load_resume_file(p) == {"v": 1}
