@@ -469,6 +469,13 @@ _DEFAULT_CONFIG: dict = {
         # recently absorbed ids persisted inside every snapshot.
         "deliveryMode": "atMostOnce",
         "dedupWindowSize": 65536,
+        # at-least-once intake batching: accepted deliveries buffer up to
+        # this many lines and reach the engine as one bulk feed (the native
+        # CSV decode path) instead of per-message object feeds; drained on
+        # batch-full, on deliveryFeedMaxDelaySeconds, and always before an
+        # epoch checkpoint (token<->effect alignment preserved).
+        "deliveryBatchSize": 256,
+        "deliveryFeedMaxDelaySeconds": 0.25,
         # mirror StatEntry/FullStatEntry lines onto the reference's 'stats' /
         # 'z_score' queues for per-stage inspection and interop (SURVEY.md §4)
         "emitStatsQueue": False,

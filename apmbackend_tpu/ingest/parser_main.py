@@ -103,6 +103,9 @@ def build(runtime, *, tail: bool = True):
         manager = TailManager(
             cfg, parser.read_line, logger=runtime.logger,
             native_binary=native, on_tail_exit=on_tail_exit,
+            # batch delivery: each poll's complete lines reach the parser as
+            # one chunk through the native ingest fast path (read_lines)
+            on_lines=parser.read_lines,
         )
         manager.start()
         runtime.qm.on("pause", manager.pause_reads)

@@ -58,7 +58,12 @@ class PyTailer:
     """Polling tailer for one file: start at EOF, follow appends, re-open on
 
     truncation (size shrink) — net-mount-safe (no inode assumptions, the
-    reason the reference patched File::Tail)."""
+    reason the reference patched File::Tail).
+
+    ``on_lines`` (optional) switches to batch delivery: each poll's
+    complete lines are handed over as ONE newline-joined str chunk, the
+    shape TransactionParser.read_lines wants for the native ingest fast
+    path — per-line callback overhead disappears from the tail loop."""
 
     def __init__(
         self,
@@ -69,15 +74,36 @@ class PyTailer:
         poll_interval_s: float = 0.2,
         from_start: bool = False,
         on_exit: Optional[Callable[[str, Optional[int]], None]] = None,
+        on_lines: Optional[Callable[[str, str], object]] = None,
     ):
         self.file_path = file_path
         self.on_line = on_line
+        self.on_lines = on_lines
         self.pause_file = pause_file
         self.poll_interval_s = poll_interval_s
         self.from_start = from_start
         self.on_exit = on_exit
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _deliver(self, buf: str) -> str:
+        """Push complete lines from ``buf``; returns the partial tail."""
+        if self.on_lines is not None:
+            cut = buf.rfind("\n")
+            if cut < 0:
+                return buf
+            try:
+                self.on_lines(self.file_path, buf[: cut + 1])
+            except Exception:
+                pass  # consumer bug must not kill the tail
+            return buf[cut + 1:]
+        while "\n" in buf:
+            line, buf = buf.split("\n", 1)
+            try:
+                self.on_line(self.file_path, line)
+            except Exception:
+                pass
+        return buf
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name=f"tail-{os.path.basename(self.file_path)}", daemon=True)
@@ -128,13 +154,7 @@ class PyTailer:
                     # first so nothing written pre-rotation is lost)
                     tail_chunk = fh.read()
                     if tail_chunk:
-                        buf += tail_chunk
-                        while "\n" in buf:
-                            line, buf = buf.split("\n", 1)
-                            try:
-                                self.on_line(self.file_path, line)
-                            except Exception:
-                                pass
+                        buf = self._deliver(buf + tail_chunk)
                     fh.close()
                     fh = None
                     self.from_start = True  # new file: read from beginning
@@ -142,15 +162,9 @@ class PyTailer:
                 chunk = fh.read()
                 if chunk:
                     pos = fh.tell()
-                    buf += chunk
-                    while "\n" in buf:
-                        line, buf = buf.split("\n", 1)
-                        try:
-                            self.on_line(self.file_path, line)
-                        except Exception:
-                            # a consumer bug must not kill the tail; fail-fast
-                            # (on_exit) is reserved for file-level problems
-                            pass
+                    # consumer bugs are swallowed inside _deliver; fail-fast
+                    # (on_exit) is reserved for file-level problems
+                    buf = self._deliver(buf + chunk)
                 else:
                     time.sleep(self.poll_interval_s)
             if fh:
@@ -179,31 +193,55 @@ class NativeTailer:
         pause_file_path: str,
         on_line: Callable[[str, str], None],
         on_exit: Optional[Callable[[str, Optional[int]], None]] = None,
+        on_lines: Optional[Callable[[str, bytes], object]] = None,
     ):
         self.binary_path = binary_path
         self.file_path = file_path
         self.pause_file_path = pause_file_path
         self.on_line = on_line
+        self.on_lines = on_lines
         self.on_exit = on_exit
         self._proc: Optional[subprocess.Popen] = None
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+
+    def _deliver(self, complete: bytes) -> None:
+        try:
+            if self.on_lines is not None:
+                # raw byte chunk straight into the parser's batch API (the
+                # native ingest fast path takes it without str-ifying lines)
+                self.on_lines(self.file_path, complete)
+            else:
+                for line in complete.split(b"\n")[:-1]:
+                    self.on_line(self.file_path, line.decode("utf-8", "replace"))
+        except Exception:
+            pass  # consumer bug must not kill the pump
 
     def start(self, from_start: bool = False) -> None:
         argv = [self.binary_path, self.file_path, self.pause_file_path]
         if from_start:
             argv.append("--from-start")
         self._proc = subprocess.Popen(
-            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, bufsize=1
+            argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL
         )
 
         def _pump():
             assert self._proc is not None and self._proc.stdout is not None
-            for line in self._proc.stdout:
-                try:
-                    self.on_line(self.file_path, line.rstrip("\n"))
-                except Exception:
-                    pass
+            stdout = self._proc.stdout
+            buf = b""
+            while True:
+                # read1: whatever the pipe has (>=1 byte, blocking) — batch
+                # naturally under load, line-latency when idle
+                chunk = stdout.read1(1 << 16)
+                if not chunk:
+                    break
+                buf += chunk
+                cut = buf.rfind(b"\n")
+                if cut >= 0:
+                    self._deliver(buf[: cut + 1])
+                    buf = buf[cut + 1:]
+            if buf:  # unterminated final line at tail death
+                self._deliver(buf + b"\n")
             rc = self._proc.wait()
             if self.on_exit and not self._stopping:
                 self.on_exit(self.file_path, rc)
@@ -239,9 +277,11 @@ class TailManager:
         native_binary: Optional[str] = None,
         on_tail_exit: Optional[Callable[[str, Optional[int]], None]] = None,
         from_start: bool = False,
+        on_lines: Optional[Callable[[str, object], object]] = None,
     ):
         self.config = config
         self.on_line = on_line
+        self.on_lines = on_lines  # batch delivery (parser.read_lines shape)
         self.logger = logger
         self.native_binary = native_binary
         self.on_tail_exit = on_tail_exit
@@ -254,12 +294,16 @@ class TailManager:
         files = discover_log_files(self.config["appLogDirMaskPrefix"], self.config["maskSuffixes"])
         for f in files:
             if self.native_binary:
-                t = NativeTailer(self.native_binary, f, self.pause.path, self.on_line, self.on_tail_exit)
+                t = NativeTailer(
+                    self.native_binary, f, self.pause.path, self.on_line,
+                    self.on_tail_exit, on_lines=self.on_lines,
+                )
                 t.start(from_start=self.from_start)
             else:
                 t = PyTailer(
                     f, self.on_line, self.pause,
                     from_start=self.from_start, on_exit=self.on_tail_exit,
+                    on_lines=self.on_lines,
                 )
                 t.start()
             self.tailers.append(t)

@@ -75,6 +75,13 @@ class TTLCache:
                 self.on_expired(key, value)
         return len(expired)
 
+    def clear(self) -> int:
+        """Drop everything WITHOUT firing callbacks (end-of-replay partial
+        discard; the native record cache exposes the same method)."""
+        n = len(self._store)
+        self._store.clear()
+        return n
+
     def flush_all(self) -> int:
         """Expire everything regardless of TTL (end-of-replay drain)."""
         items = list(self._store.items())

@@ -12,6 +12,11 @@ demand with ``make`` (g++, no external deps) and exposes:
   C++ pass over a newline-joined blob -> dense (end_ts, elapsed, key id,
   line span) arrays with first-appearance key interning; the host intake
   hot path behind pipeline.feed_csv_batch.
+- :class:`ParserEngineNative` — the log-correlation parser's ingest fast
+  path (native/parser.cpp): chunked marker pre-filter + field extraction +
+  the (logId, service) TTL correlation join, plus the per-file SOAP/audit
+  state machines; consumed by ingest.parser.TransactionParser.read_lines
+  (APM_PARSE_NO_NATIVE=1 kills it).
 
 Everything degrades gracefully: with no compiler available the build
 functions return None and callers fall back to the pure-Python paths.
@@ -288,6 +293,262 @@ class LineRing:
             if self._ring:
                 self._lib.apmring_destroy(self._ring)
                 self._ring = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------------- parser
+
+_parser_lib = None
+
+
+def _load_parser_lib():
+    global _parser_lib
+    if _parser_lib is not None:
+        return _parser_lib
+    build = ensure_built()
+    if build is None:
+        return None
+    so = os.path.join(build, "libapmparser.so")
+    if not os.path.isfile(so):
+        return None
+    lib = ctypes.CDLL(so)
+    lib.apmpar_create.restype = ctypes.c_void_p
+    lib.apmpar_create.argtypes = [ctypes.c_double, ctypes.c_double, ctypes.c_double]
+    lib.apmpar_destroy.argtypes = [ctypes.c_void_p]
+    lib.apmpar_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.apmpar_sweep.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.apmpar_clear.argtypes = [ctypes.c_void_p]
+    lib.apmpar_park.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.c_double,
+    ]
+    lib.apmpar_take.restype = ctypes.c_int32
+    lib.apmpar_take.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_char_p,
+        ctypes.c_int32, ctypes.c_double, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.apmpar_pool.restype = ctypes.c_void_p
+    lib.apmpar_pool.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.apmpar_peek.restype = ctypes.c_int64
+    lib.apmpar_peek.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_double,
+    ]
+    lib.apmpar_drain_expired.restype = ctypes.c_int64
+    lib.apmpar_drain_expired.argtypes = [ctypes.c_void_p]
+    lib.apmpar_expired_pending.restype = ctypes.c_uint64
+    lib.apmpar_expired_pending.argtypes = [ctypes.c_void_p]
+    lib.apmpar_chunk.restype = ctypes.c_int64
+    lib.apmpar_chunk.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_double, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.apmpar_soap_get.restype = ctypes.c_int32
+    lib.apmpar_soap_get.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.apmpar_soap_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+    ]
+    lib.apmpar_soap_arm.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.apmpar_soap_close.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    _parser_lib = lib
+    return lib
+
+
+def have_native_parser() -> bool:
+    """True when libapmparser built/loaded (toolchain present)."""
+    return _load_parser_lib() is not None
+
+
+def _parser_event_dtype():
+    """numpy mirror of ApmEvent (native/parser.cpp). Spans with off >= 0
+    index the chunk buffer; off < 0 index the returned pool at (-off - 1);
+    len < 0 means the field is absent."""
+    import numpy as np
+
+    return np.dtype([
+        ("line_off", np.int64), ("line_len", np.int32),
+        ("cls", np.int32), ("flags", np.int32),
+        ("logid_off", np.int32), ("logid_len", np.int32),
+        ("ts_off", np.int32), ("ts_len", np.int32),
+        ("svc_off", np.int32), ("svc_len", np.int32),
+        ("ela_off", np.int32), ("ela_len", np.int32),
+        ("jts_off", np.int32), ("jts_len", np.int32),
+        ("jserver", np.int32),
+        ("baf_off", np.int32), ("baf_len", np.int32),
+        ("bits", np.int32),
+        ("_pad", np.int32),  # C tail padding made explicit (sizeof == 80)
+    ], align=False)
+
+
+class ParserEngineNative:
+    """Ingest fast path over libapmparser: batched marker pre-filter +
+    field extraction + the (logId, service) TTL correlation map.
+
+    One instance backs one TransactionParser. ``chunk()`` processes a
+    newline-separated byte blob for one file and returns the event array;
+    ``park``/``take``/``peek`` are the per-line shims that let the Python
+    reference handler (RAW-line fallback, read_line API, tests) operate on
+    the SAME correlation map. All entry points take ``now`` from the
+    parser's injectable clock — TTL semantics replicate ingest/ttlcache.py.
+    """
+
+    # class constants mirrored from parser.cpp
+    CLS_RAW = 0
+    CLS_EJB_ENTRY = 1
+    CLS_EJB_EXIT = 2
+    CLS_CT_ENTRY = 3
+    CLS_CT_EXIT = 4
+    CLS_SOAP_ACCT = 12
+    CLS_SOAP_ALT_VALUE = 14
+    CLS_ACCT_SAVE_BAF = 21
+    CLS_AUDIT_STOP = 22
+    CLS_AUDIT_LOG = 23
+    FL_JOIN_FOUND = 1
+    FL_BAF = 2
+    FL_LOGID_EMPTY = 4
+    FL_JOIN_NOKEY = 8
+    FL_INSERT_DB = 16
+    LOG_MISSING_CTX = 1
+    LOG_UNRESOLVED = 2
+    LOG_NO_START = 3
+    LOG_NO_STOP = 4
+    LOG_DATA_INDEX = 5
+
+    def __init__(self, ttl_s: float, sweep_interval_s: float, now: float):
+        lib = _load_parser_lib()
+        if lib is None:
+            raise RuntimeError("native parser unavailable (no toolchain?)")
+        self._lib = lib
+        self._h = lib.apmpar_create(
+            ctypes.c_double(ttl_s), ctypes.c_double(sweep_interval_s),
+            ctypes.c_double(now),
+        )
+        if not self._h:
+            raise MemoryError("apmpar_create failed")
+        self.dtype = _parser_event_dtype()
+
+    def _pool_bytes(self) -> bytes:
+        n = ctypes.c_uint64(0)
+        ptr = self._lib.apmpar_pool(self._h, ctypes.byref(n))
+        if not n.value:
+            return b""
+        return ctypes.string_at(ptr, n.value)
+
+    def chunk(self, data: bytes, kind: int, server_id: int, file_id: int,
+              now: float):
+        """-> (events structured array, pool bytes, counts tuple). counts =
+        (lines, prefilter_rejected, parked, events, pool_bytes, consumed).
+        ``consumed < len(data)`` means the scan stopped at a RAW barrier:
+        process the events, then call again on ``data[consumed:]``."""
+        import numpy as np
+
+        cap = data.count(b"\n") + 1
+        ev = np.zeros(cap, self.dtype)
+        counts = (ctypes.c_uint64 * 6)()
+        n = self._lib.apmpar_chunk(
+            self._h, data, len(data), kind, server_id, file_id,
+            ctypes.c_double(now),
+            ev.ctypes.data_as(ctypes.c_void_p), cap, counts,
+        )
+        if n < 0:  # structurally impossible (cap >= line count); never retry
+            raise RuntimeError("apmpar_chunk event overflow")
+        # snapshot the pool NOW: the next native call on this handle
+        # invalidates it
+        return ev[: int(n)], self._pool_bytes(), tuple(int(c) for c in counts)
+
+    # -- soap context shims (shared state for the per-line reference path) --
+    def soap_get(self, file_id: int):
+        """(log_id bytes, pull flag) of the open context, or None."""
+        rc = self._lib.apmpar_soap_get(self._h, file_id)
+        if rc < 0:
+            return None
+        return self._pool_bytes(), rc == 1
+
+    def soap_set(self, file_id: int, log_id: bytes) -> None:
+        self._lib.apmpar_soap_set(self._h, file_id, log_id, len(log_id))
+
+    def soap_arm(self, file_id: int) -> None:
+        self._lib.apmpar_soap_arm(self._h, file_id)
+
+    def soap_close(self, file_id: int) -> None:
+        self._lib.apmpar_soap_close(self._h, file_id)
+
+    def park(self, log_id: bytes, service: bytes, server_id: int,
+             start_ts: bytes, now: float) -> None:
+        self._lib.apmpar_park(
+            self._h, log_id, len(log_id), service, len(service), server_id,
+            start_ts, len(start_ts), ctypes.c_double(now),
+        )
+
+    def take(self, log_id: bytes, service: bytes, now: float):
+        """-> None (no key), () (key but no service), or (server_id,
+        start_ts bytes) when found+popped — mirroring _join_exit's three
+        cases."""
+        srv = ctypes.c_int32(-1)
+        ts_off = ctypes.c_int32(0)
+        ts_len = ctypes.c_int32(0)
+        rc = self._lib.apmpar_take(
+            self._h, log_id, len(log_id), service, len(service),
+            ctypes.c_double(now), ctypes.byref(srv), ctypes.byref(ts_off),
+            ctypes.byref(ts_len),
+        )
+        if rc == 0:
+            return None
+        if rc == 1:
+            return ()
+        pool = self._pool_bytes()
+        off = -int(ts_off.value) - 1
+        return int(srv.value), pool[off: off + int(ts_len.value)]
+
+    def peek(self, log_id: bytes, now: float):
+        """TTLCache.get parity view: None on miss (counted), else the
+        live {service: (server_id, start_ts)} map (hit counted)."""
+        n = self._lib.apmpar_peek(self._h, log_id, len(log_id),
+                                  ctypes.c_double(now))
+        if n < 0:
+            return None
+        out = {}
+        for rec in self._pool_bytes().split(b"\x1e"):
+            if rec:
+                svc, srv, ts = rec.split(b"\x1f")
+                out[svc] = (int(srv), ts)
+        return out
+
+    def sweep(self, now: float) -> None:
+        self._lib.apmpar_sweep(self._h, ctypes.c_double(now))
+
+    def clear(self) -> None:
+        self._lib.apmpar_clear(self._h)
+
+    def stats(self):
+        out = (ctypes.c_uint64 * 3)()
+        self._lib.apmpar_stats(self._h, out)
+        return int(out[0]), int(out[1]), int(out[2])  # keys, hits, misses
+
+    def expired_pending(self) -> int:
+        return int(self._lib.apmpar_expired_pending(self._h))
+
+    def drain_expired(self):
+        """[(log_id bytes, service bytes), ...] expired since last drain."""
+        self._lib.apmpar_drain_expired(self._h)
+        out = []
+        for rec in self._pool_bytes().split(b"\x1e"):
+            if rec:
+                log_id, _, svc = rec.partition(b"\x1f")
+                out.append((log_id, svc))
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.apmpar_destroy(self._h)
+            self._h = None
 
     def __del__(self):  # pragma: no cover - GC timing
         try:

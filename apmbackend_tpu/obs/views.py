@@ -101,7 +101,13 @@ def register_parser(parser, module: str, registry: Optional[MetricsRegistry] = N
         yield Sample("apm_parser_db_direct_total", labels, c["db_direct_out"], "counter",
                      "Records routed straight to the DB queue (non-Provider audit rows)")
         yield Sample("apm_parser_parse_seconds_total", labels, c["parse_ns"] / 1e9, "counter",
-                     "Wall time inside TransactionParser.read_line")
+                     "Wall time inside TransactionParser.read_line/read_lines")
+        yield Sample("apm_parser_native_lines_total", labels, c.get("native_lines", 0),
+                     "counter",
+                     "Lines processed by the native (C++) ingest fast path")
+        yield Sample("apm_parser_prefilter_rejected_total", labels,
+                     c.get("prefilter_rejected", 0), "counter",
+                     "Lines dropped by the native marker pre-filter with zero Python work")
         for cache, st in parser.cache_stats().items():
             cl = dict(labels, cache=cache)
             yield Sample("apm_parser_cache_hits_total", cl, st["hits"], "counter",

@@ -1755,16 +1755,22 @@ class PipelineDriver:
         return rows
 
     def _ingest_arrays(self, rows: np.ndarray, labels: np.ndarray, elaps: np.ndarray) -> None:
-        """Scatter pre-decoded arrays in micro_batch_size chunks (one fixed
-        batch shape => the same compiled ingest program as the object path)."""
+        """Scatter pre-decoded arrays in micro_batch_size chunks, with the
+        SAME two pad tiers as _flush_pending (small tier for sub-256
+        segments, full tier otherwise) so a trickle-sized bulk feed — the
+        at-least-once batched intake, tick-boundary segments — doesn't pay
+        a micro_batch_size-wide scatter per segment, and both paths share
+        the same two compiled ingest variants."""
         B = self.micro_batch_size
+        small = min(256, B)
         dtype = self._np_dtype()
         for i in range(0, len(rows), B):
             m = min(B, len(rows) - i)
-            r = np.zeros(B, np.int32)
-            l = np.zeros(B, np.int32)
-            e = np.zeros(B, dtype)
-            v = np.zeros(B, bool)
+            pad = small if m <= small else B
+            r = np.zeros(pad, np.int32)
+            l = np.zeros(pad, np.int32)
+            e = np.zeros(pad, dtype)
+            v = np.zeros(pad, bool)
             r[:m] = rows[i : i + m]
             l[:m] = labels[i : i + m]
             e[:m] = elaps[i : i + m]

@@ -1,0 +1,1229 @@
+// apmpar — native ingest fast path for the log-correlation parser.
+//
+// Role: the host's hottest loop. bench_replay's parser-stage counters put
+// ~78% of the bare-parser wall inside TransactionParser.read_line at
+// ~5.7 us/line; most of that is Python regex ladders and dict/TTLCache
+// traffic on lines that carry no timing marker at all. This module takes a
+// whole chunk of newline-separated bytes from the tailer/replay feed and:
+//
+//   1. PRE-FILTERS: one pass over the chunk rejects lines carrying no
+//      marker for the file's kind (soap / server.log / app) with zero
+//      Python work — no str object is ever created for them.
+//   2. EXTRACTS: marker-bearing lines are tokenized at the byte layer and
+//      the fields the Python handlers need (logId, timestamps, service,
+//      elapsed, BAF metadata token) come back as spans into the chunk or
+//      into a handle-owned string pool.
+//   3. JOINS: the (logId, service) entry/exit correlation cache — the
+//      structural 50%-hit-rate TTL record cache — lives here as an
+//      open-addressing map with lazy expiry. Entry lines are parked
+//      entirely natively (no Python work at all); exit lines return the
+//      joined partial (start_ts + server id) in their event record.
+//      Expired partials are queued and handed back to Python in batch so
+//      the salvage / log-and-discard semantics are unchanged.
+//
+// Parity contract (enforced by tests/test_parser_native_diff.py): for the
+// same input bytes, the event stream drives the Python side to a
+// bit-identical TxEntry sequence and identical cache hit/miss counters as
+// the pure-Python reference path (APM_PARSE_NO_NATIVE=1). Two invariants
+// make byte-level matching of the Python regexes sound:
+//
+//   - every pattern is a pure-ASCII literal (plus ^ anchors and ' '* runs),
+//     and UTF-8 guarantees an ASCII substring is present in the decoded
+//     str iff the same bytes are present in the raw buffer (multi-byte
+//     sequences never contain ASCII bytes; errors='replace' only rewrites
+//     invalid sequences, never ASCII);
+//   - tokenization diverges from str.split() only on non-ASCII whitespace
+//     (U+00A0, U+0085, ...) and the ASCII control separators \x1c-\x1f.
+//     Any line containing a byte >= 0x80 or a control byte outside
+//     {\t,\v,\f,\r} is therefore flagged RAW and replayed through the
+//     Python reference handler (same record map via the park/take shims),
+//     exactly like decoder.cpp routes exotic numerics back to Python.
+//
+// Clocking: every entry point takes `now` (the parser's injectable clock)
+// so replay/fuzz runs are deterministic; within one chunk all cache ops
+// share the caller's single clock reading, which the differential test
+// mirrors on the Python side by stepping its fake clock only between
+// chunks. TTL semantics replicate ingest/ttlcache.py exactly: get-side
+// lazy expiry, maybe_sweep on an interval, set-after-miss with a fresh
+// TTL, hit counted even when the service is absent from a live key's map.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- utilities
+
+inline bool is_tok_ws(unsigned char c) {
+    // byte-level str.split() whitespace ('\n' never appears inside a line)
+    return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
+
+// bytes that make byte-level tokenization/strip diverge from str-level:
+// anything non-ASCII, or an ASCII control char that is NOT also byte-split
+// whitespace ('\x1c'..'\x1f' are str.split() separators but not bytes
+// ones; NUL etc. stay conservative).
+inline bool is_exotic(unsigned char c) {
+    if (c >= 0x80) return true;
+    if (c < 0x20) return !(c == '\t' || c == '\r' || c == '\v' || c == '\f');
+    return false;
+}
+
+inline char ascii_lower(char c) {
+    return (c >= 'A' && c <= 'Z') ? static_cast<char>(c + 32) : c;
+}
+
+// memmem with an optional ASCII-case-insensitive mode (patterns are short
+// literals; a naive scan with a first-byte skip is plenty at marker rates)
+const char* find_sub(const char* hay, size_t hlen, const char* pat, size_t plen,
+                     bool ci = false) {
+    if (plen == 0 || hlen < plen) return nullptr;
+    const char p0 = ci ? ascii_lower(pat[0]) : pat[0];
+    const char* end = hay + (hlen - plen);
+    for (const char* p = hay; p <= end; ++p) {
+        if ((ci ? ascii_lower(*p) : *p) != p0) continue;
+        size_t i = 1;
+        for (; i < plen; ++i) {
+            char h = ci ? ascii_lower(p[i]) : p[i];
+            char q = ci ? ascii_lower(pat[i]) : pat[i];
+            if (h != q) break;
+        }
+        if (i == plen) return p;
+    }
+    return nullptr;
+}
+
+// re.search of `INFO *<lit>` anywhere in the line: at every "INFO"
+// occurrence, skip the space run and compare the literal. The literals all
+// start with a non-space byte, so greedy-with-backtrack equals skip-all.
+bool find_info_marker(const char* s, size_t n, const char* lit, size_t litlen) {
+    const char* p = s;
+    const char* end = s + n;
+    while (const char* hit = find_sub(p, static_cast<size_t>(end - p), "INFO", 4)) {
+        const char* q = hit + 4;
+        while (q < end && *q == ' ') ++q;
+        if (static_cast<size_t>(end - q) >= litlen && memcmp(q, lit, litlen) == 0)
+            return true;
+        p = hit + 1;
+    }
+    return false;
+}
+
+// `^Audit Trail id *:` prefix match
+bool match_autr_line(const char* s, size_t n) {
+    static const char kPfx[] = "Audit Trail id";
+    const size_t pl = sizeof(kPfx) - 1;
+    if (n < pl + 1 || memcmp(s, kPfx, pl) != 0) return false;
+    size_t i = pl;
+    while (i < n && s[i] == ' ') ++i;
+    return i < n && s[i] == ':';
+}
+
+// `\[[^ ]+] +INFO ` — BAF bracketed metadata followed by INFO
+bool match_baf_meta(const char* s, size_t n) {
+    for (size_t i = 0; i + 1 < n; ++i) {
+        if (s[i] != '[') continue;
+        size_t j = i + 1;
+        while (j < n && s[j] != ' ' && s[j] != ']') ++j;
+        if (j == i + 1 || j >= n || s[j] != ']') continue;  // need [^ ]+ then ]
+        size_t k = j + 1;
+        size_t spaces = 0;
+        while (k < n && s[k] == ' ') { ++k; ++spaces; }
+        if (spaces >= 1 && n - k >= 5 && memcmp(s + k, "INFO ", 5) == 0) return true;
+    }
+    return false;
+}
+
+struct Tok {
+    const char* p;
+    int32_t len;
+};
+
+// str.split() over the byte span; returns up to max_toks tokens. Lines are
+// pre-screened for exotic bytes, so byte whitespace == str whitespace.
+int tokenize(const char* s, size_t n, Tok* out, int max_toks) {
+    int nt = 0;
+    size_t i = 0;
+    while (i < n && nt < max_toks) {
+        while (i < n && is_tok_ws(static_cast<unsigned char>(s[i]))) ++i;
+        if (i >= n) break;
+        size_t b = i;
+        while (i < n && !is_tok_ws(static_cast<unsigned char>(s[i]))) ++i;
+        out[nt].p = s + b;
+        out[nt].len = static_cast<int32_t>(i - b);
+        ++nt;
+    }
+    return nt;
+}
+
+// ---- unicode-aware tokenization (audit lines may be exotic) -------------
+//
+// The audit-trail state machine runs natively for EVERY app line (its
+// state cannot be split with Python), so exotic lines need tokenization
+// with str.split()/str.strip() boundary parity. Decode UTF-8 one
+// codepoint at a time; invalid sequences act as opaque non-whitespace
+// (Python replaces them with U+FFFD, also non-whitespace, so the token
+// BOUNDARIES match exactly; token BYTES decode to the same str later).
+// The whitespace set is CPython's Py_UNICODE_ISSPACE.
+
+inline bool is_uni_ws(uint32_t cp) {
+    if (cp == 0x20 || (cp >= 0x09 && cp <= 0x0D) || (cp >= 0x1C && cp <= 0x1F))
+        return true;
+    if (cp < 0x85) return false;
+    return cp == 0x85 || cp == 0xA0 || cp == 0x1680 ||
+           (cp >= 0x2000 && cp <= 0x200A) || cp == 0x2028 || cp == 0x2029 ||
+           cp == 0x202F || cp == 0x205F || cp == 0x3000;
+}
+
+// Decode one codepoint; advances *i. Anything Python's strict decoder
+// would replace (invalid lead, truncated/broken sequence, overlong form)
+// yields 0xFFFD and advances 1 byte — subsequent bytes of a broken
+// sequence each decode invalid too, and all are non-whitespace exactly
+// like Python's U+FFFD, so split/strip BOUNDARIES stay identical.
+inline uint32_t next_cp(const char* s, size_t n, size_t* i) {
+    unsigned char c = static_cast<unsigned char>(s[*i]);
+    if (c < 0x80) { ++*i; return c; }
+    size_t need;
+    uint32_t cp, min_cp;
+    if ((c & 0xE0) == 0xC0) { need = 1; cp = c & 0x1F; min_cp = 0x80; }
+    else if ((c & 0xF0) == 0xE0) { need = 2; cp = c & 0x0F; min_cp = 0x800; }
+    else if ((c & 0xF8) == 0xF0) { need = 3; cp = c & 0x07; min_cp = 0x10000; }
+    else { ++*i; return 0xFFFD; }
+    if (*i + need >= n) { ++*i; return 0xFFFD; }  // truncated at span end
+    for (size_t k = 1; k <= need; ++k) {
+        unsigned char cc = static_cast<unsigned char>(s[*i + k]);
+        if ((cc & 0xC0) != 0x80) { ++*i; return 0xFFFD; }
+        cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (cp < min_cp) { ++*i; return 0xFFFD; }  // overlong (e.g. C0 A0 'space')
+    *i += need + 1;
+    return cp;
+}
+
+int u_tokenize(const char* s, size_t n, Tok* out, int max_toks) {
+    int nt = 0;
+    size_t i = 0;
+    while (i < n && nt < max_toks) {
+        while (i < n) {
+            size_t j = i;
+            if (!is_uni_ws(next_cp(s, n, &j))) break;
+            i = j;
+        }
+        if (i >= n) break;
+        size_t b = i;
+        while (i < n) {
+            size_t j = i;
+            if (is_uni_ws(next_cp(s, n, &j))) break;
+            i = j;
+        }
+        out[nt].p = s + b;
+        out[nt].len = static_cast<int32_t>(i - b);
+        ++nt;
+    }
+    return nt;
+}
+
+// str.strip() over a byte span, unicode-aware
+void u_strip(const char** p, size_t* n) {
+    while (*n) {
+        size_t i = 0;
+        if (!is_uni_ws(next_cp(*p, *n, &i))) break;
+        *p += i;
+        *n -= i;
+    }
+    // trailing: scan forward remembering the last non-ws end
+    size_t last_end = 0;
+    size_t i = 0;
+    while (i < *n) {
+        size_t j = i;
+        bool ws = is_uni_ws(next_cp(*p, *n, &j));
+        if (!ws) last_end = j;
+        i = j;
+    }
+    *n = last_end;
+}
+
+// _strip_brackets: drop every '[' and ']' byte
+void strip_brackets(const char* p, int32_t len, std::string* out) {
+    out->clear();
+    for (int32_t i = 0; i < len; ++i)
+        if (p[i] != '[' && p[i] != ']') out->push_back(p[i]);
+}
+
+// ------------------------------------------------------ record cache (TTL)
+
+struct Svc {
+    std::string service;
+    std::string start_ts;
+    int32_t server_id;
+};
+
+struct Rec {
+    std::string log_id;
+    double expires_at = 0.0;
+    std::vector<Svc> svcs;
+    uint64_t hash = 0;
+    uint8_t state = 0;  // 0 empty, 1 live, 2 tombstone
+};
+
+inline uint64_t fnv1a(const char* p, size_t n) {
+    uint64_t h = 1469598103934665603ull;
+    for (size_t i = 0; i < n; ++i) {
+        h ^= static_cast<unsigned char>(p[i]);
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+// ------------------------------------------------- per-file parse state
+//
+// The SOAP logId context and the audit-trail state machine live HERE, not
+// in Python: they touch nearly every line of their file kinds, and a
+// state split between the batch path and the per-line path would corrupt
+// correlation. Python keeps only the side-effectful tail ends (account
+// cache saves, record emission) via events; the per-line read_line API
+// routes single lines through the same machines.
+
+struct SoapCtxN {
+    bool open = false;   // an IO=I header context exists (_soap_ctx entry)
+    bool pull = false;   // pull_next_value (riskid two-line form)
+    std::string log_id;
+};
+
+struct SvcEnt {
+    std::string elapsed;
+    std::string start_ts;  // set by <startTime>, may stay empty
+};
+
+struct AutrCtxN {
+    bool exists = false;  // Python's _autr_ctx had an entry for this file
+    bool active = false;  // active_log_id truthy
+    bool elapsed_flag = false;
+    bool sw_flag = false;
+    std::string log_id, alt_acct, active_service;
+    // autrId -> (logId, altAcct)
+    std::unordered_map<std::string, std::pair<std::string, std::string>> autr_map;
+    // service -> FIFO of pending subservice records
+    std::unordered_map<std::string, std::vector<SvcEnt>> service_map;
+};
+
+struct FileState {
+    SoapCtxN soap;
+    AutrCtxN autr;
+};
+
+struct ApmPar {
+    double ttl_s;
+    double sweep_interval_s;
+    double last_sweep;
+    uint64_t hits = 0;
+    uint64_t misses = 0;
+    uint64_t live = 0;       // live keys (incl. expired-but-uncollected)
+    uint64_t occupied = 0;   // live + tombstones (probe-chain load)
+    std::vector<Rec> table;
+    // (log_id, service) pairs expired since the last drain — Python logs
+    // the "Partial record expired!" line for each (pair set matches the
+    // reference exactly; log ORDER is not part of the parity contract)
+    std::vector<std::pair<std::string, std::string>> expired;
+    std::string scratch;
+    std::string pool;  // per-chunk string pool (stable until the next chunk)
+    std::vector<FileState*> files;  // indexed by Python-interned file id
+
+    explicit ApmPar(double ttl, double sweep_iv, double now)
+        : ttl_s(ttl), sweep_interval_s(sweep_iv), last_sweep(now), table(256) {}
+
+    ~ApmPar() {
+        for (FileState* f : files) delete f;
+    }
+
+    FileState* file(int32_t id) {
+        if (id < 0) return nullptr;
+        if (static_cast<size_t>(id) >= files.size())
+            files.resize(static_cast<size_t>(id) + 1, nullptr);
+        if (files[id] == nullptr) files[id] = new FileState();
+        return files[id];
+    }
+
+    size_t mask() const { return table.size() - 1; }
+
+    Rec* find_slot(const char* key, size_t klen, uint64_t h, bool for_insert) {
+        size_t i = static_cast<size_t>(h) & mask();
+        Rec* first_tomb = nullptr;
+        for (size_t probes = 0; probes <= mask(); ++probes, i = (i + 1) & mask()) {
+            Rec& r = table[i];
+            if (r.state == 0)
+                return for_insert ? (first_tomb ? first_tomb : &r) : nullptr;
+            if (r.state == 2) {
+                if (for_insert && !first_tomb) first_tomb = &r;
+                continue;
+            }
+            if (r.hash == h && r.log_id.size() == klen &&
+                memcmp(r.log_id.data(), key, klen) == 0)
+                return &r;
+        }
+        return for_insert ? first_tomb : nullptr;
+    }
+
+    void maybe_grow() {
+        if ((occupied + 1) * 4 < table.size() * 3) return;  // load < 0.75
+        std::vector<Rec> old;
+        old.swap(table);
+        // rehash in place when tombstones dominate, double when truly full
+        size_t nsize = (live * 2 >= old.size()) ? old.size() * 2 : old.size();
+        table.assign(nsize, Rec());
+        occupied = 0;
+        for (Rec& r : old) {
+            if (r.state != 1) continue;
+            size_t i = static_cast<size_t>(r.hash) & mask();
+            while (table[i].state == 1) i = (i + 1) & mask();
+            table[i] = std::move(r);
+            ++occupied;
+        }
+    }
+
+    void expire_rec(Rec* r) {
+        for (Svc& s : r->svcs)
+            expired.emplace_back(r->log_id, std::move(s.service));
+        r->svcs.clear();
+        r->log_id.clear();
+        r->state = 2;
+        --live;
+    }
+
+    void sweep(double now) {
+        last_sweep = now;
+        for (Rec& r : table)
+            if (r.state == 1 && now >= r.expires_at) expire_rec(&r);
+    }
+
+    void maybe_sweep(double now) {
+        if (now - last_sweep >= sweep_interval_s) sweep(now);
+    }
+
+    // TTLCache.get parity: maybe_sweep, then miss / lazy-expire-miss / hit.
+    Rec* get(const char* key, size_t klen, double now) {
+        maybe_sweep(now);
+        uint64_t h = fnv1a(key, klen);
+        Rec* r = find_slot(key, klen, h, false);
+        if (r == nullptr) {
+            ++misses;
+            return nullptr;
+        }
+        if (now >= r->expires_at) {
+            expire_rec(r);
+            ++misses;
+            return nullptr;
+        }
+        ++hits;
+        return r;
+    }
+
+    // _park_partial: get (counts), create on miss (set = fresh TTL), then
+    // overwrite-or-append the service slot.
+    void park(const char* key, size_t klen, const char* svc, size_t svlen,
+              int32_t server_id, const char* ts, size_t tslen, double now) {
+        Rec* r = get(key, klen, now);
+        if (r == nullptr) {
+            maybe_grow();
+            uint64_t h = fnv1a(key, klen);
+            r = find_slot(key, klen, h, true);
+            if (r->state == 0) ++occupied;
+            r->log_id.assign(key, klen);
+            r->hash = h;
+            r->state = 1;
+            r->expires_at = now + ttl_s;
+            r->svcs.clear();
+            ++live;
+        }
+        for (Svc& s : r->svcs) {
+            if (s.service.size() == svlen && memcmp(s.service.data(), svc, svlen) == 0) {
+                s.start_ts.assign(ts, tslen);
+                s.server_id = server_id;
+                return;
+            }
+        }
+        r->svcs.push_back(Svc{std::string(svc, svlen), std::string(ts, tslen), server_id});
+    }
+
+    // _join_exit's cache half: get (counts); 0 = no live key, 1 = key but
+    // no such service (no pop), 2 = found (service popped, partial out).
+    int take(const char* key, size_t klen, const char* svc, size_t svlen,
+             double now, int32_t* server_id, std::string* start_ts) {
+        Rec* r = get(key, klen, now);
+        if (r == nullptr) return 0;
+        for (size_t i = 0; i < r->svcs.size(); ++i) {
+            Svc& s = r->svcs[i];
+            if (s.service.size() == svlen && memcmp(s.service.data(), svc, svlen) == 0) {
+                *server_id = s.server_id;
+                *start_ts = std::move(s.start_ts);
+                r->svcs.erase(r->svcs.begin() + static_cast<long>(i));
+                return 2;
+            }
+        }
+        return 1;
+    }
+
+    void clear() {
+        for (Rec& r : table) {
+            if (r.state == 1) r = Rec();
+            else r.state = 0;
+        }
+        live = occupied = 0;
+    }
+};
+
+// ------------------------------------------------------------ event layout
+
+// Mirrored by EVENT_DTYPE in apmbackend_tpu/native/__init__.py. Span
+// convention: off >= 0 -> into the chunk buffer; off < 0 -> into the
+// handle's string pool at (-off - 1); len < 0 -> field absent.
+struct ApmEvent {
+    int64_t line_off;
+    int32_t line_len;
+    int32_t cls;
+    int32_t flags;
+    int32_t logid_off, logid_len;
+    int32_t ts_off, ts_len;    // entry start_ts / exit end_ts / soap token
+    int32_t svc_off, svc_len;
+    int32_t ela_off, ela_len;
+    int32_t jts_off, jts_len;  // joined partial start_ts (exit, FOUND)
+    int32_t jserver;           // joined partial server id
+    int32_t baf_off, baf_len;  // tokens[3] for the BAF salvage path
+    int32_t bits;              // app-pattern bitmask (cls APP_LINE)
+    int32_t _pad;              // keep sizeof == 80 explicit (the leading
+                               // int64 would pad here anyway; numpy mirrors)
+};
+static_assert(sizeof(ApmEvent) == 80, "event layout drifted from the numpy mirror");
+
+enum {
+    CLS_RAW = 0,          // replay through the Python reference handler
+    CLS_EJB_ENTRY = 1,    // (never emitted: parked fully natively)
+    CLS_EJB_EXIT = 2,
+    CLS_CT_ENTRY = 3,     // (never emitted: parked fully natively)
+    CLS_CT_EXIT = 4,
+    CLS_SOAP_ACCT = 12,   // acct save event: ts=acct, logid captured at line
+    CLS_SOAP_ALT_VALUE = 14,  // riskStrategy save event, same payload
+    CLS_ACCT_SAVE_BAF = 21,   // audit map line BAF acct: ts=acct, logid
+    CLS_AUDIT_STOP = 22,  // completed subservice: svc/logid/ts=start/
+                          // ela/jts=end/baf=altAcct/FL_INSERT_DB
+    CLS_AUDIT_LOG = 23,   // reference log line: bits=code, svc=detail span
+};
+
+enum {
+    FL_JOIN_FOUND = 1,
+    FL_BAF = 2,
+    FL_LOGID_EMPTY = 4,
+    FL_JOIN_NOKEY = 8,   // take() missed the key entirely (vs key-no-service)
+    FL_INSERT_DB = 16,   // audit stop: non-Provider -> straight to DB queue
+};
+
+enum {  // CLS_AUDIT_LOG codes (bits field)
+    LOG_MISSING_CTX = 1,    // "Missing context for audit trail id line"
+    LOG_UNRESOLVED = 2,     // "Could not resolve autrId X to a logId"
+    LOG_NO_START = 3,       // "No serviceMap entry for X on startTime"
+    LOG_NO_STOP = 4,        // "No serviceMap entry for X on stopTime"
+    LOG_DATA_INDEX = 5,     // elapsed-data line IndexError ("Unparseable")
+};
+
+int32_t pool_put(std::string* pool, const char* p, size_t n) {
+    int32_t off = -static_cast<int32_t>(pool->size()) - 1;
+    pool->append(p, n);
+    return off;
+}
+
+void init_event(ApmEvent* e, const char* base, const char* line, size_t n,
+                int32_t cls) {
+    memset(e, 0, sizeof(*e));
+    e->line_off = line - base;
+    e->line_len = static_cast<int32_t>(n);
+    e->cls = cls;
+    e->logid_len = e->ts_len = e->svc_len = e->ela_len = e->jts_len = e->baf_len = -1;
+    e->jserver = -1;
+}
+
+// re.split(r"<|>", line.strip())[2] — the span between the 2nd and 3rd
+// angle delimiter (or end-of-strip when only two exist). false => the
+// Python path raises IndexError => RAW.
+bool soap_piece2(const char* s, size_t n, const char** out, size_t* outlen) {
+    while (n && is_tok_ws(static_cast<unsigned char>(s[0]))) { ++s; --n; }
+    while (n && is_tok_ws(static_cast<unsigned char>(s[n - 1]))) --n;
+    const char* d[3];
+    int nd = 0;
+    for (size_t i = 0; i < n && nd < 3; ++i)
+        if (s[i] == '<' || s[i] == '>') d[nd++] = s + i;
+    if (nd < 2) return false;
+    *out = d[1] + 1;
+    *outlen = static_cast<size_t>((nd == 3 ? d[2] : s + n) - (d[1] + 1));
+    return true;
+}
+
+// _xml_text as a span: cut at the first "</", then after the last '>' of
+// the remainder (find/rfind only — no whitespace semantics, so byte-exact
+// even on exotic lines).
+void xml_text_span(const char* s, size_t n, const char** out, size_t* outlen) {
+    const char* cut = find_sub(s, n, "</", 2);
+    size_t m = cut ? static_cast<size_t>(cut - s) : n;
+    size_t b = 0;
+    for (size_t i = m; i > 0; --i)
+        if (s[i - 1] == '>') { b = i; break; }
+    *out = s + b;
+    *outlen = m - b;
+}
+
+// _baf_meta_acct's pure transform given tokens[3]: strip everything through
+// the LAST "][" (greedy .*]\[), drop brackets, take the part after the
+// last ':' — all byte-safe ops. Returns the alt-acct candidate (may be
+// empty). The caller gates on the BAF regex + token count.
+void baf_alt_acct(const char* t, size_t n, std::string* out) {
+    // re.sub(r".*]\[", "", tok): remove through the last "][" occurrence
+    for (size_t i = n; i >= 2; --i) {
+        if (t[i - 2] == ']' && t[i - 1] == '[') {
+            t += i;
+            n -= i;
+            break;
+        }
+    }
+    std::string info;
+    for (size_t i = 0; i < n; ++i)
+        if (t[i] != '[' && t[i] != ']') info.push_back(t[i]);
+    // info.split(":")[-1]
+    size_t c = info.rfind(':');
+    out->assign(c == std::string::npos ? info : info.substr(c + 1));
+}
+
+// _DIGITS_RE.match(acct.strip()): unicode strip, then ^[0-9]+$
+bool digits_valid(const char* s, size_t n) {
+    u_strip(&s, &n);
+    if (n == 0) return false;
+    for (size_t i = 0; i < n; ++i)
+        if (s[i] < '0' || s[i] > '9') return false;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* apmpar_create(double ttl_s, double sweep_interval_s, double now) {
+    return new (std::nothrow) ApmPar(ttl_s, sweep_interval_s, now);
+}
+
+void apmpar_destroy(void* h) { delete static_cast<ApmPar*>(h); }
+
+// out[0]=keys out[1]=hits out[2]=misses
+void apmpar_stats(void* h, uint64_t* out) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    out[0] = p->live;
+    out[1] = p->hits;
+    out[2] = p->misses;
+}
+
+void apmpar_sweep(void* h, double now) { static_cast<ApmPar*>(h)->sweep(now); }
+
+void apmpar_clear(void* h) { static_cast<ApmPar*>(h)->clear(); }
+
+// Park/take/peek: per-line shims behind the Python reference fallback
+// (RAW lines and the mixed read_line API), so exotic lines and native
+// lines share ONE correlation map.
+
+void apmpar_park(void* h, const char* logid, int32_t llen, const char* svc,
+                 int32_t slen, int32_t server_id, const char* ts, int32_t tslen,
+                 double now) {
+    static_cast<ApmPar*>(h)->park(logid, llen, svc, slen, server_id, ts, tslen, now);
+}
+
+// ret 0 = no key, 1 = key without this service, 2 = found (popped, partial
+// serialized into the handle pool; read it via apmpar_pool + the out span).
+int32_t apmpar_take(void* h, const char* logid, int32_t llen, const char* svc,
+                    int32_t slen, double now, int32_t* server_id,
+                    int32_t* ts_off, int32_t* ts_len) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    std::string ts;
+    int rc = p->take(logid, llen, svc, slen, now, server_id, &ts);
+    if (rc == 2) {
+        p->pool.clear();
+        *ts_off = pool_put(&p->pool, ts.data(), ts.size());
+        *ts_len = static_cast<int32_t>(ts.size());
+    }
+    return rc;
+}
+
+// Pointer/length of the handle's string pool (valid until the next chunk/
+// take call on this handle).
+const char* apmpar_pool(void* h, uint64_t* len) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    *len = p->pool.size();
+    return p->pool.data();
+}
+
+// TTLCache.get parity view (tests poke parser.record_cache.get directly):
+// counts a hit/miss, lazy-expires, and serializes the live service map
+// into the handle pool as service\x1fserver_id\x1fstart_ts\x1e records.
+// ret: -1 = miss/None, else bytes written (read via apmpar_pool).
+int64_t apmpar_peek(void* h, const char* logid, int32_t llen, double now) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    Rec* r = p->get(logid, static_cast<size_t>(llen), now);
+    if (r == nullptr) return -1;
+    p->pool.clear();
+    char num[16];
+    for (const Svc& s : r->svcs) {
+        p->pool.append(s.service);
+        p->pool.push_back('\x1f');
+        p->pool.append(num, static_cast<size_t>(snprintf(num, sizeof(num), "%d", s.server_id)));
+        p->pool.push_back('\x1f');
+        p->pool.append(s.start_ts);
+        p->pool.push_back('\x1e');
+    }
+    return static_cast<int64_t>(p->pool.size());
+}
+
+// Expired (logId, service) pairs accumulated since the last drain,
+// serialized into the handle pool as logid\x1fservice\x1e records.
+// Draining clears the queue. ret bytes (read via apmpar_pool).
+int64_t apmpar_drain_expired(void* h) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    p->pool.clear();
+    for (auto& pr : p->expired) {
+        p->pool.append(pr.first);
+        p->pool.push_back('\x1f');
+        p->pool.append(pr.second);
+        p->pool.push_back('\x1e');
+    }
+    p->expired.clear();
+    return static_cast<int64_t>(p->pool.size());
+}
+
+uint64_t apmpar_expired_pending(void* h) {
+    return static_cast<ApmPar*>(h)->expired.size();
+}
+
+// ---- soap context shims --------------------------------------------------
+// The per-file SOAP logId context lives natively (the chunk machine above);
+// these let the Python reference handler (_parse_soap, used for RAW-line
+// replay and the per-line read_line API) operate on the SAME context.
+
+// ret -1 = no open context; else the pull_next_value flag (0/1), with the
+// context logId serialized into the handle pool (apmpar_pool).
+int32_t apmpar_soap_get(void* h, int32_t file_id) {
+    ApmPar* p = static_cast<ApmPar*>(h);
+    FileState* fs = p->file(file_id);
+    if (fs == nullptr || !fs->soap.open) return -1;
+    p->pool.assign(fs->soap.log_id);
+    return fs->soap.pull ? 1 : 0;
+}
+
+void apmpar_soap_set(void* h, int32_t file_id, const char* logid, int32_t llen) {
+    FileState* fs = static_cast<ApmPar*>(h)->file(file_id);
+    if (fs == nullptr) return;
+    fs->soap.open = true;
+    fs->soap.pull = false;
+    fs->soap.log_id.assign(logid, static_cast<size_t>(llen));
+}
+
+void apmpar_soap_arm(void* h, int32_t file_id) {
+    FileState* fs = static_cast<ApmPar*>(h)->file(file_id);
+    if (fs != nullptr && fs->soap.open) fs->soap.pull = true;
+}
+
+void apmpar_soap_close(void* h, int32_t file_id) {
+    FileState* fs = static_cast<ApmPar*>(h)->file(file_id);
+    if (fs != nullptr) fs->soap.open = false;
+}
+
+// --------------------------------------------------------------- the chunk
+
+// Process one chunk of newline-separated lines from ONE file.
+//   kind: 0 soap_io, 1 server.log, 2 app log
+//   server_id: Python-interned id of this file's server name
+//   file_id: Python-interned id of the file path (keys the native per-file
+//            SOAP/audit state)
+// ev[] must hold at least (number of lines) events — an upper bound the
+// caller gets by counting '\n'; every event maps 1:1 to a line. String
+// fields with negative offsets point into the handle pool (apmpar_pool),
+// valid until the next chunk/take/peek/drain call.
+// counts[6]: [0] lines [1] prefilter-rejected [2] natively-parked entries
+//            [3] events [4] pool bytes [5] reserved
+// Returns the event count, or -1 if ev_cap was too small (caller bug; no
+// partial state to worry about only because cap >= line count prevents it).
+int64_t apmpar_chunk(void* h, const char* buf, uint64_t len, int32_t kind,
+                     int32_t server_id, int32_t file_id, double now,
+                     ApmEvent* ev, uint64_t ev_cap, uint64_t* counts) {
+    ApmPar* par = static_cast<ApmPar*>(h);
+    std::string* pool = &par->pool;
+    pool->clear();
+    FileState* fs = par->file(file_id);
+    uint64_t n_lines = 0, n_reject = 0, n_parked = 0, n_ev = 0;
+    const char* end = buf + len;
+    const char* line = buf;
+
+    // NB: `while (line < end)` IS the trailing-newline rule: a terminating
+    // '\n' leaves line == end, so the final empty segment of split('\n')
+    // never materializes, while interior empty lines still count.
+    while (line < end) {
+        const char* nl = static_cast<const char*>(memchr(line, '\n', end - line));
+        const char* le = nl ? nl : end;
+        const char* next = nl ? nl + 1 : end;
+        ++n_lines;
+        size_t n = static_cast<size_t>(le - line);
+        if (n == 0) {  // empty line: read_line("") no-op
+            ++n_reject;
+            line = next;
+            continue;
+        }
+
+        bool exotic = false;
+        for (size_t i = 0; i < n; ++i)
+            if (is_exotic(static_cast<unsigned char>(line[i]))) { exotic = true; break; }
+
+        if (kind == 0) {
+            // ---- soap_io: the per-file logId context runs HERE; Python
+            // only sees acct-save events (with the logId captured at this
+            // line) and RAW fallbacks (which replay through the accessor
+            // shims against this same context) ----
+            bool is_hdr = n >= 11 && memcmp(line, "=== jbossId", 11) == 0;
+            int32_t cls = -1;  // 0..4: IN OUT ACCT ALT_KEY ALT_VALUE
+            if (is_hdr && find_sub(line + 11, n - 11, "IO=I", 4)) cls = 0;
+            else if (is_hdr && find_sub(line + 11, n - 11, "IO=O", 4)) cls = 1;
+            else if (find_sub(line, n, "<accountNumber>", 15, true)) cls = 2;
+            else if (find_sub(line, n, "<key>AccountNumber</key>", 24, true)) cls = 3;
+            else if (find_sub(line, n, "<value>", 7)) cls = 4;
+            if (cls < 0) {
+                ++n_reject;  // _parse_soap no-ops on every other line
+                line = next;
+                continue;
+            }
+            if (exotic) {  // replay via Python (_parse_soap + ctx shims)
+                if (n_ev >= ev_cap) return -1;
+                init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+                ++n_ev;
+                line = next;
+                goto done;  // RAW is a scan barrier (state-order safety)
+            }
+            SoapCtxN* sc = &fs->soap;
+            if (cls == 0) {  // IO=I: open context, logId = tok1.split("=")[1]
+                Tok t[2];
+                int nt = tokenize(line, n, t, 2);
+                const char* eq = (nt >= 2)
+                    ? static_cast<const char*>(memchr(t[1].p, '=', t[1].len))
+                    : nullptr;
+                if (eq == nullptr) {  // IndexError path in Python
+                    if (n_ev >= ev_cap) return -1;
+                    init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+                    ++n_ev;
+                    line = next;
+                    goto done;
+                } else {
+                    const char* vb = eq + 1;
+                    const char* te = t[1].p + t[1].len;
+                    const char* eq2 = static_cast<const char*>(
+                        memchr(vb, '=', static_cast<size_t>(te - vb)));
+                    sc->open = true;
+                    sc->pull = false;
+                    sc->log_id.assign(vb, static_cast<size_t>((eq2 ? eq2 : te) - vb));
+                }
+            } else if (cls == 1) {  // IO=O: close
+                sc->open = false;
+            } else if (!sc->open) {
+                ++n_reject;  // no context: acct/key/value lines are no-ops
+            } else if (cls == 3) {  // <key>AccountNumber</key>: arm
+                sc->pull = true;
+            } else if (cls == 2 || (cls == 4 && sc->pull)) {
+                const char* piece;
+                size_t plen;
+                if (!soap_piece2(line, n, &piece, &plen)) {
+                    if (n_ev >= ev_cap) return -1;  // IndexError in Python
+                    init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+                    ++n_ev;
+                    line = next;
+                    goto done;
+                } else {
+                    // emit the save event with the logId captured NOW; a
+                    // digits-valid acct closes the context at this line,
+                    // exactly where the reference's saveAcctNum pops it
+                    if (n_ev >= ev_cap) return -1;
+                    ApmEvent* e = &ev[n_ev];
+                    init_event(e, buf, line, n,
+                               cls == 2 ? CLS_SOAP_ACCT : CLS_SOAP_ALT_VALUE);
+                    e->ts_off = static_cast<int32_t>(piece - buf);
+                    e->ts_len = static_cast<int32_t>(plen);
+                    e->logid_off = pool_put(pool, sc->log_id.data(), sc->log_id.size());
+                    e->logid_len = static_cast<int32_t>(sc->log_id.size());
+                    if (digits_valid(piece, plen)) sc->open = false;
+                    ++n_ev;
+                }
+            } else {
+                ++n_reject;  // unarmed <value> line
+            }
+            line = next;
+            continue;
+        }
+
+        // ---- server/app: marker classification (4 independent searches,
+        // ladder priority applied per kind — test_marker_cooccurrence) ----
+        bool ejb_in = false, ejb_out = false, ct_in = false, ct_out = false;
+        if (find_sub(line, n, "CommonTiming", 12)) {
+            ejb_in = find_info_marker(line, n, "[CommonTiming] The EJB", 22);
+            ejb_out = find_info_marker(line, n, "[CommonTiming] Total time", 25);
+            ct_in = find_info_marker(line, n, "CommonTiming::Start", 19);
+            ct_out = find_info_marker(line, n, "CommonTiming::Stop", 18);
+        }
+        int32_t cls = -1;
+        if (kind == 1) {
+            if (ejb_in) cls = CLS_EJB_ENTRY;
+            else if (ejb_out) cls = CLS_EJB_EXIT;
+            else if (ct_in) cls = CLS_CT_ENTRY;
+            else if (ct_out) cls = CLS_CT_EXIT;
+            if (cls < 0) {
+                ++n_reject;
+                line = next;
+                continue;
+            }
+        } else {
+            bool has_marker = ejb_in || ejb_out || ct_in || ct_out;
+            if (has_marker && ct_in) cls = CLS_CT_ENTRY;
+            else if (has_marker && ct_out) cls = CLS_CT_EXIT;
+            else {
+                // ---- audit-trail state machine (native, _parse_app_line
+                // parity; branch order and lazy pattern checks mirror the
+                // reference). Exotic lines run through the unicode
+                // tokenizer instead of going RAW — the state cannot be
+                // split with Python. ----
+                AutrCtxN* ac = &fs->autr;
+                if (find_sub(line, n, "INFO  auditTrailId=", 19)) {
+                    Tok arr[8];
+                    int na = exotic ? u_tokenize(line, n, arr, 8)
+                                    : tokenize(line, n, arr, 8);
+                    const char* eq = (na >= 6)
+                        ? static_cast<const char*>(memchr(arr[5].p, '=', arr[5].len))
+                        : nullptr;
+                    if (eq == nullptr) {
+                        // IndexError in the reference body BEFORE any state
+                        // mutation: RAW is a safe (and required) barrier
+                        if (n_ev >= ev_cap) return -1;
+                        init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+                        ++n_ev;
+                        line = next;
+                        goto done;
+                    }
+                    strip_brackets(arr[0].p, arr[0].len, &par->scratch);
+                    const char* ab = eq + 1;
+                    const char* ae = arr[5].p + arr[5].len;
+                    const char* eq2 = static_cast<const char*>(
+                        memchr(ab, '=', static_cast<size_t>(ae - ab)));
+                    std::string autr(ab, static_cast<size_t>((eq2 ? eq2 : ae) - ab));
+                    ac->exists = true;
+                    std::string alt;
+                    if (na >= 4 && match_baf_meta(line, n))
+                        baf_alt_acct(arr[3].p, static_cast<size_t>(arr[3].len), &alt);
+                    ac->autr_map[autr] = {par->scratch, alt};
+                    if (!alt.empty()) {  // `if acct:` gate of _baf_meta_acct
+                        if (n_ev >= ev_cap) return -1;
+                        ApmEvent* e = &ev[n_ev];
+                        init_event(e, buf, line, n, CLS_ACCT_SAVE_BAF);
+                        e->ts_off = pool_put(pool, alt.data(), alt.size());
+                        e->ts_len = static_cast<int32_t>(alt.size());
+                        e->logid_off = pool_put(pool, par->scratch.data(),
+                                                par->scratch.size());
+                        e->logid_len = static_cast<int32_t>(par->scratch.size());
+                        ++n_ev;
+                    }
+                } else if (match_autr_line(line, n)) {
+                    if (n_ev >= ev_cap) return -1;
+                    if (!ac->exists) {
+                        ApmEvent* e = &ev[n_ev];
+                        init_event(e, buf, line, n, CLS_AUDIT_LOG);
+                        e->bits = LOG_MISSING_CTX;
+                        ++n_ev;
+                    } else {
+                        // autr_id = line.split(":")[1].strip()
+                        const char* colon = static_cast<const char*>(memchr(line, ':', n));
+                        const char* vb = colon + 1;  // prefix guarantees ':'
+                        const char* ve = static_cast<const char*>(
+                            memchr(vb, ':', static_cast<size_t>(line + n - vb)));
+                        size_t vn = static_cast<size_t>((ve ? ve : line + n) - vb);
+                        u_strip(&vb, &vn);
+                        std::string autr(vb, vn);
+                        auto it = ac->autr_map.find(autr);
+                        if (it == ac->autr_map.end() || it->second.first.empty()) {
+                            if (it != ac->autr_map.end()) ac->autr_map.erase(it);
+                            ApmEvent* e = &ev[n_ev];
+                            init_event(e, buf, line, n, CLS_AUDIT_LOG);
+                            e->bits = LOG_UNRESOLVED;
+                            e->svc_off = pool_put(pool, autr.data(), autr.size());
+                            e->svc_len = static_cast<int32_t>(autr.size());
+                            ++n_ev;
+                        } else {
+                            ac->active = true;
+                            ac->log_id = it->second.first;
+                            ac->alt_acct = it->second.second;
+                            ac->autr_map.erase(it);
+                            ac->service_map.clear();
+                            ac->elapsed_flag = false;
+                            ac->sw_flag = false;
+                            ac->active_service.clear();
+                        }
+                    }
+                } else if (!ac->exists || !ac->active) {
+                    ++n_reject;  // random log line
+                } else if (find_sub(line, n, ": RequestTrace [stopWatchList=", 30)) {
+                    ac->elapsed_flag = true;
+                } else if (ac->elapsed_flag) {
+                    if (line[0] == ']') {
+                        ac->elapsed_flag = false;
+                    } else {
+                        // service : [NNN millis] ... (FIFO per service)
+                        const char* colon = static_cast<const char*>(memchr(line, ':', n));
+                        Tok val[1];
+                        bool ok_data = false;
+                        const char* sb = line;
+                        size_t sn = 0;
+                        if (colon != nullptr) {
+                            sn = static_cast<size_t>(colon - line);
+                            if (exotic) u_strip(&sb, &sn);
+                            else {
+                                while (sn && is_tok_ws(static_cast<unsigned char>(sb[0]))) { ++sb; --sn; }
+                                while (sn && is_tok_ws(static_cast<unsigned char>(sb[sn - 1]))) --sn;
+                            }
+                            const char* vb = colon + 1;
+                            const char* ve = static_cast<const char*>(
+                                memchr(vb, ':', static_cast<size_t>(line + n - vb)));
+                            if (ve == nullptr) ve = line + n;
+                            size_t vlen = static_cast<size_t>(ve - vb);
+                            int nv = exotic ? u_tokenize(vb, vlen, val, 1)
+                                            : tokenize(vb, vlen, val, 1);
+                            ok_data = nv == 1;
+                        }
+                        if (!ok_data) {
+                            // the reference body raises IndexError; same
+                            // "Unparseable" log via an event, no state change
+                            if (n_ev >= ev_cap) return -1;
+                            ApmEvent* e = &ev[n_ev];
+                            init_event(e, buf, line, n, CLS_AUDIT_LOG);
+                            e->bits = LOG_DATA_INDEX;
+                            ++n_ev;
+                        } else {
+                            strip_brackets(val[0].p, val[0].len, &par->scratch);
+                            ac->service_map[std::string(sb, sn)].push_back(
+                                SvcEnt{par->scratch, std::string()});
+                        }
+                    }
+                } else if (find_sub(line, n, "<stopWatchList>", 15)) {
+                    ac->sw_flag = true;
+                } else if (ac->sw_flag) {
+                    if (find_sub(line, n, "</stopWatchList>", 16)) {
+                        ac->active = false;
+                        ac->log_id.clear();
+                        ac->alt_acct.clear();
+                        ac->elapsed_flag = false;
+                        ac->sw_flag = false;
+                        ac->active_service.clear();
+                        ac->service_map.clear();
+                    } else if (find_sub(line, n, "<name>", 6)) {
+                        const char* tb;
+                        size_t tn;
+                        xml_text_span(line, n, &tb, &tn);
+                        ac->active_service.assign(tb, tn);
+                    } else if (!ac->active_service.empty()) {
+                        bool is_start = find_sub(line, n, "<startTime>", 11) != nullptr;
+                        bool is_stop = !is_start &&
+                                       find_sub(line, n, "<stopTime>", 10) != nullptr;
+                        if (is_start || is_stop) {
+                            auto sit = ac->service_map.find(ac->active_service);
+                            bool empty = sit == ac->service_map.end() ||
+                                         sit->second.empty();
+                            const char* tb;
+                            size_t tn;
+                            xml_text_span(line, n, &tb, &tn);
+                            if (empty) {
+                                if (n_ev >= ev_cap) return -1;
+                                ApmEvent* e = &ev[n_ev];
+                                init_event(e, buf, line, n, CLS_AUDIT_LOG);
+                                e->bits = is_start ? LOG_NO_START : LOG_NO_STOP;
+                                e->svc_off = pool_put(pool, ac->active_service.data(),
+                                                      ac->active_service.size());
+                                e->svc_len = static_cast<int32_t>(ac->active_service.size());
+                                ++n_ev;
+                            } else if (is_start) {
+                                sit->second.front().start_ts.assign(tb, tn);
+                            } else {
+                                if (n_ev >= ev_cap) return -1;
+                                SvcEnt ent = sit->second.front();
+                                sit->second.erase(sit->second.begin());
+                                ApmEvent* e = &ev[n_ev];
+                                init_event(e, buf, line, n, CLS_AUDIT_STOP);
+                                const std::string& svc = ac->active_service;
+                                e->svc_off = pool_put(pool, svc.data(), svc.size());
+                                e->svc_len = static_cast<int32_t>(svc.size());
+                                e->logid_off = pool_put(pool, ac->log_id.data(),
+                                                        ac->log_id.size());
+                                e->logid_len = static_cast<int32_t>(ac->log_id.size());
+                                e->ts_off = pool_put(pool, ent.start_ts.data(),
+                                                     ent.start_ts.size());
+                                e->ts_len = static_cast<int32_t>(ent.start_ts.size());
+                                e->ela_off = pool_put(pool, ent.elapsed.data(),
+                                                      ent.elapsed.size());
+                                e->ela_len = static_cast<int32_t>(ent.elapsed.size());
+                                e->jts_off = static_cast<int32_t>(tb - buf);
+                                e->jts_len = static_cast<int32_t>(tn);
+                                e->baf_off = pool_put(pool, ac->alt_acct.data(),
+                                                      ac->alt_acct.size());
+                                e->baf_len = static_cast<int32_t>(ac->alt_acct.size());
+                                // non-Provider -> straight to the DB queue
+                                if (find_sub(svc.data(), svc.size(), "provider[", 9,
+                                             true) == nullptr)
+                                    e->flags |= FL_INSERT_DB;
+                                ++n_ev;
+                            }
+                        } else {
+                            ++n_reject;
+                        }
+                    } else {
+                        ++n_reject;
+                    }
+                } else {
+                    ++n_reject;
+                }
+                line = next;
+                continue;
+            }
+        }
+
+        // ---- EJB / CT entry-exit extraction + correlation ----
+        if (exotic) {
+            if (n_ev >= ev_cap) return -1;
+            init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+            ++n_ev;
+            line = next;
+            goto done;  // barrier: replay must see the pre-line record map
+        }
+        Tok arr[16];
+        int na = tokenize(line, n, arr, 16);
+        Tok half[8];
+        int nh = 0;
+        if (cls == CLS_CT_ENTRY || cls == CLS_CT_EXIT) {
+            // line.split("INFO", 1)[1].strip().split() — first occurrence;
+            // the CT markers guarantee INFO exists
+            const char* info = find_sub(line, n, "INFO", 4);
+            const char* hb = info + 4;
+            nh = tokenize(hb, static_cast<size_t>(line + n - hb), half, 8);
+        }
+        // token-count guards: one fewer than the Python handler indexes =>
+        // IndexError there => RAW here (same skip + "Unparseable" log)
+        bool ok;
+        switch (cls) {
+            case CLS_EJB_ENTRY: ok = na >= 14; break;
+            case CLS_EJB_EXIT: ok = na >= 12; break;
+            case CLS_CT_ENTRY: ok = na >= 3 && nh >= 2; break;
+            default: ok = na >= 3 && nh >= 6; break;  // CT_EXIT
+        }
+        if (!ok) {
+            if (n_ev >= ev_cap) return -1;
+            init_event(&ev[n_ev], buf, line, n, CLS_RAW);
+            ++n_ev;
+            line = next;
+            goto done;  // barrier
+        }
+        strip_brackets(arr[0].p, arr[0].len, &par->scratch);
+        const std::string logid = par->scratch;
+
+        if (cls == CLS_EJB_ENTRY || cls == CLS_CT_ENTRY) {
+            if (logid.empty()) {  // `if not log_id: return`
+                ++n_reject;
+                line = next;
+                continue;
+            }
+            std::string ts;
+            ts.reserve(static_cast<size_t>(arr[1].len + arr[2].len) + 1);
+            ts.assign(arr[1].p, arr[1].len);
+            ts.push_back(' ');
+            ts.append(arr[2].p, arr[2].len);
+            if (cls == CLS_EJB_ENTRY) {
+                std::string svc;  // "S:" + arr[13]
+                svc.reserve(static_cast<size_t>(arr[13].len) + 2);
+                svc.assign("S:");
+                svc.append(arr[13].p, arr[13].len);
+                par->park(logid.data(), logid.size(), svc.data(), svc.size(),
+                          server_id, ts.data(), ts.size(), now);
+            } else {
+                par->park(logid.data(), logid.size(), half[1].p, half[1].len,
+                          server_id, ts.data(), ts.size(), now);
+            }
+            ++n_parked;
+            line = next;
+            continue;
+        }
+
+        // exits: extract fields, then join against the record map
+        if (n_ev >= ev_cap) return -1;
+        ApmEvent* e = &ev[n_ev];
+        init_event(e, buf, line, n, cls);
+        {
+            std::string ts;  // end_ts = f"{arr[1]} {arr[2]}"
+            ts.assign(arr[1].p, arr[1].len);
+            ts.push_back(' ');
+            ts.append(arr[2].p, arr[2].len);
+            e->ts_off = pool_put(pool, ts.data(), ts.size());
+            e->ts_len = static_cast<int32_t>(ts.size());
+        }
+        std::string svckey;
+        if (cls == CLS_EJB_EXIT) {
+            svckey.assign("S:");
+            svckey.append(arr[9].p, arr[9].len);
+            e->svc_off = pool_put(pool, svckey.data(), svckey.size());
+            e->svc_len = static_cast<int32_t>(svckey.size());
+            e->ela_off = static_cast<int32_t>(arr[11].p - buf);
+            e->ela_len = arr[11].len;
+        } else {
+            svckey.assign(half[1].p, static_cast<size_t>(half[1].len));
+            e->svc_off = static_cast<int32_t>(half[1].p - buf);
+            e->svc_len = half[1].len;
+            e->ela_off = static_cast<int32_t>(half[5].p - buf);
+            e->ela_len = half[5].len;
+            // BAF salvage inputs: flag + tokens[3] (len(tokens) >= 4)
+            if (na >= 4 && match_baf_meta(line, n)) {
+                e->flags |= FL_BAF;
+                e->baf_off = static_cast<int32_t>(arr[3].p - buf);
+                e->baf_len = arr[3].len;
+            }
+        }
+        if (logid.empty()) {
+            e->flags |= FL_LOGID_EMPTY;
+        } else {
+            e->logid_off = pool_put(pool, logid.data(), logid.size());
+            e->logid_len = static_cast<int32_t>(logid.size());
+            std::string jts;
+            int32_t jsrv = -1;
+            int rc = par->take(logid.data(), logid.size(), svckey.data(),
+                               svckey.size(), now, &jsrv, &jts);
+            if (rc == 2) {
+                e->flags |= FL_JOIN_FOUND;
+                e->jserver = jsrv;
+                e->jts_off = pool_put(pool, jts.data(), jts.size());
+                e->jts_len = static_cast<int32_t>(jts.size());
+            } else if (rc == 0) {
+                e->flags |= FL_JOIN_NOKEY;
+            }
+        }
+        ++n_ev;
+        line = next;
+    }
+
+done:
+    counts[0] = n_lines;
+    counts[1] = n_reject;
+    counts[2] = n_parked;
+    counts[3] = n_ev;
+    counts[4] = pool->size();
+    // bytes consumed: a RAW event stops the scan HERE so the Python replay
+    // (which shares the native state through the shims) runs strictly in
+    // line order; the caller re-invokes on the remainder
+    counts[5] = static_cast<uint64_t>(line - buf);
+    return static_cast<int64_t>(n_ev);
+}
+
+}  // extern "C"

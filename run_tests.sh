@@ -9,6 +9,17 @@
 # tunnel. conftest.py additionally pins JAX_PLATFORMS=cpu and 8 host devices.
 cd "$(dirname "$0")"
 
+# Build the native components (parser/decoder/percentile/rebuild/ring/tail)
+# up front so the suite exercises the C++ fast paths; soft-skip with a
+# visible warning when no toolchain — every native consumer degrades to its
+# Python fallback (the differential suite covers both).
+if make -C native >/dev/null 2>&1; then
+    :
+else
+    echo "WARNING: native build failed or no C++ toolchain;" \
+         "parser/decoder fast paths unavailable — Python fallbacks in use" >&2
+fi
+
 # --lint: byte-compile the whole package (hard fail on any syntax error)
 # and run pyflakes when the environment has it (soft-skip otherwise — the
 # container image does not bake it in). Consumed standalone (CI lint stage)

@@ -1,0 +1,320 @@
+"""Differential fuzz: the native ingest fast path vs the Python reference.
+
+ISSUE 4 acceptance: for the same input bytes — dense and sparse fixture
+corpora, torn/truncated/interleaved lines, unicode garbage (valid and
+invalid UTF-8), TTL-expiry and salvage timings — a native-backed
+TransactionParser and an APM_PARSE_NO_NATIVE one must emit bit-identical
+(to_csv, insert_to_db) sequences and identical cache hit/miss/keys
+counters. The clock is stepped only BETWEEN chunks (both paths see the
+same clock value for every line of a chunk — the documented granularity
+of the batch API's TTL parity).
+"""
+
+import random
+
+import pytest
+
+from apmbackend_tpu.ingest.parser import TransactionParser
+from apmbackend_tpu.ingest.replay import FixtureGenerator, write_fixture_logs
+
+try:
+    from apmbackend_tpu.native import have_native_parser
+
+    HAVE_NATIVE = have_native_parser()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="no C++ toolchain: native parser unavailable"
+)
+
+SERVER = "jvmhost1"
+
+
+def _mk_parser(use_native, clock):
+    records = []
+    parser = TransactionParser(
+        lambda tx, db: records.append((tx.to_csv(), db)),
+        server_from_path=lambda fp: SERVER,
+        clock=clock,
+        use_native=use_native,
+    )
+    return parser, records
+
+
+def run_both(feed_plan, *, sweeps=()):
+    """Feed the identical (file, chunk-bytes | ('advance', dt) | 'sweep' |
+    'drain') plan through a native and a reference parser; returns both
+    (records, stats, counters) result sets."""
+    out = []
+    for use_native in (True, False):
+        now = [1000.0]
+        parser, records = _mk_parser(use_native, lambda: now[0])
+        assert (parser._native is not None) == use_native
+        for step in feed_plan:
+            if step[0] == "advance":
+                now[0] += step[1]
+            elif step[0] == "sweep":
+                parser.sweep()
+            elif step[0] == "drain":
+                parser.drain()
+            elif step[0] == "line":
+                parser.read_line(step[1], step[2])
+            else:
+                fp, blob = step
+                parser.read_lines(fp, blob)
+        for dt in sweeps:
+            now[0] += dt
+            parser.sweep()
+        parser.drain()
+        out.append((records, parser.cache_stats(), dict(parser.counters)))
+    return out
+
+
+def assert_equal(native, ref):
+    n_rec, n_stats, n_cnt = native
+    r_rec, r_stats, r_cnt = ref
+    if n_rec != r_rec:
+        for i, (a, b) in enumerate(zip(n_rec, r_rec)):
+            assert a == b, f"record {i} diverged:\n  native: {a}\n  ref:    {b}"
+        assert len(n_rec) == len(r_rec), (
+            f"record count diverged: {len(n_rec)} vs {len(r_rec)}"
+        )
+    assert n_stats == r_stats, f"cache stats diverged: {n_stats} vs {r_stats}"
+    assert n_cnt["lines_in"] == r_cnt["lines_in"]
+    assert n_cnt["tx_out"] == r_cnt["tx_out"]
+    assert n_cnt["db_direct_out"] == r_cnt["db_direct_out"]
+
+
+def chunked_plan(paths, *, chunk, seed=0, advance=0.01):
+    """Interleave byte chunks across files, carving at line boundaries with
+    a pseudo-random chunk size so torn reads land everywhere."""
+    rng = random.Random(seed)
+    blobs = {fp: open(fp, "rb").read() for fp in sorted(paths)}
+    offs = {fp: 0 for fp in blobs}
+    tails = {fp: b"" for fp in blobs}
+    plan = []
+    live = list(blobs)
+    while live:
+        nxt = []
+        for fp in live:
+            b, o = blobs[fp], offs[fp]
+            if o >= len(b):
+                if tails[fp]:
+                    plan.append((fp, tails[fp]))
+                    tails[fp] = b""
+                continue
+            step = rng.randrange(1, chunk)
+            blob = tails[fp] + b[o: o + step]
+            offs[fp] = o + step
+            cut = blob.rfind(b"\n")
+            if cut >= 0:
+                plan.append((fp, blob[: cut + 1]))
+                tails[fp] = blob[cut + 1:]
+            else:
+                tails[fp] = blob
+            nxt.append(fp)
+        live = nxt
+        plan.append(("advance", advance))
+    return plan
+
+
+@needs_native
+@pytest.mark.parametrize("density", [1000.0, None], ids=["dense", "sparse"])
+def test_fixture_corpora_identical(tmp_path, density):
+    paths = write_fixture_logs(
+        str(tmp_path), n_transactions=400, seed=13, tx_per_bucket=density
+    )
+    plan = chunked_plan(paths.values(), chunk=2048, seed=3)
+    native, ref = run_both(plan, sweeps=(31.0, 121.0))
+    assert_equal(native, ref)
+    # the corpus must actually exercise the fast path + the pre-filter
+    assert native[2]["native_lines"] == native[2]["lines_in"] > 1000
+    assert native[2]["prefilter_rejected"] > 0
+    assert len(native[0]) >= 400
+
+
+@needs_native
+def test_ttl_expiry_and_salvage_paths_identical(tmp_path):
+    """Entries without exits (record-TTL discard), exits parked numberless
+    (need-TTL emit-anyway), BAF salvage, backfill release — with the clock
+    stepped across every TTL boundary between chunks."""
+    gen = FixtureGenerator(server=SERVER, seed=5)
+    pairs = []
+    # exit-less entry -> parked partial, discarded at record TTL
+    pairs.append(("server.log",
+                  "[jbX1] 2024-01-10 09:00:00,000 INFO [CommonTiming] The EJB "
+                  "timing entry has begun for method lostCall x y z"))
+    # numberless pair -> need cache -> emit-anyway at need TTL
+    pairs += gen.soap_transaction("getBar", 250)
+    # salvage: BAF metadata carries the number
+    pairs += gen.standard_ct_transaction("getOffers", 300, acct=555000111, baf_meta=True)
+    # backfill: timing first, SOAP account later
+    late = gen.soap_transaction("getFoo", 400, acct=111222333)
+    soap_lines = [p for p in late if p[0].startswith("soap")]
+    server_lines = [p for p in late if p[0] == "server.log"]
+    pairs += soap_lines[:1] + server_lines
+    by_file = {}
+    for fp, line in pairs:
+        by_file.setdefault(fp, []).append(line)
+    plan = [(fp, ("\n".join(ls) + "\n").encode()) for fp, ls in by_file.items()]
+    plan.append(("advance", 31.0))   # past need TTL
+    plan.append(("sweep",))
+    # late SOAP account arrives after the need-cache flush
+    plan.append((soap_lines[0][0], (soap_lines[1][1] + "\n").encode()))
+    plan.append(("advance", 121.0))  # past record TTL
+    plan.append(("sweep",))
+    native, ref = run_both(plan)
+    assert_equal(native, ref)
+    assert len(native[0]) >= 3
+
+
+def _garbage_lines(seed):
+    rng = random.Random(seed)
+    unicode_junk = ["café", " nbsp tok", "　wide", "znel",
+                    " ogham", "\x1cfs\x1d", "résumé"]
+    lines = []
+    # exotic bytes INSIDE marker lines: RAW fallback joins through the shims
+    lines.append("[jbé1] 2024-01-10 09:00:00,000 INFO [CommonTiming] The EJB "
+                 "timing entry has begun for method accént".encode())
+    lines.append("[jbé1] 2024-01-10 09:00:00,500 INFO [CommonTiming] Total "
+                 "time for EJB accént call: 500 ms".encode())
+    # NBSP is str-whitespace but not bytes-whitespace: tokenization parity
+    lines.append("[jb2] 2024-01-10 09:00:01,000 INFO CommonTiming::Start "
+                 "svc A begin".encode())
+    lines.append("[jb2] 2024-01-10 09:00:01,200 INFO CommonTiming::Stop svcA "
+                 "completed in time: 200 ms".encode())
+    # invalid UTF-8 (truncated multibyte + stray continuation)
+    lines.append(b"[jb3] 2024-01-10 09:00:02,000 INFO [CommonTiming] Total time "
+                 b"for EJB sv\xff call: 10 ms")
+    lines.append(b"\xc3 lone lead byte \x80 stray continuation")
+    lines.append(b"[jb4] 2024-01-10 09:00:03,000 \xe2\x82 truncated INFO "
+                 b"CommonTiming::Stop svcB completed in time: 30 ms")
+    # torn/truncated marker lines (IndexError paths)
+    lines.append(b"[jb5] 2024-01-10 09:00:04,000 INFO [CommonTiming] The EJB")
+    lines.append(b"INFO CommonTiming::Start")
+    lines.append(b"=== jbossId IO=I no equals token")
+    lines.append("=== jbossId=jbß ts=x IO=I ===".encode())
+    lines.append(b"  <accountNumber>987654321</accountNumber>")
+    lines.append(b"<accountNumber no closing bracket")
+    # audit machinery with unicode + garbage
+    lines.append("[jb6] 2024-01-10 09:00:05,000 [ch:9:42] INFO  "
+                 "auditTrailId=AUTRÄ04 begin".encode())
+    lines.append("Audit Trail id : AUTRÄ04".encode())
+    lines.append(b"summary: RequestTrace [stopWatchList=")
+    lines.append("svçunicode :[77 millis] step".encode())
+    lines.append(b"no colon data line inside elapsed section")
+    lines.append(b"]")
+    lines.append(b"<stopWatchList>")
+    lines.append("  <name>svçunicode</name>".encode())
+    lines.append(b"  <startTime>2024-01-10T09:00:05.000-00:00</startTime>")
+    lines.append(b"  <stopTime>2024-01-10T09:00:05.077-00:00</stopTime>")
+    lines.append(b"</stopWatchList>")
+    for _ in range(60):
+        junk = rng.choice(unicode_junk)
+        lines.append(f"{junk} noise {rng.randrange(10**6)}".encode())
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(3, 30)))
+        lines.append(raw.replace(b"\n", b"x"))
+    rng.shuffle(lines)
+    return lines
+
+
+@needs_native
+@pytest.mark.parametrize("kind_file", ["server.log", "app_x.log", "soap_io_x.log"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_unicode_garbage_identical(kind_file, seed):
+    lines = _garbage_lines(seed)
+    blob = b"\n".join(lines) + b"\n"
+    rng = random.Random(seed + 99)
+    plan = []
+    off = 0
+    tail = b""
+    while off < len(blob):
+        step = rng.randrange(8, 400)
+        piece = tail + blob[off: off + step]
+        off += step
+        cut = piece.rfind(b"\n")
+        if cut >= 0:
+            plan.append((kind_file, piece[: cut + 1]))
+            tail = piece[cut + 1:]
+        else:
+            tail = piece
+        plan.append(("advance", 0.5))
+    if tail:
+        plan.append((kind_file, tail))
+    native, ref = run_both(plan, sweeps=(31.0, 121.0))
+    assert_equal(native, ref)
+
+
+@needs_native
+def test_mixed_read_line_and_read_lines_identical(tmp_path):
+    """The per-line API and the batch API share one native state: a stream
+    fed half through read_line and half through read_lines must match the
+    reference fed identically."""
+    paths = write_fixture_logs(str(tmp_path), n_transactions=120, seed=21)
+    plan = []
+    for fp in sorted(paths.values()):
+        raw = open(fp, "rb").read().decode("utf-8", "replace").split("\n")
+        for i, line in enumerate(raw):
+            if i % 3 == 0:
+                plan.append(("line", fp, line))
+            else:
+                plan.append((fp, (line + "\n").encode()))
+        plan.append(("advance", 0.2))
+    native, ref = run_both(plan, sweeps=(31.0, 121.0))
+    assert_equal(native, ref)
+
+
+def test_native_absent_graceful_fallback(tmp_path, monkeypatch):
+    """APM_PARSE_NO_NATIVE=1 (and native-unavailable construction) must
+    yield a working pure-Python parser with the same batch API."""
+    monkeypatch.setenv("APM_PARSE_NO_NATIVE", "1")
+    records = []
+    parser = TransactionParser(
+        lambda tx, db: records.append(tx), server_from_path=lambda fp: SERVER
+    )
+    assert parser._native is None
+    gen = FixtureGenerator(server=SERVER)
+    pairs = gen.soap_transaction("getAccountInfo", 500, acct=123456789)
+    by_file = {}
+    for fp, line in pairs:
+        by_file.setdefault(fp, []).append(line)
+    fed = 0
+    for fp, ls in by_file.items():
+        fed += parser.read_lines(fp, "\n".join(ls) + "\n")
+    assert fed == len(pairs)
+    assert len(records) == 1 and records[0].acct_num == 123456789
+    # str and bytes chunks are both accepted; trailing-newline rule holds
+    assert parser.read_lines("app_x.log", b"") == 0
+    assert parser.read_lines("app_x.log", "noise\n\nmore\n") == 3
+
+
+@needs_native
+def test_kill_switch_env_disables_native(monkeypatch):
+    monkeypatch.setenv("APM_PARSE_NO_NATIVE", "1")
+    parser = TransactionParser(lambda tx, db: None)
+    assert parser._native is None
+    monkeypatch.delenv("APM_PARSE_NO_NATIVE")
+    parser2 = TransactionParser(lambda tx, db: None)
+    assert parser2._native is not None
+
+
+@needs_native
+def test_counters_and_exporter_fields(tmp_path):
+    """The new fast-path counters feed the exporter (satellite 5): present,
+    monotonic, and consistent with the line totals."""
+    paths = write_fixture_logs(str(tmp_path), n_transactions=50, seed=2)
+    parser, _ = _mk_parser(True, __import__("time").monotonic)
+    for fp in sorted(paths.values()):
+        parser.read_lines(fp, open(fp, "rb").read())
+    c = parser.counters
+    assert c["native_lines"] == c["lines_in"] > 0
+    assert 0 < c["prefilter_rejected"] < c["lines_in"]
+    from apmbackend_tpu.obs import MetricsRegistry
+    from apmbackend_tpu.obs.views import register_parser
+
+    reg = MetricsRegistry()
+    register_parser(parser, "testmod", registry=reg)
+    text = reg.render()
+    assert "apm_parser_native_lines_total" in text
+    assert "apm_parser_prefilter_rejected_total" in text
