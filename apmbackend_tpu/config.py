@@ -252,6 +252,21 @@ _DEFAULT_CONFIG: dict = {
     "observability": {
         "enabled": True,
         "metricsHost": "127.0.0.1",
+        # Distributed trace plane (obs/trace): head-sample every Nth message
+        # at transport entry (trace_id header + per-hop spans served by the
+        # exporter's /trace; histograms gain bucket exemplars). 0 disables
+        # sampling entirely — the wire and the hot path are then bit-identical
+        # to the pre-trace backend.
+        "traceSampleRate": 64,
+        "traceRingSize": 512,
+        # Crash flight recorder (obs/flight): triage-bundle directory (None =
+        # disabled). Bundles are dumped on healthz degradation, SIGTERM/SIGINT,
+        # worker feed exceptions, and on demand via /flight; a journal +
+        # alive-sentinel shadow rewritten every flightJournalSeconds survives
+        # kill−9 and is promoted to a crash bundle on the next boot.
+        "flightDir": None,
+        "flightJournalSeconds": 5.0,
+        "flightMaxBundles": 16,
     },
     "statistics": [
         {"type": "average"},

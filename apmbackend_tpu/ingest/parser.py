@@ -333,6 +333,13 @@ class TransactionParser:
         self._autr_ctx: Dict[str, _AutrContext] = {}
         self._file_ids: Dict[str, int] = {}
         self._clock = clock
+        # trace plane (obs/trace): the parser is the raw-read ingest
+        # boundary — read_lines notes each chunk's wall time so the producer
+        # can anchor the sampled ingest span there. One attribute load +
+        # integer compare per CHUNK (never per line); rate 0 = no-op.
+        from ..obs.trace import get_tracer
+
+        self._obs_tracer = get_tracer()
         # logId -> acctNum (backfill source)
         self.acct_cache = TTLCache(acct_ttl_s, clock=clock)
         # the native ingest fast path (marker pre-filter + field extraction
@@ -774,6 +781,7 @@ class TransactionParser:
             data = data.encode("utf-8", "replace")
         if not data:
             return 0
+        self._obs_tracer.note_ingest_start()  # chunk-granular ingest anchor
         if self._native is None:
             segs = data.decode("utf-8", "replace").split("\n")
             if segs[-1] == "" and len(segs) > 1:

@@ -378,6 +378,10 @@ class ManagerApp:
         self._health_streaks = {mod.module: 0 for mod in self.modules}
         if getattr(runtime, "telemetry", None) is not None:
             runtime.telemetry.add_route("/fleet", self._fleet_route)
+            # overrides the exporter's per-process /trace: the manager's view
+            # stitches spans ACROSS children by trace_id (the distributed half
+            # of the trace plane)
+            runtime.telemetry.add_route("/trace", self._trace_route)
             runtime.telemetry.add_health("fleet", self._fleet_health)
 
         if spawn_children:
@@ -516,7 +520,29 @@ class ManagerApp:
             self.annotate(msg)
             self.alerts.add(msg)
             self._m_watchdog[mod.module].inc()
+            # last-words pull: a wedged-but-serving child can still dump a
+            # flight bundle — request one before the SIGTERM destroys the
+            # evidence (best effort; a fully dead HTTP thread just times out)
+            self._request_child_flight(url, timeout_s)
             mod.force_restart()
+
+    def _request_child_flight(self, url: str, timeout_s: float) -> Optional[str]:
+        """GET <child>/flight?reason=watchdog_restart; returns the bundle
+        path the child reported, or None. Separate method for test seams."""
+        import json as _json
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"{url}/flight?reason=watchdog_restart", timeout=timeout_s
+            ) as resp:
+                body = _json.loads(resp.read().decode("utf-8", "replace"))
+            bundle = body.get("bundle")
+            if bundle:
+                self.runtime.logger.warning(f"Wedged child flight bundle: {bundle}")
+            return bundle
+        except Exception:
+            return None
 
     # -- fleet telemetry aggregation ------------------------------------------
     def _child_metrics_targets(self) -> List[tuple]:
@@ -558,6 +584,51 @@ class ManagerApp:
         from ..obs.exporter import PROM_CONTENT_TYPE
 
         return 200, PROM_CONTENT_TYPE, self.scrape_fleet()
+
+    def scrape_traces(self, trace_id: Optional[str] = None, timeout_s: float = 2.0) -> dict:
+        """GET every child's /trace, fold in the manager's own process ring
+        (colocated producers), and stitch spans by trace_id — one
+        cross-module view of each sampled transaction's ingest → queue →
+        feed → tick → emit → alert → sink journey. A down child contributes
+        an error marker instead of failing the stitch."""
+        import json as _json
+        import urllib.parse
+        import urllib.request
+
+        from ..obs.trace import get_tracer
+
+        spans: List[dict] = []
+        children: dict = {}
+        q = f"?trace_id={urllib.parse.quote(trace_id)}" if trace_id else ""
+        for name, url in self._child_metrics_targets():
+            try:
+                with urllib.request.urlopen(f"{url}/trace{q}", timeout=timeout_s) as resp:
+                    body = _json.loads(resp.read().decode("utf-8", "replace"))
+                children[name] = body.get("count", 0)
+                for s in body.get("spans", []):
+                    s.setdefault("module", name)
+                    spans.append(s)
+            except Exception as e:
+                children[name] = f"error: {e!r}"
+        for s in get_tracer().ring.spans(trace_id=trace_id):
+            spans.append(s)
+        traces: dict = {}
+        for s in spans:
+            traces.setdefault(s.get("trace_id"), []).append(s)
+        for tid in traces:
+            traces[tid].sort(key=lambda s: (s.get("start", 0.0), s.get("end", 0.0)))
+        return {
+            "children": children,
+            "trace_count": len(traces),
+            "traces": traces,
+        }
+
+    def _trace_route(self, query):
+        import json as _json
+
+        trace_id = (query.get("trace_id") or [None])[0]
+        body = self.scrape_traces(trace_id)
+        return 200, "application/json", _json.dumps(body, indent=1, default=repr)
 
     def _fleet_health(self) -> dict:
         """Aggregated child liveness for the manager's own /healthz: process

@@ -15,29 +15,50 @@ optimization (PAPERS.md: arxiv 1712.08285 per-stage timing, arxiv
 - :mod:`.tracing` — the per-tick span ring + stage histograms the
   PipelineDriver records so "where did this tick's 0.56 ms go" is
   answerable in production, not just in bench_dispatch.py.
+- :mod:`.trace` — sampled per-transaction trace propagation: head-sampled
+  trace contexts stamped at transport entry, spans per hop (ingest →
+  queue → feed → tick → emit → alert) in a ring served by ``/trace``,
+  histograms linking back via OpenMetrics exemplars.
+- :mod:`.decisions` — alert decision provenance: the z-score inputs
+  behind every page, keyed by trace_id, served by ``/decisions``.
+- :mod:`.flight` — crash flight-recorder bundles: bounded triage dumps on
+  healthz degradation / SIGTERM / watchdog restart, plus a journal +
+  sentinel shadow that survives kill−9 and is promoted to a crash bundle
+  on the next boot.
 
 Everything here is stdlib-only and import-light: no jax at import time
 (the /profile route imports it lazily), no hard dependency from any hot
 path — a driver with telemetry disabled never touches this package.
 """
 
+from .decisions import DecisionRing, get_decisions
 from .exporter import TelemetryServer, telemetry_active
+from .flight import FlightRecorder
 from .registry import (
     MetricsRegistry,
     Sample,
     get_registry,
+    histogram_quantile,
     parse_prom_text,
     relabel_metrics,
     set_registry,
 )
+from .trace import SpanRing, Tracer, get_tracer
 from .tracing import TickTracer
 
 __all__ = [
+    "DecisionRing",
+    "FlightRecorder",
     "MetricsRegistry",
     "Sample",
+    "SpanRing",
     "TelemetryServer",
     "TickTracer",
+    "Tracer",
+    "get_decisions",
     "get_registry",
+    "get_tracer",
+    "histogram_quantile",
     "parse_prom_text",
     "relabel_metrics",
     "set_registry",

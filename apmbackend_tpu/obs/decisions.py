@@ -1,0 +1,73 @@
+"""Alert decision provenance: why did this page fire?
+
+The reference's alert was a bare wire line — a number crossed a band, an
+email went out, and the on-call replayed logs to reconstruct why. Every
+anomaly alert the PipelineDriver dispatches now also emits a **decision
+record**: the z-score inputs per channel at trigger time — per-metric
+triggering value, rolling window mean, derived std, the lower/upper bands
+actually compared, the smoothed signal, the configured threshold and
+influence, window occupancy (ring fill for lag channels / sample count
+for EWMA channels), and the device cause bits — keyed by the sampled
+trace_id when the triggering bucket contained one. A page is thereby
+*replayable* instead of a bare number.
+
+Records are plain dicts in a process-wide bounded ring (same discipline
+as the trace SpanRing) served by the exporter's ``/decisions`` endpoint
+and folded into flight-recorder bundles. Recording happens on the ALERT
+path only — never per message or per tick — so the hot path is untouched;
+``observability.enabled: false`` removes it entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+
+class DecisionRing:
+    """Thread-safe bounded ring of alert decision records (plain dicts)."""
+
+    def __init__(self, maxlen: int = 256):
+        self._ring: deque = deque(maxlen=int(maxlen))
+        self._lock = threading.Lock()
+        self.total = 0  # monotonic count of decisions ever recorded
+
+    def record(self, decision: dict) -> None:
+        with self._lock:
+            self._ring.append(decision)
+            self.total += 1
+
+    def recent(self, n: Optional[int] = None, trace_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        if trace_id is not None:
+            items = [d for d in items if d.get("trace_id") == trace_id]
+        if n is not None and n > 0:
+            items = items[-n:]
+        return items
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+# -- the process-global ring --------------------------------------------------
+
+_decisions = DecisionRing()
+
+
+def get_decisions() -> DecisionRing:
+    """The process-wide decision ring the driver records into."""
+    return _decisions
+
+
+def set_decisions(ring: DecisionRing) -> DecisionRing:
+    """Swap the process-global ring (test isolation); returns the old."""
+    global _decisions
+    old, _decisions = _decisions, ring
+    return old

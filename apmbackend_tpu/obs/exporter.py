@@ -16,7 +16,21 @@ exposed as :attr:`TelemetryServer.port`). Routes:
 - ``GET /profile?ms=500`` — on-demand capture: a jax.profiler trace of
   ``ms`` milliseconds into a timestamped directory (TensorBoard/perfetto
   readable) plus a heap snapshot via utils.profiling — the live
-  "attach the inspector" affordance, now one curl away.
+  "attach the inspector" affordance, now one curl away. Captures are
+  serialized process-wide (jax.profiler is a process-global singleton):
+  a second concurrent request — even against another TelemetryServer in
+  the same process — gets 409 instead of racing two traces.
+- ``GET /trace[?trace_id=&n=]`` — recent spans from the process trace
+  ring (obs.trace), JSON; the per-module half of distributed traces (the
+  manager's own ``/trace`` stitches across children by trace_id).
+- ``GET /decisions[?trace_id=&n=]`` — recent alert decision records
+  (obs.decisions): why each page fired, resolvable by trace_id.
+- ``GET /flight?reason=...`` — on-demand flight-recorder bundle when the
+  module runs one (obs.flight); the manager's watchdog requests this
+  from a wedged child right before force-restarting it. A degraded
+  /healthz also dumps a bundle (rate-limited).
+- ``GET /metrics?exemplars=1`` — OpenMetrics-style exposition with
+  histogram bucket exemplars (``# {trace_id="..."} value ts``).
 - extra routes via :meth:`add_route` (the manager mounts ``/fleet``).
 
 Health providers and routes are plain callables so modules register
@@ -35,6 +49,12 @@ from urllib.parse import parse_qs, urlparse
 from .registry import MetricsRegistry, get_registry
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+# jax.profiler is a process-global singleton: captures must serialize across
+# every TelemetryServer in the process, not per instance (two modules'
+# exporters in one standalone process used to race start_trace/stop_trace)
+_profile_capture_lock = threading.Lock()
 
 # live exporter count: single-process topologies (standalone) start ONE
 # exporter on the lead runtime while satellites share the process registry —
@@ -70,7 +90,9 @@ class TelemetryServer:
         self._thread: Optional[threading.Thread] = None
         self._health: Dict[str, Callable[[], dict]] = {}
         self._routes: Dict[str, Callable[[dict], Tuple[int, str, str]]] = {}
-        self._profile_lock = threading.Lock()
+        # the module's FlightRecorder when it runs one (ModuleRuntime wires
+        # it); serves /flight and the degraded-healthz auto-dump
+        self.flight = None
 
     # -- registration ---------------------------------------------------------
     def add_health(self, name: str, fn: Callable[[], dict]) -> None:
@@ -82,7 +104,15 @@ class TelemetryServer:
         self._routes[path] = fn
 
     # -- handlers -------------------------------------------------------------
-    def _handle_metrics(self, _query) -> Tuple[int, str, str]:
+    def _handle_metrics(self, query) -> Tuple[int, str, str]:
+        if query.get("exemplars"):
+            # OpenMetrics-style exposition: bucket lines carry trace_id
+            # exemplars linking the latency histogram back to /trace
+            return (
+                200,
+                OPENMETRICS_CONTENT_TYPE,
+                self.registry.render(exemplars=True) + "# EOF\n",
+            )
         return 200, PROM_CONTENT_TYPE, self.registry.render()
 
     def _handle_healthz(self, _query) -> Tuple[int, str, str]:
@@ -97,23 +127,86 @@ class TelemetryServer:
                 ok = False
             body[name] = section
         body["status"] = "ok" if ok else "degraded"
+        if not ok and self.flight is not None:
+            # degradation is a flight-recorder trigger; rate-limited inside
+            # dump() so a flapping probe cannot churn the bundle directory
+            try:
+                bundle = self.flight.dump("healthz_degraded")
+                if bundle:
+                    body["flight_bundle"] = bundle
+            except Exception:
+                pass
         return (200 if ok else 503), "application/json", json.dumps(body, indent=1)
 
+    def _handle_trace(self, query) -> Tuple[int, str, str]:
+        from .trace import get_tracer
+
+        trace_id = (query.get("trace_id") or [None])[0]
+        try:
+            n = max(1, min(int((query.get("n") or ["256"])[0]), 4096))
+        except (TypeError, ValueError):
+            return 400, "application/json", json.dumps({"error": "bad n parameter"})
+        tracer = get_tracer()
+        spans = tracer.ring.spans(trace_id=trace_id, n=n)
+        body = {
+            "module": self.module,
+            "sample_rate": tracer.rate,
+            "count": len(spans),
+            "spans": spans,
+        }
+        return 200, "application/json", json.dumps(body, indent=1, default=repr)
+
+    def _handle_decisions(self, query) -> Tuple[int, str, str]:
+        from .decisions import get_decisions
+
+        trace_id = (query.get("trace_id") or [None])[0]
+        try:
+            n = max(1, min(int((query.get("n") or ["128"])[0]), 4096))
+        except (TypeError, ValueError):
+            return 400, "application/json", json.dumps({"error": "bad n parameter"})
+        ring = get_decisions()
+        records = ring.recent(n, trace_id=trace_id)
+        body = {
+            "module": self.module,
+            "total": ring.total,
+            "count": len(records),
+            "decisions": records,
+        }
+        return 200, "application/json", json.dumps(body, indent=1, default=repr)
+
+    def _handle_flight(self, query) -> Tuple[int, str, str]:
+        if self.flight is None:
+            return 404, "application/json", json.dumps(
+                {"error": "flight recorder not configured (observability.flightDir)"}
+            )
+        reason = (query.get("reason") or ["on_demand"])[0][:64]
+        try:
+            path = self.flight.dump(reason, force=True)
+        except Exception as e:
+            return 500, "application/json", json.dumps({"error": repr(e)})
+        return 200, "application/json", json.dumps({"module": self.module, "bundle": path})
+
     def _handle_profile(self, query) -> Tuple[int, str, str]:
-        """Capture a bounded device trace + heap snapshot; serialized so two
-        concurrent curls cannot interleave jax.profiler start/stop."""
+        """Capture a bounded device trace + heap snapshot; serialized
+        process-wide so two concurrent curls (or two exporters in one
+        process) cannot interleave jax.profiler start/stop or land two
+        captures in the same directory."""
         try:
             ms = max(1, min(int(query.get("ms", ["500"])[0]), 60_000))
         except (TypeError, ValueError):
             return 400, "application/json", json.dumps({"error": "bad ms parameter"})
-        if not self._profile_lock.acquire(blocking=False):
+        if not _profile_capture_lock.acquire(blocking=False):
             return 409, "application/json", json.dumps({"error": "profile capture already running"})
         try:
             import os
 
             from ..utils.profiling import heap_snapshot
 
-            stamp = time.strftime("%Y%m%d-%H%M%S")
+            # pid + uuid: two captures in the same second (or from two
+            # processes sharing a log dir) must not collide on one directory
+            import uuid
+
+            stamp = f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
             trace_dir = os.path.join(self.profile_dir, f"profile-{self.module}-{stamp}")
             result = {"module": self.module, "ms": ms}
             try:
@@ -132,7 +225,7 @@ class TelemetryServer:
             status = 200 if ("trace_dir" in result or result["heap_snapshot"]) else 503
             return status, "application/json", json.dumps(result, indent=1)
         finally:
-            self._profile_lock.release()
+            _profile_capture_lock.release()
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> int:
@@ -145,6 +238,9 @@ class TelemetryServer:
                     "/metrics": outer._handle_metrics,
                     "/healthz": outer._handle_healthz,
                     "/profile": outer._handle_profile,
+                    "/trace": outer._handle_trace,
+                    "/decisions": outer._handle_decisions,
+                    "/flight": outer._handle_flight,
                     **outer._routes,
                 }.get(parsed.path)
                 if route is None:
@@ -179,7 +275,7 @@ class TelemetryServer:
         if self.logger:
             self.logger.info(
                 f"Telemetry exporter listening on http://{self.host}:{self.port} "
-                f"(/metrics /healthz /profile)"
+                f"(/metrics /healthz /profile /trace /decisions /flight)"
             )
         return self.port
 

@@ -1,0 +1,255 @@
+"""Crash flight-recorder bundles: post-mortems without grepping logs.
+
+A watchdog restart or a kill−9 used to mean reconstructing the module's
+final moments from interleaved log lines. The :class:`FlightRecorder`
+keeps a bounded, always-current triage picture of one module process —
+registered *sources* (tick-span ring, recent traces + decisions, metrics
+snapshot, config hash, backlog depths) sampled on demand — and writes it
+to ``observability.flightDir`` as a JSON **bundle** on the paths a
+process can still act on:
+
+- healthz degradation (the exporter dumps, rate-limited);
+- SIGTERM / SIGINT (ModuleRuntime's handler, before exit handlers run);
+- an unhandled worker feed exception (the device loop's crash-damping);
+- on demand via the exporter's ``GET /flight?reason=...`` (the manager's
+  hung-tick watchdog requests one from a wedged-but-serving child right
+  before force-restarting it).
+
+**kill−9 has no handler**, so the recorder also maintains an on-disk
+shadow: a *journal* (atomic snapshot of the same sources, rewritten on a
+timer) plus an *alive sentinel* (written at boot, removed on clean
+shutdown). A SIGKILLed process leaves both behind; the NEXT boot finds
+the sentinel, promotes the last journal into a ``...-crash.json`` bundle
+(:meth:`recover_crash`), and re-arms. The chaos harness asserts this end
+to end: kill−9 produces a parseable bundle while the run stays
+bit-identical to the golden run — the recorder only ever *reads* pipeline
+state and writes files under its own directory.
+
+Bundles are bounded (``max_bundles``, oldest pruned) and every source is
+guarded: a broken source degrades to an error string, never a failed
+dump. Stdlib only, like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# a runaway metrics render must not balloon bundles: cap any single
+# string-valued source (journals rewrite frequently)
+MAX_SOURCE_CHARS = 262_144
+
+
+def config_hash(config: dict) -> str:
+    """Stable digest of the live config — ties a bundle to the exact
+    settings the process was running under."""
+    import hashlib
+
+    try:
+        blob = json.dumps(config, sort_keys=True, default=repr)
+    except Exception:
+        blob = repr(config)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        directory: str,
+        module: str,
+        *,
+        max_bundles: int = 16,
+        min_interval_s: float = 30.0,
+        logger=None,
+    ):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.module = module
+        self.max_bundles = int(max_bundles)
+        self.min_interval_s = float(min_interval_s)
+        self.logger = logger
+        self._sources: Dict[str, Callable[[], object]] = {}
+        self._lock = threading.Lock()
+        self._last_dump = 0.0
+        self._seq = 0  # per-process bundle counter: unique names within one second
+        self.journal_path = os.path.join(self.directory, f"{module}.journal.json")
+        self.sentinel_path = os.path.join(self.directory, f"{module}.alive")
+
+    # -- sources --------------------------------------------------------------
+    def add_source(self, name: str, fn: Callable[[], object]) -> None:
+        """``fn() -> JSON-serializable`` sampled at snapshot time; a broken
+        source contributes its error string instead of failing the dump."""
+        self._sources[name] = fn
+
+    def snapshot(self, reason: str = "") -> dict:
+        body: dict = {
+            "module": self.module,
+            "ts": time.time(),
+            "reason": reason,
+            "pid": os.getpid(),
+        }
+        for name, fn in list(self._sources.items()):
+            try:
+                value = fn()
+                if isinstance(value, str) and len(value) > MAX_SOURCE_CHARS:
+                    value = value[:MAX_SOURCE_CHARS] + "...[truncated]"
+                json.dumps(value, default=repr)  # serializability gate per source
+            except Exception as e:
+                value = f"source error: {e!r}"
+            body[name] = value
+        return body
+
+    # -- direct bundles -------------------------------------------------------
+    def dump(self, reason: str, *, force: bool = False) -> Optional[str]:
+        """Write one bundle; rate-limited unless ``force`` (a flapping
+        healthz must not churn the directory). Returns the path or None."""
+        with self._lock:
+            now = time.time()
+            if not force and now - self._last_dump < self.min_interval_s:
+                return None
+            self._last_dump = now
+            self._seq += 1
+            seq = self._seq
+        body = self.snapshot(reason)
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.localtime(body["ts"]))
+        safe_reason = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)[:48]
+        path = os.path.join(
+            self.directory,
+            f"flight-{self.module}-{stamp}-{os.getpid()}-{seq}-{safe_reason or 'manual'}.json",
+        )
+        try:
+            self._write_atomic(path, body)
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Flight bundle write failed: {e}")
+            return None
+        if self.logger:
+            self.logger.warning(f"Flight bundle written ({reason}): {path}")
+        self._prune()
+        return path
+
+    def bundles(self) -> List[str]:
+        """Bundle paths, oldest first."""
+        try:
+            names = [
+                n for n in os.listdir(self.directory)
+                if n.startswith("flight-") and n.endswith(".json")
+            ]
+        except OSError:
+            return []
+        paths = [os.path.join(self.directory, n) for n in names]
+        paths.sort(key=lambda p: (os.path.getmtime(p), p))
+        return paths
+
+    def _prune(self) -> None:
+        paths = self.bundles()
+        for path in paths[: max(0, len(paths) - self.max_bundles)]:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -- the kill−9 shadow ----------------------------------------------------
+    def journal(self) -> None:
+        """Rewrite the on-disk journal (atomic) — the state a SIGKILL leaves
+        behind for the next boot to promote. Runs on a timer; cheap enough
+        for sub-second cadences (one JSON dump of bounded sources)."""
+        try:
+            self._write_atomic(self.journal_path, self.snapshot("journal"))
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Flight journal write failed: {e}")
+
+    def mark_alive(self) -> None:
+        """Write the alive sentinel (+ an initial journal so even an
+        immediate SIGKILL leaves something to promote)."""
+        self.journal()
+        try:
+            self._write_atomic(
+                self.sentinel_path, {"pid": os.getpid(), "start_ts": time.time()}
+            )
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Flight sentinel write failed: {e}")
+
+    def mark_clean_exit(self) -> None:
+        try:
+            os.unlink(self.sentinel_path)
+        except OSError:
+            pass
+
+    def recover_crash(self) -> Optional[str]:
+        """Boot-time check: a leftover sentinel means the previous process
+        died without a clean shutdown (kill−9, OOM, power). Promote its last
+        journal into a crash bundle; returns the bundle path or None."""
+        if not os.path.exists(self.sentinel_path):
+            return None
+        crash: dict = {"module": self.module, "recovered": True,
+                       "crash_detected_ts": time.time()}
+        try:
+            with open(self.sentinel_path, "r", encoding="utf-8") as fh:
+                crash["previous_process"] = json.load(fh)
+        except Exception:
+            crash["previous_process"] = None
+        try:
+            with open(self.journal_path, "r", encoding="utf-8") as fh:
+                crash["journal"] = json.load(fh)
+        except Exception as e:
+            crash["journal"] = None
+            crash["journal_error"] = repr(e)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        path = os.path.join(
+            self.directory,
+            f"flight-{self.module}-{stamp}-{os.getpid()}-{seq}-crash.json",
+        )
+        try:
+            self._write_atomic(path, crash)
+        except Exception as e:
+            if self.logger:
+                self.logger.error(f"Crash bundle write failed: {e}")
+            return None
+        self.mark_clean_exit()  # consume the sentinel: one crash, one bundle
+        self._prune()
+        if self.logger:
+            self.logger.warning(f"Crash flight bundle recovered: {path}")
+        return path
+
+    # -- io -------------------------------------------------------------------
+    @staticmethod
+    def _write_atomic(path: str, body: dict) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(body, fh, indent=1, default=repr)
+        os.replace(tmp, path)
+
+
+def read_bundle(path: str) -> dict:
+    """Parse one bundle (tests, triage tooling)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def list_bundles(directory: str, module: Optional[str] = None) -> List[Tuple[str, dict]]:
+    """(path, parsed body) for every bundle in ``directory``, oldest first.
+    Unparseable files raise — a bundle that cannot be read is a bug the
+    chaos harness exists to catch."""
+    directory = os.path.abspath(directory)
+    try:
+        names = sorted(
+            n for n in os.listdir(directory)
+            if n.startswith("flight-") and n.endswith(".json")
+            and (module is None or n.startswith(f"flight-{module}-"))
+        )
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        path = os.path.join(directory, name)
+        out.append((path, read_bundle(path)))
+    return out

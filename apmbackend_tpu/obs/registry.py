@@ -25,6 +25,7 @@ from __future__ import annotations
 import bisect
 import re
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 # latency buckets in SECONDS: 100 µs .. 10 s, tuned so the ~0.5 ms tick
@@ -117,9 +118,16 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram (cumulative render, prometheus semantics)."""
+    """Fixed-bucket histogram (cumulative render, prometheus semantics).
 
-    __slots__ = ("labels", "bounds", "_counts", "_sum", "_count", "_lock")
+    Buckets optionally carry OpenMetrics **exemplars** — the last sampled
+    trace_id whose observation landed in each bucket
+    (:meth:`observe_exemplar`), rendered only when the scrape asks for the
+    OpenMetrics exposition — the bridge from "the p99 moved" to "here is a
+    transaction that lived in that bucket" (the trace plane's ``/trace``).
+    """
+
+    __slots__ = ("labels", "bounds", "_counts", "_sum", "_count", "_lock", "_exemplars")
 
     def __init__(self, labels: Dict[str, str], buckets: Tuple[float, ...]):
         self.labels = labels
@@ -128,6 +136,9 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # bucket index -> (trace_id, observed value, unix ts); populated only
+        # by observe_exemplar, so unsampled traffic pays nothing extra
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
 
     def observe(self, value: float) -> None:
         idx = bisect.bisect_left(self.bounds, value)
@@ -135,6 +146,19 @@ class Histogram:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+
+    def observe_exemplar(self, value: float, trace_id: str) -> None:
+        """observe() + remember this trace as the bucket's exemplar."""
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            self._exemplars[idx] = (str(trace_id), float(value), time.time())
+
+    def exemplars_snapshot(self) -> Dict[int, Tuple[str, float, float]]:
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -220,7 +244,11 @@ class MetricsRegistry:
             return fam.metrics.get(key) if fam else None
 
     # -- render --------------------------------------------------------------
-    def render(self) -> str:
+    def render(self, *, exemplars: bool = False) -> str:
+        """Prometheus text 0.0.4; ``exemplars=True`` appends OpenMetrics
+        exemplar suffixes (``# {trace_id="..."} value ts``) to histogram
+        bucket lines that have one — served when the scrape opts into the
+        OpenMetrics exposition (exporter ``/metrics?exemplars=1``)."""
         out: List[str] = []
         with self._lock:
             families = list(self._families.values())
@@ -234,15 +262,30 @@ class MetricsRegistry:
             for inst in fam.metrics.values():
                 if isinstance(inst, Histogram):
                     counts, total, count = inst.snapshot()
+                    ex = inst.exemplars_snapshot() if exemplars else {}
                     cum = 0
-                    for bound, c in zip(inst.bounds, counts):
+                    for i, (bound, c) in enumerate(zip(inst.bounds, counts)):
                         cum += c
                         lb = dict(inst.labels)
                         lb["le"] = _fmt_value(bound)
-                        out.append(f"{fam.name}_bucket{_fmt_labels(lb)} {cum}")
+                        line = f"{fam.name}_bucket{_fmt_labels(lb)} {cum}"
+                        if i in ex:
+                            tid, val, ts = ex[i]
+                            line += (
+                                f' # {{trace_id="{_escape(tid)}"}} '
+                                f"{_fmt_value(val)} {ts:.3f}"
+                            )
+                        out.append(line)
                     lb = dict(inst.labels)
                     lb["le"] = "+Inf"
-                    out.append(f"{fam.name}_bucket{_fmt_labels(lb)} {count}")
+                    line = f"{fam.name}_bucket{_fmt_labels(lb)} {count}"
+                    if len(inst.bounds) in ex:
+                        tid, val, ts = ex[len(inst.bounds)]
+                        line += (
+                            f' # {{trace_id="{_escape(tid)}"}} '
+                            f"{_fmt_value(val)} {ts:.3f}"
+                        )
+                    out.append(line)
                     out.append(
                         f"{fam.name}_sum{_fmt_labels(inst.labels)} {_fmt_value(total)}"
                     )
@@ -265,6 +308,31 @@ class MetricsRegistry:
                     seen_types[s.name] = s.mtype
                 out.append(f"{s.name}{_fmt_labels(s.labels)} {_fmt_value(s.value)}")
         return "\n".join(out) + "\n"
+
+
+def histogram_quantile(buckets: List[Tuple[float, float]], q: float) -> float:
+    """Estimate quantile ``q`` from cumulative histogram buckets
+    ``[(le, cumulative_count)]`` — prometheus ``histogram_quantile``
+    semantics (linear interpolation inside the winning bucket; the +Inf
+    bucket clamps to the highest finite bound). NaN when empty."""
+    if not buckets:
+        return float("nan")
+    pts = sorted(buckets, key=lambda p: p[0])
+    total = pts[-1][1]
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in pts:
+        if cum >= rank:
+            if bound == float("inf"):
+                # open-ended tail: clamp to the highest finite bound
+                return prev_bound if len(pts) > 1 else float("nan")
+            if cum == prev_cum:
+                return bound
+            return prev_bound + (bound - prev_bound) * (rank - prev_cum) / (cum - prev_cum)
+        prev_bound, prev_cum = bound, cum
+    return pts[-1][0]
 
 
 # -- text-format helpers (qstat --metrics-url, manager fleet merge, tests) ----

@@ -1,0 +1,180 @@
+"""Sampled per-transaction trace propagation (the distributed trace plane).
+
+The telemetry plane (PR 2) observes the pipeline in aggregate —
+``apm_e2e_ingest_to_alert_seconds`` says *how long*, never *which
+transaction*. This module adds the missing axis: a **head-sampled trace
+context** stamped where a record enters the transport fabric
+(``ProducerQueue.write_line``, the parser/tailer ingest boundary for the
+``transactions`` queue), carried in transport headers alongside the
+existing ``ingest_ts``/``msg_id`` (memory-broker tuples, AMQP message
+properties, spool JSON — wire payloads untouched, reference interop
+intact), and closed span by span as the record crosses each hop:
+
+- ``ingest``  — raw-line read (chunk granular, :meth:`Tracer.note_ingest_start`)
+                → transport entry, recorded by the producer;
+- ``queue``   — producer ingest stamp → consumer delivery (ConsumerQueue);
+- ``feed``    — delivery → the device driver absorbing the line (worker);
+- ``tick``    — the device tick that closed the transaction's bucket;
+- ``emit``    — that tick's emission readback + host fan-out;
+- ``alert``   — alert dispatch for the transaction's service (when fired).
+
+Redelivered messages keep their ORIGINAL trace_id (the broker retains
+headers, exactly like ``msg_id``), so an at-least-once crash/redeliver
+cycle extends a trace instead of splitting it.
+
+Cost discipline (the hot path is sacred): the sampling decision is ONE
+integer compare per produced message (``seq % rate``); an unsampled
+message pays nothing else, and ``sample_rate <= 0`` (tracing OFF) skips
+even that — behavior is bit-identical to the pre-trace wire. Sampling is
+deterministic in the producer's message sequence, so a replayed stream
+samples the same positions every run.
+
+Spans land in a process-wide bounded :class:`SpanRing` served by the
+exporter's ``/trace`` endpoint; the manager stitches cross-module spans
+by trace_id on its own ``/trace`` route. Histograms link back into the
+plane via OpenMetrics exemplars (``registry.Histogram.observe_exemplar``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+# transport header key carrying the trace context end to end
+TRACE_HEADER = "trace_id"
+
+
+class SpanRing:
+    """Thread-safe bounded ring of finished spans (plain dicts)."""
+
+    def __init__(self, maxlen: int = 512):
+        self._ring: deque = deque(maxlen=int(maxlen))
+        self._lock = threading.Lock()
+
+    def add(self, span: dict) -> None:
+        with self._lock:
+            self._ring.append(span)
+
+    def spans(self, trace_id: Optional[str] = None, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        if trace_id is not None:
+            items = [s for s in items if s.get("trace_id") == trace_id]
+        if n is not None and n > 0:
+            items = items[-n:]
+        return items
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    @property
+    def maxlen(self) -> int:
+        return self._ring.maxlen or 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+class Tracer:
+    """Head-sampled trace recorder for one process.
+
+    One instance per process (see :func:`get_tracer`), mutated in place by
+    :func:`configure` so transport objects may cache the reference at
+    construction regardless of wiring order. ``rate == 0`` disables the
+    plane entirely — producers then stamp no header and record no span.
+    """
+
+    def __init__(self, module: str = "apm", sample_rate: int = 0, ring_size: int = 512):
+        self.module = module
+        self.rate = int(sample_rate)
+        self.ring = SpanRing(ring_size)
+        # chunk-granular ingest anchor: the tailer/replay/parser note when a
+        # chunk of raw lines was read; the producer's ingest span starts
+        # there (or at transport entry when no boundary noted one)
+        self._ingest_start: Optional[float] = None
+
+    # -- wiring ---------------------------------------------------------------
+    def configure(
+        self,
+        *,
+        sample_rate: Optional[int] = None,
+        module: Optional[str] = None,
+        ring_size: Optional[int] = None,
+    ) -> "Tracer":
+        if sample_rate is not None:
+            self.rate = int(sample_rate)
+        if module is not None:
+            self.module = module
+        if ring_size is not None and int(ring_size) != self.ring.maxlen:
+            self.ring = SpanRing(int(ring_size))
+        return self
+
+    # -- sampling -------------------------------------------------------------
+    def should_sample(self, seq: int) -> bool:
+        """The head-sampling decision: one integer compare. Deterministic in
+        the producer's sequence number (message N of every run samples the
+        same way), so replay/chaos runs are reproducible."""
+        r = self.rate
+        return r > 0 and seq % r == 0
+
+    # -- ingest boundary ------------------------------------------------------
+    def note_ingest_start(self) -> None:
+        """Mark 'a chunk of raw input was just read' — the start anchor of
+        the next ingest span. Called once per chunk (never per line)."""
+        if self.rate > 0:
+            self._ingest_start = time.time()
+
+    @property
+    def ingest_start(self) -> Optional[float]:
+        return self._ingest_start
+
+    # -- spans ----------------------------------------------------------------
+    def span(
+        self,
+        trace_id: str,
+        name: str,
+        start: float,
+        end: float,
+        *,
+        module: Optional[str] = None,
+        **attrs,
+    ) -> dict:
+        """Record one finished span into the ring."""
+        span = {
+            "trace_id": trace_id,
+            "name": name,
+            "module": module or self.module,
+            "start": start,
+            "end": end,
+            "duration_ms": round((end - start) * 1000.0, 3),
+        }
+        if attrs:
+            span["attrs"] = attrs
+        self.ring.add(span)
+        return span
+
+
+# -- the process-global tracer ------------------------------------------------
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer every transport/driver hop records into."""
+    return _tracer
+
+
+def configure(**kwargs) -> Tracer:
+    """Configure the process tracer in place (ModuleRuntime wiring; tests)."""
+    return _tracer.configure(**kwargs)
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-global tracer (test isolation); returns the old."""
+    global _tracer
+    old, _tracer = _tracer, tracer
+    return old

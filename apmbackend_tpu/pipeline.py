@@ -26,7 +26,8 @@ import math
 import os
 import re
 import time
-from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+from collections import deque
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1295,11 +1296,37 @@ class PipelineDriver:
         self._telemetry = bool(apm_config.get("observability", {}).get("enabled", True))
         self._intake_oldest_ts: Optional[float] = None  # oldest undelivered ingest stamp
         self._emitting_intake_ts: Optional[float] = None
+        # -- distributed trace plane + decision provenance -------------------
+        # Sampled per-transaction traces (obs/trace): the worker registers
+        # in-flight sampled transactions via note_trace(); the tick that
+        # closes a transaction's bucket records its tick/emit/alert spans.
+        # Alert decision records (obs/decisions) capture the z inputs behind
+        # every page. Both are alert/trace-path only: an unsampled message
+        # costs nothing here, and a tick with no live traces pays one
+        # truthiness check.
+        self._live_traces: deque = deque(maxlen=256)
+        self._emitting_traces: Sequence[dict] = ()
+        self._emit_wall_start: Optional[float] = None
+        # tick wall-clock windows by label: the "tick" span of a claimed
+        # trace must describe the tick that CLOSED its bucket even when
+        # async-emission delivers the emission one tick late
+        self._tick_walls: Dict[int, Tuple[float, float]] = {}
+        # host numpy mirrors of the per-row alert params (threshold/influence
+        # by channel id) + channel -> device-state index maps; refreshed with
+        # the device params, read only on the alert path (decision records)
+        self._host_thresholds: Dict = {}
+        self._host_influences: Dict = {}
+        self._lag_index: Dict = {}
+        self._ewma_index: Dict = {}
         if self._telemetry:
             from .obs import get_registry
+            from .obs.decisions import get_decisions
             from .obs.registry import DEFAULT_COUNT_BUCKETS
+            from .obs.trace import get_tracer
             from .obs.tracing import TickTracer
 
+            self._trace = get_tracer()
+            self._decisions = get_decisions()
             reg = metrics_registry if metrics_registry is not None else get_registry()
             self._tracer = TickTracer(reg)
             self._m_capacity = reg.gauge(
@@ -1336,6 +1363,8 @@ class PipelineDriver:
             )
         else:
             self._tracer = None
+            self._trace = None
+            self._decisions = None
         self._refresh_params()
         # emission pipelining (tpuEngine.asyncEmission / the async_emission
         # kwarg; default OFF): hold each tick's TickEmission and fetch it
@@ -1385,6 +1414,21 @@ class PipelineDriver:
             ),
         )
         self._params_registry_count = self.registry.count
+        if self._telemetry:
+            # decision-record inputs (obs/decisions): the exact host vectors
+            # the device params were built from, keyed by channel id (lag
+            # value for z-score channels, negative channel_id for EWMA)
+            self._host_thresholds = {
+                int(l): zparams[l]["threshold"] for l in lag_values
+            }
+            self._host_influences = {
+                int(l): zparams[l]["influence"] for l in lag_values
+            }
+            for spec in self.cfg.ewma:
+                self._host_thresholds[spec.channel_id] = eparams[spec.channel_id]["threshold"]
+                self._host_influences[spec.channel_id] = eparams[spec.channel_id]["influence"]
+            self._lag_index = {int(spec.lag): i for i, spec in enumerate(self.cfg.lags)}
+            self._ewma_index = {spec.channel_id: i for i, spec in enumerate(self.cfg.ewma)}
         if self._tracer is not None:
             self._m_capacity.set(self.cfg.capacity)
             self._m_services.set(self.registry.count)
@@ -1400,6 +1444,38 @@ class PipelineDriver:
         cur = self._intake_oldest_ts
         if cur is None or ingest_ts < cur:
             self._intake_oldest_ts = ingest_ts
+
+    def note_trace(
+        self,
+        trace_id: str,
+        server: str,
+        service: str,
+        label: int,
+        start: float,
+        end: Optional[float] = None,
+        **attrs,
+    ) -> None:
+        """Register one SAMPLED in-flight transaction (the worker's feed
+        boundary). Records the ``feed`` span (transport delivery -> device
+        absorb) and keeps the trace live until the tick that closes its
+        bucket emits — _process_emission then records the tick/emit (and
+        alert, when fired) spans under the same trace_id. Called only for
+        the 1/rate sampled messages, never on the per-message hot path."""
+        if self._trace is None:
+            return
+        end = time.time() if end is None else end
+        self._trace.span(
+            trace_id, "feed", start, end,
+            server=server, service=service, label=int(label), **attrs,
+        )
+        self._live_traces.append(
+            {
+                "trace_id": trace_id,
+                "server": server,
+                "service": service,
+                "label": int(label),
+            }
+        )
 
     def apply_config(self, apm_config: dict) -> None:
         """Hot-reload hook: re-derive per-row params (thresholds, overrides,
@@ -1827,6 +1903,13 @@ class PipelineDriver:
     # -- tick ----------------------------------------------------------------
     def _run_tick(self, new_label: int) -> None:
         tr = self._tracer
+        # trace plane: a tick with live sampled traces notes its wall window
+        # so their "tick" span describes the tick that closed their bucket
+        # (looked up by label at emission — exact under async-emission too).
+        # A tick with no live traces pays one truthiness check.
+        trace_tick = self._trace is not None and bool(self._live_traces)
+        if trace_tick:
+            tick_wall_start = time.time()
         if tr is not None:
             # catch-up depth: labels advanced by this tick (1 = steady state;
             # >1 = replay/backfill jump — the megatick-candidate signal)
@@ -1865,6 +1948,12 @@ class PipelineDriver:
                     self.on_ordered_csv(line)
 
         t3 = time.perf_counter() if tr is not None else 0.0
+        if trace_tick:
+            self._tick_walls[new_label] = (tick_wall_start, time.time())
+            if len(self._tick_walls) > 8:  # bounded: emission pops its label;
+                # a zero-row emission (count==0) leaves one behind — prune
+                for stale in sorted(self._tick_walls)[:-8]:
+                    self._tick_walls.pop(stale, None)
         if self._async_emission:
             # double-buffered readback: hold this tick's emission; deliver
             # the PREVIOUS one now, while this tick's programs are still in
@@ -1906,6 +1995,19 @@ class PipelineDriver:
         # emission that actually fans out — a zero-row tick leaves it for
         # the first real one
         self._emitting_intake_ts, self._intake_oldest_ts = self._intake_oldest_ts, None
+        # claim the sampled traces whose bucket this tick closed (labels
+        # below new_label); later labels stay live for their own tick. The
+        # claimed set is matched against alerts during the fan-out below.
+        if self._trace is not None and self._live_traces:
+            keep: deque = deque(maxlen=self._live_traces.maxlen)
+            claimed: List[dict] = []
+            for t in self._live_traces:
+                (claimed if t["label"] < new_label else keep).append(t)
+            self._live_traces = keep
+            self._emitting_traces = claimed
+        else:
+            self._emitting_traces = ()
+        self._emit_wall_start = time.time()
         # np.asarray(whole)[:count], never np.asarray(x[:count]): slicing a
         # jax array dispatches a compiled gather per call (~1.2 ms each on
         # CPU), and this path runs 3 + 6*channels of them per tick — the
@@ -1913,10 +2015,29 @@ class PipelineDriver:
         tpm = np.asarray(emission.tpm)[:count]
         metrics = np.asarray(emission.average)[:count]  # [count, 3]
 
+        emit_landed = time.time()
         if self._tracer is not None and self._emitting_intake_ts is not None:
             # the readback above (np.asarray of the emission) has landed: the
             # tick's results are host-visible — the "emit" moment
-            self._m_emit_lat.observe(time.time() - self._emitting_intake_ts)
+            lat = emit_landed - self._emitting_intake_ts
+            if self._emitting_traces:
+                # OpenMetrics exemplar: the latency bucket points at a trace
+                # that actually lived through this emission
+                self._m_emit_lat.observe_exemplar(lat, self._emitting_traces[0]["trace_id"])
+            else:
+                self._m_emit_lat.observe(lat)
+        if self._emitting_traces:
+            tick_wall = self._tick_walls.pop(new_label, None)
+            for t in self._emitting_traces:
+                if tick_wall is not None:
+                    self._trace.span(
+                        t["trace_id"], "tick", tick_wall[0], tick_wall[1],
+                        label=new_label, service=t["service"],
+                    )
+                self._trace.span(
+                    t["trace_id"], "emit", self._emit_wall_start, emit_landed,
+                    label=new_label, service=t["service"], rows=count,
+                )
 
         n_overflowed = int(np.asarray(emission.overflowed)[:count].sum())
         if n_overflowed:
@@ -1990,17 +2111,112 @@ class PipelineDriver:
                     fs = make_fs(row)
                     self.on_fullstat(fs)
                     if need_alert and trig[row]:
-                        self._dispatch_alert(fs, int(bits[row]))
+                        self._dispatch_alert(fs, int(bits[row]), row=row)
             elif need_alert:
                 # alert-only fast path: build objects for triggered rows only
                 for row in np.nonzero(trig)[0]:
-                    self._dispatch_alert(make_fs(int(row)), int(bits[row]))
+                    self._dispatch_alert(make_fs(int(row)), int(bits[row]), row=int(row))
 
-    def _dispatch_alert(self, fs: FullStatEntry, bits: int) -> None:
+    def _trace_for_alert(self, fs: FullStatEntry) -> Optional[str]:
+        """trace_id of a claimed (this-emission) sampled trace matching the
+        alert's (server, service), or None. Alert-path only."""
+        for t in self._emitting_traces:
+            if t["service"] == fs.service and t["server"] == fs.server:
+                return t["trace_id"]
+        return None
+
+    def _window_occupancy(self, chan_id, row: int) -> Optional[int]:
+        """Ring fill (lag channels) / max slot update count (EWMA channels)
+        for one row — a device readback, paid on the ALERT path only."""
+        try:
+            i = self._lag_index.get(chan_id)
+            if i is not None:
+                return int(np.asarray(self.state.zscores[i].fill)[row])
+            i = self._ewma_index.get(chan_id)
+            if i is not None:
+                return int(np.asarray(self.state.ewmas[i].count)[row].max())
+        except Exception:
+            pass
+        return None
+
+    def _record_decision(self, fs: FullStatEntry, bits: int, row: Optional[int],
+                         trace_id: Optional[str]) -> None:
+        """Alert decision provenance (obs/decisions): the z inputs behind
+        this page — triggering values, window means, the bands actually
+        compared, smoothed signals, configured threshold/influence, window
+        occupancy, device cause bits — keyed by trace_id when the bucket
+        carried a sampled trace. A failure here must never lose the alert."""
+        try:
+            chan_id = fs.lag
+            thr = infl = None
+            if row is not None:
+                tv = self._host_thresholds.get(chan_id)
+                iv = self._host_influences.get(chan_id)
+                if tv is not None and row < len(tv):
+                    thr = float(tv[row])
+                if iv is not None and row < len(iv):
+                    infl = float(iv[row])
+            self._decisions.record(
+                {
+                    "trace_id": trace_id,
+                    "ts": time.time(),
+                    "edge_ts": int(fs.timestamp),
+                    "server": fs.server,
+                    "service": fs.service,
+                    "channel": chan_id,
+                    "row": row,
+                    "cause_bits": bits,
+                    "cause": dalerts.cause_string(bits),
+                    "threshold": thr,
+                    "influence": infl,
+                    "window_occupancy": self._window_occupancy(chan_id, row)
+                    if row is not None else None,
+                    "tpm": fs.tpm,
+                    "metrics": {
+                        "average": {
+                            "value": fs.average, "window_mean": fs.average_avg,
+                            "lower": fs.average_lb, "upper": fs.average_ub,
+                            "signal": fs.average_signal,
+                        },
+                        "per75": {
+                            "value": fs.per75, "window_mean": fs.per75_avg,
+                            "lower": fs.per75_lb, "upper": fs.per75_ub,
+                            "signal": fs.per75_signal,
+                        },
+                        "per95": {
+                            "value": fs.per95, "window_mean": fs.per95_avg,
+                            "lower": fs.per95_lb, "upper": fs.per95_ub,
+                            "signal": fs.per95_signal,
+                        },
+                    },
+                }
+            )
+        except Exception:
+            if self.logger:
+                self.logger.exception("Decision record failed (alert still dispatched)")
+
+    def _dispatch_alert(self, fs: FullStatEntry, bits: int, row: Optional[int] = None) -> None:
+        trace_id = None
         if self._tracer is not None:
             self._m_alerts.inc()
+            trace_id = self._trace_for_alert(fs) if self._emitting_traces else None
             if self._emitting_intake_ts is not None:
-                self._m_alert_lat.observe(time.time() - self._emitting_intake_ts)
+                lat = time.time() - self._emitting_intake_ts
+                if trace_id is not None:
+                    self._m_alert_lat.observe_exemplar(lat, trace_id)
+                else:
+                    self._m_alert_lat.observe(lat)
+            if trace_id is not None:
+                # the alert hop of the sampled transaction's trace: emission
+                # readback -> this dispatch
+                self._trace.span(
+                    trace_id, "alert",
+                    self._emit_wall_start or time.time(), time.time(),
+                    service=fs.service, channel=fs.lag,
+                    cause=dalerts.cause_string(bits),
+                )
+        if self._decisions is not None:
+            self._record_decision(fs, bits, row, trace_id)
         if self.alerts_manager is not None:
             alert = self.alerts_manager.process_trigger(fs, bits)
             if alert is not None:

@@ -120,6 +120,7 @@ class ModuleRuntime:
         # metricsPort (0 = ephemeral) — serve /metrics, /healthz, /profile
         # from a per-module exporter thread.
         self.telemetry = None
+        self.flight = None
         obs_cfg = self.config.get("observability", {})
         if bool(obs_cfg.get("enabled", True)):
             from ..obs.views import register_queue_stats
@@ -138,6 +139,49 @@ class ModuleRuntime:
                 )
                 self.telemetry.add_health("process", self._process_health)
                 self.telemetry.start()
+            # distributed trace plane (obs/trace): configure the process
+            # tracer in place — transport objects cache the reference, so
+            # this is wiring-order independent. In single-process topologies
+            # every runtime applies the same shared config; only the
+            # exporter-owning runtime claims the module label.
+            from ..obs import trace as obs_trace
+
+            obs_trace.configure(
+                sample_rate=int(obs_cfg.get("traceSampleRate", 64) or 0),
+                ring_size=int(obs_cfg.get("traceRingSize", 512)),
+                module=prefix if self.telemetry is not None else None,
+            )
+            # crash flight recorder (obs/flight): bundles on degradation/
+            # signals/exceptions plus the kill−9 journal+sentinel shadow
+            flight_dir = obs_cfg.get("flightDir")
+            if flight_dir:
+                from ..obs import get_registry
+                from ..obs.decisions import get_decisions
+                from ..obs.flight import FlightRecorder, config_hash
+                from ..obs.trace import get_tracer
+
+                self.flight = FlightRecorder(
+                    str(flight_dir),
+                    prefix,
+                    max_bundles=int(obs_cfg.get("flightMaxBundles", 16)),
+                    logger=self.logger,
+                )
+                self.flight.add_source("config_hash", lambda: config_hash(self.config))
+                self.flight.add_source("metrics", lambda: get_registry().render())
+                self.flight.add_source("traces", lambda: get_tracer().ring.spans(n=128))
+                self.flight.add_source("decisions", lambda: get_decisions().recent(64))
+                self.flight.add_source("process_health", self._process_health)
+                # a leftover sentinel = the previous process died without a
+                # clean shutdown (kill−9/OOM): promote its last journal NOW
+                self.flight.recover_crash()
+                self.flight.mark_alive()
+                self.every(
+                    max(0.05, float(obs_cfg.get("flightJournalSeconds", 5.0))),
+                    self.flight.journal,
+                    name="flight-journal",
+                )
+                if self.telemetry is not None:
+                    self.telemetry.flight = self.flight
 
     def _process_health(self) -> dict:
         """Baseline liveness every module reports: the process is serving,
@@ -181,6 +225,14 @@ class ModuleRuntime:
             self.logger.info(f"Caught signal {signal.Signals(signum).name}")
             if self._exiting:
                 os._exit(1)
+            if self.flight is not None:
+                # the triage bundle must land BEFORE exit handlers start
+                # tearing state down (they may hang — that is what the
+                # second-signal os._exit path is for)
+                try:
+                    self.flight.dump(f"signal_{signal.Signals(signum).name}", force=True)
+                except Exception:
+                    pass
             self.exit()
 
         def _gc(_signum, _frame):
@@ -243,6 +295,10 @@ class ModuleRuntime:
         for t in self._timers:
             if t is not me and t.is_alive():
                 t.join(timeout=5.0)
+        if self.flight is not None:
+            # an orderly teardown is not a crash: consume the alive sentinel
+            # so the next boot does not promote this run's journal
+            self.flight.mark_clean_exit()
 
     def exit(self, code: int = 0) -> None:
         if self._exiting:

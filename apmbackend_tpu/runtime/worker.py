@@ -198,6 +198,12 @@ class WorkerApp:
         # the lines). deque append/popleft are thread-safe (pump thread
         # appends, device loop pops).
         self._intake_ts_fifo: collections.deque = collections.deque()
+        # sampled-trace handoff (obs/trace): trace contexts of consumed-but-
+        # not-yet-fed SAMPLED messages, tagged with their consume sequence
+        # (= _ring_pushed at accept) so the feed that absorbs line N also
+        # registers every trace with seq <= N on the driver. Only 1/rate
+        # messages ever enter this FIFO; unsampled traffic pays one dict.get.
+        self._trace_fifo: collections.deque = collections.deque()
         self._overflow_max = int(eng_cfg.get("intakeOverflowMaxLines", 200_000))
         self.intake_dropped = 0
         self._ring_spin_s = float(eng_cfg.get("ringFullMaxBlockSeconds", 2.0))
@@ -307,6 +313,17 @@ class WorkerApp:
             get_registry().add_collector(self._collect_metrics)
         if getattr(runtime, "telemetry", None) is not None:
             runtime.telemetry.add_health("engine", self._health)
+        flight = getattr(runtime, "flight", None)
+        if flight is not None:
+            # worker-specific flight-recorder sources: the tick-span ring
+            # (where did the final ticks' time go), the engine healthz
+            # section (backlog depths, delivery state, executor identity)
+            flight.add_source(
+                "tick_spans",
+                lambda: self.driver._tracer.recent(64)
+                if self.driver._tracer is not None else [],
+            )
+            flight.add_source("engine_health", self._health)
 
     def _collect_metrics(self):
         from ..obs import Sample
@@ -458,21 +475,60 @@ class WorkerApp:
         if oldest is not None:
             self.driver.note_intake_time(oldest)
 
+    def _trace_context(self, trace_id: str, headers: dict, line: str):
+        """(trace_id, consume_ts, server, service, label, redelivered) for a
+        sampled tx line, or None when the line is not a parseable tx."""
+        p = line.split("|", 7)
+        if len(p) < 8 or p[0] != "tx":
+            return None
+        try:
+            label = int(float(p[6])) // 10000
+        except ValueError:
+            return None
+        return (
+            trace_id, time.time(), p[1], p[2], label,
+            bool(headers.get("redelivered")),
+        )
+
+    def _note_trace_now(self, ctx) -> None:
+        """Register one sampled trace with the driver right before its line
+        is fed (feed span: transport delivery -> device absorb)."""
+        tid, consume_ts, server, service, label, redelivered = ctx
+        self.driver.note_trace(
+            tid, server, service, label, consume_ts,
+            redelivered=redelivered,
+        )
+
+    def _drain_trace_fifo(self, upto_seq: int) -> None:
+        """Hand every queued sampled-trace context whose line is covered by
+        the feed about to run (consume seq <= upto_seq) to the driver."""
+        fifo = self._trace_fifo
+        while fifo and fifo[0][0] <= upto_seq:
+            _seq, ctx = fifo.popleft()
+            self._note_trace_now(ctx)
+
     def _consume(self, line: str, headers=None, token=None) -> None:
         if self._at_least_once:
             self._consume_at_least_once(line, headers, token)
             return
         # transport ingest stamp (ProducerQueue header): queue it for the
-        # feed-time handoff that anchors the ingest->emit/alert series
+        # feed-time handoff that anchors the ingest->emit/alert series.
+        # trace_id marks the 1/rate sampled messages (obs/trace).
+        trace_ctx = None
         if headers and self.driver._tracer is not None:
             ts = headers.get("ingest_ts")
             if ts is not None:
                 self._intake_ts_fifo.append(ts)
+            tid = headers.get("trace_id")
+            if tid is not None and self.driver._trace is not None:
+                trace_ctx = self._trace_context(tid, headers, line)
         if self._ring is not None and self._ring_thread.is_alive():
             # FIFO: while older overflow lines are pending, new lines must
             # queue behind them, not jump into the ring
             if self._overflow:
                 self._enqueue_overflow(line)
+                if trace_ctx is not None:
+                    self._trace_fifo.append((self._ring_pushed, trace_ctx))
                 return
             data = line.encode("utf-8")
             deadline = time.monotonic() + self._ring_spin_s
@@ -483,10 +539,14 @@ class WorkerApp:
                     break  # loop died: fall through to the direct path
                 if time.monotonic() > deadline:
                     self._enqueue_overflow(line)
+                    if trace_ctx is not None:
+                        self._trace_fifo.append((self._ring_pushed, trace_ctx))
                     return
                 time.sleep(0.001)
             else:
                 self._ring_pushed += 1
+                if trace_ctx is not None:
+                    self._trace_fifo.append((self._ring_pushed, trace_ctx))
                 return
         # ring-less (or dead-loop) fallback: the per-line object path — one
         # from_csv + feed() is far cheaper than feed_csv_batch's numpy
@@ -496,6 +556,8 @@ class WorkerApp:
             self.runtime.logger.info(f"Not a transactions entry: {line[:200]}")
             return
         self._note_intake(1)
+        if trace_ctx is not None:
+            self._note_trace_now(trace_ctx)
         with self._driver_lock:
             self.driver.feed(entry)
 
@@ -529,8 +591,20 @@ class WorkerApp:
                     if len(self._dedup_fifo) > self._dedup_max:
                         self._dedup_set.discard(self._dedup_fifo.popleft())
                 if line.startswith("tx|"):
-                    ts = (headers or {}).get("ingest_ts")
-                    self._alo_pending.append((line, ts))
+                    h = headers or {}
+                    ts = h.get("ingest_ts")
+                    # sampled trace context rides the pending entry so the
+                    # bulk drain registers it right before the feed; a broker
+                    # redelivery kept the ORIGINAL trace_id (headers survive
+                    # requeue like msg_id), so the trace extends across a
+                    # crash instead of splitting
+                    tid = h.get("trace_id")
+                    ctx = (
+                        self._trace_context(tid, h, line)
+                        if tid is not None and self.driver._trace is not None
+                        else None
+                    )
+                    self._alo_pending.append((line, ts, ctx))
                     if len(self._alo_pending) >= self._alo_batch:
                         self._drain_alo_pending_locked()
                 else:
@@ -552,10 +626,15 @@ class WorkerApp:
             return
         self._alo_pending = []
         if self.driver._tracer is not None:
-            oldest = min((ts for _l, ts in pending if ts is not None), default=None)
+            oldest = min((ts for _l, ts, _c in pending if ts is not None), default=None)
             if oldest is not None:
                 self.driver.note_intake_time(oldest)
-        self.driver.feed_csv_batch([line for line, _ts in pending])
+            for _l, _ts, ctx in pending:
+                # register sampled traces BEFORE the feed: the tick that
+                # closes their bucket may fire inside this very batch
+                if ctx is not None:
+                    self._note_trace_now(ctx)
+        self.driver.feed_csv_batch([line for line, _ts, _c in pending])
 
     def drain_delivery_pending(self) -> None:
         """Public drain hook (feed-delay timer + tests)."""
@@ -623,6 +702,10 @@ class WorkerApp:
 
     def _feed_guarded(self, fn, n: int) -> None:
         self._note_intake(n)
+        if self._trace_fifo:
+            # sampled traces whose lines this feed absorbs go live on the
+            # driver first: their tick may fire inside this very feed
+            self._drain_trace_fifo(self._ring_fed + n)
         try:
             with self._driver_lock:
                 fn()
@@ -637,6 +720,16 @@ class WorkerApp:
                 f"Device loop: bulk feed failed; {n} lines dropped:\n"
                 + traceback.format_exc()
             )
+            flight = getattr(self.runtime, "flight", None)
+            if flight is not None:
+                # an unhandled feed exception is a flight-recorder trigger:
+                # the bundle captures the tick rings/backlogs while the
+                # wreckage is fresh (rate-limited — a poison batch loop must
+                # not churn the bundle directory)
+                try:
+                    flight.dump("worker_feed_exception")
+                except Exception:
+                    pass
         finally:
             self._ring_fed += n
 

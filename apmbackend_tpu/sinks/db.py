@@ -288,18 +288,34 @@ class DBWriter:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # trace plane: close sampled db_insert-queue trace contexts with a
+        # "sink" span at buffer absorb (the last hop of the db_insert leg)
+        from ..obs.trace import get_tracer
+
+        self._obs_tracer = get_tracer()
         if start_timer:
             self._thread = threading.Thread(target=self._timer_loop, daemon=True, name="dbwriter-timer")
             self._thread.start()
 
     # -- intake --------------------------------------------------------------
-    def consume_line(self, line: str) -> None:
+    def consume_line(self, line: str, headers: Optional[dict] = None) -> None:
         """CSV line off the db_insert queue (consumeMsg, stream_insert_db.js:355-376)."""
         entry = self._factory.from_csv(line)
         if entry is None:
             if self.logger:
                 self.logger.info(f"Entry undefined: {line}")
             return
+        if headers:
+            tid = headers.get("trace_id")
+            if tid is not None:
+                # sampled message: mark the sink absorb under its trace_id
+                now = time.time()
+                start = headers.get("ingest_ts")
+                self._obs_tracer.span(
+                    tid, "sink",
+                    now if start is None else float(start), now,
+                    entry_type=entry.type,
+                )
         self.add_entry(entry)
 
     def add_entry(self, entry: Entry) -> None:

@@ -440,11 +440,22 @@ class ChaosWorkerHarness:
         self.seed = seed
         self.capacity = capacity
         self.save_every_s = save_every_s
+        # crash flight-recorder bundles (obs/flight): the child journals
+        # here on a fast cadence; a kill−9 leaves journal+sentinel behind
+        # and the RESTARTED child promotes them into a ...-crash.json bundle
+        self.flight_dir = os.path.join(self.workdir, "flight")
         self.python = sys.executable
         self.proc = None
         self.generation = 0
         self._seq = 0
         self._producer = SpoolChannel(self.spool_dir)
+
+    def flight_bundles(self) -> list:
+        """(path, parsed body) for every flight bundle the child produced —
+        parse errors raise (an unreadable bundle is the bug this asserts on)."""
+        from ..obs.flight import list_bundles
+
+        return list_bundles(self.flight_dir)
 
     # -- stream --------------------------------------------------------------
     def send_line(self, line: str) -> None:
@@ -479,6 +490,7 @@ class ChaosWorkerHarness:
                 "--save-every-s", str(self.save_every_s),
                 "--dup-p", str(self.dup_p),
                 "--seed", str(self.seed + self.generation),
+                "--flight-dir", self.flight_dir,
             ],
             stdout=log_fh, stderr=log_fh, stdin=subprocess.DEVNULL,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
@@ -552,6 +564,7 @@ def _child_main(argv=None) -> int:
     ap.add_argument("--save-every-s", type=float, default=0.4)
     ap.add_argument("--dup-p", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--flight-dir", default=None)
     args = ap.parse_args(argv)
 
     from ..config import default_config
@@ -573,6 +586,14 @@ def _child_main(argv=None) -> int:
     cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = args.save_every_s
     cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
     cfg["logDir"] = None
+    if args.flight_dir:
+        # crash flight recorder under kill−9: journal on a sub-second
+        # cadence so a SIGKILL at any instant leaves a fresh shadow; the
+        # restarted child promotes it to a crash bundle at boot. The
+        # recorder only READS pipeline state and writes under its own
+        # directory — the bit-identical golden comparison is untouched.
+        cfg["observability"]["flightDir"] = args.flight_dir
+        cfg["observability"]["flightJournalSeconds"] = 0.2
 
     runtime = ModuleRuntime(
         "tpuEngine", config=cfg, install_signals=True, console_log=True

@@ -61,14 +61,18 @@ def format_rows(rows: List[Tuple[str, int, float]]) -> str:
     return "\n".join(lines)
 
 
-def metrics_url_stats(url: str, timeout_s: float = 5.0) -> List[Tuple[str, int, float, float, float]]:
+def metrics_url_stats(url: str, timeout_s: float = 5.0) -> List[Tuple[str, int, float, float, float, float, float]]:
     """Scrape ``<url>/metrics`` -> [(queue, depth, memory MB, in_total,
-    out_total)]. Depth/bytes come from the broker gauges
-    (apm_queue_depth/apm_queue_memory_bytes); throughput from the
-    QueueStats-view counters (apm_queue_messages_total)."""
+    out_total, wait_p50_s, wait_p95_s)]. Depth/bytes come from the broker
+    gauges (apm_queue_depth/apm_queue_memory_bytes); throughput from the
+    QueueStats-view counters (apm_queue_messages_total); the per-queue wait
+    percentiles are estimated from the ``apm_queue_wait_seconds`` histogram
+    buckets (producer ingest stamp -> consumer delivery) with prometheus
+    ``histogram_quantile`` semantics. NaN when the queue has no consumer-side
+    wait series yet."""
     import urllib.request
 
-    from ..obs import parse_prom_text
+    from ..obs import histogram_quantile, parse_prom_text
 
     if not url.rstrip("/").endswith("/metrics"):
         url = url.rstrip("/") + "/metrics"
@@ -78,6 +82,7 @@ def metrics_url_stats(url: str, timeout_s: float = 5.0) -> List[Tuple[str, int, 
     mem: Dict[str, float] = {}
     inc: Dict[str, float] = {}
     out: Dict[str, float] = {}
+    wait: Dict[str, Dict[float, float]] = {}  # queue -> {le: cumulative}
     for name, labels, value in parse_prom_text(text):
         q = labels.get("queue")
         if q is None:
@@ -90,26 +95,43 @@ def metrics_url_stats(url: str, timeout_s: float = 5.0) -> List[Tuple[str, int, 
             # counters are per (queue, direction, module); fold modules
             target = inc if labels.get("direction") == "in" else out
             target[q] = target.get(q, 0.0) + value
-    queues = sorted(set(depth) | set(mem) | set(inc) | set(out))
-    return [
-        (
-            q,
-            int(depth.get(q, 0)),
-            mem.get(q, 0.0) / (1024.0 * 1024.0),
-            inc.get(q, 0.0),
-            out.get(q, 0.0),
+        elif name == "apm_queue_wait_seconds_bucket":
+            le = labels.get("le")
+            if le is None:
+                continue
+            bound = float("inf") if le == "+Inf" else float(le)
+            buckets = wait.setdefault(q, {})
+            # fold module-labeled duplicates (a /fleet scrape) by bound
+            buckets[bound] = buckets.get(bound, 0.0) + value
+    queues = sorted(set(depth) | set(mem) | set(inc) | set(out) | set(wait))
+    rows = []
+    for q in queues:
+        buckets = sorted(wait.get(q, {}).items())
+        rows.append(
+            (
+                q,
+                int(depth.get(q, 0)),
+                mem.get(q, 0.0) / (1024.0 * 1024.0),
+                inc.get(q, 0.0),
+                out.get(q, 0.0),
+                histogram_quantile(buckets, 0.50),
+                histogram_quantile(buckets, 0.95),
+            )
         )
-        for q in queues
-    ]
+    return rows
 
 
-def format_metrics_rows(rows: List[Tuple[str, int, float, float, float]]) -> str:
+def format_metrics_rows(rows: List[Tuple[str, int, float, float, float, float, float]]) -> str:
     lines = [
-        f"{'queue':<20} {'messages':>10} {'memory MB':>10} {'in total':>12} {'out total':>12}"
+        f"{'queue':<20} {'messages':>10} {'memory MB':>10} {'in total':>12} "
+        f"{'out total':>12} {'wait p50 ms':>12} {'wait p95 ms':>12}"
     ]
-    for name, depth, mb, in_t, out_t in rows:
+    for name, depth, mb, in_t, out_t, p50, p95 in rows:
+        p50_s = f"{p50 * 1000.0:.2f}" if p50 == p50 else "-"
+        p95_s = f"{p95 * 1000.0:.2f}" if p95 == p95 else "-"
         lines.append(
-            f"{name:<20} {depth:>10} {mb:>10.2f} {int(in_t):>12} {int(out_t):>12}"
+            f"{name:<20} {depth:>10} {mb:>10.2f} {int(in_t):>12} {int(out_t):>12} "
+            f"{p50_s:>12} {p95_s:>12}"
         )
     return "\n".join(lines)
 

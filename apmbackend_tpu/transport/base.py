@@ -131,6 +131,14 @@ class ProducerQueue(EventEmitter):
         # it). One string concat per line; at-most-once consumers ignore it.
         self._msg_prefix = f"{os.getpid():x}-{os.urandom(4).hex()}-"
         self._msg_seq = 0
+        # the trace plane (obs/trace): this producer IS the transport-entry
+        # ingest boundary; every sample_rate-th message gets a trace_id
+        # header + an ingest span. The singleton is configured in place by
+        # ModuleRuntime, so caching the reference here is order-independent;
+        # rate 0 (tracing off) costs one integer compare per message.
+        from ..obs.trace import get_tracer
+
+        self._tracer = get_tracer()
         self.queue_stats.add_counter(queue_name, "p")
         channel.assert_queue(queue_name)
 
@@ -171,7 +179,22 @@ class ProducerQueue(EventEmitter):
         # the fabric, the anchor of the ingest->emit/alert latency series —
         # plus the unique msg_id at-least-once consumers dedup redeliveries by
         self._msg_seq += 1
-        headers = {"ingest_ts": time.time(), "msg_id": self._msg_prefix + str(self._msg_seq)}
+        now = time.time()
+        headers = {"ingest_ts": now, "msg_id": self._msg_prefix + str(self._msg_seq)}
+        tr = self._tracer
+        if tr.rate > 0 and self._msg_seq % tr.rate == 0:
+            # head-sampled trace context: deterministic in the message
+            # sequence, carried end to end in headers (redelivery keeps it,
+            # like msg_id). The ingest span runs from the last noted raw-read
+            # boundary (tailer/replay chunk) to transport entry.
+            trace_id = "t-" + headers["msg_id"]
+            headers["trace_id"] = trace_id
+            start = tr.ingest_start
+            tr.span(
+                trace_id, "ingest",
+                now if start is None or start > now else start, now,
+                queue=self.queue_name,
+            )
         with self._lock:
             entered_pause = self._send_locked(line, headers, verbose)
         if entered_pause:
@@ -224,10 +247,13 @@ class ConsumerQueue(EventEmitter):
         self.manual_ack = manual_ack
         self.queue_stats.add_counter(queue_name, "c")
         # resolved ONCE (this runs per message): does the consumer want the
-        # transport headers, and the queue-wait histogram instrument
+        # transport headers, the queue-wait histogram instrument, and the
+        # process tracer (queue spans + bucket exemplars for sampled messages)
         self._cb_headers = accepts_headers(consume_cb)
         from ..obs import get_registry
+        from ..obs.trace import get_tracer
 
+        self._trace = get_tracer()
         self._wait_hist = get_registry().histogram(
             "apm_queue_wait_seconds",
             "Transport latency: producer ingest stamp -> consumer delivery",
@@ -235,14 +261,31 @@ class ConsumerQueue(EventEmitter):
         )
         channel.assert_queue(queue_name)
 
+    def _observe_delivery(self, headers: dict) -> None:
+        """Queue-wait histogram + (for sampled messages) the queue span and
+        the histogram's trace exemplar. One dict.get per message beyond the
+        pre-trace cost; only sampled messages (1/rate) do more."""
+        ts = headers.get("ingest_ts")
+        trace_id = headers.get("trace_id")
+        now = time.time()
+        if ts is not None:
+            if trace_id is not None:
+                self._wait_hist.observe_exemplar(now - ts, trace_id)
+            else:
+                self._wait_hist.observe(now - ts)
+        if trace_id is not None:
+            self._trace.span(
+                trace_id, "queue", ts if ts is not None else now, now,
+                queue=self.queue_name,
+                redelivered=bool(headers.get("redelivered")),
+            )
+
     def _wrapped(self, payload: bytes, headers: Optional[dict] = None) -> None:
         # Ack-on-receipt semantics: the backend has already removed the message
         # by the time we see it (queue.js:277-283).
         self.queue_stats.incr(self.queue_name)
         if headers:
-            ts = headers.get("ingest_ts")
-            if ts is not None:
-                self._wait_hist.observe(time.time() - ts)
+            self._observe_delivery(headers)
         if self._cb_headers:
             self.consume_cb(payload.decode("utf-8"), headers)
         else:
@@ -253,9 +296,7 @@ class ConsumerQueue(EventEmitter):
         # ledger; the consumer owes ack([token]) after its effect is durable.
         self.queue_stats.incr(self.queue_name)
         if headers:
-            ts = headers.get("ingest_ts")
-            if ts is not None:
-                self._wait_hist.observe(time.time() - ts)
+            self._observe_delivery(headers)
         self.consume_cb(payload.decode("utf-8"), headers, token)
 
     def ack(self, tokens) -> None:

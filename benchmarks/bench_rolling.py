@@ -265,6 +265,124 @@ def _measure_delivery(quick: bool) -> dict:
     }
 
 
+def _measure_tracing(quick: bool) -> dict:
+    """ISSUE 5 acceptance: distributed trace plane ON vs OFF.
+
+    The same transport->driver loop twice — tracing OFF (sample rate 0: no
+    headers, no spans, the pre-trace wire) vs ON at the default 1/64 head
+    sampling with a live exporter and a background scraper pulling /trace
+    at 2 Hz throughout. The consumer registers sampled traces with the
+    driver exactly like the worker's feed handoff does, so the measured
+    path includes the span recording at every hop. The delta must stay
+    under 2%."""
+    import threading as _threading
+    import urllib.request
+
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import EntryFactory
+    from apmbackend_tpu.obs import MetricsRegistry, TelemetryServer
+    from apmbackend_tpu.obs.trace import Tracer, set_tracer
+    from apmbackend_tpu.pipeline import PipelineDriver
+    from apmbackend_tpu.transport.base import QueueManager
+    from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+    ticks = 8 if quick else 48
+    per_tick = 128  # ~reference density over ~100 services
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 128
+    cfg["tpuEngine"]["samplesPerBucket"] = 64
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1}
+    ]
+    base = 170_200_000
+    rng = np.random.RandomState(3)
+    stream = []
+    for t in range(ticks + 2):
+        for i in range(per_tick):
+            e = int(rng.randint(50, 900))
+            stream.append(
+                f"tx|jvm{i % 4}|svc{i % 100:03d}|t{t}-{i}|1|{(base + t) * 10000 - e}|"
+                f"{(base + t) * 10000 + i}|{e}|Y"
+            )
+
+    def one(rate: int) -> tuple:
+        old = set_tracer(Tracer(module="bench", sample_rate=rate))
+        server = None
+        stop = None
+        scrapes = [0]
+        try:
+            drv = PipelineDriver(cfg, capacity=128)
+            fac = EntryFactory()
+            broker = MemoryBroker()
+            prod = QueueManager(lambda d: MemoryChannel(broker), 3600).get_queue(
+                "transactions", "p"
+            )
+            qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+
+            def cb(line, h=None):
+                if h:
+                    tid = h.get("trace_id")
+                    if tid is not None:
+                        p = line.split("|", 7)
+                        drv.note_trace(
+                            tid, p[1], p[2], int(p[6]) // 10000, time.time()
+                        )
+                drv.feed(fac.from_csv(line))
+
+            qm_c.get_queue("transactions", "c", cb).start_consume()
+
+            if rate:
+                server = TelemetryServer(port=0, module="bench_tracing")
+                server.start()
+                stop = _threading.Event()
+
+                def _scrape_loop():
+                    while not stop.is_set():
+                        try:
+                            with urllib.request.urlopen(
+                                f"{server.url}/trace?n=256", timeout=2
+                            ) as r:
+                                r.read()
+                            scrapes[0] += 1
+                        except Exception:
+                            pass
+                        stop.wait(0.5)
+
+                _threading.Thread(target=_scrape_loop, daemon=True).start()
+
+            # warmup (compile) on the first 2 ticks, measured loop after
+            for line in stream[: 2 * per_tick]:
+                prod.write_line(line)
+            broker.pump()
+            t0 = time.perf_counter()
+            for t in range(ticks):
+                lo = (t + 2) * per_tick
+                for line in stream[lo : lo + per_tick]:
+                    prod.write_line(line)
+                broker.pump()
+            drv.flush()
+            wall = time.perf_counter() - t0
+            return ticks * per_tick / wall, scrapes[0]
+        finally:
+            if stop is not None:
+                stop.set()
+            if server is not None:
+                server.stop()
+            set_tracer(old)
+
+    off, _ = one(0)
+    on, n_scrapes = one(64)
+    return {
+        "lines_per_s_off": round(off, 1),
+        "lines_per_s_on": round(on, 1),
+        "sample_rate": 64,
+        "overhead_pct": round((off - on) / off * 100.0, 2),
+        "trace_scrapes_during_run": n_scrapes,
+        "ticks": ticks,
+        "tx_per_tick": per_tick,
+    }
+
+
 def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tick: int = 4096) -> dict:
     import jax
 
@@ -276,6 +394,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
     teleme = _measure(ticks, tx_per_tick, services, capacity, telemetry=True)
     overhead_pct = (bare["throughput"] - teleme["throughput"]) / bare["throughput"] * 100.0
     delivery = _measure_delivery(quick)
+    tracing = _measure_tracing(quick)
 
     tick, sched, lat, rebuilds = bare["tick"], bare["sched"], bare["lat"], bare["rebuilds"]
     return result(
@@ -311,5 +430,8 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
             # ISSUE 3 acceptance: at-least-once epoch checkpoint+ack cadence
             # vs the at-most-once default, same stream same process
             "delivery": delivery,
+            # ISSUE 5 acceptance: distributed trace plane at default 1/64
+            # head sampling (+ live /trace scraper) vs sampling OFF
+            "tracing": tracing,
         },
     )

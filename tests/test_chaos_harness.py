@@ -18,6 +18,9 @@ are bit-identical to the crash-free run, zero messages are lost, and every
 redelivery is accounted for in the dedup counter.
 """
 
+import os
+import time
+
 import numpy as np
 import pytest
 
@@ -218,6 +221,36 @@ def test_kill9_crash_equivalence_subprocess(tmp_path):
     assert stats_g["acked"] == len(lines)
     assert stats_g["deduped_total"] == 0
 
+    def wait_rearmed(n_bundles, timeout_s=60.0):
+        """Block until the restarted child has promoted the previous
+        generation's journal+sentinel shadow into crash bundle ``n_bundles``
+        (boot-time recover_crash) AND its live journal carries the worker
+        sources again (WorkerApp registered + a journal tick ran). The
+        spool cursor can race far past the nominal kill points, so without
+        this the next SIGKILL can land mid-boot — before the recorder
+        re-arms (two crashes legitimately collapse into one promotion) or
+        before the journal is source-populated."""
+        import json as _json
+
+        journal = os.path.join(chaos.flight_dir, "tpu_worker.journal.json")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            promoted = sum(
+                1 for _p, b in chaos.flight_bundles() if b.get("recovered")
+            )
+            if promoted >= n_bundles:
+                try:
+                    with open(journal, "r", encoding="utf-8") as fh:
+                        if "engine_health" in _json.load(fh):
+                            return
+                except Exception:
+                    pass
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"crash bundle {n_bundles} / re-armed journal never appeared; "
+            f"see {chaos.log_path}"
+        )
+
     chaos = ChaosWorkerHarness(str(tmp_path / "chaos"), dup_p=0.08, seed=7)
     for line in lines:
         chaos.send_line(line)
@@ -226,6 +259,7 @@ def test_kill9_crash_equivalence_subprocess(tmp_path):
     chaos.kill9()
     first_kill_cursor = chaos.acked()
     chaos.start()
+    wait_rearmed(1)
     chaos.wait_acked(2 * len(lines) // 3)
     chaos.kill9()
     assert chaos.acked() >= first_kill_cursor  # the cursor never regresses
@@ -238,6 +272,24 @@ def test_kill9_crash_equivalence_subprocess(tmp_path):
     assert stats_c["services"] == stats_g["services"]
     assert stats_c["latest_label"] == stats_g["latest_label"]
     assert_snapshots_equal(golden.resume_path, chaos.resume_path)
+
+    # flight recorder (ISSUE 5): each kill−9 left a journal+sentinel shadow
+    # that the NEXT boot promoted into a parseable ...-crash.json bundle —
+    # while the run above stayed bit-identical to the golden snapshot. The
+    # golden (never-killed) run exits cleanly and must promote nothing.
+    crash_bundles = [
+        (p, b) for p, b in chaos.flight_bundles()
+        if b.get("recovered") and p.endswith("-crash.json")
+    ]
+    assert len(crash_bundles) >= 2  # two SIGKILLs, two promoted journals
+    for _path, body in crash_bundles:
+        journal = body.get("journal")
+        assert journal, "crash bundle must carry the promoted journal"
+        assert journal["module"]  # parseable, source-populated shadow
+        assert "engine_health" in journal and "config_hash" in journal
+    assert not [
+        (p, b) for p, b in golden.flight_bundles() if b.get("recovered")
+    ]
 
 
 @pytest.mark.slow
