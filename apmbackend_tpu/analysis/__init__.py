@@ -1,0 +1,54 @@
+"""Static correctness plane: in-repo AST analysis for the invariants the
+runtime harnesses only catch after the fact.
+
+Five PRs grew a concurrency-heavy system — a multithreaded transport/ack
+ledger, a ctypes C++ ingest engine, donated/fused XLA hot paths — whose
+invariants were enforced purely at runtime, and the runtime harnesses have
+already caught real bugs of exactly the classes a static pass prevents
+(the dup-ack one-message loss, the concurrent-profiler race). This package
+machine-checks them on every run, wired as a hard gate in
+``run_tests.sh --lint`` and runnable standalone::
+
+    python -m apmbackend_tpu.analysis            # whole repo, exit 0 = clean
+    python -m apmbackend_tpu.analysis --list-rules
+
+Rule families (see DESIGN.md §9 for the full contract):
+
+- **JAX hot path** (:mod:`.jaxrules`): implicit device syncs
+  (``float()``/``int()``/``bool()``/``.item()``/``np.asarray`` on
+  device-tainted values) outside functions annotated as sanctioned sync
+  boundaries; donated-buffer reuse after a ``donate_argnums`` call;
+  recompile hazards (Python scalar literals into jitted callables without
+  ``static_argnums``, ``jax.jit`` inside a loop).
+- **Lock discipline** (:mod:`.locks`): ``# guarded-by: <lock>`` annotations
+  on shared attributes are verified — every annotated access must occur
+  under ``with self.<lock>:`` or in a method annotated
+  ``# apm: holds(<lock>)``.
+- **Config-key cross-reference** (:mod:`.configkeys`): every config key
+  read in code exists in ``config.py`` defaults, and every default is read
+  somewhere — a typo'd ``tpuEngine.deliveryBatchSize`` fails the gate
+  instead of silently defaulting.
+- **Metric-catalogue drift** (:mod:`.metriccat`): every metric registered
+  via ``obs`` appears in the DESIGN.md §8 catalogue and vice versa.
+- **pyflakes-lite** (:mod:`.pyflakes_lite`): unused imports and
+  same-scope redefinitions — the hard-requirement core of the pyflakes
+  pass for containers that don't ship pyflakes.
+
+Suppressions are inline, deliberate, and auditable::
+
+    x = float(dev_val)  # apm: allow(jax-sync): readback at the emit boundary
+
+A pragma without a written reason is itself a finding (``pragma-bare``),
+and a pragma that no longer suppresses anything is too (``pragma-unused``).
+Stdlib only; no third-party linter dependencies.
+"""
+
+from .core import (
+    Finding,
+    Project,
+    RULES,
+    SourceFile,
+    run_analysis,
+)
+
+__all__ = ["Finding", "Project", "RULES", "SourceFile", "run_analysis"]

@@ -1,0 +1,67 @@
+"""CLI: ``python -m apmbackend_tpu.analysis`` — the static-correctness gate.
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error. ``run_tests.sh
+--lint`` runs this over the repo as a hard requirement; the tier-1 suite
+additionally asserts a clean run (tests/test_analysis.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import Project, RULES, run_analysis
+from . import core as _core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apmbackend_tpu.analysis",
+        description="AST static analysis: JAX hot-path, lock discipline, "
+                    "config keys, metric catalogue, pyflakes-lite.",
+    )
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected from the package)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print rule names + descriptions and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    _core._register_builtin_rules()
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name in sorted(RULES):
+            print(f"{name:<{width}}  {RULES[name][1]}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        project = Project(root=args.root)
+        findings = run_analysis(project, rules=rules)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=1))
+    elif not args.quiet:
+        for f in findings:
+            print(f.format())
+    n_files = len(project.files)
+    n_rules = len(rules) if rules is not None else len(RULES)
+    status = "clean" if not findings else f"{len(findings)} finding(s)"
+    print(f"analysis: {n_files} files, {n_rules} rules — {status}",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,328 @@
+"""Config-key cross-reference lint.
+
+``config.py`` ``_DEFAULT_CONFIG`` is the schema: every key code reads must
+exist there (``config-key-unknown`` — a typo'd ``tpuEngine.deliveryBatchSize``
+fails the gate instead of silently defaulting through ``.get()``), and
+every defined key must be read somewhere in the package or benchmarks
+(``config-key-unread`` — dead config is a lie waiting for an operator).
+
+Usage extraction is AST-based and deliberately conservative:
+
+- subscript / ``.get()`` chains rooted at a config-shaped name
+  (``config``, ``cfg``, ``self.config``, ...) or at a local alias assigned
+  from such a chain (``eng = config["tpuEngine"]``);
+- ``resolve_path(obj, "dotted.path")`` string arguments;
+- chains whose first segment is a known *section* key are auto-anchored at
+  that section, so ``section_cfg.get("deliveryBatchSize")`` resolves
+  without knowing which variable held the section.
+
+A chain that descends into a non-dict default (lists like
+``defaults[0].LAG``, free-form maps like ``statCmdMap``) stops validating
+at that point. The unread check covers depth ≤ 2 (sections and their
+direct keys); deeper structures are consumed wholesale by their owners.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile, rule
+
+# names that conventionally hold the WHOLE config dict. ``cfg``/``conf``
+# often hold a SECTION, so they anchor through _key_like instead — a
+# whole-config claim there would misreport every section read.
+_ROOT_NAMES = {"config", "new_config", "app_config", "apm_config", "full_config"}
+_ROOT_ATTRS = {"config", "_config", "app_config"}
+
+
+def _schema(project: Project) -> Tuple[dict, Dict[Tuple[str, ...], int]]:
+    """(nested default tree, {dotted path tuple: config.py line})."""
+    def build():
+        sf = project.file(f"{project.package}/config.py")
+        tree: dict = {}
+        lines: Dict[Tuple[str, ...], int] = {}
+        if sf is None:
+            return tree, lines
+
+        def walk_dict(node: ast.Dict, prefix: Tuple[str, ...], into: dict) -> None:
+            for k, v in zip(node.keys, node.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                    continue
+                path = prefix + (k.value,)
+                lines[path] = k.lineno
+                if isinstance(v, ast.Dict):
+                    sub: dict = {}
+                    into[k.value] = sub
+                    walk_dict(v, path, sub)
+                else:
+                    into[k.value] = None
+
+        for node in ast.walk(sf.tree):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if (target is not None and isinstance(target, ast.Name)
+                    and target.id == "_DEFAULT_CONFIG"
+                    and isinstance(node.value, ast.Dict)):
+                walk_dict(node.value, (), tree)
+        # keys config.py itself injects at load time (config["apmConfigFilePath"])
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)):
+                sub = node.targets[0]
+                if (isinstance(sub.value, ast.Name) and sub.value.id in _ROOT_NAMES
+                        and isinstance(sub.slice, ast.Constant)
+                        and isinstance(sub.slice.value, str)):
+                    tree.setdefault(sub.slice.value, None)
+                    lines.setdefault((sub.slice.value,), node.lineno)
+        return tree, lines
+    return project.cached("config.schema", build)
+
+
+def _chain_of(node: ast.AST) -> Optional[Tuple[ast.AST, List[Tuple[str, int]]]]:
+    """Decompose ``root["a"].get("b")`` into (root node, [(seg, line)...]).
+    Returns None when the expression isn't a constant-string key chain."""
+    segs: List[Tuple[str, int]] = []
+    cur = node
+    while True:
+        if isinstance(cur, ast.Subscript):
+            sl = cur.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                segs.append((sl.value, cur.lineno))
+                cur = cur.value
+                continue
+            return None
+        if (isinstance(cur, ast.Call) and isinstance(cur.func, ast.Attribute)
+                and cur.func.attr == "get" and cur.args
+                and isinstance(cur.args[0], ast.Constant)
+                and isinstance(cur.args[0].value, str)):
+            segs.append((cur.args[0].value, cur.lineno))
+            cur = cur.func.value
+            continue
+        # `(cfg.get("x") or {}).get(...)` — look through the or-{} guard
+        if isinstance(cur, ast.BoolOp) and isinstance(cur.op, ast.Or) and cur.values:
+            cur = cur.values[0]
+            continue
+        break
+    if not segs:
+        return None
+    segs.reverse()
+    return cur, segs
+
+
+def _root_prefix(root: ast.AST, aliases: Dict[str, Tuple[str, ...]]) -> Optional[Tuple[str, ...]]:
+    """Config-path prefix the chain root stands for, or None if not config."""
+    if isinstance(root, ast.Name):
+        if root.id in _ROOT_NAMES:
+            return ()
+        if root.id in aliases:
+            return aliases[root.id]
+        return None
+    if (isinstance(root, ast.Attribute) and isinstance(root.value, ast.Name)
+            and root.value.id == "self" and root.attr in _ROOT_ATTRS):
+        return ()
+    return None
+
+
+def _dict_nodes(tree: dict, prefix: Tuple[str, ...] = ()):
+    """(path, dict node) for every dict in the schema tree — anchor
+    candidates for section/subsection variables (``multivariateDetector``
+    blocks travel as their own config objects)."""
+    for key, sub in tree.items():
+        if isinstance(sub, dict):
+            path = prefix + (key,)
+            yield path, sub
+            yield from _dict_nodes(sub, path)
+
+
+def _descend(tree: dict, path: Tuple[str, ...]) -> Tuple[bool, int]:
+    """(valid, depth_validated): walk the schema; descending into a non-dict
+    (list / scalar / free-form map) stops validation successfully."""
+    cur: Optional[dict] = tree
+    for i, seg in enumerate(path):
+        if cur is None:
+            return True, i  # inside a non-dict default: can't validate further
+        if seg not in cur:
+            return False, i
+        cur = cur[seg]
+    return True, len(path)
+
+
+def _validate(project: Project, sf: SourceFile, segs: List[Tuple[str, int]],
+              prefix: Tuple[str, ...], findings: List[Finding],
+              used: Set[Tuple[str, ...]]) -> None:
+    """Resolve a chain read off a config-shaped object. Components routinely
+    receive their SECTION as ``config``/``self.config``, so a chain is
+    accepted when it resolves from the tree root OR auto-anchored at any
+    section defining its first segment; only a chain no anchor explains is
+    a finding. Every successful anchor marks its keys read (over-marking is
+    the price of not knowing which section the variable held)."""
+    tree, _ = _schema(project)
+    names = tuple(s for s, _ in segs)
+    if prefix:
+        anchors: List[Tuple[str, ...]] = [prefix]  # alias: exact location known
+    else:
+        anchors = [()]
+        anchors += [p for p, node in _dict_nodes(tree) if names[0] in node]
+    best: Tuple[int, Tuple[str, ...]] = (-1, names)
+    resolved = False
+    for anchor in anchors:
+        full = anchor + names
+        ok, depth = _descend(tree, full)
+        if ok:
+            resolved = True
+            for i in range(len(full)):
+                used.add(full[:i + 1])
+        elif depth > best[0]:
+            best = (depth, full)
+    if resolved:
+        return
+    depth, full = best
+    prefix_len = len(full) - len(names)
+    seg_idx = min(max(depth - prefix_len, 0), len(segs) - 1)
+    _seg, line = segs[seg_idx]
+    findings.append(Finding(
+        "config-key-unknown", sf.rel, line,
+        f"config key {'.'.join(full)!r} not in config.py defaults "
+        f"(unknown segment {full[min(depth, len(full) - 1)]!r}) — typo or "
+        "missing schema entry"))
+
+
+def _collect_usage(project: Project, sf: SourceFile,
+                   findings: List[Finding], used: Set[Tuple[str, ...]]) -> None:
+    tree, _ = _schema(project)
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.aliases: Dict[str, Tuple[str, ...]] = {}
+
+        def visit_Assign(self, node: ast.Assign) -> None:
+            ch = _chain_of(node.value)
+            if ch is not None:
+                prefix = _root_prefix(ch[0], self.aliases)
+                if prefix is not None and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    names = tuple(s for s, _ in ch[1])
+                    ok, _d = _descend(tree, prefix + names)
+                    if ok:
+                        self.aliases[node.targets[0].id] = prefix + names
+            self.generic_visit(node)
+
+        def visit_Subscript(self, node: ast.Subscript) -> None:
+            self._check(node)
+
+        def visit_Call(self, node: ast.Call) -> None:
+            # resolve_path(obj, "a.b.c")
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if (name == "resolve_path" and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                path = tuple(node.args[1].value.split("."))
+                ok, _d = _descend(tree, path)
+                if ok:
+                    for i in range(len(path)):
+                        used.add(path[:i + 1])
+                else:
+                    findings.append(Finding(
+                        "config-key-unknown", sf.rel, node.args[1].lineno,
+                        f"resolve_path key {'.'.join(path)!r} not in config.py "
+                        "defaults — typo or missing schema entry"))
+                self.generic_visit(node)
+                return
+            self._check(node)
+
+        def _check(self, node: ast.AST) -> None:
+            ch = _chain_of(node)
+            if ch is None:
+                for child in ast.iter_child_nodes(node):
+                    self.visit(child)
+                return
+            root, segs = ch
+            prefix = _root_prefix(root, self.aliases)
+            if prefix is not None:
+                _validate(project, sf, segs, prefix, findings, used)
+            elif _key_like(root):
+                # section dicts passed as parameters resolve by auto-anchor
+                _validate(project, sf, segs, (), findings, used)
+            self.visit(root)
+
+    V().visit(sf.tree)
+
+
+def _key_like(root: ast.AST) -> bool:
+    """Heuristic: chains rooted at *_cfg / *_config / section-named vars are
+    section reads worth anchoring (never reported, only marked used)."""
+    # NOT "section"/"conf": too generic — healthz payload dicts and the
+    # like travel under those names and are not config
+    if isinstance(root, ast.Name):
+        n = root.id.lower()
+        return n.endswith(("cfg", "config", "settings"))
+    if isinstance(root, ast.Attribute):
+        n = root.attr.lower()
+        return n.endswith(("cfg", "config", "settings"))
+    return False
+
+
+@rule("config-key-unknown", "config keys read in code that don't exist in config.py defaults")
+def check_config_unknown(project: Project) -> List[Finding]:
+    findings, _used = _usage(project)
+    return findings
+
+
+@rule("config-key-unread", "config.py default keys nothing in the code reads")
+def check_config_unread(project: Project) -> List[Finding]:
+    _findings, used = _usage(project)
+    tree, lines = _schema(project)
+    findings: List[Finding] = []
+    sf = project.file(f"{project.package}/config.py")
+    if sf is None:
+        return findings
+    # literal-string fallback evidence: any string constant equal to the key
+    # name anywhere outside _DEFAULT_CONFIG counts as a read (iteration-style
+    # consumers, wire formats)
+    literals = project.cached("config.literals", lambda: _string_literals(project))
+    for path, line in sorted(lines.items()):
+        if len(path) > 2:
+            continue  # deeper structures are consumed wholesale
+        if path in used or path[-1] in literals:
+            continue
+        findings.append(Finding(
+            "config-key-unread", sf.rel, line,
+            f"default config key {'.'.join(path)!r} is never read by "
+            f"{project.package}/ or benchmarks/ — dead schema or missing wiring"))
+    return findings
+
+
+def _usage(project: Project):
+    def build():
+        findings: List[Finding] = []
+        used: Set[Tuple[str, ...]] = set()
+        for sf in project.files:
+            _collect_usage(project, sf, findings, used)
+        return findings, used
+    return project.cached("config.usage", build)
+
+
+def _string_literals(project: Project) -> Set[str]:
+    out: Set[str] = set()
+    schema_sf = project.file(f"{project.package}/config.py")
+    schema_span = None
+    if schema_sf is not None:
+        for node in ast.walk(schema_sf.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_DEFAULT_CONFIG"):
+                schema_span = (node.lineno, node.end_lineno or node.lineno)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if (sf is schema_sf and schema_span
+                        and schema_span[0] <= node.lineno <= schema_span[1]):
+                    continue  # the schema's own keys are not evidence
+                out.add(node.value)
+    return out

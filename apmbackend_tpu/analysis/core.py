@@ -1,0 +1,264 @@
+"""Analyzer infrastructure: parsed sources, pragma grammar, rule registry.
+
+The pragma grammar (DESIGN.md §9):
+
+- ``# apm: allow(rule[, rule2]): <reason>`` — suppress the named rule(s)
+  on this line (trailing comment) or on the next line (comment-only line).
+  The reason is mandatory: a bare ``allow`` is reported as ``pragma-bare``,
+  and an ``allow`` that suppressed nothing this run as ``pragma-unused`` —
+  every exemption stays deliberate and auditable.
+- ``# apm: holds(<lock>): <reason>`` — on (or directly above) a ``def``:
+  the method is documented as called with ``self.<lock>`` already held;
+  the lock-discipline checker treats guarded accesses inside it as covered.
+- ``# apm: sync-boundary: <reason>`` — on (or directly above) a ``def``:
+  the function IS a sanctioned host/device sync boundary (the emit
+  readback, checkpoint save); the JAX sync rule skips its body.
+- ``# guarded-by: <lock>`` — trailing on a ``self.<attr> = ...`` line in
+  ``__init__``: declares the attribute shared state owned by that lock.
+
+Rules are callables ``rule(project) -> [Finding]`` registered in
+:data:`RULES`; per-file work iterates ``project.files``. The runner
+applies suppression centrally so every rule gets pragma handling for free.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+_ALLOW_RE = re.compile(r"#\s*apm:\s*allow\(\s*([\w\-, ]+?)\s*\)\s*(?::\s*(.*\S))?\s*$")
+_HOLDS_RE = re.compile(r"#\s*apm:\s*holds\(\s*(?:self\.)?([\w]+)\s*\)\s*(?::\s*(.*\S))?\s*$")
+_SYNC_RE = re.compile(r"#\s*apm:\s*sync-boundary\s*(?::\s*(.*\S))?\s*$")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?:self\.)?([\w]+)")
+# anything that claims to be an apm pragma must parse as one of the above
+_PRAGMA_ANY_RE = re.compile(r"#\s*apm:")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Allow:
+    rules: Tuple[str, ...]
+    reason: str
+    line: int  # line the pragma applies to
+    comment_line: int  # line the comment physically sits on
+    used: bool = False
+
+
+@dataclass
+class SourceFile:
+    path: str  # absolute
+    rel: str  # repo-relative
+    text: str
+    tree: ast.Module
+    comments: Dict[int, str] = field(default_factory=dict)  # line -> comment text
+    code_lines: set = field(default_factory=set)  # lines bearing non-comment tokens
+    allows: List[Allow] = field(default_factory=list)
+    holds: Dict[int, Tuple[str, str]] = field(default_factory=dict)  # line -> (lock, reason)
+    sync_boundaries: Dict[int, str] = field(default_factory=dict)  # line -> reason
+    guarded: Dict[int, str] = field(default_factory=dict)  # line -> lock name
+
+    def allow_for(self, rule: str, line: int) -> Optional[Allow]:
+        for al in self.allows:
+            if al.line == line and rule in al.rules:
+                return al
+        return None
+
+    def annotation_lines(self, def_line: int) -> Tuple[int, int]:
+        """Lines a function-level pragma may sit on: the ``def`` line itself
+        or the comment-only line directly above it (skipping decorators is
+        deliberate — the pragma belongs next to the def)."""
+        return (def_line - 1, def_line)
+
+    def holds_for_def(self, def_line: int) -> Optional[Tuple[str, str]]:
+        for ln in self.annotation_lines(def_line):
+            if ln in self.holds:
+                return self.holds[ln]
+        return None
+
+    def sync_boundary_for_def(self, def_line: int) -> Optional[str]:
+        for ln in self.annotation_lines(def_line):
+            if ln in self.sync_boundaries:
+                return self.sync_boundaries[ln]
+        return None
+
+
+def _collect_comments(text: str) -> Tuple[Dict[int, str], set]:
+    comments: Dict[int, str] = {}
+    code_lines: set = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+            elif tok.type not in (
+                tokenize.NL,
+                tokenize.NEWLINE,
+                tokenize.INDENT,
+                tokenize.DEDENT,
+                tokenize.ENDMARKER,
+            ):
+                code_lines.add(tok.start[0])
+    except tokenize.TokenError:
+        pass  # compileall is the syntax gate; salvage what tokenized
+    return comments, code_lines
+
+
+def parse_source(path: str, rel: str, text: str) -> SourceFile:
+    tree = ast.parse(text, filename=rel)
+    comments, code_lines = _collect_comments(text)
+    sf = SourceFile(path=path, rel=rel, text=text, tree=tree,
+                    comments=comments, code_lines=code_lines)
+    for line, comment in comments.items():
+        target = line if line in code_lines else line + 1
+        m = _ALLOW_RE.search(comment)
+        if m:
+            rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+            sf.allows.append(Allow(rules, (m.group(2) or "").strip(), target, line))
+            continue
+        m = _HOLDS_RE.search(comment)
+        if m:
+            sf.holds[line] = (m.group(1), (m.group(2) or "").strip())
+            continue
+        m = _SYNC_RE.search(comment)
+        if m:
+            sf.sync_boundaries[line] = (m.group(1) or "").strip()
+            continue
+        m = _GUARDED_RE.search(comment)
+        if m:
+            sf.guarded[line] = m.group(1)
+            continue
+        if _PRAGMA_ANY_RE.search(comment):
+            # a malformed apm pragma silently suppressing nothing is worse
+            # than no pragma; surfaced through a dedicated pseudo-rule below
+            sf.allows.append(Allow(("pragma-malformed",), comment, target, line))
+    return sf
+
+
+class Project:
+    """The analyzed tree: parsed package sources + repo-level artifacts
+    (config schema, DESIGN.md) shared by rules via cached properties."""
+
+    def __init__(self, root: Optional[str] = None,
+                 package: str = "apmbackend_tpu",
+                 extra_dirs: Tuple[str, ...] = ()):
+        if root is None:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.extra_dirs = extra_dirs
+        self.files: List[SourceFile] = []
+        self.parse_errors: List[Finding] = []
+        self._scan()
+        self._cache: dict = {}
+
+    def _scan(self) -> None:
+        dirs = [os.path.join(self.root, self.package)]
+        dirs += [os.path.join(self.root, d) for d in self.extra_dirs]
+        for base in dirs:
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    rel = os.path.relpath(path, self.root)
+                    try:
+                        with open(path, "r", encoding="utf-8") as fh:
+                            text = fh.read()
+                        self.files.append(parse_source(path, rel, text))
+                    except (OSError, SyntaxError, ValueError) as e:
+                        self.parse_errors.append(
+                            Finding("parse-error", rel, getattr(e, "lineno", 0) or 0, str(e))
+                        )
+
+    def file(self, rel_suffix: str) -> Optional[SourceFile]:
+        for sf in self.files:
+            if sf.rel.endswith(rel_suffix):
+                return sf
+        return None
+
+    def cached(self, key: str, fn: Callable[[], object]):
+        if key not in self._cache:
+            self._cache[key] = fn()
+        return self._cache[key]
+
+
+RuleFn = Callable[[Project], List[Finding]]
+RULES: Dict[str, Tuple[RuleFn, str]] = {}
+
+
+def rule(name: str, description: str) -> Callable[[RuleFn], RuleFn]:
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES[name] = (fn, description)
+        return fn
+    return deco
+
+
+def _register_builtin_rules() -> None:
+    # imported for their @rule side effects; late import breaks the cycle
+    from . import configkeys, jaxrules, locks, metriccat, pyflakes_lite
+    _ = (configkeys, jaxrules, locks, metriccat, pyflakes_lite)
+
+
+def run_analysis(
+    project: Optional[Project] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the selected rules (default: all) and the pragma audit; returns
+    findings with suppressed ones already removed. Clean repo == []."""
+    _register_builtin_rules()
+    if project is None:
+        project = Project()
+    enabled = list(RULES) if rules is None else list(rules)
+    unknown = [r for r in enabled if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+
+    findings: List[Finding] = list(project.parse_errors)
+    for name in enabled:
+        fn, _ = RULES[name]
+        for f in fn(project):
+            sf = project.file(f.path)
+            al = sf.allow_for(f.rule, f.line) if sf else None
+            if al is not None:
+                al.used = True
+            else:
+                findings.append(f)
+
+    # pragma audit: bare, malformed, and unused allows. Only audit pragmas
+    # naming an enabled rule — a subset run must not flag the others' pragmas.
+    for sf in project.files:
+        for al in sf.allows:
+            if al.rules == ("pragma-malformed",):
+                findings.append(Finding(
+                    "pragma-malformed", sf.rel, al.comment_line,
+                    f"unrecognized apm pragma: {al.reason.strip()!r}"))
+                continue
+            if not any(r in enabled for r in al.rules):
+                continue
+            if not al.reason:
+                findings.append(Finding(
+                    "pragma-bare", sf.rel, al.comment_line,
+                    f"allow({', '.join(al.rules)}) without a written reason — "
+                    "every suppression must say why"))
+            if not al.used:
+                findings.append(Finding(
+                    "pragma-unused", sf.rel, al.comment_line,
+                    f"allow({', '.join(al.rules)}) suppresses nothing — "
+                    "remove it or fix the rule name"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,523 @@
+"""JAX hot-path rules: implicit syncs, donated-buffer reuse, recompiles.
+
+These are the exact bug classes the r6 perf work root-caused by hand:
+
+- ``jax-sync``: a ``float()``/``int()``/``bool()``/``.item()``/``.tolist()``
+  /``np.asarray()`` on a *device-tainted* value forces a blocking device
+  sync. Syncs are only legal inside functions annotated
+  ``# apm: sync-boundary: <reason>`` — the deliberate readback points
+  (emit, checkpoint, healthz snapshots) — or under a per-line
+  ``# apm: allow(jax-sync): <reason>``.
+- ``jax-donated-reuse``: a buffer passed in a ``donate_argnums`` position
+  is dead after the call; reading the same name afterwards (without
+  rebinding) is use-after-donate — XLA may have already aliased the
+  memory. The ``state = step(state, ...)`` rebind idiom is recognized as
+  safe.
+- ``jax-recompile``: a Python scalar literal passed to a jitted callable
+  in a non-``static_argnums`` position retraces per value, and a
+  ``jax.jit(...)`` constructed inside a loop rebuilds its cache entry per
+  iteration — both silent throughput cliffs.
+
+Taint model (deliberately local and conservative): a value is
+device-tainted when it flows from a ``jnp.``/``jax.``/``lax.`` call, a
+call through a known jitted callable (``x = jax.jit(...)``, including
+``self._x`` attributes and decorated defs), a parameter annotated with a
+device container type (any class in the package with a ``jnp.ndarray``/
+``jax.Array`` field), or a ``self.<attr>`` assigned from any of those
+anywhere in the class. Attribute/subscript access propagates taint.
+Branches merge by union; loop bodies are walked twice for loop-carried
+taint. Files that never import jax are skipped entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, SourceFile, rule
+
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_SYNC_METHODS = {"item", "tolist"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.")
+# jax.* calls that return host/control objects, not device arrays
+_NON_DEVICE_JAX = (
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.devices",
+    "jax.device_count", "jax.local_devices", "jax.local_device_count",
+    "jax.default_backend", "jax.process_index", "jax.process_count",
+    "jax.named_scope", "jax.profiler.", "jax.tree_util.", "jax.config.",
+    "jax.distributed.", "jax.sharding.", "jax.eval_shape",
+    "jnp.shape", "jnp.dtype", "jnp.issubdtype",
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _key(node: ast.AST) -> Optional[str]:
+    """Trackable lvalue/rvalue key: a bare name or a self attribute."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return None
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if d is None:
+        return False
+    if any(d == p.rstrip(".") or d.startswith(p) for p in _NON_DEVICE_JAX):
+        return False
+    return d.startswith(_DEVICE_PREFIXES)
+
+
+def _int_set(node: Optional[ast.AST]) -> Optional[Set[int]]:
+    """Literal int / tuple-of-ints keyword value; None when unparseable."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+class JitInfo:
+    __slots__ = ("donate", "static")
+
+    def __init__(self, donate: Optional[Set[int]], static: Optional[Set[int]]):
+        self.donate = donate or set()
+        self.static = static
+
+
+def _jit_info_from_call(call: ast.Call) -> Optional[JitInfo]:
+    """JitInfo when ``call`` is jax.jit(...) / functools.partial(jax.jit, ...)."""
+    d = _dotted(call.func)
+    if d in ("jax.jit", "jit"):
+        kw = {k.arg: k.value for k in call.keywords}
+        return JitInfo(_int_set(kw.get("donate_argnums")), _int_set(kw.get("static_argnums")))
+    if d in ("functools.partial", "partial") and call.args:
+        inner = _dotted(call.args[0])
+        if inner in ("jax.jit", "jit"):
+            kw = {k.arg: k.value for k in call.keywords}
+            # static_argnames can't be mapped to positions statically; treat
+            # the callable as fully static (never flag literal scalars)
+            if "static_argnames" in kw:
+                return JitInfo(_int_set(kw.get("donate_argnums")), set(range(64)))
+            return JitInfo(_int_set(kw.get("donate_argnums")), _int_set(kw.get("static_argnums")))
+    return None
+
+
+def _device_classes(project: Project) -> Set[str]:
+    """Names of classes whose annotated fields hold device arrays — the
+    NamedTuple state/emission containers (EngineState, TickEmission, ...)."""
+    def build() -> Set[str]:
+        out: Set[str] = set()
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign):
+                        try:
+                            ann = ast.unparse(stmt.annotation)
+                        except Exception:
+                            continue
+                        if "jnp.ndarray" in ann or "jax.Array" in ann:
+                            out.add(node.name)
+                            break
+        return out
+    return project.cached("jax.device_classes", build)
+
+
+def _imports_jax(sf: SourceFile) -> bool:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.") for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax" or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _module_jitted(sf: SourceFile) -> Dict[str, JitInfo]:
+    """File-wide jitted callables: module/class/self assignments from
+    jax.jit(...) and @jax.jit/@functools.partial(jax.jit, ...) defs."""
+    out: Dict[str, JitInfo] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            info = _jit_info_from_call(node.value)
+            if info is not None:
+                for tgt in node.targets:
+                    k = _key(tgt)
+                    if k:
+                        out[k] = info
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                info = None
+                if isinstance(deco, ast.Call):
+                    info = _jit_info_from_call(deco)
+                elif _dotted(deco) in ("jax.jit", "jit"):
+                    info = JitInfo(None, None)
+                if info is not None:
+                    out[node.name] = info
+    return out
+
+
+def _class_device_attrs(cls: ast.ClassDef, jitted: Dict[str, JitInfo]) -> Set[str]:
+    """self.<attr> keys assigned from device/jitted calls anywhere in the
+    class — cross-method taint roots (self.state, self._params, ...)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            is_dev = _is_device_call(call)
+            if not is_dev:
+                d = _key(call.func)
+                is_dev = d is not None and d in jitted
+            if is_dev:
+                for tgt in node.targets:
+                    k = _key(tgt)
+                    if k and k.startswith("self."):
+                        out.add(k)
+    return out
+
+
+class _FnState:
+    def __init__(self):
+        self.tainted: Set[str] = set()
+        self.dead: Dict[str, int] = {}  # key -> donation line
+        self.jitted: Dict[str, JitInfo] = {}
+
+    def copy(self) -> "_FnState":
+        st = _FnState()
+        st.tainted = set(self.tainted)
+        st.dead = dict(self.dead)
+        st.jitted = dict(self.jitted)
+        return st
+
+    def merge(self, other: "_FnState") -> None:
+        self.tainted |= other.tainted
+        for k, ln in other.dead.items():
+            self.dead.setdefault(k, ln)
+        self.jitted.update(other.jitted)
+
+
+class _FnChecker:
+    """Walks one function's statements in order, tracking taint, donated
+    buffers, and jitted locals; emits findings into ``self.findings``."""
+
+    def __init__(self, sf: SourceFile, fn: ast.FunctionDef,
+                 jitted: Dict[str, JitInfo], device_classes: Set[str],
+                 device_attrs: Set[str], check_sync: bool):
+        self.sf = sf
+        self.fn = fn
+        self.check_sync = check_sync
+        self.findings: List[Finding] = []
+        self.state = _FnState()
+        self.state.jitted.update(jitted)
+        self.state.tainted |= device_attrs
+        self.loop_depth = 0
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.annotation is None:
+                continue
+            try:
+                ann = ast.unparse(a.annotation)
+            except Exception:
+                continue
+            if isinstance(a.annotation, ast.Constant) and isinstance(a.annotation.value, str):
+                ann = a.annotation.value
+            if ("jnp.ndarray" in ann or "jax.Array" in ann
+                    or any(dc in ann for dc in device_classes)):
+                self.state.tainted.add(a.arg)
+
+    # -- expression helpers ---------------------------------------------------
+    def expr_tainted(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            k = _key(sub)
+            if k is not None and k in self.state.tainted:
+                # self.<attr> taints only via the self-attribute node, not
+                # the bare 'self' name inside it
+                if isinstance(sub, ast.Name) and sub.id == "self":
+                    continue
+                return True
+            if isinstance(sub, ast.Call):
+                if _is_device_call(sub):
+                    return True
+                d = _key(sub.func)
+                if d is not None and d in self.state.jitted:
+                    return True
+        return False
+
+    def scan_expr(self, node: Optional[ast.AST]) -> None:
+        """Findings inside one expression: syncs, donated reads, jit-in-loop,
+        literal-scalar args to jitted callables. Donations apply afterwards
+        via ``pending_donations``."""
+        if node is None:
+            return
+        self.pending_donations: List[Tuple[str, int]] = getattr(self, "pending_donations", [])
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._scan_call(sub)
+            else:
+                k = _key(sub)
+                if (k is not None and isinstance(getattr(sub, "ctx", None), ast.Load)
+                        and k in self.state.dead):
+                    self.findings.append(Finding(
+                        "jax-donated-reuse", self.sf.rel, sub.lineno,
+                        f"'{k}' was donated to a donate_argnums call on line "
+                        f"{self.state.dead[k]} and read again here — the buffer "
+                        "may already be aliased; rebind the result or copy first"))
+                    # one report per donation site keeps burn-down tractable
+                    self.state.dead.pop(k, None)
+
+    def _scan_call(self, call: ast.Call) -> None:
+        d = _dotted(call.func)
+        # jax.jit inside a loop: per-iteration retrace/cache churn
+        if d in ("jax.jit", "jit") and self.loop_depth > 0:
+            self.findings.append(Finding(
+                "jax-recompile", self.sf.rel, call.lineno,
+                "jax.jit(...) constructed inside a loop — hoist it; each "
+                "iteration rebuilds trace/cache state"))
+        # implicit syncs
+        if self.check_sync:
+            if (isinstance(call.func, ast.Name) and call.func.id in _SYNC_BUILTINS
+                    and len(call.args) == 1 and self.expr_tainted(call.args[0])):
+                self.findings.append(Finding(
+                    "jax-sync", self.sf.rel, call.lineno,
+                    f"{call.func.id}() on a device value blocks on the device — "
+                    "move into a sync-boundary function or batch the readback"))
+            elif (isinstance(call.func, ast.Attribute) and call.func.attr in _SYNC_METHODS
+                    and self.expr_tainted(call.func.value)):
+                self.findings.append(Finding(
+                    "jax-sync", self.sf.rel, call.lineno,
+                    f".{call.func.attr}() on a device value blocks on the device — "
+                    "move into a sync-boundary function or batch the readback"))
+            elif (d in _NP_SYNC and call.args and self.expr_tainted(call.args[0])):
+                self.findings.append(Finding(
+                    "jax-sync", self.sf.rel, call.lineno,
+                    f"{d}() on a device value forces a transfer — move into a "
+                    "sync-boundary function or batch the readback"))
+        # calls through jitted callables: donation + literal-scalar hazards
+        k = _key(call.func)
+        info = self.state.jitted.get(k) if k is not None else None
+        if info is None:
+            return
+        for pos, arg in enumerate(call.args):
+            if pos in info.donate:
+                ak = _key(arg)
+                if ak is not None:
+                    self.pending_donations.append((ak, call.lineno))
+            if (isinstance(arg, ast.Constant)
+                    and type(arg.value) in (int, float)
+                    and (info.static is None or pos not in info.static)):
+                self.findings.append(Finding(
+                    "jax-recompile", self.sf.rel, call.lineno,
+                    f"Python scalar literal {arg.value!r} passed to jitted "
+                    f"'{k}' at position {pos} without static_argnums — "
+                    "retraces per value; pass an array or mark it static"))
+
+    # -- statement walk -------------------------------------------------------
+    def _apply_donations(self, rebound: Set[str]) -> None:
+        for ak, ln in getattr(self, "pending_donations", []):
+            if ak not in rebound:
+                self.state.dead[ak] = ln
+        self.pending_donations = []
+
+    def _assign_taint(self, targets: List[ast.AST], value: ast.AST) -> None:
+        tainted = self.expr_tainted(value)
+        jit_info = _jit_info_from_call(value) if isinstance(value, ast.Call) else None
+        for tgt in targets:
+            for el in ast.walk(tgt):
+                k = _key(el)
+                if k is None or (isinstance(el, ast.Name) and el.id == "self"):
+                    continue
+                self.state.dead.pop(k, None)  # rebind revives the name
+                if jit_info is not None:
+                    self.state.jitted[k] = jit_info
+                elif tainted:
+                    self.state.tainted.add(k)
+                else:
+                    self.state.tainted.discard(k)
+
+    def _targets_keys(self, targets: List[ast.AST]) -> Set[str]:
+        out: Set[str] = set()
+        for tgt in targets:
+            for el in ast.walk(tgt):
+                k = _key(el)
+                if k and not (isinstance(el, ast.Name) and el.id == "self"):
+                    out.add(k)
+        return out
+
+    def exec_stmts(self, stmts: List[ast.stmt]) -> bool:
+        """Returns True when the block terminates (return/raise/break/
+        continue) — a terminated branch must not merge into fall-through
+        state, or an if-return's donation would poison the else path."""
+        terminated = False
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                self.scan_expr(stmt.value)
+                self._apply_donations(self._targets_keys(stmt.targets))
+                self._assign_taint(stmt.targets, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self.scan_expr(stmt.value)
+                self._apply_donations(self._targets_keys([stmt.target]))
+                self._assign_taint([stmt.target], stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                self.scan_expr(stmt.value)
+                self.scan_expr(stmt.target)
+                self._apply_donations(set())
+            elif isinstance(stmt, (ast.Expr, ast.Return)):
+                self.scan_expr(stmt.value)
+                self._apply_donations(set())
+                if isinstance(stmt, ast.Return):
+                    terminated = True
+            elif isinstance(stmt, (ast.Raise, ast.Break, ast.Continue)):
+                if isinstance(stmt, ast.Raise):
+                    self.scan_expr(stmt.exc)
+                    self._apply_donations(set())
+                terminated = True
+            elif isinstance(stmt, ast.If):
+                self.scan_expr(stmt.test)
+                self._apply_donations(set())
+                branch = self.state.copy()
+                body_term = self.exec_stmts(stmt.body)
+                taken, self.state = self.state, branch
+                else_term = self.exec_stmts(stmt.orelse)
+                if body_term and else_term:
+                    terminated = True
+                elif body_term:
+                    pass  # fall-through state is the else branch alone
+                elif else_term:
+                    self.state = taken  # fall-through is the if branch alone
+                else:
+                    self.state.merge(taken)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.scan_expr(stmt.iter)
+                self._apply_donations(set())
+                self._assign_taint([stmt.target], stmt.iter)
+                self.loop_depth += 1
+                for _ in range(2):  # second pass catches loop-carried taint
+                    body = self.state.copy()
+                    self.exec_stmts(stmt.body)
+                    self.state.merge(body)
+                self.loop_depth -= 1
+                self.exec_stmts(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self.scan_expr(stmt.test)
+                self._apply_donations(set())
+                self.loop_depth += 1
+                for _ in range(2):
+                    body = self.state.copy()
+                    self.exec_stmts(stmt.body)
+                    self.state.merge(body)
+                self.loop_depth -= 1
+                self.exec_stmts(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.scan_expr(item.context_expr)
+                self._apply_donations(set())
+                self.exec_stmts(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                pre = self.state.copy()
+                self.exec_stmts(stmt.body)
+                for handler in stmt.handlers:
+                    h = self.state.copy()
+                    self.state = pre.copy()
+                    self.exec_stmts(handler.body)
+                    self.state.merge(h)
+                self.exec_stmts(stmt.orelse)
+                self.exec_stmts(stmt.finalbody)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                pass  # nested defs are analyzed as their own functions
+            else:
+                for child in ast.iter_child_nodes(stmt):
+                    if isinstance(child, ast.expr):
+                        self.scan_expr(child)
+                self._apply_donations(set())
+        return terminated
+
+
+def _iter_functions(tree: ast.Module):
+    """(fn, enclosing_class|None) for every def, including nested ones."""
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child)
+            else:
+                yield from walk(child, cls)
+    yield from walk(tree, None)
+
+
+def _check_file(sf: SourceFile, device_classes: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    jitted = _module_jitted(sf)
+    class_attrs: Dict[ast.ClassDef, Set[str]] = {}
+    for fn, cls in _iter_functions(sf.tree):
+        if cls is not None and cls not in class_attrs:
+            class_attrs[cls] = _class_device_attrs(cls, jitted)
+        device_attrs = class_attrs.get(cls, set()) if cls is not None else set()
+        check_sync = sf.sync_boundary_for_def(fn.lineno) is None
+        checker = _FnChecker(sf, fn, jitted, device_classes, device_attrs, check_sync)
+        checker.exec_stmts(fn.body)
+        findings.extend(checker.findings)
+    # loop bodies are walked twice and expressions can be revisited across
+    # branch merges: one report per (rule, line, message) is enough
+    seen: Set[Tuple[str, int, str]] = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _all_jax_findings(project: Project) -> List[Finding]:
+    def build() -> List[Finding]:
+        out: List[Finding] = []
+        dc = _device_classes(project)
+        for sf in project.files:
+            if _imports_jax(sf):
+                out.extend(_check_file(sf, dc))
+        return out
+    return project.cached("jax.findings", build)
+
+
+@rule("jax-sync", "implicit device syncs outside sanctioned sync boundaries")
+def check_jax_sync(project: Project) -> List[Finding]:
+    return [f for f in _all_jax_findings(project) if f.rule == "jax-sync"]
+
+
+@rule("jax-donated-reuse", "buffer read after being passed to a donate_argnums call")
+def check_donated_reuse(project: Project) -> List[Finding]:
+    return [f for f in _all_jax_findings(project) if f.rule == "jax-donated-reuse"]
+
+
+@rule("jax-recompile", "scalar literals into jitted callables / jit inside loops")
+def check_recompile(project: Project) -> List[Finding]:
+    return [f for f in _all_jax_findings(project) if f.rule == "jax-recompile"]

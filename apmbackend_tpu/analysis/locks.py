@@ -1,0 +1,175 @@
+"""Lock-discipline checker driven by ``# guarded-by: <lock>`` annotations.
+
+The transport ledger, the obs rings, and the worker's delivery buffers are
+all mutated from multiple threads; their locking contract used to live in
+docstrings ("Caller holds self._lock"). This rule makes it machine-checked:
+
+- Declaring: a trailing ``# guarded-by: _lock`` on a ``self.<attr> = ...``
+  line (conventionally in ``__init__``) declares the attribute shared
+  state owned by ``self._lock``.
+- Checking: every ``self.<attr>`` access anywhere in the class must be
+  (a) lexically inside ``with self._lock:``, (b) in a method annotated
+  ``# apm: holds(_lock): <reason>`` (the ``*_locked`` helper convention),
+  or (c) in ``__init__`` itself (construction happens-before publication).
+
+Nested functions and lambdas defined inside a ``with`` block do NOT
+inherit the held lock — they may run later on another thread (collector
+closures, timer callbacks), which is exactly the PR-5 profiler-race shape.
+Deliberate lock-free reads (GIL-atomic snapshots for scrape endpoints)
+carry ``# apm: allow(lock-guard): <reason>`` so every one is auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, Project, SourceFile, rule
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _guarded_attrs(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """{attr: lock} declared via guarded-by comments on self-assign lines."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        lock = sf.guarded.get(node.lineno)
+        if lock is None:
+            continue
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is not None:
+                out[attr] = lock
+    return out
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walk one method tracking which self.<lock> locks are lexically held."""
+
+    def __init__(self, sf: SourceFile, cls_name: str, method: ast.FunctionDef,
+                 guarded: Dict[str, str], held0: Set[str]):
+        self.sf = sf
+        self.cls_name = cls_name
+        self.method = method
+        self.guarded = guarded
+        self.held: Set[str] = set(held0)
+        self.findings: List[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            attr = _self_attr(item.context_expr)
+            if attr is not None:
+                acquired.append(attr)
+                self.held.add(attr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for attr in acquired:
+            self.held.discard(attr)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr in self.guarded:
+            lock = self.guarded[attr]
+            if lock not in self.held:
+                self.findings.append(Finding(
+                    "lock-guard", self.sf.rel, node.lineno,
+                    f"{self.cls_name}.{attr} is guarded-by {lock} but accessed "
+                    f"in {self.method.name}() without holding it — wrap in "
+                    f"'with self.{lock}:' or annotate the method "
+                    f"'# apm: holds({lock}): <reason>'"))
+        self.generic_visit(node)
+
+    def _enter_closure(self, node) -> None:
+        # a closure/lambda body runs later, possibly without the lock
+        inner = _MethodVisitor(self.sf, self.cls_name, self.method,
+                               self.guarded, set())
+        for child in ast.iter_child_nodes(node):
+            inner.visit(child)
+        self.findings.extend(inner.findings)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        held0 = set()
+        h = self.sf.holds_for_def(node.lineno)
+        if h is not None:
+            held0.add(h[0])
+        inner = _MethodVisitor(self.sf, self.cls_name, node, self.guarded, held0)
+        for child in node.body:
+            inner.visit(child)
+        self.findings.extend(inner.findings)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_closure(node)
+
+
+def _topmost_closures(fn: ast.FunctionDef) -> List[ast.AST]:
+    """First-level nested defs/lambdas of ``fn`` (deeper nesting is reached
+    through the visitor's own recursion, never visited twice)."""
+    out: List[ast.AST] = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                out.append(child)
+            else:
+                walk(child)
+
+    walk(fn)
+    return out
+
+
+@rule("lock-guard", "guarded-by annotated attributes accessed without the owning lock")
+def check_lock_guard(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        if not sf.guarded:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(sf, node)
+            if not guarded:
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if stmt.name == "__init__":
+                    # construction happens-before publication, so direct
+                    # accesses are fine — but closures defined here (collector
+                    # callbacks, timers) run later and are still checked
+                    v = _MethodVisitor(sf, node.name, stmt, guarded, set())
+                    for closure in _topmost_closures(stmt):
+                        if isinstance(closure, ast.Lambda):
+                            v.visit_Lambda(closure)
+                        else:
+                            v.visit_FunctionDef(closure)
+                    findings.extend(v.findings)
+                    continue
+                held0: Set[str] = set()
+                h = sf.holds_for_def(stmt.lineno)
+                if h is not None:
+                    held0.add(h[0])
+                visitor = _MethodVisitor(sf, node.name, stmt, guarded, held0)
+                for child in stmt.body:
+                    visitor.visit(child)
+                findings.extend(visitor.findings)
+    return findings

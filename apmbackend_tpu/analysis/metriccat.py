@@ -1,0 +1,141 @@
+"""Metric-catalogue drift check: obs registrations vs DESIGN.md §8.
+
+Every metric name registered through the telemetry plane — ``.counter()``/
+``.gauge()``/``.histogram()`` calls and ``Sample(...)`` collector views —
+must appear in the DESIGN.md §8 "Metric catalogue" block, and every
+catalogued name must still be registered somewhere. PR 2 promised the
+catalogue as the operator's index; without a gate it drifts one PR later.
+
+Catalogue grammar (the block from the line containing "Metric catalogue"
+to the next markdown heading): backticked tokens, where a brace group
+with commas expands (``apm_engine_{capacity,services}``) and a comma-free
+trailing group is a label annotation to strip
+(``apm_tick_stage_seconds{stage}``). Registrations with dynamic
+(non-literal) names can't be checked and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Project, rule
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"`([a-zA-Z_][\w{},.()|-]*)`")
+_METRIC_RE = re.compile(r"^apm_[a-z0-9_]+$")
+
+
+def _registered(project: Project) -> Dict[str, Tuple[str, int]]:
+    """{metric name: (file, line)} for every literal registration site."""
+    def build() -> Dict[str, Tuple[str, int]]:
+        out: Dict[str, Tuple[str, int]] = {}
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REG_METHODS
+                        and node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    name = node.args[0].value
+                elif (((isinstance(node.func, ast.Name) and node.func.id == "Sample")
+                       or (isinstance(node.func, ast.Attribute) and node.func.attr == "Sample"))
+                      and node.args and isinstance(node.args[0], ast.Constant)
+                      and isinstance(node.args[0].value, str)):
+                    name = node.args[0].value
+                if name is not None and name.startswith("apm_"):
+                    out.setdefault(name, (sf.rel, node.lineno))
+        return out
+    return project.cached("metrics.registered", build)
+
+
+def _expand(token: str) -> Tuple[Set[str], bool]:
+    """(names, is_expansion): interpret one catalogue token. A comma brace
+    group expands; a comma-free group is a label annotation and strips."""
+    m = re.search(r"\{([^{}]*)\}", token)
+    if not m:
+        return {token}, False
+    pre, group, post = token[: m.start()], m.group(1), token[m.end():]
+    if "," in group:
+        names: Set[str] = set()
+        for alt in group.split(","):
+            sub, _ = _expand(pre + alt.strip() + post)
+            names |= sub
+        return names, True
+    return _expand(pre + post)  # label annotation: strip and re-examine
+
+
+def _catalogue(project: Project) -> List[Tuple[str, int, Set[str], bool]]:
+    """[(token, DESIGN.md line, expanded names, is_expansion)] from §8."""
+    def build():
+        out: List[Tuple[str, int, Set[str], bool]] = []
+        path = os.path.join(project.root, "DESIGN.md")
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            return out
+        in_block = False
+        for i, line in enumerate(lines, 1):
+            if not in_block:
+                if "Metric catalogue" in line:
+                    in_block = True
+                else:
+                    continue
+            elif line.startswith("#"):
+                break
+            for token in _NAME_RE.findall(line):
+                names, is_exp = _expand(token)
+                if all(_METRIC_RE.match(n) for n in names) and names:
+                    out.append((token, i, names, is_exp))
+        return out
+    return project.cached("metrics.catalogue", build)
+
+
+@rule("metric-uncatalogued", "metrics registered in code but missing from DESIGN.md §8")
+def check_uncatalogued(project: Project) -> List[Finding]:
+    registered = _registered(project)
+    catalogued: Set[str] = set()
+    for _tok, _ln, names, _exp in _catalogue(project):
+        catalogued |= names
+    findings: List[Finding] = []
+    for name, (rel, line) in sorted(registered.items()):
+        if name not in catalogued:
+            findings.append(Finding(
+                "metric-uncatalogued", rel, line,
+                f"metric {name!r} is registered here but missing from the "
+                "DESIGN.md §8 catalogue — document it"))
+    return findings
+
+
+def _mentioned(project: Project) -> Set[str]:
+    """apm_* tokens inside any string constant — evidence for metrics
+    emitted as raw exposition text (the manager's ``apm_fleet_child_up``
+    f-string markers) rather than through registry instruments."""
+    def build() -> Set[str]:
+        out: Set[str] = set()
+        pat = re.compile(r"apm_[a-z0-9_]+")
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    out.update(pat.findall(node.value))
+        return out
+    return project.cached("metrics.mentioned", build)
+
+
+@rule("metric-unregistered", "DESIGN.md §8 catalogue entries no code registers")
+def check_unregistered(project: Project) -> List[Finding]:
+    registered = set(_registered(project)) | _mentioned(project)
+    findings: List[Finding] = []
+    for token, line, names, _exp in _catalogue(project):
+        missing = sorted(n for n in names if n not in registered)
+        if missing:
+            findings.append(Finding(
+                "metric-unregistered", "DESIGN.md", line,
+                f"catalogue entry `{token}` names {', '.join(missing)} but "
+                "no code registers it — stale catalogue or lost metric"))
+    return findings

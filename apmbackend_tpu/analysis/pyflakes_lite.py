@@ -1,0 +1,144 @@
+"""pyflakes-lite: the hard-requirement core of the lint gate.
+
+The container image does not bake pyflakes in, and a lint gate that
+soft-skips is not a gate. This module implements the two pyflakes checks
+with near-zero false-positive rates as in-repo rules, so
+``run_tests.sh --lint`` can hard-fail everywhere; when real pyflakes IS
+available the script additionally runs it (also hard).
+
+- ``unused-import``: a module-level or function-level import binding never
+  referenced in the file. ``__init__.py`` files are exempt (the re-export
+  idiom), as are ``__future__`` imports, ``import x as x`` explicit
+  re-exports, and names listed in ``__all__``.
+- ``redefinition``: a def/class name bound twice in the same scope body
+  where the earlier binding is a def/class — shadowed dead code.
+  ``@property``/``.setter``/``.deleter``/``@overload``/
+  ``@singledispatch .register`` stacks are recognized as intentional.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Project, rule
+
+
+def _import_bindings(tree: ast.Module) -> List[Tuple[str, int, str]]:
+    """(bound name, line, display) for every import in the file."""
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    if alias.asname == alias.name:
+                        continue  # explicit re-export idiom
+                    out.append((alias.asname, node.lineno, alias.name))
+                else:
+                    out.append((alias.name.split(".")[0], node.lineno, alias.name))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                if alias.asname:
+                    if alias.asname == alias.name:
+                        continue
+                    out.append((alias.asname, node.lineno, alias.name))
+                else:
+                    out.append((alias.name, node.lineno, alias.name))
+    return out
+
+
+def _used_names(tree: ast.Module) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # roots arrive as the inner Name node
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # __all__ strings and string annotations reference names textually
+            v = node.value
+            if v.isidentifier():
+                used.add(v)
+            else:
+                # 'Optional[EngineState]'-style string annotations
+                for part in _ident_parts(v):
+                    used.add(part)
+    return used
+
+
+def _ident_parts(s: str) -> List[str]:
+    out, cur = [], []
+    for ch in s:
+        if ch.isalnum() or ch == "_":
+            cur.append(ch)
+        else:
+            if cur:
+                out.append("".join(cur))
+            cur = []
+    if cur:
+        out.append("".join(cur))
+    return out if len(out) <= 32 else []  # long prose strings aren't annotations
+
+
+@rule("unused-import", "import bindings never referenced in the file")
+def check_unused_imports(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        if sf.rel.endswith("__init__.py"):
+            continue  # re-export surface
+        used = _used_names(sf.tree)
+        for name, line, display in _import_bindings(sf.tree):
+            if name not in used:
+                findings.append(Finding(
+                    "unused-import", sf.rel, line,
+                    f"'{display}' imported but unused"))
+    return findings
+
+
+_SETTER_DECOS = {"setter", "deleter", "getter", "register"}
+
+
+def _is_intentional_redef(node: ast.AST) -> bool:
+    for deco in getattr(node, "decorator_list", []):
+        if isinstance(deco, ast.Attribute) and deco.attr in _SETTER_DECOS:
+            return True
+        if isinstance(deco, ast.Call) and isinstance(deco.func, ast.Attribute) \
+                and deco.func.attr in _SETTER_DECOS:
+            return True
+        if isinstance(deco, ast.Name) and deco.id == "overload":
+            return True
+        if isinstance(deco, ast.Attribute) and deco.attr == "overload":
+            return True
+    return False
+
+
+def _scope_bodies(tree: ast.Module):
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield node.body
+
+
+@rule("redefinition", "def/class names rebound in the same scope (shadowed dead code)")
+def check_redefinition(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        for body in _scope_bodies(sf.tree):
+            seen: Dict[str, Tuple[int, bool]] = {}  # name -> (line, intentional)
+            for stmt in body:
+                if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                    continue
+                name = stmt.name
+                intentional = _is_intentional_redef(stmt)
+                if name in seen and not intentional and not seen[name][1]:
+                    findings.append(Finding(
+                        "redefinition", sf.rel, stmt.lineno,
+                        f"'{name}' redefined; earlier definition on line "
+                        f"{seen[name][0]} is dead"))
+                seen[name] = (stmt.lineno, intentional)
+    return findings

@@ -297,6 +297,13 @@ _DEFAULT_CONFIG: dict = {
         "sendAlertOnUnexpectedScriptEnd": True,
         "triggerGCThreshold": 500,
         "appLogRetentionDays": 7,
+        # disk inspection mount point (None = appDirectory) and the RabbitMQ
+        # sbin dir for broker admin commands ("" = resolve from PATH)
+        "diskInspectionMount": None,
+        "rabbitSbinPath": "",
+        # full child teardown on manager shutdown (default: children keep
+        # running so a manager restart is non-disruptive)
+        "stopChildrenOnShutdown": False,
         # per-child "metricsPort" makes the child a /fleet scrape target of
         # the manager's exporter (see tools.qstat --metrics-url, DESIGN.md)
         "moduleSettings": [
@@ -400,6 +407,10 @@ _DEFAULT_CONFIG: dict = {
         "dbJmxTable": "jmx",
         "dbInsertBufferLimit": 1000,
         "dbMaxTimeBetweenInsertsMs": 5000,
+        # sqlite backend file (":memory:" = ephemeral); postgres credentials
+        "dbFileFullPath": ":memory:",
+        "dbPassword": None,
+        "dbPort": 5432,
         "metricsPort": None,  # telemetry exporter port (0 = ephemeral)
     },
     "pullJvmStats": {
@@ -476,6 +487,34 @@ _DEFAULT_CONFIG: dict = {
         "checkpointDir": "save/tpu_engine",
         "resumeFileFullPath": "save/tpu_engine.resume.npz",
         "microBatchSize": 65536,
+        # Tick executor selection (DESIGN.md §1): "auto" size-gates the fused
+        # single-dispatch program vs the staged pipeline; force with
+        # "fused"/"staged". percentileImpl "auto" uses the native radix/
+        # nth_element host kernel when the toolchain built it ("native"/
+        # "device" force). zscoreRebuildEvery is the staggered sliding-agg
+        # rebuild cadence in ticks.
+        "tickExecutor": "auto",
+        "percentileImpl": "auto",
+        "zscoreRebuildEvery": 64,
+        # host intake: C++ TxDecoder batch CSV decode (nativeDecode), SPSC
+        # byte ring between transport consumer and device loop
+        # (useNativeRing/ringBytes), bounded Python-list overflow when the
+        # ring is full (intakeOverflowMaxLines after blocking up to
+        # ringFullMaxBlockSeconds)
+        "nativeDecode": True,
+        "useNativeRing": True,
+        "ringBytes": 4194304,
+        "intakeOverflowMaxLines": 200000,
+        "ringFullMaxBlockSeconds": 2.0,
+        # double-buffered emission overlap (catch-up aware; r6)
+        "asyncEmission": False,
+        # per-module profiling harness keys (honored in EVERY module section,
+        # like metricsPort; listed once here for the schema): SIGUSR2 /
+        # MemoryError heap snapshots into heapSnapshotDir, optional JAX
+        # profiler server on profilerPort, tracemalloc via traceAllocations
+        "heapSnapshotDir": "logs",
+        "profilerPort": None,
+        "traceAllocations": False,
         # Delivery guarantee (DESIGN.md §7): "atMostOnce" = reference parity,
         # ack on receipt, in-flight loss bounded by the resume cadence.
         # "atLeastOnce" = manual acks committed only after the engine

@@ -18,7 +18,7 @@ import json
 import re
 import subprocess
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from ..entries import JmxEntry
 

@@ -14,9 +14,8 @@ from __future__ import annotations
 import os
 import random
 from datetime import datetime, timedelta
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-from ..entries import TxEntry
 from .parser import TransactionParser
 
 

@@ -10,7 +10,7 @@ The clock is injectable so log replay is deterministic.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
 class TTLCache:

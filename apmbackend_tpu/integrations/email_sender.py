@@ -14,7 +14,6 @@ import shutil
 import subprocess
 import time
 from email.message import EmailMessage
-from email.utils import make_msgid
 from typing import Callable, Optional
 
 

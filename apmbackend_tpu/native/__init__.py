@@ -41,28 +41,38 @@ def native_source_dir() -> str:
 
 def ensure_built(*, quiet: bool = True, timeout_s: float = 45.0) -> Optional[str]:
     """Run ``make`` in native/ once per process; returns the build dir or
-    None when the toolchain/sources are unavailable."""
+    None when the toolchain/sources are unavailable.
+
+    ``APM_NATIVE_SANITIZE=1`` switches to the ASan+UBSan instrumented
+    artifacts (``make sanitize`` -> build-sanitize/) — the hardened mode
+    ``run_tests.sh --sanitize`` drives the differential fuzz suite under,
+    with libasan LD_PRELOADed so the instrumented .so files resolve their
+    runtime inside the stock Python process."""
+    sanitize = os.environ.get("APM_NATIVE_SANITIZE", "") not in ("", "0")
+    key = "sanitize-dir" if sanitize else "dir"
     with _BUILD_LOCK:
-        if "dir" in _BUILD_RESULT:
-            return _BUILD_RESULT["dir"]
+        if key in _BUILD_RESULT:
+            return _BUILD_RESULT[key]
         src = native_source_dir()
         result: Optional[str] = None
         if os.path.isfile(os.path.join(src, "Makefile")):
+            cmd = ["make", "-C", src] + (["sanitize"] if sanitize else [])
             try:
                 proc = subprocess.run(
-                    ["make", "-C", src],
+                    cmd,
                     stdout=subprocess.PIPE,
                     stderr=subprocess.STDOUT,
                     timeout=timeout_s,
                 )
                 if proc.returncode == 0:
-                    result = os.path.join(src, "build")
+                    result = os.path.join(
+                        src, "build-sanitize" if sanitize else "build")
                 elif not quiet:
                     raise RuntimeError(f"native build failed:\n{proc.stdout.decode()}")
             except (OSError, subprocess.TimeoutExpired):
                 if not quiet:
                     raise
-        _BUILD_RESULT["dir"] = result
+        _BUILD_RESULT[key] = result
         return result
 
 

@@ -29,9 +29,9 @@ class DecisionRing:
     """Thread-safe bounded ring of alert decision records (plain dicts)."""
 
     def __init__(self, maxlen: int = 256):
-        self._ring: deque = deque(maxlen=int(maxlen))
+        self._ring: deque = deque(maxlen=int(maxlen))  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.total = 0  # monotonic count of decisions ever recorded
+        self.total = 0  # guarded-by: _lock (monotonic count ever recorded)
 
     def record(self, decision: dict) -> None:
         with self._lock:

@@ -71,18 +71,20 @@ class FlightRecorder:
         self.max_bundles = int(max_bundles)
         self.min_interval_s = float(min_interval_s)
         self.logger = logger
-        self._sources: Dict[str, Callable[[], object]] = {}
+        self._sources: Dict[str, Callable[[], object]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._last_dump = 0.0
-        self._seq = 0  # per-process bundle counter: unique names within one second
+        self._last_dump = 0.0  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock (bundle counter: unique names within one second)
         self.journal_path = os.path.join(self.directory, f"{module}.journal.json")
         self.sentinel_path = os.path.join(self.directory, f"{module}.alive")
 
     # -- sources --------------------------------------------------------------
     def add_source(self, name: str, fn: Callable[[], object]) -> None:
         """``fn() -> JSON-serializable`` sampled at snapshot time; a broken
-        source contributes its error string instead of failing the dump."""
-        self._sources[name] = fn
+        source contributes its error string instead of failing the dump.
+        Locked: wiring can race the journal timer's first snapshot."""
+        with self._lock:
+            self._sources[name] = fn
 
     def snapshot(self, reason: str = "") -> dict:
         body: dict = {
@@ -91,7 +93,9 @@ class FlightRecorder:
             "reason": reason,
             "pid": os.getpid(),
         }
-        for name, fn in list(self._sources.items()):
+        with self._lock:
+            sources = list(self._sources.items())
+        for name, fn in sources:
             try:
                 value = fn()
                 if isinstance(value, str) and len(value) > MAX_SOURCE_CHARS:

@@ -75,7 +75,7 @@ class Counter:
 
     def __init__(self, labels: Dict[str, str]):
         self.labels = labels
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, value: float = 1.0) -> None:
@@ -84,6 +84,7 @@ class Counter:
 
     @property
     def value(self) -> float:
+        # apm: allow(lock-guard): GIL-atomic float read at scrape time — a torn logical value only skews one scrape, never the counter
         return self._value
 
 
@@ -92,8 +93,8 @@ class Gauge:
 
     def __init__(self, labels: Dict[str, str]):
         self.labels = labels
-        self._value = 0.0
-        self._fn: Optional[Callable[[], float]] = None
+        self._value = 0.0  # guarded-by: _lock
+        self._fn: Optional[Callable[[], float]] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
@@ -108,12 +109,14 @@ class Gauge:
 
     @property
     def value(self) -> float:
+        # apm: allow(lock-guard): one volatile-style read of the fn slot; set()/set_fn() order is irrelevant to a single scrape
         fn = self._fn
         if fn is not None:
             try:
                 return float(fn())
             except Exception:
                 return float("nan")  # a broken view must not kill the scrape
+        # apm: allow(lock-guard): GIL-atomic float read at scrape time (same contract as Counter.value)
         return self._value
 
 
@@ -132,13 +135,13 @@ class Histogram:
     def __init__(self, labels: Dict[str, str], buckets: Tuple[float, ...]):
         self.labels = labels
         self.bounds = tuple(sorted(float(b) for b in buckets))
-        self._counts = [0] * (len(self.bounds) + 1)  # +Inf tail
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         # bucket index -> (trace_id, observed value, unix ts); populated only
         # by observe_exemplar, so unsampled traffic pays nothing extra
-        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}  # guarded-by: _lock
 
     def observe(self, value: float) -> None:
         idx = bisect.bisect_left(self.bounds, value)
@@ -162,10 +165,12 @@ class Histogram:
 
     @property
     def count(self) -> int:
+        # apm: allow(lock-guard): GIL-atomic int read for tests/summaries; the consistent triple goes through snapshot()
         return self._count
 
     @property
     def sum(self) -> float:
+        # apm: allow(lock-guard): GIL-atomic float read for tests/summaries; the consistent triple goes through snapshot()
         return self._sum
 
     def snapshot(self) -> Tuple[List[int], float, int]:
@@ -187,8 +192,8 @@ class _Family:
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
-        self._collectors: List[Callable[[], Iterable[Sample]]] = []
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
+        self._collectors: List[Callable[[], Iterable[Sample]]] = []  # guarded-by: _lock
 
     # -- instrument wire-up (get-or-create) ----------------------------------
     def _get(self, name: str, mtype: str, help: str, labels, factory):
@@ -252,6 +257,7 @@ class MetricsRegistry:
         out: List[str] = []
         with self._lock:
             families = list(self._families.values())
+            family_names = set(self._families)
             collectors = list(self._collectors)
         for fam in families:
             if not fam.metrics:
@@ -301,7 +307,9 @@ class MetricsRegistry:
             except Exception:
                 continue
             for s in samples:
-                if s.name not in seen_types and s.name not in self._families:
+                # membership against the locked snapshot: _families can grow
+                # concurrently (another thread wiring an instrument mid-render)
+                if s.name not in seen_types and s.name not in family_names:
                     if s.help:
                         out.append(f"# HELP {s.name} {s.help}")
                     out.append(f"# TYPE {s.name} {s.mtype}")

@@ -50,7 +50,7 @@ class SpanRing:
     """Thread-safe bounded ring of finished spans (plain dicts)."""
 
     def __init__(self, maxlen: int = 512):
-        self._ring: deque = deque(maxlen=int(maxlen))
+        self._ring: deque = deque(maxlen=int(maxlen))  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add(self, span: dict) -> None:
@@ -72,6 +72,7 @@ class SpanRing:
 
     @property
     def maxlen(self) -> int:
+        # apm: allow(lock-guard): deque.maxlen is immutable after construction — no torn read possible
         return self._ring.maxlen or 0
 
     def __len__(self) -> int:

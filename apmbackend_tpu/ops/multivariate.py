@@ -235,6 +235,7 @@ class MvDriver:
         self.state = grow_state(self.state, new_capacity)
         self.capacity = new_capacity
 
+    # apm: sync-boundary: JMX poll-path readback — one device round-trip per polling interval (seconds), not per tick
     def feed(self, entries: Sequence[JmxEntry]) -> List[dict]:
         """One poll round. Returns [{server, score, signal, observed}] for
         hosts present in this batch (NaN score while warming up)."""

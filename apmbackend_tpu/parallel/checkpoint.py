@@ -188,6 +188,7 @@ class ShardedCheckpointer:
         )
 
     @staticmethod
+    # apm: sync-boundary: resume-load shape migration runs once at boot on host arrays
     def _migrate_per_row_cursors(
         state: EngineState, template: EngineState, cfg: EngineConfig
     ) -> EngineState:

@@ -375,6 +375,7 @@ def make_sharded_step(mesh: Mesh, cfg: EngineConfig):
         jnp.any, out_shardings=jax.sharding.NamedSharding(mesh, P())
     )
 
+    # apm: sync-boundary: pod executor's host percentile stage — same sanctioned readback as the single-chip staged path
     def native_core(state, nl, params, evicted):
         res = pre(state.stats)
         if bool(jax.device_get(any_overflow(res.overflowed))):
@@ -603,6 +604,7 @@ class ShardedRebuildScheduler(_StaggeredRebuildBase):
     def _slice_call(self, state: EngineState, start: int) -> EngineState:
         return self._slice_fn(state, jnp.int32(start))
 
+    # apm: sync-boundary: sharded rebuild's native window-agg pass reads the ring chunk back for the C++ kernel
     def _native_step(self, state: EngineState, start: int) -> EngineState:
         from .. import native as _native
 

@@ -34,7 +34,6 @@ you have, not a padded registry.
 
 from __future__ import annotations
 
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +46,6 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..ops.zscore import (
-    N_METRICS,
     ZScoreConfig,
     ZScoreResult,
     ZScoreState,

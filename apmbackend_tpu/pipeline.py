@@ -516,6 +516,7 @@ def make_fused_step(cfg: EngineConfig, *, integrate_rebuild: bool = True):
     weighted = jax.jit(dstats.window_stats, static_argnums=1)
     weighted_cfg = cfg.stats._replace(percentile_impl="sort")
 
+    # apm: sync-boundary: the fused executor's single sanctioned readiness wait — dlpack views of program A's outputs feed the host percentile kernel between the two donated programs
     def step(state, new_label, params):
         nl = np.int32(new_label)
         aggs = tuple(state.zscores[i].agg for i in sliding_idx)
@@ -707,6 +708,7 @@ def make_engine_step(cfg: EngineConfig):
     NB = cfg.stats.num_buckets
     offsets = np.arange(cfg.stats.buffer_sz, cfg.stats.num_keep + 1)
 
+    # apm: sync-boundary: staged executor's host percentile stage — the overflow probe and reservoir readback sit between the pre and core programs by design
     def native_core(state, nl, params, evicted):
         res = pre(state.stats, cfg.stats)
         if bool(np.asarray(res.overflowed).any()):
@@ -1028,6 +1030,7 @@ class RebuildScheduler(_StaggeredRebuildBase):
     def _slice_call(self, state: EngineState, start: int) -> EngineState:
         return self._slice_fn(state, self.cfg, start, self.chunk)
 
+    # apm: sync-boundary: rebuild scheduler's native window-agg pass reads the ring chunk back for the C++ kernel (merge returns to device)
     def _native_step(self, state: EngineState, start: int) -> EngineState:
         from . import native as _native
 
@@ -1982,6 +1985,7 @@ class PipelineDriver:
                 catchup_labels=catchup,
             )
 
+    # apm: sync-boundary: THE emit readback — the one blocking sync per tick the cost model budgets for (async emission overlaps it with the next dispatch)
     def _process_emission(self, new_label: int, emission: TickEmission, count: int) -> None:
         """Device->host readback + host fan-out of one tick's emission
         (StatEntry/FullStatEntry/alert callbacks). Split from _run_tick so
@@ -2125,6 +2129,7 @@ class PipelineDriver:
                 return t["trace_id"]
         return None
 
+    # apm: sync-boundary: alert-path only — one ring-fill scalar read per dispatched alert for decision provenance, never per tick
     def _window_occupancy(self, chan_id, row: int) -> Optional[int]:
         """Ring fill (lag channels) / max slot update count (EWMA channels)
         for one row — a device readback, paid on the ALERT path only."""
@@ -2258,6 +2263,7 @@ class PipelineDriver:
         return lines
 
     # -- checkpoint / resume (§5.4) ------------------------------------------
+    # apm: sync-boundary: checkpoint serialization reads the full engine state back by contract (epoch cadence, not tick cadence)
     def save_resume(self, path: str, *, delivery: Optional[dict] = None) -> None:
         """Atomic snapshot (tmp + rename); `path` is used verbatim — no .npz
         suffix magic — so load_resume(path) always finds what was saved.

@@ -78,11 +78,11 @@ class WorkerApp:
         # already in durable state, skip it"). Sized to cover the broker's
         # redelivery span (<= prefetch) plus injected duplicates.
         self._dedup_max = int(eng_cfg.get("dedupWindowSize", 65536))
-        self._dedup_set: set = set()
-        self._dedup_fifo: collections.deque = collections.deque()
-        self._epoch_tokens: list = []  # absorbed, unacked delivery tokens
-        self._delivery_epoch = 0
-        self._deduped_total = 0  # apm_redelivered_deduped_total
+        self._dedup_set: set = set()  # guarded-by: _driver_lock
+        self._dedup_fifo: collections.deque = collections.deque()  # guarded-by: _driver_lock
+        self._epoch_tokens: list = []  # guarded-by: _driver_lock (absorbed, unacked delivery tokens)
+        self._delivery_epoch = 0  # guarded-by: _driver_lock
+        self._deduped_total = 0  # guarded-by: _driver_lock (apm_redelivered_deduped_total)
         # batched feed (ISSUE 4 satellite, ROADMAP PR-3 follow-up): accepted
         # deliveries buffer here and reach the engine as ONE bulk feed
         # (feed_csv_batch -> native decoder) instead of per-message
@@ -93,7 +93,7 @@ class WorkerApp:
         # line's effect is in the snapshot. Dedup-window ids are added at
         # ACCEPT time, which is safe for the same reason (the window is
         # only persisted by save_state, after the drain).
-        self._alo_pending: list = []       # (line, ingest_ts|None)
+        self._alo_pending: list = []  # guarded-by: _driver_lock ((line, ingest_ts|None, ctx))
         self._alo_batch = max(1, int(eng_cfg.get("deliveryBatchSize", 256)))
         self._alo_drain_s = float(eng_cfg.get("deliveryFeedMaxDelaySeconds", 0.25))
 
@@ -188,7 +188,7 @@ class WorkerApp:
         # newer ring entries; beyond the cap, drop-oldest + count.
         import collections
 
-        self._overflow: collections.deque = collections.deque()
+        self._overflow: collections.deque = collections.deque()  # guarded-by: _overflow_lock
         self._overflow_lock = threading.Lock()
         # transport ingest stamps (header ingest_ts) of consumed-but-not-yet-
         # fed lines, FIFO like the ring: handed to the driver at FEED time so
@@ -337,21 +337,30 @@ class WorkerApp:
         yield Sample("apm_intake_ring_bytes", {},
                      self._ring.used_bytes if self._ring is not None else 0,
                      "gauge", "Bytes buffered in the native intake ring")
-        yield Sample("apm_intake_overflow_lines", {}, len(self._overflow), "gauge",
+        with self._overflow_lock:
+            overflow_lines = len(self._overflow)
+        yield Sample("apm_intake_overflow_lines", {}, overflow_lines, "gauge",
                      "Lines parked in the ring-full overflow FIFO")
         yield Sample("apm_hbm_bytes_in_use", {}, self.hbm_bytes_in_use, "gauge",
                      "Device memory in use (HBM watchdog view)")
         yield Sample("apm_hbm_bytes_limit", {}, self.hbm_bytes_limit, "gauge",
                      "Device memory limit (HBM watchdog view)")
         if self._at_least_once:
-            yield Sample("apm_delivery_epoch", {}, self._delivery_epoch, "gauge",
+            # consistent snapshot: the scrape must not interleave with an
+            # epoch commit swapping the token list (RLock, scrape cadence)
+            with self._driver_lock:
+                epoch = self._delivery_epoch
+                deduped = self._deduped_total
+                unacked = len(self._epoch_tokens)
+                pending = len(self._alo_pending)
+            yield Sample("apm_delivery_epoch", {}, epoch, "gauge",
                          "At-least-once epoch watermark (checkpoints committed)")
-            yield Sample("apm_redelivered_deduped_total", {}, self._deduped_total,
+            yield Sample("apm_redelivered_deduped_total", {}, deduped,
                          "counter",
                          "Redelivered/duplicate messages skipped by the dedup window")
-            yield Sample("apm_delivery_unacked", {}, len(self._epoch_tokens), "gauge",
+            yield Sample("apm_delivery_unacked", {}, unacked, "gauge",
                          "Absorbed-but-unacked deliveries in the open epoch")
-            yield Sample("apm_delivery_pending_feed", {}, len(self._alo_pending),
+            yield Sample("apm_delivery_pending_feed", {}, pending,
                          "gauge",
                          "Accepted deliveries buffered for the next bulk feed")
 
@@ -374,14 +383,15 @@ class WorkerApp:
             "device_loop_alive": ring_alive,
         }
         if self._at_least_once:
-            out["delivery"] = {
-                "mode": "atLeastOnce",
-                "epoch": self._delivery_epoch,
-                "unacked": len(self._epoch_tokens),
-                "pending_feed": len(self._alo_pending),
-                "deduped_total": self._deduped_total,
-                "dedup_window": len(self._dedup_fifo),
-            }
+            with self._driver_lock:  # consistent healthz delivery block
+                out["delivery"] = {
+                    "mode": "atLeastOnce",
+                    "epoch": self._delivery_epoch,
+                    "unacked": len(self._epoch_tokens),
+                    "pending_feed": len(self._alo_pending),
+                    "deduped_total": self._deduped_total,
+                    "dedup_window": len(self._dedup_fifo),
+                }
         if tracer is not None:
             out.update(tracer.summary())
         try:
@@ -407,6 +417,7 @@ class WorkerApp:
             return
         self.runtime.logger.info(
             f"INTAKE> pushed: {self._ring_pushed} - fed: {self._ring_fed} - "
+            # apm: allow(lock-guard): diagnostic log line; deque len is GIL-atomic and a stale count is fine
             f"ring bytes: {self._ring.used_bytes} - overflow: {len(self._overflow)} - "
             f"dropped: {self.intake_dropped} - reservoir row-ticks: "
             f"{self.driver.overflow_rows_total}"
@@ -525,7 +536,7 @@ class WorkerApp:
         if self._ring is not None and self._ring_thread.is_alive():
             # FIFO: while older overflow lines are pending, new lines must
             # queue behind them, not jump into the ring
-            if self._overflow:
+            if self._overflow:  # apm: allow(lock-guard): single-producer emptiness probe; enqueue itself locks, and the consumer drains overflow before ring so FIFO holds either way
                 self._enqueue_overflow(line)
                 if trace_ctx is not None:
                     self._trace_fifo.append((self._ring_pushed, trace_ctx))
@@ -618,6 +629,7 @@ class WorkerApp:
                 if token is not None:
                     self._epoch_tokens.append(token)
 
+    # apm: holds(_driver_lock): every caller acquires it (accept path, drain timer, save_state)
     def _drain_alo_pending_locked(self) -> None:
         """Feed the buffered at-least-once deliveries as one bulk batch
         (caller holds the driver lock)."""
@@ -673,7 +685,7 @@ class WorkerApp:
                 if recs:
                     self._feed_recs(recs)
                     recs = []
-                elif self._overflow:
+                elif self._overflow:  # apm: allow(lock-guard): consumer-side emptiness probe; the pop helper holds the lock
                     batch = self._drain_overflow_locked_pop(max_batch)
                     if batch:
                         self._feed_lines(batch)

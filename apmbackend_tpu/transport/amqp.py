@@ -104,8 +104,8 @@ class AmqpChannel(Channel):
         self._prefetch = int(prefetch_count)
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._queues: Set[str] = set()
-        self._drain_callbacks: List[Callable[[], None]] = []
+        self._queues: Set[str] = set()  # guarded-by: _lock
+        self._drain_callbacks: List[Callable[[], None]] = []  # guarded-by: _lock
 
         # producer side: (queue, payload, headers) triples — headers ride
         # AMQP message properties so the ingest stamp crosses processes
@@ -119,9 +119,9 @@ class AmqpChannel(Channel):
         # (queue, callback, manual_ack). _conn_gen stamps every manual-ack
         # token so acks for a dead connection's delivery tags are dropped
         # instead of poisoning the new channel's tag space.
-        self._consumer_ops: List[Tuple[str, tuple]] = []
-        self._consumers: Dict[str, Tuple[str, Callable[[bytes], None], bool]] = {}
-        self._conn_gen = 0
+        self._consumer_ops: List[Tuple[str, tuple]] = []  # guarded-by: _lock
+        self._consumers: Dict[str, Tuple[str, Callable[[bytes], None], bool]] = {}  # guarded-by: _lock
+        self._conn_gen = 0  # guarded-by: _lock
 
         target = self._publisher_loop if direction == "p" else self._consumer_loop
         self._thread = threading.Thread(
@@ -181,7 +181,8 @@ class AmqpChannel(Channel):
             self._consumer_ops.append(("cancel", (consumer_tag,)))
 
     def on_drain(self, callback: Callable[[], None]) -> None:
-        self._drain_callbacks.append(callback)
+        with self._lock:  # wiring can race the publisher thread's drain scan
+            self._drain_callbacks.append(callback)
 
     def close(self, drain_timeout_s: float = 5.0) -> None:
         if self._direction == "p":
@@ -225,7 +226,9 @@ class AmqpChannel(Channel):
     def _maybe_fire_drain(self) -> None:
         if self._pressure and not self._blocked and self._out.qsize() <= self._low_water:
             self._pressure = False
-            for cb in list(self._drain_callbacks):
+            with self._lock:
+                callbacks = list(self._drain_callbacks)
+            for cb in callbacks:
                 try:
                     cb()
                 except Exception as e:  # a retry bug must not kill the publisher

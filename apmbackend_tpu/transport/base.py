@@ -121,8 +121,8 @@ class ProducerQueue(EventEmitter):
         self.logger = logger
         # buffered entries keep their original ingest stamp: a pause episode
         # must show up as queue-wait latency downstream, not vanish from it
-        self.buffer: List[Tuple[str, Optional[dict]]] = []
-        self.paused = False
+        self.buffer: List[Tuple[str, Optional[dict]]] = []  # guarded-by: _lock
+        self.paused = False  # guarded-by: _lock
         self.type = "p"
         self._lock = threading.Lock()
         # message-id stamp for at-least-once consumers: unique across
@@ -130,7 +130,7 @@ class ProducerQueue(EventEmitter):
         # ORIGINAL id — the broker retains headers — so consumers dedup on
         # it). One string concat per line; at-most-once consumers ignore it.
         self._msg_prefix = f"{os.getpid():x}-{os.urandom(4).hex()}-"
-        self._msg_seq = 0
+        self._msg_seq = 0  # guarded-by: _lock
         # the trace plane (obs/trace): this producer IS the transport-entry
         # ingest boundary; every sample_rate-th message gets a trace_id
         # header + an ingest span. The singleton is configured in place by
@@ -143,8 +143,10 @@ class ProducerQueue(EventEmitter):
         channel.assert_queue(queue_name)
 
     def buffer_count(self) -> int:
-        return len(self.buffer)
+        with self._lock:
+            return len(self.buffer)
 
+    # apm: holds(_lock): every caller acquires it (write_line, retry_buffer)
     def _send_locked(
         self, line: str, headers: Optional[dict], verbose: bool, requeue_front: bool = False
     ) -> bool:
@@ -177,25 +179,29 @@ class ProducerQueue(EventEmitter):
     def write_line(self, line: str, verbose: bool = False) -> None:
         # the transport-entry stamp: every message carries when it entered
         # the fabric, the anchor of the ingest->emit/alert latency series —
-        # plus the unique msg_id at-least-once consumers dedup redeliveries by
-        self._msg_seq += 1
-        now = time.time()
-        headers = {"ingest_ts": now, "msg_id": self._msg_prefix + str(self._msg_seq)}
-        tr = self._tracer
-        if tr.rate > 0 and self._msg_seq % tr.rate == 0:
-            # head-sampled trace context: deterministic in the message
-            # sequence, carried end to end in headers (redelivery keeps it,
-            # like msg_id). The ingest span runs from the last noted raw-read
-            # boundary (tailer/replay chunk) to transport entry.
-            trace_id = "t-" + headers["msg_id"]
-            headers["trace_id"] = trace_id
-            start = tr.ingest_start
-            tr.span(
-                trace_id, "ingest",
-                now if start is None or start > now else start, now,
-                queue=self.queue_name,
-            )
+        # plus the unique msg_id at-least-once consumers dedup redeliveries
+        # by. The seq increment lives under the lock: two threads writing
+        # the same producer queue must not mint duplicate msg_ids (the
+        # at-least-once dedup window would silently drop a real message).
         with self._lock:
+            self._msg_seq += 1
+            seq = self._msg_seq
+            now = time.time()
+            headers = {"ingest_ts": now, "msg_id": self._msg_prefix + str(seq)}
+            tr = self._tracer
+            if tr.rate > 0 and seq % tr.rate == 0:
+                # head-sampled trace context: deterministic in the message
+                # sequence, carried end to end in headers (redelivery keeps
+                # it, like msg_id). The ingest span runs from the last noted
+                # raw-read boundary (tailer/replay chunk) to transport entry.
+                trace_id = "t-" + headers["msg_id"]
+                headers["trace_id"] = trace_id
+                start = tr.ingest_start
+                tr.span(
+                    trace_id, "ingest",
+                    now if start is None or start > now else start, now,
+                    queue=self.queue_name,
+                )
             entered_pause = self._send_locked(line, headers, verbose)
         if entered_pause:
             if self.logger:

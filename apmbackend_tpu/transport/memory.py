@@ -44,18 +44,18 @@ class MemoryBroker:
     def __init__(self, capacity: int = 10000, low_water_ratio: float = 0.5):
         self.capacity = capacity
         self.low_water_ratio = low_water_ratio
-        self._queues: Dict[str, _NamedQueue] = {}
+        self._queues: Dict[str, _NamedQueue] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
-        self._drain_callbacks: List[Callable[[], None]] = []
-        self._was_full = False
+        self._drain_callbacks: List[Callable[[], None]] = []  # guarded-by: _lock
+        self._was_full = False  # guarded-by: _lock
         self._pump_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._work = threading.Event()
         # manual-ack ledger: token -> (queue_name, payload, headers), in
         # delivery order (dict preserves insertion order — requeue walks it
         # newest-last so redelivery keeps the original FIFO)
-        self._unacked: Dict[int, Tuple[str, bytes, Optional[dict]]] = {}
-        self._next_token = 0
+        self._unacked: Dict[int, Tuple[str, bytes, Optional[dict]]] = {}  # guarded-by: _lock
+        self._next_token = 0  # guarded-by: _lock
 
     # -- queue admin ---------------------------------------------------------
     def assert_queue(self, name: str) -> None:

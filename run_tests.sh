@@ -20,22 +20,51 @@ else
          "parser/decoder fast paths unavailable — Python fallbacks in use" >&2
 fi
 
-# --lint: byte-compile the whole package (hard fail on any syntax error)
-# and run pyflakes when the environment has it (soft-skip otherwise — the
-# container image does not bake it in). Consumed standalone (CI lint stage)
-# or before the suite: ./run_tests.sh --lint [pytest args...].
+# --lint: the static-correctness gate, ALL hard requirements (the PR-2
+# pyflakes soft-skip is gone): byte-compile everything, run the in-repo
+# analyzer (JAX hot-path, lock discipline, config keys, metric catalogue,
+# pyflakes-lite — see DESIGN.md §9), and run real pyflakes when the
+# environment ships it (its undefined-name pass goes beyond pyflakes-lite;
+# when absent, the in-repo analyzer IS the hard lint floor). Consumed
+# standalone (CI lint stage) or before the suite:
+# ./run_tests.sh --lint [pytest args...].
 if [ "$1" = "--lint" ]; then
     shift
     echo "lint: python -m compileall apmbackend_tpu benchmarks tests"
     python -m compileall -q apmbackend_tpu benchmarks tests || exit 1
+    echo "lint: python -m apmbackend_tpu.analysis"
+    env -u PYTHONPATH python -m apmbackend_tpu.analysis || exit 1
     if python -c "import pyflakes" 2>/dev/null; then
         echo "lint: python -m pyflakes apmbackend_tpu"
         python -m pyflakes apmbackend_tpu || exit 1
-    else
-        echo "lint: pyflakes unavailable, skipping (soft)"
     fi
     # --lint alone: stop after linting; with more args fall through to pytest
     [ $# -eq 0 ] && exit 0
+fi
+
+# --sanitize: rebuild every native component with ASan+UBSan (make
+# sanitize -> build-sanitize/) and drive the differential fuzz suite and
+# the native unit tier against the instrumented parser/percentile/rebuild/
+# ring/decoder/tailer. libasan/libubsan are LD_PRELOADed so the
+# instrumented .so files resolve their runtime inside the stock Python;
+# leak detection stays off (CPython+jax hold arenas for the process
+# lifetime — interceptor noise, not parser bugs), everything else aborts
+# hard so a report can never hide behind a green exit.
+if [ "$1" = "--sanitize" ]; then
+    shift
+    echo "sanitize: make -C native sanitize"
+    make -C native sanitize || exit 1
+    LIBASAN=$(${CXX:-g++} -print-file-name=libasan.so)
+    LIBUBSAN=$(${CXX:-g++} -print-file-name=libubsan.so)
+    [ -f "$LIBASAN" ] || { echo "sanitize: libasan.so not found"; exit 1; }
+    exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        APM_NATIVE_SANITIZE=1 \
+        LD_PRELOAD="$LIBASAN $LIBUBSAN" \
+        ASAN_OPTIONS=detect_leaks=0:abort_on_error=1:handle_segv=1 \
+        UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 \
+        python -m pytest tests/test_parser_native_diff.py tests/test_native.py \
+        -q -m "not slow" "$@"
 fi
 
 # --chaos: the crash-consistency tier explicitly — the kill−9/restart

@@ -1,0 +1,535 @@
+"""Fixture-driven tests for the static-correctness plane (ISSUE 6).
+
+Every rule gets at least one positive fixture (must flag) and one clean
+fixture (must pass), plus the pragma grammar round-trips: allow suppresses,
+bare allow is itself a finding, stale allow is itself a finding. The final
+tier-1 gate runs the analyzer over the real repo and asserts a clean run —
+the same invariant ``run_tests.sh --lint`` enforces in CI.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from apmbackend_tpu.analysis import Project, run_analysis
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+def make_project(tmp_path, files, design="", package="pkg"):
+    pkg = tmp_path / package
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, text in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    (tmp_path / "DESIGN.md").write_text(textwrap.dedent(design))
+    return Project(root=str(tmp_path), package=package)
+
+
+def run_rules(tmp_path, files, rules, design=""):
+    return run_analysis(make_project(tmp_path, files, design), rules=rules)
+
+
+def rule_set(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------- jax-sync
+
+_SYNC_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    def hot(x):
+        y = jnp.cumsum(x)
+        return float(y)
+"""
+
+_SYNC_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    # apm: sync-boundary: the emit readback fixture
+    def emit(x):
+        y = jnp.cumsum(x)
+        return float(y)
+
+    def also_fine(n):
+        return float(n) + int("4")
+"""
+
+
+def test_jax_sync_flags_device_conversion(tmp_path):
+    f = run_rules(tmp_path, {"hot.py": _SYNC_BAD}, ["jax-sync"])
+    assert [x.rule for x in f] == ["jax-sync"]
+    assert "float()" in f[0].message
+
+
+def test_jax_sync_clean_inside_sync_boundary(tmp_path):
+    assert run_rules(tmp_path, {"hot.py": _SYNC_CLEAN}, ["jax-sync"]) == []
+
+
+def test_jax_sync_item_and_asarray_and_param_annotation(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    class EngineState:
+        rings: jnp.ndarray
+
+    def f(state: EngineState):
+        a = state.rings[0].item()
+        b = np.asarray(state.rings)
+        return a, b
+    """
+    f = run_rules(tmp_path, {"hot.py": src}, ["jax-sync"])
+    assert len(f) == 2 and rule_set(f) == {"jax-sync"}
+
+
+def test_jax_sync_skips_files_without_jax(tmp_path):
+    src = "def f(x):\n    y = x.compute()\n    return float(y.item())\n"
+    assert run_rules(tmp_path, {"plain.py": src}, ["jax-sync"]) == []
+
+
+# -------------------------------------------------------- jax-donated-reuse
+
+_DONATE_BAD = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+    def loop(state):
+        out = step(state)
+        return state.sum()
+"""
+
+_DONATE_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+    def loop(state):
+        state = step(state)
+        return state.sum()
+"""
+
+_DONATE_BRANCH_CLEAN = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+
+    def loop(state, fast):
+        if fast:
+            return step(state)
+        return state.sum()
+"""
+
+
+def test_donated_reuse_flagged(tmp_path):
+    f = run_rules(tmp_path, {"d.py": _DONATE_BAD}, ["jax-donated-reuse"])
+    assert [x.rule for x in f] == ["jax-donated-reuse"]
+
+
+def test_donated_rebind_idiom_clean(tmp_path):
+    assert run_rules(tmp_path, {"d.py": _DONATE_CLEAN}, ["jax-donated-reuse"]) == []
+
+
+def test_donated_if_return_branch_clean(tmp_path):
+    # the donating branch returns; the fall-through still owns the buffer
+    assert run_rules(tmp_path, {"d.py": _DONATE_BRANCH_CLEAN}, ["jax-donated-reuse"]) == []
+
+
+# ------------------------------------------------------------ jax-recompile
+
+def test_recompile_literal_scalar_flagged(tmp_path):
+    src = """
+    import jax
+
+    step = jax.jit(lambda s, k: s + k)
+
+    def tick(state):
+        return step(state, 3)
+    """
+    f = run_rules(tmp_path, {"r.py": src}, ["jax-recompile"])
+    assert [x.rule for x in f] == ["jax-recompile"]
+
+
+def test_recompile_static_argnums_clean(tmp_path):
+    src = """
+    import jax
+
+    step = jax.jit(lambda s, k: s + k, static_argnums=(1,))
+
+    def tick(state):
+        return step(state, 3)
+    """
+    assert run_rules(tmp_path, {"r.py": src}, ["jax-recompile"]) == []
+
+
+def test_recompile_jit_in_loop_flagged(tmp_path):
+    src = """
+    import jax
+
+    def rebuild(fns, xs):
+        for fn in fns:
+            g = jax.jit(fn)
+            xs = g(xs)
+        return xs
+    """
+    f = run_rules(tmp_path, {"r.py": src}, ["jax-recompile"])
+    assert any("inside a loop" in x.message for x in f)
+
+
+# -------------------------------------------------------------- lock-guard
+
+_LOCK_BAD = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._unacked = {}  # guarded-by: _lock
+
+        def size(self):
+            return len(self._unacked)
+"""
+
+_LOCK_CLEAN = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._unacked = {}  # guarded-by: _lock
+
+        def size(self):
+            with self._lock:
+                return len(self._unacked)
+
+        # apm: holds(_lock): callers in this fixture acquire it
+        def _size_locked(self):
+            return len(self._unacked)
+"""
+
+_LOCK_CLOSURE_BAD = """
+    import threading
+
+    class Ledger:
+        def __init__(self, register):
+            self._lock = threading.Lock()
+            self._unacked = {}  # guarded-by: _lock
+            with self._lock:
+                register(lambda: len(self._unacked))
+"""
+
+
+def test_lock_guard_flags_unlocked_access(tmp_path):
+    f = run_rules(tmp_path, {"l.py": _LOCK_BAD}, ["lock-guard"])
+    assert [x.rule for x in f] == ["lock-guard"]
+    assert "_unacked" in f[0].message
+
+
+def test_lock_guard_with_block_and_holds_clean(tmp_path):
+    assert run_rules(tmp_path, {"l.py": _LOCK_CLEAN}, ["lock-guard"]) == []
+
+
+def test_lock_guard_closure_does_not_inherit_lock(tmp_path):
+    # a callback registered under the lock RUNS later without it — the
+    # PR-5 concurrent-profiler race shape
+    f = run_rules(tmp_path, {"l.py": _LOCK_CLOSURE_BAD}, ["lock-guard"])
+    assert [x.rule for x in f] == ["lock-guard"]
+
+
+# ------------------------------------------------------------- config keys
+
+_CONFIG_FIXTURE = """
+    _DEFAULT_CONFIG = {
+        "tpuEngine": {
+            "deliveryBatchSize": 256,
+            "deliveryMode": "atMostOnce",
+        },
+        "logDir": "logs",
+    }
+"""
+
+
+def test_config_key_typo_flagged(tmp_path):
+    reader = """
+    def wire(config):
+        return config["tpuEngine"]["deliveryBatchSze"]
+    """
+    f = run_rules(tmp_path, {"config.py": _CONFIG_FIXTURE, "w.py": reader},
+                  ["config-key-unknown"])
+    assert [x.rule for x in f] == ["config-key-unknown"]
+    assert "deliveryBatchSze" in f[0].message
+
+
+def test_config_key_valid_chains_clean(tmp_path):
+    reader = """
+    def resolve_path(o, p):
+        return o
+
+    def wire(config):
+        a = config.get("tpuEngine", {}).get("deliveryBatchSize", 256)
+        b = config["logDir"]
+        c = resolve_path(config, "tpuEngine.deliveryMode")
+        return a, b, c
+    """
+    f = run_rules(tmp_path, {"config.py": _CONFIG_FIXTURE, "w.py": reader},
+                  ["config-key-unknown"])
+    assert f == []
+
+
+def test_config_section_param_auto_anchors(tmp_path):
+    reader = """
+    def wire(eng_cfg):
+        return eng_cfg.get("deliveryBatchSize", 256)
+    """
+    f = run_rules(tmp_path, {"config.py": _CONFIG_FIXTURE, "w.py": reader},
+                  ["config-key-unknown"])
+    assert f == []
+
+
+def test_config_resolve_path_typo_flagged(tmp_path):
+    reader = """
+    def resolve_path(o, p):
+        return o
+
+    def wire(config):
+        return resolve_path(config, "tpuEngine.deliveryMoed")
+    """
+    f = run_rules(tmp_path, {"config.py": _CONFIG_FIXTURE, "w.py": reader},
+                  ["config-key-unknown"])
+    assert [x.rule for x in f] == ["config-key-unknown"]
+
+
+def test_config_key_unread_flagged_and_satisfied(tmp_path):
+    reader = """
+    def wire(config):
+        return config["tpuEngine"]["deliveryBatchSize"], config["logDir"]
+    """
+    f = run_rules(tmp_path, {"config.py": _CONFIG_FIXTURE, "w.py": reader},
+                  ["config-key-unread"])
+    # deliveryMode is never read anywhere in the fixture package
+    assert [x.rule for x in f] == ["config-key-unread"]
+    assert "deliveryMode" in f[0].message
+
+
+# --------------------------------------------------------- metric catalogue
+
+_METRIC_SRC = """
+    from .registry import get_registry, Sample
+
+    def wire():
+        get_registry().counter("apm_ticks_total", "ticks")
+        get_registry().histogram("apm_tick_seconds", "tick wall")
+
+    def collect():
+        yield Sample("apm_queue_depth", {}, 1.0)
+"""
+
+_METRIC_DESIGN_OK = """
+    # design
+
+    Metric catalogue: `apm_ticks_total`, `apm_tick_seconds`,
+    `apm_queue_depth`.
+
+    ## next section
+"""
+
+_METRIC_DESIGN_DRIFT = """
+    # design
+
+    Metric catalogue: `apm_ticks_total`, `apm_gone_total`.
+
+    ## next section
+"""
+
+
+def test_metric_catalogue_in_sync(tmp_path):
+    f = run_rules(tmp_path, {"m.py": _METRIC_SRC},
+                  ["metric-uncatalogued", "metric-unregistered"],
+                  design=_METRIC_DESIGN_OK)
+    assert f == []
+
+
+def test_metric_catalogue_drift_both_directions(tmp_path):
+    f = run_rules(tmp_path, {"m.py": _METRIC_SRC},
+                  ["metric-uncatalogued", "metric-unregistered"],
+                  design=_METRIC_DESIGN_DRIFT)
+    rules = sorted(x.rule for x in f)
+    assert rules == ["metric-uncatalogued", "metric-uncatalogued",
+                     "metric-unregistered"]
+    assert any("apm_gone_total" in x.message for x in f)
+
+
+def test_metric_catalogue_expansion_and_labels(tmp_path):
+    src = """
+    def wire(reg):
+        reg.counter("apm_engine_capacity")
+        reg.counter("apm_engine_services")
+        reg.histogram("apm_queue_wait_seconds")
+    """
+    design = """
+    Metric catalogue: `apm_engine_{capacity,services}`,
+    `apm_queue_wait_seconds{queue}`.
+
+    ## next
+    """
+    f = run_rules(tmp_path, {"m.py": src},
+                  ["metric-uncatalogued", "metric-unregistered"], design=design)
+    assert f == []
+
+
+# ------------------------------------------------------------ pyflakes-lite
+
+def test_unused_import_flagged_and_init_exempt(tmp_path):
+    files = {
+        "a.py": "import os\nimport sys\n\nprint(sys.argv)\n",
+        "sub/__init__.py": "from . import thing\n",
+        "sub/thing.py": "x = 1\n",
+    }
+    f = run_rules(tmp_path, files, ["unused-import"])
+    assert [x.rule for x in f] == ["unused-import"]
+    assert "'os'" in f[0].message
+
+
+def test_redefinition_flagged_property_stack_clean(tmp_path):
+    src = """
+    class C:
+        @property
+        def x(self):
+            return self._x
+
+        @x.setter
+        def x(self, v):
+            self._x = v
+
+        def go(self):
+            return 1
+
+        def go(self):
+            return 2
+    """
+    f = run_rules(tmp_path, {"c.py": src}, ["redefinition"])
+    assert [x.rule for x in f] == ["redefinition"]
+    assert "'go'" in f[0].message
+
+
+# ---------------------------------------------------------- pragma grammar
+
+def test_allow_pragma_suppresses_with_reason(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def hot(x):
+        y = jnp.cumsum(x)
+        return float(y)  # apm: allow(jax-sync): fixture-sanctioned readback
+    """
+    assert run_rules(tmp_path, {"h.py": src}, ["jax-sync"]) == []
+
+
+def test_bare_allow_is_a_finding(tmp_path):
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    def hot(x):
+        y = jnp.cumsum(x)
+        return float(y)  # apm: allow(jax-sync)
+    """
+    f = run_rules(tmp_path, {"h.py": src}, ["jax-sync"])
+    assert [x.rule for x in f] == ["pragma-bare"]
+
+
+def test_unused_allow_is_a_finding(tmp_path):
+    src = """
+    def cold(x):
+        return x + 1  # apm: allow(jax-sync): nothing here needs this
+    """
+    f = run_rules(tmp_path, {"h.py": src}, ["jax-sync"])
+    assert [x.rule for x in f] == ["pragma-unused"]
+
+
+def test_malformed_pragma_is_a_finding(tmp_path):
+    src = "x = 1  # apm: alow(jax-sync): typo'd verb\n"
+    f = run_rules(tmp_path, {"h.py": src}, ["jax-sync"])
+    assert [x.rule for x in f] == ["pragma-malformed"]
+
+
+def test_disabled_rules_do_not_audit_their_pragmas(tmp_path):
+    src = """
+    def cold(x):
+        return x + 1  # apm: allow(lock-guard): other rule's pragma
+    """
+    assert run_rules(tmp_path, {"h.py": src}, ["jax-sync"]) == []
+
+
+# ------------------------------------------------------------- repo + CLI
+
+def test_repo_is_clean():
+    """The gate itself: the whole package passes every rule. Any new
+    finding must be fixed or carry a reasoned pragma before it lands."""
+    findings = run_analysis(Project(root=REPO_ROOT))
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "apmbackend_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert out.returncode == 0
+    assert "jax-sync" in out.stdout and "lock-guard" in out.stdout
+
+    clean = subprocess.run(
+        [sys.executable, "-m", "apmbackend_tpu.analysis", "-q"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = subprocess.run(
+        [sys.executable, "-m", "apmbackend_tpu.analysis", "--rules", "nope"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert bad.returncode == 2
+
+
+def test_cli_reports_findings_nonzero(tmp_path):
+    pkg = tmp_path / "apmbackend_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text("import os\n\nx = 1\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "apmbackend_tpu.analysis",
+         "--root", str(tmp_path), "--rules", "unused-import"],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert out.returncode == 1
+    assert "unused-import" in out.stdout
+
+
+@pytest.mark.parametrize("direction", ["registered", "catalogued"])
+def test_real_metric_catalogue_is_two_way_checked(direction):
+    """Belt-and-braces on the real repo: the §8 catalogue and the live
+    registration set describe each other (the repo-clean test would catch
+    drift too, but this pins the failure to the metric rules)."""
+    from apmbackend_tpu.analysis import metriccat
+    project = Project(root=REPO_ROOT)
+    registered = set(metriccat._registered(project))
+    catalogued = set()
+    for _tok, _ln, names, _exp in metriccat._catalogue(project):
+        catalogued |= names
+    assert registered, "no metric registrations found in the repo?"
+    if direction == "registered":
+        assert registered <= catalogued
+    else:
+        missing = catalogued - registered - metriccat._mentioned(project)
+        assert missing == set()
