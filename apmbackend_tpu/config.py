@@ -486,6 +486,27 @@ _DEFAULT_CONFIG: dict = {
         "zscoreVariancePass": "auto",
         "checkpointDir": "save/tpu_engine",
         "resumeFileFullPath": "save/tpu_engine.resume.npz",
+        # Checkpoint representation (DESIGN.md §7.4): "full" = one atomic
+        # npz snapshot per save (state-size-proportional, the pre-delta
+        # behavior); "delta" = incremental delta-chain commits under
+        # checkpointChainDir — each epoch appends only the rows/columns
+        # touched since the last commit (ingest-rate-proportional, the
+        # sub-second-epoch mode), with a full-snapshot compaction rewritten
+        # off the hot path every checkpointCompactEveryEpochs commits.
+        # checkpointFsync hardens segment/manifest renames against power
+        # loss (SIGKILL safety needs only the atomic rename). Write failures
+        # (ENOSPC/EIO) retry with decorrelated jitter between
+        # checkpointWriteRetryBaseSeconds and checkpointWriteRetryMaxSeconds;
+        # after checkpointWriteMaxRetries consecutive failures the worker
+        # degrades: flight bundle, operator alert, intake paused until a
+        # write lands (healthz 503, apm_checkpoint_degraded).
+        "checkpointMode": "full",
+        "checkpointChainDir": "save/tpu_engine.chain",
+        "checkpointCompactEveryEpochs": 64,
+        "checkpointFsync": True,
+        "checkpointWriteMaxRetries": 5,
+        "checkpointWriteRetryBaseSeconds": 0.5,
+        "checkpointWriteRetryMaxSeconds": 30.0,
         "microBatchSize": 65536,
         # Tick executor selection (DESIGN.md §1): "auto" size-gates the fused
         # single-dispatch program vs the staged pipeline; force with

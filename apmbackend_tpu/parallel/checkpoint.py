@@ -68,11 +68,14 @@ class ShardedCheckpointer:
 
     ``last_delivery`` holds the delivery tree (epoch watermark + dedup
     window) of the snapshot the most recent :meth:`restore` returned — None
-    when the snapshot predates at-least-once mode."""
+    when the snapshot predates at-least-once mode. ``last_chain`` likewise
+    carries the delta-chain manifest (deltachain.py) recorded at save time,
+    None for pre-delta snapshots."""
 
     def __init__(self, directory: str, *, keep: int = 2):
         self.directory = os.path.abspath(directory)
         self.last_delivery: Optional[dict] = None
+        self.last_chain: Optional[dict] = None
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
@@ -85,6 +88,7 @@ class ShardedCheckpointer:
         cfg: EngineConfig,
         registry_keys: Tuple[Tuple[str, str], ...],
         delivery: Optional[dict] = None,
+        chain: Optional[dict] = None,
     ) -> None:
         meta = {
             "signature": _shape_signature(cfg),
@@ -95,6 +99,15 @@ class ShardedCheckpointer:
             # scale): the per-queue epoch watermark + dedup window commits in
             # the same atomic checkpoint as the sharded state it describes
             meta["delivery"] = delivery
+        if chain is not None:
+            # delta-chain coupling (deltachain.py at pod scale): a sharded
+            # snapshot doubles as a chain COMPACTION base, so the manifest
+            # facts — chain id, the epoch this snapshot compacts, the tail
+            # uid the next delta must link from — ride the orbax meta.
+            # Restore surfaces it via ``last_chain`` so a per-shard writer
+            # can continue its delta chain from the restored boundary
+            # instead of forcing a fresh full snapshot per epoch.
+            meta["chain"] = chain
         # async: the write overlaps the driver's tick/ingest loop; orbax
         # finalizes the previous save on the next save(), and wait()/close()
         # (and restore/latest_step) synchronize explicitly
@@ -157,6 +170,7 @@ class ShardedCheckpointer:
                     continue
             registry = tuple(tuple(k.split("\x00", 1)) for k in meta["registry"])
             self.last_delivery = meta.get("delivery")
+            self.last_chain = meta.get("chain")
             return engine_derive_aggs(state, cfg), registry, step
         return None
 

@@ -46,6 +46,25 @@ from .utils.heap import MinHeap
 _PLAIN_NUMBER = re.compile(r"^[+-]?\d+(?:\.\d+)?$")
 
 
+def _pad_tier_repeat(idx: np.ndarray, *, last: bool = False) -> np.ndarray:
+    """Pad a gather-index vector to the next power-of-two tier by repeating
+    one element (first by default, last with ``last=True``) so the delta
+    capture compiles a BOUNDED set of gather shapes instead of one per
+    distinct count. Duplicated indices are harmless on both sides: the
+    gather reads the same cell twice, the replay scatter writes the same
+    post-state value twice."""
+    n = len(idx)
+    if n == 0:
+        return idx
+    tier = 1
+    while tier < n:
+        tier *= 2
+    if tier == n:
+        return idx
+    fill = idx[-1] if last else idx[0]
+    return np.concatenate([idx, np.full(tier - n, fill, idx.dtype)])
+
+
 class LagSpec(NamedTuple):
     lag: int
     suppressed: bool  # lag in suppressedLags
@@ -1278,6 +1297,21 @@ class PipelineDriver:
         # save_resume carried / load_resume recovered. None = snapshot
         # predates the feature or the worker runs at-most-once.
         self.delivery_state: Optional[dict] = None
+        # -- incremental delta-checkpoint capture (deltachain.py) -----------
+        # Enabled by enable_delta_capture() (the worker's checkpointMode:
+        # "delta"); at-most-once / full-snapshot drivers pay one bool check
+        # per bulk feed. Tracking granularity: stats mutations are dirty
+        # (row, bucket-slot) CELLS (feeds scatter into exactly those cells;
+        # tick ring-advances are derivable from the tick labels), z rings
+        # are one pushed column per tick at the shared cursor, EWMA channels
+        # one season-slot column per tick — so a delta's size is
+        # proportional to the epoch's ingest + tick count, not state size.
+        self._delta_track = False
+        self._delta_np_gather = False
+        self._dirty_cells: set = set()  # packed row*NB+slot ints since last commit
+        self._delta_ticks: List[int] = []  # tick labels since last commit
+        self._delta_pos0: List[int] = []  # per-lag ring cursor at last commit
+        self._delta_reg_base = 0  # registry count at last commit
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._pending: List[Tuple[int, int, float]] = []  # (row, label, elapsed)
         self._latest_label = 0  # host mirror of stats.latest_bucket (hot path)
@@ -1843,6 +1877,8 @@ class PipelineDriver:
         B = self.micro_batch_size
         small = min(256, B)
         dtype = self._np_dtype()
+        if self._delta_track:
+            self._mark_cells(rows, labels)
         for i in range(0, len(rows), B):
             m = min(B, len(rows) - i)
             pad = small if m <= small else B
@@ -1894,6 +1930,8 @@ class PipelineDriver:
         labels[:n] = l_t
         elaps[:n] = e_t
         valid[:n] = True
+        if self._delta_track:
+            self._mark_cells(rows[:n], labels[:n])
         self._pending.clear()
         self.state = ingest(self.state, self.cfg, rows, labels, elaps, valid)
         if self._tracer is not None:
@@ -1905,6 +1943,11 @@ class PipelineDriver:
 
     # -- tick ----------------------------------------------------------------
     def _run_tick(self, new_label: int) -> None:
+        if self._delta_track:
+            # delta capture derives the stats ring-advance, the z ring push
+            # positions and the EWMA season slots from the tick-label
+            # sequence alone — no per-tick readback
+            self._delta_ticks.append(int(new_label))
         tr = self._tracer
         # trace plane: a tick with live sampled traces notes its wall window
         # so their "tick" span describes the tick that closed their bucket
@@ -2264,20 +2307,11 @@ class PipelineDriver:
 
     # -- checkpoint / resume (§5.4) ------------------------------------------
     # apm: sync-boundary: checkpoint serialization reads the full engine state back by contract (epoch cadence, not tick cadence)
-    def save_resume(self, path: str, *, delivery: Optional[dict] = None) -> None:
-        """Atomic snapshot (tmp + rename); `path` is used verbatim — no .npz
-        suffix magic — so load_resume(path) always finds what was saved.
-
-        ``delivery`` couples the snapshot to queue position (the at-least-once
-        epoch contract): a per-queue dict of {"epoch": watermark, "dedup":
-        [recently absorbed msg ids], ...} saved ATOMICALLY WITH the engine
-        state that absorbed those messages — the invariant the worker's
-        ack-after-checkpoint cycle rests on (a message id is in the saved
-        window iff its effect is in the saved tensors)."""
-        # a held emission describes a tick already IN the snapshot state; it
-        # must reach its consumers now or a restore would silently drop it
-        self.drain_emission()
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    def _capture_resume_arrays(self, delivery: Optional[dict] = None) -> dict:
+        """The full-snapshot array dict (save_resume's npz schema, registry
+        and pending included) — shared by the atomic npz writer and the
+        delta chain's compaction path (deltachain.DeltaChain.compact), which
+        writes the same capture as a chain base off the hot path."""
         arrays = {
             "latest_bucket": np.asarray(self.state.stats.latest_bucket),
             "counts": np.asarray(self.state.stats.counts),
@@ -2308,13 +2342,13 @@ class PipelineDriver:
             arrays[f"{ek}_count"] = np.asarray(e.count)
             arrays[f"{ek}_counters"] = np.asarray(self.state.ewma_counters[i])
             arrays[f"{ek}_trend"] = np.asarray(e.trend)
-        keys = np.array(["\x00".join(k) for k in self.registry.rows()], dtype=object)
+        arrays["registry"] = np.array(
+            ["\x00".join(k) for k in self.registry.rows()], dtype=object
+        )
         # pending ordered-tx records (not yet past the window edge) must
         # survive a restart — the reference keeps its heap in the resume file
         # (stream_calc_stats resume semantics). Stored as wire lines.
-        pending = [tx.to_csv() for tx in self.heap.items()]
-        pending += [line for _ts, line in self._tx_backlog]
-        arrays["pending_tx"] = np.array(pending, dtype=object)
+        arrays["pending_tx"] = np.array(self._pending_tx_lines(), dtype=object)
         if delivery is None:
             delivery = self.delivery_state
         if delivery is not None:
@@ -2324,17 +2358,225 @@ class PipelineDriver:
 
             arrays["delivery_state"] = np.array(_json.dumps(delivery), dtype=object)
             self.delivery_state = delivery
+        return arrays
+
+    def _pending_tx_lines(self) -> List[str]:
+        pending = [tx.to_csv() for tx in self.heap.items()]
+        pending += [line for _ts, line in self._tx_backlog]
+        return pending
+
+    def save_resume(self, path: str, *, delivery: Optional[dict] = None) -> None:
+        """Atomic snapshot (tmp + rename); `path` is used verbatim — no .npz
+        suffix magic — so load_resume(path) always finds what was saved.
+
+        ``delivery`` couples the snapshot to queue position (the at-least-once
+        epoch contract): a per-queue dict of {"epoch": watermark, "dedup":
+        [recently absorbed msg ids], ...} saved ATOMICALLY WITH the engine
+        state that absorbed those messages — the invariant the worker's
+        ack-after-checkpoint cycle rests on (a message id is in the saved
+        window iff its effect is in the saved tensors)."""
+        # a held emission describes a tick already IN the snapshot state; it
+        # must reach its consumers now or a restore would silently drop it
+        self.drain_emission()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        arrays = self._capture_resume_arrays(delivery)
         import tempfile
 
         fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)), suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as fh:
-                np.savez_compressed(fh, registry=keys, **arrays)
+                np.savez_compressed(fh, **arrays)
             os.replace(tmp, path)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+
+    # -- incremental delta checkpoints (deltachain.py) -----------------------
+    def enable_delta_capture(self) -> None:
+        """Arm dirty-state tracking for delta commits (checkpointMode:
+        "delta"). Call after construction or after a resume install — the
+        capture baseline is the CURRENT state, which must equal the chain
+        tail the next delta will append to."""
+        self._delta_track = True
+        # CPU backend: gather the epoch's cells/columns on a zero-copy
+        # numpy view — no dispatch, no per-shape compile. Other backends
+        # pay a device gather with tier-padded (bounded-compile) indices.
+        self._delta_np_gather = jax.default_backend() == "cpu"
+        self._delta_reset_capture()
+
+    def _delta_gather(self, arr, index) -> np.ndarray:
+        """One capture gather (``arr[index]``), returned as an owning numpy
+        array in the npz schema's dtype (bf16 ring bits decode to exact
+        f32). Advanced indexing copies on both paths, so the result never
+        aliases a device buffer a later donated dispatch could invalidate."""
+        if self._delta_np_gather:
+            view = None
+            try:
+                view = cpu_zero_copy_view(arr)
+            except Exception:
+                pass  # exotic layout: fall through to the device gather
+            if view is not None:
+                out = view[index]
+                if view.dtype == np.uint16:  # bf16 bit pattern -> exact f32
+                    out = (out.astype(np.uint32) << 16).view(np.float32)
+                return out
+        out = arr[index]
+        if out.dtype not in (jnp.float32, jnp.float64, jnp.int32):
+            out = out.astype(jnp.float32)  # npz schema: no bf16
+        return np.asarray(out)
+
+    def _mark_cells(self, rows: np.ndarray, labels: np.ndarray) -> None:
+        """Record the (row, bucket-slot) cells one ingest scatter touches."""
+        nb = self.cfg.stats.num_buckets
+        packed = rows.astype(np.int64) * nb + labels.astype(np.int64) % nb
+        self._dirty_cells.update(np.unique(packed).tolist())
+
+    # apm: sync-boundary: delta-capture baseline reads the ring cursors back once per epoch commit
+    def _delta_reset_capture(self) -> None:
+        self._dirty_cells.clear()
+        self._delta_ticks = []
+        self._delta_pos0 = [int(np.asarray(z.pos)) for z in self.state.zscores]
+        self._delta_reg_base = self.registry.count
+
+    # apm: sync-boundary: delta capture gathers the epoch's touched cells/columns back by contract (epoch cadence, not tick cadence)
+    def _capture_delta(self, delivery_delta: Optional[dict] = None):
+        """(arrays, meta) for one delta segment: everything the state changed
+        since the last commit, at dirty-cell / pushed-column granularity.
+        Does NOT reset tracking — the caller resets only after the segment
+        is durably on disk, so a failed write retries with a superset."""
+        cfg = self.cfg
+        nb = cfg.stats.num_buckets
+        ticks = list(self._delta_ticks)
+        T = len(ticks)
+        arrays: dict = {"latest_bucket": np.asarray(self.state.stats.latest_bucket)}
+        meta: dict = {
+            "capacity": int(cfg.capacity),
+            "nb": int(nb),
+            "ticks": ticks,
+            "zchannels": [],
+            "echannels": [],
+        }
+        if self._dirty_cells:
+            packed = np.fromiter(self._dirty_cells, np.int64, len(self._dirty_cells))
+            packed.sort()
+            rows = (packed // nb).astype(np.int32)
+            slots = (packed % nb).astype(np.int32)
+            # pad the index vectors to power-of-two tiers: a shape-varying
+            # gather would recompile per distinct cell count (the XLA trap
+            # _ingest_arrays' pad tiers exist for). Padding REPEATS the
+            # first cell — the duplicate scatters the same post-state value
+            # twice at replay, which is idempotent by construction.
+            rows = _pad_tier_repeat(rows)
+            slots = _pad_tier_repeat(slots)
+            arrays["cell_rows"] = rows
+            arrays["cell_slots"] = slots
+            st = self.state.stats
+            # O(cells) gathers, not O(state) (zero-copy numpy view on CPU,
+            # device gather elsewhere — _delta_gather)
+            arrays["cell_counts"] = self._delta_gather(st.counts, (rows, slots))
+            arrays["cell_sums"] = self._delta_gather(st.sums, (rows, slots))
+            arrays["cell_nsamples"] = self._delta_gather(st.nsamples, (rows, slots))
+            arrays["cell_samples"] = self._delta_gather(st.samples, (rows, slots))
+        if T:
+            for i, spec in enumerate(cfg.lags):
+                z = self.state.zscores[i]
+                L = spec.lag
+                key = f"z{spec.lag}"
+                pos0 = self._delta_pos0[i]
+                meta["zchannels"].append({"key": key, "lag": L, "pos0": pos0})
+                if T >= L:
+                    # every ring slot was rewritten this epoch: store the
+                    # whole ring (the full snapshot's representation)
+                    zvals = np.asarray(
+                        z.values.astype(jnp.float32)
+                        if z.values.dtype not in (jnp.float32, jnp.float64)
+                        else z.values
+                    )
+                    arrays[f"{key}_values"] = zvals
+                else:
+                    # tier-padded with the last position repeated (same
+                    # column gathered twice == same column written twice at
+                    # replay); apply_delta slices back to len(ticks)
+                    positions = _pad_tier_repeat(
+                        np.asarray([(pos0 + t) % L for t in range(T)], np.int32),
+                        last=True,
+                    )
+                    arrays[f"{key}_push"] = self._delta_gather(
+                        z.values, (slice(None), slice(None), positions)
+                    )
+                arrays[f"{key}_fill"] = np.asarray(z.fill)
+                arrays[f"{key}_pos"] = np.asarray(z.pos)
+                arrays[f"{key}_counters"] = np.asarray(self.state.alert_counters[i])
+            buf1 = cfg.stats.buffer_sz + 1
+            for i, espec in enumerate(cfg.ewma):
+                e = self.state.ewmas[i]
+                K = espec.season_slots
+                ek = f"e{espec.channel_id}x{K}x{espec.slot_intervals}"
+                slots_e = sorted(
+                    {((nl - buf1) // espec.slot_intervals) % K for nl in ticks}
+                )
+                meta["echannels"].append({"key": ek, "slots": slots_e})
+                if len(slots_e) >= K:
+                    arrays[f"{ek}_mean"] = np.asarray(e.mean)
+                    arrays[f"{ek}_var"] = np.asarray(e.var)
+                    arrays[f"{ek}_trend"] = np.asarray(e.trend)
+                    arrays[f"{ek}_count"] = np.asarray(e.count)
+                else:
+                    sl = _pad_tier_repeat(np.asarray(slots_e, np.int32), last=True)
+                    ix3 = (slice(None), slice(None), sl)
+                    arrays[f"{ek}_mean_cols"] = self._delta_gather(e.mean, ix3)
+                    arrays[f"{ek}_var_cols"] = self._delta_gather(e.var, ix3)
+                    arrays[f"{ek}_trend_cols"] = self._delta_gather(e.trend, ix3)
+                    arrays[f"{ek}_count_cols"] = self._delta_gather(
+                        e.count, (slice(None), sl)
+                    )
+                arrays[f"{ek}_counters"] = np.asarray(self.state.ewma_counters[i])
+        new_keys = self.registry.rows()[self._delta_reg_base :]
+        if new_keys:
+            meta["registry_new"] = ["\x00".join(k) for k in new_keys]
+        if T or self._dirty_cells:
+            # any feed/tick may have moved the ordered-tx heap/backlog;
+            # bounded by the window buffer (drained past the edge every tick)
+            meta["pending"] = self._pending_tx_lines()
+        if delivery_delta is not None:
+            meta["delivery_delta"] = delivery_delta
+        return arrays, meta
+
+    def save_resume_delta(self, chain, *, delivery_delta: Optional[dict] = None) -> int:
+        """Commit one epoch as a delta segment appended to ``chain``
+        (deltachain.DeltaChain). The delta + the worker's incremental dedup
+        record form the SAME atomic commit unit the full snapshot provides:
+        a msg id is in the chain's recovered window iff its effect is in the
+        chain's recovered tensors. Raises deltachain.CheckpointWriteError on
+        storage failure — tracking is NOT reset, so the retry captures a
+        superset and the chain still ends at a committed boundary."""
+        if not self._delta_track:
+            raise RuntimeError("delta capture not enabled (enable_delta_capture)")
+        self.flush()  # pending scatters + held emission belong to this epoch
+        arrays, meta = self._capture_delta(delivery_delta)
+        epoch = chain.append(arrays, meta)
+        self._delta_reset_capture()
+        return epoch
+
+    def load_resume_chain(self, chain) -> bool:
+        """Restore from a delta chain (deltachain.DeltaChain or directory
+        path): base + ordered deltas replayed to the last committed epoch,
+        then installed through the exact same path as a full-snapshot
+        restore. Returns False (start fresh) when no readable chain exists."""
+        from .deltachain import DeltaChain
+
+        if isinstance(chain, str):
+            chain = DeltaChain(chain, logger=self.logger)
+        rec = chain.load()
+        if rec is None or rec.data is None:
+            return False
+        self.drain_emission()  # pre-restore emissions belong to the old stream
+        if not self._install_resume_data(rec.data, f"chain {chain.directory}"):
+            return False
+        if self._delta_track:
+            self._delta_reset_capture()
+        return True
 
     def load_resume(self, path: str) -> bool:
         if not os.path.exists(path):
@@ -2347,6 +2589,19 @@ class PipelineDriver:
         try:
             with np.load(path, allow_pickle=True) as npz:
                 data = {name: npz[name] for name in npz.files}
+        except Exception:
+            if self.logger:
+                self.logger.error(f"Could not load resume snapshot (starting fresh): {path}")
+            return False
+        return self._install_resume_data(data, path)
+
+    # apm: sync-boundary: resume install materializes host arrays onto the device once at boot
+    def _install_resume_data(self, data: dict, source: str) -> bool:
+        """Install a full-snapshot ``data`` dict (npz schema) into the live
+        driver — shared by the npz path and the delta-chain replay, so a
+        chain restore is bit-identical to restoring a full snapshot of the
+        same state. Validation failure means "start fresh", never a crash."""
+        try:
             keys = [tuple(k.split("\x00", 1)) for k in data["registry"].tolist()]
             required = ["latest_bucket", "counts", "sums", "samples", "nsamples"]
             for spec in self.cfg.lags:
@@ -2359,7 +2614,7 @@ class PipelineDriver:
                 raise KeyError(missing[0])
         except Exception:
             if self.logger:
-                self.logger.error(f"Could not load resume snapshot (starting fresh): {path}")
+                self.logger.error(f"Could not load resume snapshot (starting fresh): {source}")
             return False
         needed = len(keys)
         while needed > self.cfg.capacity:
@@ -2458,7 +2713,7 @@ class PipelineDriver:
                 # redelivery double-counts — the at-most-once baseline
                 if self.logger:
                     self.logger.error(
-                        f"Resume snapshot delivery state unreadable (ignored): {path}"
+                        f"Resume snapshot delivery state unreadable (ignored): {source}"
                     )
         self.heap = MinHeap(lambda tx: tx.end_ts)
         self._tx_backlog = []

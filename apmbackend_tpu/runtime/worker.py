@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from ..entries import EntryFactory
 from ..integrations import EmailSender, GrafanaClient
 from ..ops.alerts import AlertsManager
@@ -96,6 +98,48 @@ class WorkerApp:
         self._alo_pending: list = []  # guarded-by: _driver_lock ((line, ingest_ts|None, ctx))
         self._alo_batch = max(1, int(eng_cfg.get("deliveryBatchSize", 256)))
         self._alo_drain_s = float(eng_cfg.get("deliveryFeedMaxDelaySeconds", 0.25))
+        # incremental dedup-window record for delta commits (deltachain):
+        # ids appended / evicted since the last committed epoch — the
+        # rate-proportional equivalent of serializing the whole window
+        self._dedup_added_epoch: list = []  # guarded-by: _driver_lock
+        self._dedup_evicted_epoch = 0  # guarded-by: _driver_lock
+
+        # -- checkpoint plane (full npz vs delta chain + failure policy) -----
+        ck_mode = str(eng_cfg.get("checkpointMode", "full"))
+        if ck_mode not in ("full", "delta"):
+            raise ValueError(
+                f"tpuEngine.checkpointMode must be full|delta, got {ck_mode!r}"
+            )
+        self._ckpt_mode = ck_mode
+        self._ckpt_chain = None
+        self._ckpt_compact_every = max(
+            0, int(eng_cfg.get("checkpointCompactEveryEpochs", 64))
+        )
+        self._ckpt_last_compact = 0  # guarded-by: _driver_lock (chain epoch)
+        # write-failure policy (ENOSPC/EIO graceful degradation): every
+        # failed checkpoint write backs off with decorrelated jitter (the
+        # AMQP reconnect _next_backoff shape — a fleet of workers on one
+        # full disk must not hammer it in lockstep); after
+        # checkpointWriteMaxRetries consecutive failures the worker enters
+        # DEGRADED mode — flight bundle, operator alert, intake paused
+        # (backpressure to the broker instead of a crash loop) — and keeps
+        # retrying at the capped cadence until a write lands.
+        import random as _random
+
+        self._ckpt_max_retries = max(1, int(eng_cfg.get("checkpointWriteMaxRetries", 5)))
+        self._ckpt_backoff_base = float(
+            eng_cfg.get("checkpointWriteRetryBaseSeconds", 0.5)
+        )
+        self._ckpt_backoff_max = float(
+            eng_cfg.get("checkpointWriteRetryMaxSeconds", 30.0)
+        )
+        self._ckpt_jitter = _random.Random()
+        self._ckpt_fail_streak = 0  # guarded-by: _driver_lock
+        self._ckpt_failures_total = 0  # guarded-by: _driver_lock
+        self._ckpt_backoff = 0.0  # guarded-by: _driver_lock
+        self._ckpt_retry_at: Optional[float] = None  # guarded-by: _driver_lock
+        self._ckpt_degraded = False  # guarded-by: _driver_lock
+        self._ckpt_paused_intake = False  # guarded-by: _driver_lock
 
         # -- outbound queues -------------------------------------------------
         qm = runtime.qm
@@ -232,22 +276,37 @@ class WorkerApp:
         # -- resume ----------------------------------------------------------
         self.engine_resume = eng_cfg.get("resumeFileFullPath")
         self.alerts_resume = alerts_cfg.get("alertsResumeFileFullPath")
-        if self.engine_resume and self.driver.load_resume(self.engine_resume):
-            logger.info(f"Engine state resumed from {self.engine_resume}")
-            dstate = (self.driver.delivery_state or {}).get(in_queue_name)
-            if self._at_least_once and dstate:
-                # seed the dedup window from the checkpoint: redeliveries of
-                # messages this snapshot already absorbed are skipped
-                self._delivery_epoch = int(dstate.get("epoch", 0))
-                self._deduped_total = int(dstate.get("deduped_total", 0))
-                for mid in dstate.get("dedup", []):
-                    if mid not in self._dedup_set:
-                        self._dedup_set.add(mid)
-                        self._dedup_fifo.append(mid)
+        if self._ckpt_mode == "delta":
+            from ..deltachain import CheckpointWriteError, DeltaChain
+
+            chain_dir = eng_cfg.get("checkpointChainDir") or "save/tpu_engine.chain"
+            self._ckpt_chain = DeltaChain(
+                chain_dir,
+                fsync=bool(eng_cfg.get("checkpointFsync", True)),
+                logger=logger,
+            )
+            if self.driver.load_resume_chain(self._ckpt_chain):
                 logger.info(
-                    f"Delivery state resumed: epoch {self._delivery_epoch}, "
-                    f"dedup window {len(self._dedup_fifo)} ids"
+                    f"Engine state resumed from delta chain {chain_dir} "
+                    f"(epoch {self._ckpt_chain.tail_epoch})"
                 )
+                self._seed_delivery(in_queue_name)
+            else:
+                # fresh chain: the initial base IS the first committed epoch
+                # boundary (an empty engine) — written before any ack can
+                # happen. A failing disk at boot defers to the epoch commit
+                # path's retry/degradation machinery.
+                try:
+                    self._ckpt_chain.initialize(
+                        self.driver._capture_resume_arrays(None), epoch=0
+                    )
+                except CheckpointWriteError as e:
+                    logger.error(f"Checkpoint chain initialize failed (will retry): {e}")
+            self._ckpt_last_compact = self._ckpt_chain.tail_epoch
+            self.driver.enable_delta_capture()
+        elif self.engine_resume and self.driver.load_resume(self.engine_resume):
+            logger.info(f"Engine state resumed from {self.engine_resume}")
+            self._seed_delivery(in_queue_name)
         if self.alerts_resume:
             self.alerts_manager.load_resume(self.alerts_resume)
 
@@ -325,6 +384,25 @@ class WorkerApp:
             )
             flight.add_source("engine_health", self._health)
 
+    def _seed_delivery(self, in_queue_name: str) -> None:
+        """Seed the dedup window / epoch watermark from a restored snapshot
+        or chain: redeliveries of messages the checkpoint already absorbed
+        are skipped."""
+        dstate = (self.driver.delivery_state or {}).get(in_queue_name)
+        if not (self._at_least_once and dstate):
+            return
+        with self._driver_lock:  # boot wiring, but cheap to be rigorous
+            epoch = self._delivery_epoch = int(dstate.get("epoch", 0))
+            self._deduped_total = int(dstate.get("deduped_total", 0))
+            for mid in dstate.get("dedup", []):
+                if mid not in self._dedup_set:
+                    self._dedup_set.add(mid)
+                    self._dedup_fifo.append(mid)
+            n_window = len(self._dedup_fifo)
+        self.runtime.logger.info(
+            f"Delivery state resumed: epoch {epoch}, dedup window {n_window} ids"
+        )
+
     def _collect_metrics(self):
         from ..obs import Sample
 
@@ -345,6 +423,23 @@ class WorkerApp:
                      "Device memory in use (HBM watchdog view)")
         yield Sample("apm_hbm_bytes_limit", {}, self.hbm_bytes_limit, "gauge",
                      "Device memory limit (HBM watchdog view)")
+        with self._driver_lock:
+            ck_failures = self._ckpt_failures_total
+            ck_degraded = self._ckpt_degraded
+        yield Sample("apm_checkpoint_write_failures_total", {}, ck_failures,
+                     "counter", "Checkpoint writes that failed (ENOSPC/EIO/...)")
+        yield Sample("apm_checkpoint_degraded", {}, int(ck_degraded), "gauge",
+                     "1 while persistent checkpoint failures keep intake paused")
+        if self._ckpt_chain is not None:
+            yield Sample("apm_checkpoint_chain_epoch", {},
+                         self._ckpt_chain.tail_epoch, "gauge",
+                         "Last committed delta-chain epoch")
+            yield Sample("apm_checkpoint_delta_last_bytes", {},
+                         self._ckpt_chain.last_delta_bytes, "gauge",
+                         "Size of the most recent delta segment")
+            yield Sample("apm_checkpoint_compactions_total", {},
+                         self._ckpt_chain.compactions, "counter",
+                         "Delta-chain full-snapshot compactions completed")
         if self._at_least_once:
             # consistent snapshot: the scrape must not interleave with an
             # epoch commit swapping the token list (RLock, scrape cadence)
@@ -382,6 +477,21 @@ class WorkerApp:
             "overflow_row_ticks": self.driver.overflow_rows_total,
             "device_loop_alive": ring_alive,
         }
+        with self._driver_lock:  # consistent healthz checkpoint block
+            ck = {
+                "mode": self._ckpt_mode,
+                "write_failures": self._ckpt_failures_total,
+                "fail_streak": self._ckpt_fail_streak,
+                "degraded": self._ckpt_degraded,
+            }
+            if self._ckpt_degraded:
+                # persistent checkpoint failure = cannot commit epochs = an
+                # unhealthy worker the manager watchdog should see as 503
+                out["ok"] = False
+        if self._ckpt_chain is not None:
+            ck["chain_epoch"] = self._ckpt_chain.tail_epoch
+            ck["chain_dir"] = self._ckpt_chain.directory
+        out["checkpoint"] = ck
         if self._at_least_once:
             with self._driver_lock:  # consistent healthz delivery block
                 out["delivery"] = {
@@ -599,8 +709,14 @@ class WorkerApp:
                 if msg_id is not None:
                     self._dedup_set.add(msg_id)
                     self._dedup_fifo.append(msg_id)
+                    if self._ckpt_chain is not None:
+                        # incremental window record for the delta commit:
+                        # replay = (old + added)[evicted:]
+                        self._dedup_added_epoch.append(msg_id)
                     if len(self._dedup_fifo) > self._dedup_max:
                         self._dedup_set.discard(self._dedup_fifo.popleft())
+                        if self._ckpt_chain is not None:
+                            self._dedup_evicted_epoch += 1
                 if line.startswith("tx|"):
                     h = headers or {}
                     ts = h.get("ingest_ts")
@@ -807,41 +923,200 @@ class WorkerApp:
         self.alerts_manager.set_config(alerts_cfg)
 
     # -- state ---------------------------------------------------------------
-    def save_state(self) -> None:
+    def _next_ckpt_backoff(self, prev: float) -> float:
+        """Decorrelated-jitter retry delay for checkpoint write failures —
+        the AMQP reconnect ``_next_backoff`` shape: ~U(base, 3·prev), capped
+        (a fleet sharing one full filesystem must not retry in lockstep)."""
+        return min(
+            self._ckpt_backoff_max,
+            self._ckpt_jitter.uniform(
+                self._ckpt_backoff_base, max(prev * 3.0, self._ckpt_backoff_base)
+            ),
+        )
+
+    # apm: holds(_driver_lock): called only from save_state's locked section
+    def _ckpt_write_failed(self, err: Exception) -> None:
+        """One failed checkpoint write: count, back off, and past the retry
+        budget enter DEGRADED mode — flight bundle first (capture the
+        wreckage while it is fresh), operator alert, intake paused so the
+        broker absorbs the backlog (backpressure, not a crash loop)."""
+        self._ckpt_failures_total += 1
+        self._ckpt_fail_streak += 1
+        self._ckpt_backoff = self._next_ckpt_backoff(self._ckpt_backoff)
+        self._ckpt_retry_at = time.monotonic() + self._ckpt_backoff
+        self.runtime.logger.error(
+            f"Checkpoint write failed ({self._ckpt_fail_streak}/"
+            f"{self._ckpt_max_retries} before degradation, retry in "
+            f"{self._ckpt_backoff:.1f}s): {err}"
+        )
+        if self._ckpt_fail_streak != self._ckpt_max_retries or self._ckpt_degraded:
+            return
+        self._ckpt_degraded = True
+        flight = getattr(self.runtime, "flight", None)
+        if flight is not None:
+            try:
+                flight.dump("checkpoint_write_failure", force=True)
+            except Exception:
+                pass
+        self.ops_alerts.add(
+            f"Checkpoint writes failing persistently ({err}); epochs cannot "
+            f"commit, so intake is PAUSED (unacked deliveries back up on the "
+            f"broker) and retries continue with jittered backoff up to "
+            f"{self._ckpt_backoff_max:.0f}s. Free disk space / fix the "
+            f"checkpoint volume to resume."
+        )
+        in_queue = getattr(self, "in_queue", None)
+        if in_queue is not None and self._consume_enabled:
+            try:
+                in_queue.stop_consume()
+                self._ckpt_paused_intake = True
+            except Exception as e:
+                self.runtime.logger.error(f"Degradation intake pause failed: {e}")
+
+    # apm: holds(_driver_lock): called only from save_state's locked section
+    def _ckpt_write_ok(self) -> None:
+        if not self._ckpt_fail_streak and not self._ckpt_degraded:
+            return
+        self.runtime.logger.warning(
+            f"Checkpoint writes recovered after {self._ckpt_fail_streak} failures"
+        )
+        self._ckpt_fail_streak = 0
+        self._ckpt_backoff = 0.0
+        self._ckpt_retry_at = None
+        if self._ckpt_degraded:
+            self._ckpt_degraded = False
+            self.ops_alerts.add("Checkpoint writes recovered; intake resumed.")
+            if self._ckpt_paused_intake and self._consume_enabled:
+                try:
+                    self.in_queue.start_consume()
+                except Exception as e:
+                    self.runtime.logger.error(f"Degradation intake resume failed: {e}")
+            self._ckpt_paused_intake = False
+
+    # apm: holds(_driver_lock): called only from save_state's locked section
+    def _commit_checkpoint_locked(self, in_queue) -> bool:
+        """Write one checkpoint (delta append or full npz) with the delivery
+        tree when an epoch is committing. Returns True when the write landed
+        durably; False routes through the failure policy and MUST NOT ack."""
+        from ..deltachain import CheckpointWriteError
+
+        epoch_commit = self._at_least_once and in_queue is not None
+        next_epoch = self._delivery_epoch + 1 if epoch_commit else self._delivery_epoch
+        try:
+            if self._ckpt_chain is not None:
+                if not self._ckpt_chain.initialized:
+                    # boot-time initialize failed (e.g. disk already full):
+                    # keep trying to lay the base down under the same policy
+                    self._ckpt_chain.initialize(
+                        self.driver._capture_resume_arrays(None), epoch=0
+                    )
+                dd = None
+                if epoch_commit:
+                    dd = {
+                        in_queue.queue_name: {
+                            "epoch": next_epoch,
+                            "added": list(self._dedup_added_epoch),
+                            "evicted": self._dedup_evicted_epoch,
+                            "deduped_total": self._deduped_total,
+                        }
+                    }
+                chain_epoch = self.driver.save_resume_delta(
+                    self._ckpt_chain, delivery_delta=dd
+                )
+                self._dedup_added_epoch = []
+                self._dedup_evicted_epoch = 0
+                self._maybe_compact_locked(chain_epoch, in_queue, next_epoch)
+            else:
+                delivery = None
+                if epoch_commit:
+                    delivery = {
+                        in_queue.queue_name: {
+                            "epoch": next_epoch,
+                            "dedup": list(self._dedup_fifo),
+                            "deduped_total": self._deduped_total,
+                        }
+                    }
+                self.driver.save_resume(self.engine_resume, delivery=delivery)
+        except (CheckpointWriteError, OSError) as e:
+            self._ckpt_write_failed(e)
+            return False
+        if epoch_commit:
+            self._delivery_epoch = next_epoch
+        self._ckpt_write_ok()
+        return True
+
+    # apm: holds(_driver_lock): called only from _commit_checkpoint_locked
+    def _maybe_compact_locked(self, chain_epoch: int, in_queue, next_epoch: int) -> None:
+        """Kick the periodic full-snapshot compaction OFF the hot path: the
+        locked section only captures the state arrays (device gathers); the
+        compress + write + manifest swap + GC run on the chain's background
+        thread while epochs keep appending."""
+        if (
+            self._ckpt_compact_every <= 0
+            or chain_epoch - self._ckpt_last_compact < self._ckpt_compact_every
+        ):
+            return
+        delivery = None
+        if self._at_least_once and in_queue is not None:
+            delivery = {
+                in_queue.queue_name: {
+                    "epoch": next_epoch,
+                    "dedup": list(self._dedup_fifo),
+                    "deduped_total": self._deduped_total,
+                }
+            }
+        arrays = self.driver._capture_resume_arrays(delivery)
+        # DEEP-COPY before handing off: np.asarray over CPU device buffers
+        # can be zero-copy, and the tick loop's donated dispatches recycle
+        # those buffers while the background thread is still serializing
+        # (the exact use-after-donate shape behind the seed's old segfault,
+        # tests/conftest.py) — save_resume is safe only because it
+        # serializes synchronously under the driver lock
+        arrays = {
+            k: np.array(v, copy=True) if isinstance(v, np.ndarray) else v
+            for k, v in arrays.items()
+        }
+        if self._ckpt_chain.compact_async(chain_epoch, arrays):
+            self._ckpt_last_compact = chain_epoch
+
+    def save_state(self, force: bool = False) -> None:
         """Snapshot device + alert state; in at-least-once mode this IS the
         epoch commit: flush → checkpoint (with the dedup window) → ack. The
         tokens are cleared only after the snapshot lands, so a failed save
-        leaves them unacked (the broker redelivers; dedup absorbs)."""
+        leaves them unacked (the broker redelivers; dedup absorbs).
+        ``force`` (shutdown) bypasses the failure-backoff gate for one last
+        attempt."""
         # the resume-save interval fires once at registration, which is
         # before the intake wiring exists: plain snapshot, no epoch to commit
         in_queue = getattr(self, "in_queue", None)
         tokens: list = []
+        committed = True
         with self._driver_lock:
             if self._at_least_once:
                 # batched intake MUST reach the engine before the snapshot:
                 # the tokens below only commit effects the checkpoint holds
                 self._drain_alo_pending_locked()
             self.driver.flush()
+            if (
+                not force
+                and self._ckpt_retry_at is not None
+                and time.monotonic() < self._ckpt_retry_at
+            ):
+                return  # backoff window after a failed checkpoint write
+            has_ckpt = self._ckpt_chain is not None or self.engine_resume
             if self._at_least_once and in_queue is not None:
                 tokens = self._epoch_tokens
-                if self.engine_resume:
-                    self._delivery_epoch += 1
-                    self.driver.save_resume(
-                        self.engine_resume,
-                        delivery={
-                            in_queue.queue_name: {
-                                "epoch": self._delivery_epoch,
-                                "dedup": list(self._dedup_fifo),
-                                "deduped_total": self._deduped_total,
-                            }
-                        },
-                    )
-                # no resume path configured: the "checkpoint" is process
+                if has_ckpt:
+                    committed = self._commit_checkpoint_locked(in_queue)
+                # no checkpoint configured: the "checkpoint" is process
                 # memory — still ack per epoch (commit-to-memory batching)
-                self._epoch_tokens = []
-            elif self.engine_resume:
-                self.driver.save_resume(self.engine_resume)
-        if tokens:
+                if committed:
+                    self._epoch_tokens = []
+                else:
+                    tokens = []  # unacked => redelivered; dedup absorbs
+            elif has_ckpt:
+                committed = self._commit_checkpoint_locked(None)
+        if tokens and committed:
             try:
                 in_queue.ack(tokens)
             except Exception as e:
@@ -882,7 +1157,11 @@ class WorkerApp:
             self.ops_alerts.flush()
         except Exception as e:
             self.runtime.logger.error(f"Final ops-alert flush error: {e}")
-        self.save_state()
+        self.save_state(force=True)
+        if self._ckpt_chain is not None:
+            # a compaction still running is crash-safe to abandon (the old
+            # manifest stays valid), but an orderly exit gives it a moment
+            self._ckpt_chain.wait_compaction(timeout_s=30.0)
 
 
 def build(runtime) -> WorkerApp:

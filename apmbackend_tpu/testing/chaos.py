@@ -46,7 +46,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, List
 
 from ..transport.base import Channel
 
@@ -183,228 +183,11 @@ class ChaosChannel(Channel):
 # ---------------------------------------------------------------------------
 
 
-class _SpoolQueue:
-    """Consumer-side view of one spool file: incremental record parsing plus
-    the acked-cursor bookkeeping."""
-
-    def __init__(self, directory: str, name: str):
-        self.path = os.path.join(directory, f"{name}.spool")
-        self.cursor_path = os.path.join(directory, f"{name}.cursor")
-        self.records: List[Tuple[bytes, Optional[dict]]] = []
-        self._buf = b""
-        self._read_off = 0
-        self.acked_upto = 0  # records [0, acked_upto) are committed
-        self._acked_set: set = set()
-        self.next_deliver = 0
-        if os.path.exists(self.cursor_path):
-            try:
-                with open(self.cursor_path, "r", encoding="utf-8") as fh:
-                    self.acked_upto = int(json.load(fh)["acked"])
-            except Exception:
-                self.acked_upto = 0  # torn cursor: redeliver from zero (safe)
-        self.next_deliver = self.acked_upto
-
-    def poll(self) -> None:
-        """Parse any newly appended COMPLETE records (a concurrently writing
-        producer may leave a partial trailing line — it stays buffered)."""
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as fh:
-            fh.seek(self._read_off)
-            chunk = fh.read()
-        if not chunk:
-            return
-        self._read_off += len(chunk)
-        self._buf += chunk
-        *lines, self._buf = self._buf.split(b"\n")
-        for line in lines:
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-                self.records.append((rec["p"].encode("utf-8"), rec.get("h")))
-            except Exception:
-                # a mangled record is a poison message: skip it rather than
-                # wedging the queue forever
-                self.records.append((b"", None))
-
-    def ack(self, index: int) -> bool:
-        """Mark one record committed; returns True when the contiguous
-        cursor advanced (caller persists it)."""
-        if index < self.acked_upto:
-            return False  # idempotent re-ack
-        self._acked_set.add(index)
-        advanced = False
-        while self.acked_upto in self._acked_set:
-            self._acked_set.discard(self.acked_upto)
-            self.acked_upto += 1
-            advanced = True
-        return advanced
-
-    def persist_cursor(self) -> None:
-        tmp = self.cursor_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump({"acked": self.acked_upto}, fh)
-        os.replace(tmp, self.cursor_path)
-
-
-class SpoolChannel(Channel):
-    """Durable file-backed broker channel — the kill−9 fabric.
-
-    One append-only JSON-lines spool per queue under ``directory``; the
-    consumer's committed cursor lives in ``<queue>.cursor`` and is advanced
-    ONLY by ``ack()`` (atomic tmp+rename). SIGKILL the consumer process at
-    any instant and a fresh SpoolChannel resumes delivery from the last
-    committed cursor — everything delivered-but-unacked is redelivered, the
-    exact contract a durable AMQP queue with manual acks provides, minus the
-    network. ``send`` appends with flush (the producer/harness process
-    survives the chaos, so line-buffered append is durable enough).
-
-    Delivery is pumped (``deliver()`` / ``start_pump_thread``) like the
-    memory broker. Ack-on-receipt consumers advance the cursor at delivery;
-    manual-ack consumers receive ``(queue, index)`` tokens.
-    """
-
-    def __init__(self, directory: str, *, prefetch: int = 100000):
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
-        self.prefetch = prefetch
-        self._queues: Dict[str, _SpoolQueue] = {}
-        # (tag, callback, manual) per queue
-        self._consumers: Dict[str, Tuple[str, Callable, bool]] = {}
-        self._send_fhs: Dict[str, object] = {}
-        self._lock = threading.RLock()
-        self._drain_cbs: List[Callable[[], None]] = []
-        self._pump_thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
-
-    # -- Channel contract ----------------------------------------------------
-    def assert_queue(self, name: str) -> None:
-        with self._lock:
-            if name not in self._queues:
-                self._queues[name] = _SpoolQueue(self.directory, name)
-
-    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
-        with self._lock:
-            self.assert_queue(name)
-            fh = self._send_fhs.get(name)
-            if fh is None:
-                fh = open(os.path.join(self.directory, f"{name}.spool"), "ab")
-                self._send_fhs[name] = fh
-            rec = json.dumps({"p": payload.decode("utf-8"), "h": headers})
-            fh.write(rec.encode("utf-8") + b"\n")
-            fh.flush()
-        return True
-
-    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str,
-                manual_ack: bool = False) -> None:
-        from ..transport.base import accepts_headers
-
-        if not manual_ack and not accepts_headers(callback):
-            inner = callback
-            callback = lambda payload, _h=None, _cb=inner: _cb(payload)  # noqa: E731
-        with self._lock:
-            self.assert_queue(name)
-            self._consumers[name] = (consumer_tag, callback, manual_ack)
-
-    def cancel(self, consumer_tag: str) -> None:
-        with self._lock:
-            self._consumers = {
-                q: c for q, c in self._consumers.items() if c[0] != consumer_tag
-            }
-
-    def ack(self, tokens) -> None:
-        with self._lock:
-            advanced: set = set()
-            for name, index in tokens:
-                q = self._queues.get(name)
-                if q is not None and q.ack(index):
-                    advanced.add(name)
-            for name in advanced:
-                self._queues[name].persist_cursor()
-
-    def on_drain(self, callback: Callable[[], None]) -> None:
-        self._drain_cbs.append(callback)
-
-    def close(self) -> None:
-        self.stop()
-        with self._lock:
-            for fh in self._send_fhs.values():
-                try:
-                    fh.close()
-                except Exception:
-                    pass
-            self._send_fhs.clear()
-
-    # -- delivery ------------------------------------------------------------
-    def deliver(self, max_messages: Optional[int] = None) -> int:
-        delivered = 0
-        while max_messages is None or delivered < max_messages:
-            batch = []
-            with self._lock:
-                for name, (tag, cb, manual) in self._consumers.items():
-                    q = self._queues[name]
-                    q.poll()
-                    if q.next_deliver >= len(q.records):
-                        continue
-                    if manual and q.next_deliver - q.acked_upto >= self.prefetch:
-                        continue  # unacked ledger at the prefetch bound
-                    payload, headers = q.records[q.next_deliver]
-                    index = q.next_deliver
-                    q.next_deliver += 1
-                    if not manual and q.ack(index):
-                        q.persist_cursor()
-                    batch.append((cb, payload, headers, manual, (name, index)))
-            if not batch:
-                break
-            for cb, payload, headers, manual, token in batch:
-                if manual:
-                    cb(payload, headers, token)
-                else:
-                    cb(payload, headers)
-                delivered += 1
-        return delivered
-
-    def acked_count(self, name: str) -> int:
-        with self._lock:
-            q = self._queues.get(name)
-            return q.acked_upto if q else 0
-
-    def delivered_count(self, name: str) -> int:
-        with self._lock:
-            q = self._queues.get(name)
-            return q.next_deliver if q else 0
-
-    def start_pump_thread(self, poll_s: float = 0.005) -> None:
-        if self._pump_thread is not None:
-            return
-
-        def _loop():
-            while not self._stop.is_set():
-                if self.deliver() == 0:
-                    self._stop.wait(poll_s)
-
-        self._pump_thread = threading.Thread(target=_loop, name="spool-pump", daemon=True)
-        self._pump_thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._pump_thread is not None:
-            self._pump_thread.join(timeout=2.0)
-            self._pump_thread = None
-
-
-def read_spool_cursor(directory: str, queue: str) -> int:
-    """Committed (acked) record count for ``queue`` — the harness's view of
-    a (possibly dead) worker's progress, read straight off disk."""
-    path = os.path.join(os.path.abspath(directory), f"{queue}.cursor")
-    if not os.path.exists(path):
-        return 0
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            return int(json.load(fh)["acked"])
-    except Exception:
-        return 0
+# SpoolChannel moved to transport/spool.py (it is a real transport backend,
+# not a test double — the production worker runs over it in the chaos and
+# hostile-storage tiers); re-exported here for compatibility.
+from ..transport.spool import SpoolChannel, read_spool_cursor  # noqa: E402
+from ..transport.spool import _SpoolQueue as _SpoolQueue  # noqa: E402 (re-export)
 
 
 class ChaosWorkerHarness:
@@ -421,12 +204,24 @@ class ChaosWorkerHarness:
     harness to completion with no kills (golden), another over the same line
     stream with kills + dup chaos, then compare the two final resume
     snapshots array-for-array.
+
+    ``checkpoint_mode="delta"`` runs the child on the incremental delta
+    chain (deltachain.py) under ``<workdir>/chain``; at clean exit the child
+    exports a FULL snapshot to ``resume_path``, so the same array-for-array
+    comparison covers delta runs — including cross-mode comparisons (a delta
+    chaos run vs a full-snapshot golden run must still be bit-identical).
+    ``fault_env`` injects hostile-storage faults (deltachain.StorageFaultPlan
+    grammar) via ``APM_CHAOS_FS``: a string applies to every child
+    generation, a ``{generation: spec}`` dict targets specific restarts
+    (e.g. kill-during-compaction only in generation 1).
     """
 
     QUEUE = "transactions"
 
     def __init__(self, workdir: str, *, dup_p: float = 0.0, seed: int = 0,
-                 capacity: int = 64, save_every_s: float = 0.4):
+                 capacity: int = 64, save_every_s: float = 0.4,
+                 checkpoint_mode: str = "full", compact_every: int = 0,
+                 fault_env=None):
         import sys
 
         self.workdir = os.path.abspath(workdir)
@@ -440,6 +235,10 @@ class ChaosWorkerHarness:
         self.seed = seed
         self.capacity = capacity
         self.save_every_s = save_every_s
+        self.checkpoint_mode = checkpoint_mode
+        self.chain_dir = os.path.join(self.workdir, "chain")
+        self.compact_every = compact_every
+        self.fault_env = fault_env
         # crash flight-recorder bundles (obs/flight): the child journals
         # here on a fast cadence; a kill−9 leaves journal+sentinel behind
         # and the RESTARTED child promotes them into a ...-crash.json bundle
@@ -477,21 +276,31 @@ class ChaosWorkerHarness:
         self.generation += 1
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env.pop("PYTHONPATH", None)  # no TPU-relay sitecustomize in children
+        env.pop("APM_CHAOS_FS", None)
+        fault = self.fault_env
+        if isinstance(fault, dict):
+            fault = fault.get(self.generation)
+        if fault:
+            env["APM_CHAOS_FS"] = fault
+        argv = [
+            self.python, "-m", "apmbackend_tpu.testing.chaos", "--child",
+            "--spool-dir", self.spool_dir,
+            "--resume", self.resume_path,
+            "--queue", self.QUEUE,
+            "--stats-out", self.stats_path,
+            "--done-file", self.done_path,
+            "--capacity", str(self.capacity),
+            "--save-every-s", str(self.save_every_s),
+            "--dup-p", str(self.dup_p),
+            "--seed", str(self.seed + self.generation),
+            "--flight-dir", self.flight_dir,
+            "--checkpoint-mode", self.checkpoint_mode,
+            "--chain-dir", self.chain_dir,
+            "--compact-every", str(self.compact_every),
+        ]
         log_fh = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
-            [
-                self.python, "-m", "apmbackend_tpu.testing.chaos", "--child",
-                "--spool-dir", self.spool_dir,
-                "--resume", self.resume_path,
-                "--queue", self.QUEUE,
-                "--stats-out", self.stats_path,
-                "--done-file", self.done_path,
-                "--capacity", str(self.capacity),
-                "--save-every-s", str(self.save_every_s),
-                "--dup-p", str(self.dup_p),
-                "--seed", str(self.seed + self.generation),
-                "--flight-dir", self.flight_dir,
-            ],
+            argv,
             stdout=log_fh, stderr=log_fh, stdin=subprocess.DEVNULL,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             env=env,
@@ -507,8 +316,49 @@ class ChaosWorkerHarness:
             os.kill(self.proc.pid, _signal.SIGKILL)
             self.proc.wait(timeout=30)
 
+    def wait_child_death(self, timeout_s: float = 120.0) -> int:
+        """Block until the child dies on its own — the fault-plan SIGKILL
+        scenarios (kill:compact=...) where the child, not the harness, picks
+        the crash instant. Returns the (negative-signal) exit code."""
+        return self.proc.wait(timeout=timeout_s)
+
     def acked(self) -> int:
         return read_spool_cursor(self.spool_dir, self.QUEUE)
+
+    def chain_tail_segment(self):
+        """Path of the newest delta segment on disk (None when no deltas)."""
+        segs = sorted(
+            n for n in os.listdir(self.chain_dir)
+            if n.startswith("delta-") and n.endswith(".seg")
+        )
+        return os.path.join(self.chain_dir, segs[-1]) if segs else None
+
+    def corrupt_chain_tail(self, mode: str) -> str:
+        """Damage the chain tail between child generations — the hostile-
+        storage matrix rows a SIGKILL alone cannot produce on a journaling
+        filesystem: ``truncate`` (torn final write: half the segment),
+        ``garbage`` (bit rot in the payload), ``header`` (truncated inside
+        the header framing), ``stale-dup`` (a leftover same-name future
+        segment from a dead incarnation: the tail copied to epoch+1, which
+        recovery must reject via the uid linkage, never replay)."""
+        seg = self.chain_tail_segment()
+        assert seg is not None, "no delta segment to corrupt"
+        blob = open(seg, "rb").read()
+        if mode == "truncate":
+            open(seg, "wb").write(blob[: max(1, len(blob) // 2)])
+        elif mode == "header":
+            open(seg, "wb").write(blob[: len(b"APMDCSG1") + 5])
+        elif mode == "garbage":
+            mid = len(blob) // 2  # 0xA5: never a no-op over real segment bytes
+            open(seg, "wb").write(blob[:mid] + b"\xa5" * 16 + blob[mid + 16:])
+        elif mode == "stale-dup":
+            epoch = int(os.path.basename(seg)[6:-4])
+            dup = os.path.join(self.chain_dir, f"delta-{epoch + 1:012d}.seg")
+            open(dup, "wb").write(blob)
+            return dup
+        else:
+            raise ValueError(f"unknown corruption mode {mode!r}")
+        return seg
 
     def wait_acked(self, n: int, timeout_s: float = 120.0) -> int:
         """Block until the committed cursor reaches ``n`` (or timeout); the
@@ -565,6 +415,9 @@ def _child_main(argv=None) -> int:
     ap.add_argument("--dup-p", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--flight-dir", default=None)
+    ap.add_argument("--checkpoint-mode", default="full", choices=("full", "delta"))
+    ap.add_argument("--chain-dir", default=None)
+    ap.add_argument("--compact-every", type=int, default=0)
     args = ap.parse_args(argv)
 
     from ..config import default_config
@@ -577,8 +430,20 @@ def _child_main(argv=None) -> int:
     eng["serviceCapacity"] = args.capacity
     eng["samplesPerBucket"] = 64
     eng["deliveryMode"] = "atLeastOnce"
-    eng["resumeFileFullPath"] = args.resume
     eng["metricsPort"] = None
+    if args.checkpoint_mode == "delta":
+        # delta-chain epoch commits; the full `--resume` npz is written only
+        # as a clean-exit EXPORT so the harness's array-for-array comparison
+        # (and cross-mode full-vs-delta comparisons) keep working
+        eng["checkpointMode"] = "delta"
+        eng["checkpointChainDir"] = args.chain_dir
+        eng["resumeFileFullPath"] = None
+        eng["checkpointCompactEveryEpochs"] = args.compact_every
+        # fast retry cadence: the ENOSPC scenarios must clear inside a test
+        eng["checkpointWriteRetryBaseSeconds"] = 0.05
+        eng["checkpointWriteRetryMaxSeconds"] = 0.5
+    else:
+        eng["resumeFileFullPath"] = args.resume
     cfg["streamCalcZScore"]["defaults"] = [{"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
     cfg["streamCalcStats"]["inQueue"] = args.queue
     # the resume-save timer IS the epoch cadence: short, so SIGKILLs land at
@@ -630,6 +495,12 @@ def _child_main(argv=None) -> int:
 
     consumer.stop()
     worker.shutdown()  # final save_state + ack inside
+    if args.checkpoint_mode == "delta":
+        # clean-exit export: the comparison snapshot (NOT a checkpoint — the
+        # chain is the durable state; this npz exists for the harness's
+        # bit-identical assertions against full-mode/golden runs)
+        with worker._driver_lock:
+            worker.driver.save_resume(args.resume)
     stats = {
         "epoch": worker._delivery_epoch,
         "deduped_total": worker._deduped_total,
@@ -637,6 +508,14 @@ def _child_main(argv=None) -> int:
         "acked": consumer.acked_count(args.queue),
         "services": worker.driver.registry.count,
         "latest_label": worker.driver._latest_label,
+        "checkpoint_mode": args.checkpoint_mode,
+        "checkpoint_write_failures": worker._ckpt_failures_total,
+        "chain_epoch": (
+            worker._ckpt_chain.tail_epoch if worker._ckpt_chain is not None else None
+        ),
+        "compactions": (
+            worker._ckpt_chain.compactions if worker._ckpt_chain is not None else 0
+        ),
     }
     tmp = args.stats_out + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:

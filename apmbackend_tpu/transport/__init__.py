@@ -1,6 +1,7 @@
 from .base import Channel, ConsumerQueue, EventEmitter, ProducerQueue, QueueManager  # noqa: F401
 from .memory import MemoryBroker, MemoryChannel  # noqa: F401
 from .amqp import AmqpChannel, HAVE_PIKA  # noqa: F401
+from .spool import SpoolChannel, read_spool_cursor  # noqa: F401
 
 
 def make_queue_manager(config: dict, *, broker=None, logger=None) -> QueueManager:

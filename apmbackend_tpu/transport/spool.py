@@ -1,0 +1,277 @@
+"""Durable file-backed spool transport — the kill−9 fabric.
+
+Promoted out of ``testing/chaos.py`` (which re-exports it for
+compatibility): the spool is a real transport backend, not a test double —
+it shares the manual-ack Channel contract with the memory broker and AMQP
+(DESIGN.md §7.1), and both the chaos harness and the delta-checkpoint
+hostile-storage tier run the production worker over it.
+
+Durability audit (ISSUE 7 satellite):
+
+- the consumer's committed cursor advances ONLY on ``ack()`` and is
+  persisted tmp → ``os.replace`` — atomic against SIGKILL at any byte: a
+  reader either sees the previous cursor or the new one, never a torn file
+  (regression-tested in tests/test_spool_durability.py, including a torn
+  leftover ``.tmp`` from a crash mid-write).
+- the tmp name is **pid-suffixed**: a not-quite-dead predecessor process
+  racing a restarted consumer must not interleave writes into one shared
+  tmp file (the old constant ``<cursor>.tmp`` name allowed exactly that).
+- ``fsync=True`` upgrades atomicity to power-loss durability: cursor and
+  spool appends fsync before the rename / after the write, plus a directory
+  fsync so the rename itself is journaled. Default off — the chaos model is
+  process death (SIGKILL), where the page cache survives; flip it on when
+  the spool must survive kernel panics or power loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .base import Channel
+
+
+class _SpoolQueue:
+    """Consumer-side view of one spool file: incremental record parsing plus
+    the acked-cursor bookkeeping."""
+
+    def __init__(self, directory: str, name: str, *, fsync: bool = False):
+        self.path = os.path.join(directory, f"{name}.spool")
+        self.cursor_path = os.path.join(directory, f"{name}.cursor")
+        self.fsync = fsync
+        self.records: List[Tuple[bytes, Optional[dict]]] = []
+        self._buf = b""
+        self._read_off = 0
+        self.acked_upto = 0  # records [0, acked_upto) are committed
+        self._acked_set: set = set()
+        self.next_deliver = 0
+        if os.path.exists(self.cursor_path):
+            try:
+                with open(self.cursor_path, "r", encoding="utf-8") as fh:
+                    self.acked_upto = int(json.load(fh)["acked"])
+            except Exception:
+                self.acked_upto = 0  # torn cursor: redeliver from zero (safe)
+        self.next_deliver = self.acked_upto
+
+    def poll(self) -> None:
+        """Parse any newly appended COMPLETE records (a concurrently writing
+        producer may leave a partial trailing line — it stays buffered)."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            fh.seek(self._read_off)
+            chunk = fh.read()
+        if not chunk:
+            return
+        self._read_off += len(chunk)
+        self._buf += chunk
+        *lines, self._buf = self._buf.split(b"\n")
+        for line in lines:
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                self.records.append((rec["p"].encode("utf-8"), rec.get("h")))
+            except Exception:
+                # a mangled record is a poison message: skip it rather than
+                # wedging the queue forever
+                self.records.append((b"", None))
+
+    def ack(self, index: int) -> bool:
+        """Mark one record committed; returns True when the contiguous
+        cursor advanced (caller persists it)."""
+        if index < self.acked_upto:
+            return False  # idempotent re-ack
+        self._acked_set.add(index)
+        advanced = False
+        while self.acked_upto in self._acked_set:
+            self._acked_set.discard(self.acked_upto)
+            self.acked_upto += 1
+            advanced = True
+        return advanced
+
+    def persist_cursor(self) -> None:
+        # pid-suffixed tmp + atomic rename: SIGKILL at any byte leaves the
+        # previous cursor intact, and a zombie predecessor cannot share (and
+        # corrupt) the tmp a restarted consumer is writing
+        tmp = f"{self.cursor_path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"acked": self.acked_upto}, fh)
+            if self.fsync:
+                fh.flush()
+                os.fsync(fh.fileno())
+        os.replace(tmp, self.cursor_path)
+        if self.fsync:
+            try:
+                fd = os.open(os.path.dirname(self.cursor_path), os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass  # platform without dir fsync
+
+
+class SpoolChannel(Channel):
+    """Durable file-backed broker channel — the kill−9 fabric.
+
+    One append-only JSON-lines spool per queue under ``directory``; the
+    consumer's committed cursor lives in ``<queue>.cursor`` and is advanced
+    ONLY by ``ack()`` (atomic tmp+rename, optional fsync). SIGKILL the
+    consumer process at any instant and a fresh SpoolChannel resumes
+    delivery from the last committed cursor — everything
+    delivered-but-unacked is redelivered, the exact contract a durable AMQP
+    queue with manual acks provides, minus the network. ``send`` appends
+    with flush (the producer/harness process survives the chaos, so
+    line-buffered append is durable enough; ``fsync=True`` hardens it).
+
+    Delivery is pumped (``deliver()`` / ``start_pump_thread``) like the
+    memory broker. Ack-on-receipt consumers advance the cursor at delivery;
+    manual-ack consumers receive ``(queue, index)`` tokens.
+    """
+
+    def __init__(self, directory: str, *, prefetch: int = 100000, fsync: bool = False):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.prefetch = prefetch
+        self.fsync = fsync
+        self._queues: Dict[str, _SpoolQueue] = {}  # guarded-by: _lock
+        # (tag, callback, manual) per queue
+        self._consumers: Dict[str, Tuple[str, Callable, bool]] = {}  # guarded-by: _lock
+        self._send_fhs: Dict[str, object] = {}  # guarded-by: _lock
+        self._lock = threading.RLock()
+        self._drain_cbs: List[Callable[[], None]] = []
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- Channel contract ----------------------------------------------------
+    def assert_queue(self, name: str) -> None:
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = _SpoolQueue(self.directory, name, fsync=self.fsync)
+
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        with self._lock:
+            self.assert_queue(name)
+            fh = self._send_fhs.get(name)
+            if fh is None:
+                fh = open(os.path.join(self.directory, f"{name}.spool"), "ab")
+                self._send_fhs[name] = fh
+            rec = json.dumps({"p": payload.decode("utf-8"), "h": headers})
+            fh.write(rec.encode("utf-8") + b"\n")
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        return True
+
+    def consume(self, name: str, callback: Callable[[bytes], None], consumer_tag: str,
+                manual_ack: bool = False) -> None:
+        from .base import accepts_headers
+
+        if not manual_ack and not accepts_headers(callback):
+            inner = callback
+            callback = lambda payload, _h=None, _cb=inner: _cb(payload)  # noqa: E731
+        with self._lock:
+            self.assert_queue(name)
+            self._consumers[name] = (consumer_tag, callback, manual_ack)
+
+    def cancel(self, consumer_tag: str) -> None:
+        with self._lock:
+            self._consumers = {
+                q: c for q, c in self._consumers.items() if c[0] != consumer_tag
+            }
+
+    def ack(self, tokens) -> None:
+        with self._lock:
+            advanced: set = set()
+            for name, index in tokens:
+                q = self._queues.get(name)
+                if q is not None and q.ack(index):
+                    advanced.add(name)
+            for name in advanced:
+                self._queues[name].persist_cursor()
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        self._drain_cbs.append(callback)
+
+    def close(self) -> None:
+        self.stop()
+        with self._lock:
+            for fh in self._send_fhs.values():
+                try:
+                    fh.close()
+                except Exception:
+                    pass
+            self._send_fhs.clear()
+
+    # -- delivery ------------------------------------------------------------
+    def deliver(self, max_messages: Optional[int] = None) -> int:
+        delivered = 0
+        while max_messages is None or delivered < max_messages:
+            batch = []
+            with self._lock:
+                for name, (tag, cb, manual) in self._consumers.items():
+                    q = self._queues[name]
+                    q.poll()
+                    if q.next_deliver >= len(q.records):
+                        continue
+                    if manual and q.next_deliver - q.acked_upto >= self.prefetch:
+                        continue  # unacked ledger at the prefetch bound
+                    payload, headers = q.records[q.next_deliver]
+                    index = q.next_deliver
+                    q.next_deliver += 1
+                    if not manual and q.ack(index):
+                        q.persist_cursor()
+                    batch.append((cb, payload, headers, manual, (name, index)))
+            if not batch:
+                break
+            for cb, payload, headers, manual, token in batch:
+                if manual:
+                    cb(payload, headers, token)
+                else:
+                    cb(payload, headers)
+                delivered += 1
+        return delivered
+
+    def acked_count(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return q.acked_upto if q else 0
+
+    def delivered_count(self, name: str) -> int:
+        with self._lock:
+            q = self._queues.get(name)
+            return q.next_deliver if q else 0
+
+    def start_pump_thread(self, poll_s: float = 0.005) -> None:
+        if self._pump_thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.is_set():
+                if self.deliver() == 0:
+                    self._stop.wait(poll_s)
+
+        self._pump_thread = threading.Thread(target=_loop, name="spool-pump", daemon=True)
+        self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+            self._pump_thread = None
+
+
+def read_spool_cursor(directory: str, queue: str) -> int:
+    """Committed (acked) record count for ``queue`` — an external observer's
+    view of a (possibly dead) consumer's progress, read straight off disk."""
+    path = os.path.join(os.path.abspath(directory), f"{queue}.cursor")
+    if not os.path.exists(path):
+        return 0
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return int(json.load(fh)["acked"])
+    except Exception:
+        return 0

@@ -69,14 +69,19 @@ fi
 
 # --chaos: the crash-consistency tier explicitly — the kill−9/restart
 # subprocess scenarios (marked `slow`, now also asserting crash flight
-# bundles are produced and parseable after SIGKILL) plus every fast
-# chaos/at-least-once test and the trace-plane suite (trace headers must
-# survive redelivery). Tier-1 runs the fast subset; this runs everything.
+# bundles are produced and parseable after SIGKILL), the hostile-storage
+# matrix (delta-chain torn tails, crash-during-compaction, ENOSPC
+# degradation, stale duplicate tails), the spool durability audit, plus
+# every fast chaos/at-least-once test and the trace-plane suite (trace
+# headers must survive redelivery). Tier-1 runs the fast subset; this
+# runs everything.
 if [ "$1" = "--chaos" ]; then
     shift
     exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -m pytest tests/test_chaos.py tests/test_chaos_harness.py \
+        tests/test_chaos_storage.py tests/test_delta_chain.py \
+        tests/test_spool_durability.py \
         tests/test_at_least_once.py tests/test_trace_plane.py \
         -m "slow or not slow" "$@"
 fi

@@ -23,15 +23,21 @@ jax.config.update("jax_enable_x64", True)
 
 # The persistent XLA compilation cache is DISABLED for the suite: setting
 # jax_compilation_cache_dir routes XLA:CPU through the cpu_aot_loader
-# compile path, which MISCOMPILES buffer donation for fused (single-program
+# compile path, which MISCOMPILED buffer donation for fused (single-program
 # read+write) steps — reproduced deterministically (round 6): two
 # PipelineDrivers stepping the same donated program in one process corrupt
 # each other's state leaves (zeros/garbage rings, window stats from freed
 # buffers), and np.savez over zero-copy views of the corrupted buffers was
-# the long-flaky suite segfault. The corruption appears on COLD runs too —
-# it is the AOT codegen path, not stale cache entries. Opt back in only via
-# APM_TEST_JAX_CACHE for experiments; the suite runs one process, so the
-# in-process jit cache already deduplicates compiles within a run.
+# the long-flaky suite segfault. The corruption appeared on COLD runs too —
+# it is the AOT codegen path, not stale cache entries.
+#
+# RETESTED (round 12, jax 0.4.37): NOT reproducible — the two-driver donated
+# fused repro and the fused-tick parity suite are bit-identical oracle vs
+# cold-cache vs warm-cache. tests/test_xla_cache_retest.py keeps that repro
+# as a standing regression gate for future jax bumps. The cache stays
+# opt-in (APM_TEST_JAX_CACHE) regardless: its only upside here is compile
+# time, the suite runs one process, and the in-process jit cache already
+# deduplicates compiles within a run.
 if os.environ.get("APM_TEST_JAX_CACHE"):
     jax.config.update("jax_compilation_cache_dir", os.environ["APM_TEST_JAX_CACHE"])
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.4)

@@ -1,0 +1,429 @@
+"""Hostile-storage chaos tier: the delta-checkpoint chain under kill−9,
+torn tails, crash-during-compaction and ENOSPC (ISSUE 7).
+
+Two tiers, like tests/test_chaos_harness.py:
+
+- fast (tier-1): the production WorkerApp epoch cycle in delta mode over
+  the durable spool, with in-process "crashes" (abandon without shutdown),
+  post-crash tail corruption, and injected write failures driving the
+  graceful-degradation machinery end to end;
+- ``slow``: real subprocesses — SIGKILL mid-stream under duplicate
+  injection in delta mode compared bit-identically against a FULL-mode
+  golden run (cross-representation equivalence is the strongest form of
+  the chain's correctness claim), deterministic SIGKILL inside the
+  compaction window via ``APM_CHAOS_FS=kill:compact=...``, and ENOSPC
+  retry/recovery under the real epoch timer. Run via
+  ``./run_tests.sh --chaos``.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.deltachain import (
+    DeltaChain,
+    StorageFaultPlan,
+    install_fault_plan,
+)
+from apmbackend_tpu.testing.chaos import ChaosWorkerHarness, SpoolChannel
+from apmbackend_tpu.transport.base import QueueManager
+
+from test_chaos_harness import assert_snapshots_equal, make_stream
+
+
+def _delta_worker(spool_dir, workdir, *, dup_p=0.0, seed=0, compact_every=0,
+                  max_retries=2, flight=False):
+    """The chaos child's wiring in-process: real WorkerApp, atLeastOnce,
+    delta-chain checkpoints over a spool transport."""
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+    from apmbackend_tpu.testing.chaos import ChaosChannel
+
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 64
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["checkpointMode"] = "delta"
+    eng["checkpointChainDir"] = os.path.join(workdir, "chain")
+    eng["checkpointCompactEveryEpochs"] = compact_every
+    eng["checkpointWriteMaxRetries"] = max_retries
+    eng["checkpointWriteRetryBaseSeconds"] = 0.01
+    eng["checkpointWriteRetryMaxSeconds"] = 0.05
+    eng["resumeFileFullPath"] = None
+    cfg["streamCalcZScore"]["defaults"] = [{"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = 3600  # manual commits
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+    if flight:
+        cfg["observability"]["flightDir"] = os.path.join(workdir, "flight")
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False, console_log=False)
+    spools = {}
+
+    def factory(direction):
+        ch = SpoolChannel(spool_dir)
+        spools[direction] = ch
+        if direction == "c" and dup_p:
+            return ChaosChannel(ch, dup_p=dup_p, seed=seed)
+        return ch
+
+    rt.qm = QueueManager(factory, 3600, logger=rt.logger)
+    worker = WorkerApp(rt)
+    return worker, rt, spools["c"]
+
+
+def _feed_spool(spool_dir, lines, start_seq=0):
+    prod = SpoolChannel(spool_dir)
+    for n, line in enumerate(lines, start=start_seq + 1):
+        prod.send(
+            "transactions", line.encode("utf-8"),
+            {"ingest_ts": time.time(), "msg_id": f"h-{n}"},
+        )
+    prod.close()
+
+
+def _golden_full_snapshot(tmp_path, lines):
+    """A crash-free FULL-mode worker run: the cross-representation oracle."""
+    from test_chaos_harness import _spool_worker
+
+    gdir = str(tmp_path / "golden")
+    gres = str(tmp_path / "golden.npz")
+    _feed_spool(gdir, lines)
+    w, rt, spool = _spool_worker(gdir, gres)
+    n = 0
+    while n < len(lines):
+        n += spool.deliver(50)
+    w.save_state()
+    assert spool.acked_count("transactions") == len(lines)
+    rt.stop_timers()
+    spool.stop()
+    return gres
+
+
+def _export_snapshot(worker, path):
+    with worker._driver_lock:
+        worker.driver.save_resume(path)
+    return path
+
+
+# -- fast tier ---------------------------------------------------------------
+
+
+def test_in_process_delta_crash_equivalence(tmp_path):
+    """Delta-mode epoch cycle, crash (no shutdown), restart from the chain:
+    final state equals a crash-free FULL-mode run bit-for-bit."""
+    lines = make_stream(n_labels=5, per_label=60)
+    gres = _golden_full_snapshot(tmp_path, lines)
+
+    cdir = str(tmp_path / "chaos")
+    wdir = str(tmp_path / "chaoswork")
+    os.makedirs(wdir, exist_ok=True)
+    _feed_spool(cdir, lines)
+    w1, rt1, spool1 = _delta_worker(cdir, wdir, dup_p=0.15, seed=11)
+    delivered = 0
+    while delivered < 120:
+        delivered += spool1.deliver(30)
+        if delivered == 60:
+            w1.save_state()  # one committed epoch
+    committed = spool1.acked_count("transactions")
+    assert committed > 0
+    rt1.stop_timers()
+    spool1.stop()  # SIGKILL stand-in: no flush, no save, no acks
+
+    w2, rt2, spool2 = _delta_worker(cdir, wdir, dup_p=0.15, seed=12)
+    assert w2._delivery_epoch >= 1  # chain seeded the watermark
+    n = spool2.delivered_count("transactions")
+    assert n == committed  # redelivery starts AT the cursor: zero loss
+    while n < len(lines):
+        n += spool2.deliver(50)
+    w2.save_state()
+    assert spool2.acked_count("transactions") == len(lines)
+    cres = _export_snapshot(w2, str(tmp_path / "chaos.npz"))
+    rt2.stop_timers()
+    spool2.stop()
+    assert_snapshots_equal(gres, cres)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "garbage", "header"])
+def test_torn_tail_before_ack_recovers_and_redelivers(tmp_path, mode):
+    """Crash tears the final segment AFTER the rename but BEFORE the ack
+    (the non-atomic-storage window): recovery falls back one epoch, the
+    broker redelivers the whole torn epoch, dedup absorbs what the
+    surviving window knows, and the final state still equals golden."""
+    lines = make_stream(n_labels=4, per_label=50)
+    gres = _golden_full_snapshot(tmp_path, lines)
+
+    cdir = str(tmp_path / "spool")
+    wdir = str(tmp_path / "work")
+    os.makedirs(wdir, exist_ok=True)
+    chain_dir = os.path.join(wdir, "chain")
+    _feed_spool(cdir, lines)
+    w1, rt1, spool1 = _delta_worker(cdir, wdir)
+    n = 0
+    while n < 100:
+        n += spool1.deliver(25)
+    w1.save_state()  # committed + acked epoch
+    while n < len(lines):
+        n += spool1.deliver(50)
+    # commit WITHOUT ack: the crash window between segment rename and ack
+    with w1._driver_lock:
+        w1._drain_alo_pending_locked()
+        w1.driver.flush()
+        w1.driver.save_resume_delta(
+            w1._ckpt_chain,
+            delivery_delta={"transactions": {
+                "epoch": w1._delivery_epoch + 1,
+                "added": list(w1._dedup_added_epoch),
+                "evicted": w1._dedup_evicted_epoch,
+                "deduped_total": w1._deduped_total,
+            }},
+        )
+    torn_epoch = w1._ckpt_chain.tail_epoch
+    rt1.stop_timers()
+    spool1.stop()  # crash: the ack never happened
+
+    # hostile storage tears the just-renamed tail
+    seg = os.path.join(chain_dir, f"delta-{torn_epoch:012d}.seg")
+    blob = open(seg, "rb").read()
+    if mode == "truncate":
+        open(seg, "wb").write(blob[: len(blob) // 2])
+    elif mode == "garbage":
+        mid = len(blob) // 2  # 0xA5: never a no-op over real segment bytes
+        open(seg, "wb").write(blob[:mid] + b"\xa5" * 16 + blob[mid + 16 :])
+    else:
+        open(seg, "wb").write(blob[:13])
+
+    w2, rt2, spool2 = _delta_worker(cdir, wdir)
+    assert w2._ckpt_chain.tail_epoch == torn_epoch - 1  # fell back cleanly
+    n = spool2.delivered_count("transactions")
+    while n < len(lines):
+        n += spool2.deliver(50)
+    w2.save_state()
+    assert spool2.acked_count("transactions") == len(lines)
+    cres = _export_snapshot(w2, str(tmp_path / "chaos.npz"))
+    rt2.stop_timers()
+    spool2.stop()
+    assert_snapshots_equal(gres, cres)
+
+
+def test_enospc_degradation_pauses_intake_then_recovers(tmp_path):
+    """Persistent write failure → bounded jittered retries → DEGRADED:
+    flight bundle, operator alert, intake paused (healthz 503, counter up)
+    — and a later successful write resumes intake and converges to golden.
+    Never a crash loop."""
+    lines = make_stream(n_labels=4, per_label=40)
+    gres = _golden_full_snapshot(tmp_path, lines)
+
+    cdir = str(tmp_path / "spool")
+    wdir = str(tmp_path / "work")
+    os.makedirs(wdir, exist_ok=True)
+    _feed_spool(cdir, lines)
+    w, rt, spool = _delta_worker(cdir, wdir, max_retries=2, flight=True)
+    n = 0
+    while n < 80:
+        n += spool.deliver(20)
+    try:
+        install_fault_plan(StorageFaultPlan("enospc:after=0,count=99999"))
+        w.save_state(force=True)  # failure 1
+        assert w._ckpt_fail_streak == 1 and not w._ckpt_degraded
+        w.save_state(force=True)  # failure 2 == checkpointWriteMaxRetries
+        assert w._ckpt_degraded
+        assert w._ckpt_failures_total == 2
+        assert spool.acked_count("transactions") == 0  # nothing acked un-durably
+        health = w._health()
+        assert health["ok"] is False
+        assert health["checkpoint"]["degraded"] is True
+        # intake paused: the consumer is cancelled until a write lands
+        assert not spool._consumers
+        # the flight recorder captured the wreckage before the fallback
+        bundles = [p for p, b in _bundles(w) if "checkpoint_write_failure" in p]
+        assert bundles
+        # ... and the retry loop keeps going instead of crash-looping
+        w.save_state(force=True)
+        assert w._ckpt_failures_total == 3 and w._ckpt_degraded
+    finally:
+        install_fault_plan(None)
+
+    w.save_state(force=True)  # storage recovered: commit + un-degrade
+    assert not w._ckpt_degraded and w._ckpt_fail_streak == 0
+    assert w._health()["ok"] is True
+    assert spool._consumers  # intake resumed
+    assert spool.acked_count("transactions") > 0
+    n = spool.delivered_count("transactions")
+    while n < len(lines):
+        n += spool.deliver(50)
+    w.save_state()
+    assert spool.acked_count("transactions") == len(lines)
+    cres = _export_snapshot(w, str(tmp_path / "chaos.npz"))
+    rt.stop_timers()
+    spool.stop()
+    assert_snapshots_equal(gres, cres)
+
+
+def _bundles(worker):
+    from apmbackend_tpu.obs.flight import list_bundles
+
+    return list_bundles(worker.runtime.flight.directory)
+
+
+def test_degraded_worker_counts_failures_in_metrics(tmp_path):
+    """apm_checkpoint_* series reflect the failure/degradation state."""
+    lines = make_stream(n_labels=2, per_label=20)
+    cdir = str(tmp_path / "spool")
+    wdir = str(tmp_path / "work")
+    os.makedirs(wdir, exist_ok=True)
+    _feed_spool(cdir, lines)
+    w, rt, spool = _delta_worker(cdir, wdir, max_retries=1)
+    spool.deliver()
+    try:
+        install_fault_plan(StorageFaultPlan("enospc:after=0,count=99999"))
+        w.save_state(force=True)
+        samples = {s.name: s.value for s in w._collect_metrics()}
+        assert samples["apm_checkpoint_write_failures_total"] == 1
+        assert samples["apm_checkpoint_degraded"] == 1
+    finally:
+        install_fault_plan(None)
+    w.save_state(force=True)
+    samples = {s.name: s.value for s in w._collect_metrics()}
+    assert samples["apm_checkpoint_degraded"] == 0
+    assert samples["apm_checkpoint_chain_epoch"] == w._ckpt_chain.tail_epoch
+    rt.stop_timers()
+    spool.stop()
+
+
+# -- slow tier: real subprocesses --------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill9_delta_vs_full_golden_subprocess(tmp_path):
+    """THE delta acceptance scenario: SIGKILL a delta-mode worker twice
+    mid-stream under duplicate injection (with live compaction every 4
+    epochs), and the final state equals a crash-free FULL-mode golden run
+    bit-identically — cross-representation equivalence."""
+    lines = make_stream(n_labels=10, per_label=120)
+
+    golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=1)
+    for line in lines:
+        golden.send_line(line)
+    golden.start()
+    stats_g = golden.finish(timeout_s=240)
+    golden.close()
+    assert stats_g["acked"] == len(lines)
+
+    chaos = ChaosWorkerHarness(
+        str(tmp_path / "chaos"), dup_p=0.08, seed=7,
+        checkpoint_mode="delta", compact_every=4,
+    )
+    for line in lines:
+        chaos.send_line(line)
+    chaos.start()
+    chaos.wait_acked(len(lines) // 3)
+    chaos.kill9()
+    first_cursor = chaos.acked()
+    chaos.start()
+    chaos.wait_acked(min(len(lines), first_cursor + len(lines) // 3))
+    chaos.kill9()
+    assert chaos.acked() >= first_cursor  # the cursor never regresses
+    chaos.start()
+    stats_c = chaos.finish(timeout_s=240)
+    chaos.close()
+
+    assert stats_c["acked"] == len(lines)  # zero message loss
+    assert stats_c["chain_epoch"] >= stats_c["epoch"]
+    assert stats_c["latest_label"] == stats_g["latest_label"]
+    assert_snapshots_equal(golden.resume_path, chaos.resume_path)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", ["pre_base", "pre_manifest"])
+def test_crash_during_compaction_subprocess(tmp_path, point):
+    """Deterministic SIGKILL inside the compaction window (before the new
+    base lands / after it lands but before the MANIFEST swap): the restart
+    recovers through the surviving generation and converges bit-identically
+    to the FULL-mode golden run."""
+    lines = make_stream(n_labels=8, per_label=100)
+
+    golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=2)
+    for line in lines:
+        golden.send_line(line)
+    golden.start()
+    stats_g = golden.finish(timeout_s=240)
+    golden.close()
+
+    chaos = ChaosWorkerHarness(
+        str(tmp_path / "chaos"), dup_p=0.0, seed=3,
+        checkpoint_mode="delta", compact_every=3,
+        fault_env={1: f"kill:compact={point}"},
+    )
+    for line in lines:
+        chaos.send_line(line)
+    chaos.start()
+    rc = chaos.wait_child_death(timeout_s=120)  # the fault plan kills gen 1
+    assert rc != 0
+    chaos.start()  # gen 2: no faults, finishes the stream (and compacts)
+    stats_c = chaos.finish(timeout_s=240)
+    chaos.close()
+    assert stats_c["acked"] == len(lines)
+    assert stats_c["latest_label"] == stats_g["latest_label"]
+    assert_snapshots_equal(golden.resume_path, chaos.resume_path)
+
+
+@pytest.mark.slow
+def test_enospc_under_epoch_timer_subprocess(tmp_path):
+    """ENOSPC injected under the REAL epoch timer: the child retries with
+    jittered backoff, commits once the 'disk' clears, and the run converges
+    with the failure counted — no kill, no crash loop, no loss."""
+    lines = make_stream(n_labels=6, per_label=80)
+
+    golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=4)
+    for line in lines:
+        golden.send_line(line)
+    golden.start()
+    golden.finish(timeout_s=240)
+    golden.close()
+
+    chaos = ChaosWorkerHarness(
+        str(tmp_path / "chaos"), dup_p=0.0, seed=5,
+        checkpoint_mode="delta",
+        fault_env="enospc:after=2,count=3",
+    )
+    for line in lines:
+        chaos.send_line(line)
+    chaos.start()
+    stats_c = chaos.finish(timeout_s=240)
+    chaos.close()
+    assert stats_c["acked"] == len(lines)
+    assert stats_c["checkpoint_write_failures"] >= 1
+    assert_snapshots_equal(golden.resume_path, chaos.resume_path)
+
+
+@pytest.mark.slow
+def test_stale_dup_tail_subprocess(tmp_path):
+    """Duplicate chain tail after kill−9: a leftover future-named segment
+    from the dead generation must be ignored by the restarted child, which
+    then overwrites it with its own commits and converges."""
+    lines = make_stream(n_labels=6, per_label=80)
+    golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=6)
+    for line in lines:
+        golden.send_line(line)
+    golden.start()
+    golden.finish(timeout_s=240)
+    golden.close()
+
+    chaos = ChaosWorkerHarness(
+        str(tmp_path / "chaos"), dup_p=0.05, seed=8, checkpoint_mode="delta",
+    )
+    for line in lines:
+        chaos.send_line(line)
+    chaos.start()
+    chaos.wait_acked(len(lines) // 3)
+    chaos.kill9()
+    chaos.corrupt_chain_tail("stale-dup")  # dead incarnation's leftover
+    chaos.start()
+    stats_c = chaos.finish(timeout_s=240)
+    chaos.close()
+    assert stats_c["acked"] == len(lines)
+    assert_snapshots_equal(golden.resume_path, chaos.resume_path)

@@ -1,0 +1,101 @@
+"""SpoolChannel durability audit (ISSUE 7 satellite).
+
+The spool's cursor is the broker-side commit record the whole kill−9 story
+rests on: it must be atomic under SIGKILL at any byte (tmp + rename), its
+tmp must not be shareable with a zombie predecessor process (pid suffix),
+and a torn leftover must never corrupt recovery.
+"""
+
+import json
+import os
+
+import pytest
+
+from apmbackend_tpu.transport.spool import SpoolChannel, _SpoolQueue, read_spool_cursor
+
+
+def _fill(tmp_path, n=5):
+    ch = SpoolChannel(str(tmp_path))
+    for i in range(n):
+        ch.send("q", f"m{i}".encode(), {"msg_id": f"h-{i}"})
+    got = []
+    ch.consume("q", lambda p, h, tok: got.append(tok), "t", manual_ack=True)
+    ch.deliver()
+    return ch, got
+
+
+def test_cursor_persist_is_atomic_against_crash_midwrite(tmp_path, monkeypatch):
+    """SIGKILL between tmp write and rename == os.replace never ran: the
+    cursor file must still hold the PREVIOUS committed value, and the torn
+    tmp must be ignored by the next boot."""
+    ch, tokens = _fill(tmp_path)
+    ch.ack(tokens[:2])
+    assert read_spool_cursor(str(tmp_path), "q") == 2
+
+    real_replace = os.replace
+
+    def crash_before_rename(src, dst):
+        raise RuntimeError("SIGKILL stand-in: process died before the rename")
+
+    monkeypatch.setattr(os, "replace", crash_before_rename)
+    with pytest.raises(RuntimeError):
+        ch.ack(tokens[2:4])
+    monkeypatch.setattr(os, "replace", real_replace)
+    # old cursor intact; the torn tmp exists but is ignored on recovery
+    assert read_spool_cursor(str(tmp_path), "q") == 2
+    tmps = [n for n in os.listdir(tmp_path) if ".tmp" in n]
+    assert tmps, "expected the torn tmp left behind by the crash"
+    q2 = _SpoolQueue(str(tmp_path), "q")
+    assert q2.acked_upto == 2  # redelivery restarts at the committed cursor
+    ch.close()
+
+
+def test_cursor_tmp_is_pid_suffixed(tmp_path, monkeypatch):
+    """Regression: the pre-audit constant ``<cursor>.tmp`` name let a
+    not-quite-dead predecessor interleave writes into the SAME tmp file a
+    restarted consumer was committing through."""
+    seen = []
+    real_replace = os.replace
+
+    def spy(src, dst):
+        seen.append(src)
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", spy)
+    ch, tokens = _fill(tmp_path)
+    ch.ack(tokens)
+    assert seen and all(f".{os.getpid()}.tmp" in s for s in seen)
+    ch.close()
+
+
+def test_torn_cursor_json_redelivers_from_zero(tmp_path):
+    ch, tokens = _fill(tmp_path)
+    ch.ack(tokens)
+    ch.close()
+    cursor = os.path.join(str(tmp_path), "q.cursor")
+    open(cursor, "w").write('{"acked": ')  # torn JSON
+    assert read_spool_cursor(str(tmp_path), "q") == 0
+    q = _SpoolQueue(str(tmp_path), "q")
+    assert q.acked_upto == 0  # safe: redeliver everything, dedup absorbs
+
+
+def test_fsync_knob(tmp_path):
+    """fsync=True hardens cursor + spool appends; semantics unchanged."""
+    ch = SpoolChannel(str(tmp_path), fsync=True)
+    for i in range(3):
+        ch.send("q", f"m{i}".encode(), {"msg_id": f"h-{i}"})
+    toks = []
+    ch.consume("q", lambda p, h, tok: toks.append(tok), "t", manual_ack=True)
+    ch.deliver()
+    ch.ack(toks)
+    assert read_spool_cursor(str(tmp_path), "q") == 3
+    assert json.load(open(os.path.join(str(tmp_path), "q.cursor")))["acked"] == 3
+    ch.close()
+
+
+def test_testing_chaos_reexport():
+    """Moved to transport/spool.py; the old import path keeps working."""
+    from apmbackend_tpu.testing import chaos
+
+    assert chaos.SpoolChannel is SpoolChannel
+    assert chaos.read_spool_cursor is read_spool_cursor
