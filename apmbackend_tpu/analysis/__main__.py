@@ -1,8 +1,20 @@
 """CLI: ``python -m apmbackend_tpu.analysis`` — the static-correctness gate.
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error. ``run_tests.sh
---lint`` runs this over the repo as a hard requirement; the tier-1 suite
-additionally asserts a clean run (tests/test_analysis.py).
+Exit codes: 0 clean, 1 findings or a protocol model violation, 2 usage/
+internal error. ``run_tests.sh --lint`` runs this over the repo as a hard
+requirement; the tier-1 suite additionally asserts a clean run
+(tests/test_analysis.py).
+
+Beyond the AST rules, the gate runs the protocol model checker
+(``analysis/protocol/``): ``--models small`` (the default when analyzing
+the whole repo) exhaustively verifies the delivery, delta-chain, and
+sharded-epoch protocols at the documented small scopes in well under the
+10 s budget; ``--models deep`` is the ``run_tests.sh --model`` tier;
+``--models mutants`` additionally requires a counterexample from every
+seeded protocol bug. A violated model prints its counterexample schedule
+and fails the gate exactly like a finding. ``--json`` emits a single
+object ``{"findings": [...], "model_checks": [...], "mutants": [...]}``
+for CI annotation.
 """
 
 from __future__ import annotations
@@ -15,11 +27,22 @@ from .core import Project, RULES, run_analysis
 from . import core as _core
 
 
+def _run_models(tier: str):
+    from .protocol import run_model_checks, verify_mutants
+
+    results = run_model_checks("deep" if tier == "deep" else "small")
+    mutants = verify_mutants() if tier in ("mutants", "deep") else []
+    return results, mutants
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m apmbackend_tpu.analysis",
-        description="AST static analysis: JAX hot-path, lock discipline, "
-                    "config keys, metric catalogue, pyflakes-lite.",
+        description="AST static analysis + protocol model checking: JAX "
+                    "hot-path, lock discipline, config keys, metric "
+                    "catalogue, transport headers, durability discipline, "
+                    "pyflakes-lite, and exhaustive small-scope verification "
+                    "of the delivery/delta-chain/sharded-epoch protocols.",
     )
     ap.add_argument("--root", default=None,
                     help="repo root (default: auto-detected from the package)")
@@ -27,8 +50,12 @@ def main(argv=None) -> int:
                     help="comma-separated rule subset (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print rule names + descriptions and exit")
+    ap.add_argument("--models", default=None,
+                    choices=("off", "small", "deep", "mutants"),
+                    help="protocol model-check tier (default: small for a "
+                         "full-rule run, off when --rules selects a subset)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable findings")
+                    help="machine-readable findings + model verdicts")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)
@@ -43,6 +70,9 @@ def main(argv=None) -> int:
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    models = args.models
+    if models is None:
+        models = "off" if rules is not None else "small"
     try:
         project = Project(root=args.root)
         findings = run_analysis(project, rules=rules)
@@ -50,17 +80,45 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    model_results, mutant_results = [], []
+    if models != "off":
+        model_results, mutant_results = _run_models(models)
+    bad_models = [r for r in model_results if not r.ok]
+    bad_mutants = [(n, d, r) for n, d, r in mutant_results if r.ok]
+
     if args.as_json:
-        print(json.dumps([f.__dict__ for f in findings], indent=1))
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "model_checks": [r.to_dict() for r in model_results],
+            "mutants": [
+                {"name": n, "description": d, "counterexample_found": not r.ok,
+                 "schedule_steps": max(0, len(r.schedule) - 1),
+                 "states": r.states}
+                for n, d, r in mutant_results
+            ],
+        }, indent=1))
     elif not args.quiet:
         for f in findings:
             print(f.format())
+        for r in bad_models:
+            print(r.format_schedule())
+        for n, _d, r in bad_mutants:
+            print(f"mutant {n}: NO counterexample found ({r.states} states) "
+                  f"— the checker lost its teeth for this bug class")
+
     n_files = len(project.files)
     n_rules = len(rules) if rules is not None else len(RULES)
-    status = "clean" if not findings else f"{len(findings)} finding(s)"
-    print(f"analysis: {n_files} files, {n_rules} rules — {status}",
-          file=sys.stderr)
-    return 1 if findings else 0
+    parts = [f"{n_files} files", f"{n_rules} rules"]
+    if models != "off":
+        total_states = sum(r.states for r in model_results)
+        parts.append(f"{len(model_results)} protocol models "
+                     f"({models}, {total_states} states)")
+        if mutant_results:
+            parts.append(f"{len(mutant_results)} mutants")
+    bad = len(findings) + len(bad_models) + len(bad_mutants)
+    status = "clean" if not bad else f"{bad} finding(s)"
+    print(f"analysis: {', '.join(parts)} — {status}", file=sys.stderr)
+    return 1 if bad else 0
 
 
 if __name__ == "__main__":

@@ -210,8 +210,10 @@ def rule(name: str, description: str) -> Callable[[RuleFn], RuleFn]:
 
 def _register_builtin_rules() -> None:
     # imported for their @rule side effects; late import breaks the cycle
-    from . import configkeys, jaxrules, locks, metriccat, pyflakes_lite
-    _ = (configkeys, jaxrules, locks, metriccat, pyflakes_lite)
+    from . import (configkeys, durability, jaxrules, locks, metriccat,
+                   pyflakes_lite, transport_headers)
+    _ = (configkeys, durability, jaxrules, locks, metriccat,
+         pyflakes_lite, transport_headers)
 
 
 def run_analysis(

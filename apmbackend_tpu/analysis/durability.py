@@ -1,0 +1,145 @@
+"""Durability discipline: no raw writes under crash-consistent directories.
+
+Every crash-consistency proof in this repo (delta-chain recovery, spool
+cursor atomicity, flight-journal promotion) rests on ONE idiom: write a
+tmp file, optionally fsync, then ``os.replace`` onto the final name — the
+rename is the commit. A raw ``open(path, "w")`` or a bare rename on a
+path under a checkpoint/spool/flight directory bypasses that idiom, and
+the failure it introduces (a torn file AT the committed name) is exactly
+the one the recovery walks cannot always detect. PR 7's durability audit
+found the spool cursor's shared-tmp bug by hand; this rule makes the
+discipline machine-checked.
+
+Mechanics:
+
+- a *durable write* is ``open(..., "w"/"wb"/...)`` (or ``os.fdopen`` with
+  a write mode), ``os.rename`` or ``os.replace`` whose path expression
+  mentions a durability-flavored token (spool/cursor/chain/manifest/
+  checkpoint/resume/flight/journal/sentinel/.seg/.npz) — or ANY such call
+  inside the modules that own durable state (deltachain, transport/spool,
+  obs/flight, utils/resume);
+- the *sanctioned atomic-writer* exemption: a function whose body
+  renames/replaces FROM a tmp name (``os.replace(tmp, path)``) is an
+  atomic commit helper — its open-the-tmp and rename calls are the idiom
+  itself. Everything else is a finding: fix it, or carry an explicit
+  ``# apm: allow(durability-discipline): <reason>`` (the chaos harness's
+  deliberate corruption injectors do).
+
+Append-mode opens are NOT flagged: append-only journals with record
+framing (the spool, the protocol event log) are a legitimate second
+discipline — torn tails there are detected by the reader, not prevented
+by rename.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from .core import Finding, Project, rule
+
+_PATH_TOKEN_RE = re.compile(
+    r"(spool|cursor|chain|manifest|checkpoint|resume|flight|journal|"
+    r"sentinel|seg|\.npz)", re.IGNORECASE)
+
+# modules whose whole job is durable state: every write-ish call in them
+# is in scope regardless of what the path expression looks like
+_DURABILITY_MODULES = (
+    "deltachain.py", "transport/spool.py", "obs/flight.py",
+    "utils/resume.py",
+)
+
+
+def _is_os_call(node: ast.Call, name: str) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == name
+            and isinstance(f.value, ast.Name) and f.value.id == "os")
+
+
+def _write_call(node: ast.Call) -> Optional[ast.AST]:
+    """The path expression of a durable-write call, or None."""
+    f = node.func
+    if (isinstance(f, ast.Name) and f.id == "open") or \
+            (isinstance(f, ast.Attribute) and f.attr == "fdopen"
+             and isinstance(f.value, ast.Name) and f.value.id == "os"):
+        if (len(node.args) >= 2 and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and node.args[1].value.startswith(("w", "x"))):
+            return node.args[0]
+        return None
+    if _is_os_call(node, "rename") or _is_os_call(node, "replace"):
+        # the destination is the committed name; the source tells us
+        # whether this is the sanctioned tmp->final commit
+        return node.args[1] if len(node.args) >= 2 else None
+    return None
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def _string_payload(node: ast.AST) -> str:
+    """All string constants inside an expression (f-string parts, concat
+    pieces) — the path evidence the relevance regex runs over."""
+    parts = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            parts.append(n.value)
+    return " ".join(parts)
+
+
+def _atomic_writer_functions(tree: ast.Module) -> List[ast.AST]:
+    """Functions containing an ``os.replace/rename`` whose SOURCE operand
+    mentions tmp — the sanctioned atomic-commit helpers."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and (
+                    _is_os_call(sub, "replace") or _is_os_call(sub, "rename")):
+                if sub.args and "tmp" in _expr_text(sub.args[0]).lower():
+                    out.append(node)
+                    break
+    return out
+
+
+@rule("durability-discipline",
+      "raw writes/renames on durable paths outside atomic tmp+rename helpers")
+def check_durability(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        rel_posix = sf.rel.replace("\\", "/")
+        owner_module = any(rel_posix.endswith(m) for m in _DURABILITY_MODULES)
+        sanctioned_spans = [
+            (fn.lineno, max(getattr(fn, "end_lineno", fn.lineno), fn.lineno))
+            for fn in _atomic_writer_functions(sf.tree)
+        ]
+
+        def inside_sanctioned(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi in sanctioned_spans)
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            path = _write_call(node)
+            if path is None:
+                continue
+            text = _expr_text(path) + " " + _string_payload(node)
+            if not (owner_module or _PATH_TOKEN_RE.search(text)):
+                continue
+            if inside_sanctioned(node.lineno):
+                continue
+            kind = ("rename" if isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("rename", "replace") else "open-for-write")
+            findings.append(Finding(
+                "durability-discipline", sf.rel, node.lineno,
+                f"raw {kind} on a durable path ({_expr_text(path)[:60]}) "
+                f"outside a sanctioned atomic tmp+rename helper — a crash "
+                f"here leaves a torn file at a committed name; use the "
+                f"tmp+fsync+os.replace idiom or pragma with a reason"))
+    return findings

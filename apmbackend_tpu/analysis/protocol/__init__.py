@@ -1,0 +1,87 @@
+"""Protocol model checker: exhaustive small-scope verification of the
+delivery, delta-chain, and sharded-epoch protocols.
+
+The chaos harness (PR 3/7) SAMPLES interleavings of the at-least-once
+epoch cycle and the delta-chain recovery; the static plane (PR 6) checks
+structure. Neither enumerates schedules — and the one real loss bug the
+harness caught (the dup-of-uncommitted-message ack) was caught by luck.
+This package checks the protocols themselves: stdlib-only explicit-state
+models (checker.py BFS, canonical state hashing, shortest-counterexample
+schedules) of
+
+- the **ALO epoch cycle** (:mod:`.alo`) across the memory / AMQP / spool
+  ledger semantics — producer msg_id stamping, unacked ledger, bounded
+  persisted dedup window, crash/bounce/duplicate at every step;
+- the **delta-chain commit/recovery protocol** (:mod:`.deltamodel`) —
+  tmp+rename commits, uid linkage, background compaction with
+  keep-one-generation GC, torn/stale/forged tails, base rot;
+- **sharded epochs** (:mod:`.shardmodel`) — the pod-scale spine
+  pre-verified: per-shard cycles over service-hash partitions, quiesced
+  rebalance handoff, fleet-level exactly-once + owner-locality.
+
+Two tiers of scope: ``small`` runs inside ``run_tests.sh --lint`` and
+tier-1 (< 10 s, a hard gate), ``deep`` behind ``run_tests.sh --model``.
+The checker proves it can fail via :mod:`.mutations` — every seeded
+protocol bug must yield a human-readable counterexample schedule.
+
+Conformance to the real implementation is pinned two ways: the models
+mirror named functions (each model docstring cites its code), and
+:mod:`.conformance` replays protocol event logs emitted by the REAL
+worker (``tpuEngine.protocolEventLog``) — including kill−9 chaos runs —
+as paths of the models.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .alo import AloModel
+from .checker import CheckResult, check
+from .conformance import check_protocol_trace, read_event_log
+from .deltamodel import DeltaChainModel
+from .mutations import BOUNDARY_MUTANTS, MUTANTS, verify_mutants
+from .shardmodel import ShardedEpochModel
+
+# The verified scopes. Documented in DESIGN.md §9.4 — "verified" always
+# means "at these bounds": N messages, window W, fault budgets per run.
+SCOPES = {
+    "small": [
+        # ~15k states total, well under a second — the --lint gate
+        lambda: AloModel(kind="memory"),
+        lambda: AloModel(kind="amqp"),
+        lambda: AloModel(kind="spool"),
+        lambda: DeltaChainModel(),
+        lambda: ShardedEpochModel(),
+    ],
+    "deep": [
+        # minutes-scale exhaustive sweep — the --model tier
+        lambda: AloModel(kind="memory", n_msgs=4, crashes=2, bounces=2, dups=2),
+        lambda: AloModel(kind="amqp", n_msgs=4, crashes=2, bounces=2, dups=2),
+        lambda: AloModel(kind="spool", n_msgs=4, crashes=2, dups=2),
+        lambda: AloModel(kind="memory", n_msgs=3, window=3, crashes=3,
+                         bounces=2, dups=2),
+        lambda: DeltaChainModel(max_epochs=6, crashes=3, corrupts=2,
+                                compacts=2),
+        lambda: ShardedEpochModel(n_msgs=3, crashes=2, bounces=1, dups=2,
+                                  rebalances=2),
+        lambda: ShardedEpochModel(n_shards=3, n_msgs=3, crashes=1,
+                                  bounces=1, dups=1, rebalances=1),
+    ],
+}
+
+
+def run_model_checks(tier: str = "small") -> List[CheckResult]:
+    """Check every protocol model at the named tier's scopes. All results
+    must have ``ok`` — a violation is a protocol bug (or a model drift)
+    and fails the gate exactly like an analyzer finding."""
+    if tier not in SCOPES:
+        raise ValueError(f"unknown model-check tier {tier!r} "
+                         f"(expected one of {sorted(SCOPES)})")
+    return [check(factory()) for factory in SCOPES[tier]]
+
+
+__all__ = [
+    "AloModel", "DeltaChainModel", "ShardedEpochModel", "CheckResult",
+    "check", "run_model_checks", "SCOPES", "MUTANTS", "BOUNDARY_MUTANTS",
+    "verify_mutants", "check_protocol_trace", "read_event_log",
+]

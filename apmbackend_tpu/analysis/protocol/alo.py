@@ -1,0 +1,306 @@
+"""Model of the at-least-once epoch cycle (runtime/worker.py + transports).
+
+One model, three broker semantics (``kind``):
+
+- ``memory`` — the MemoryBroker unacked ledger: tokens settle atomically
+  under the broker lock; crash/bounce requeues every unacked delivery at
+  the FRONT of the queue in original order (transport/memory.py
+  ``requeue_unacked``).
+- ``amqp`` — same ledger shape, but acks land ONE AT A TIME on the
+  consumer thread (``basic_ack`` marshalling), so a crash can interleave
+  a half-acked epoch; stale-generation tokens are dropped (the
+  ``_conn_gen`` stamp in transport/amqp.py).
+- ``spool`` — the durable cursor (transport/spool.py): acks advance a
+  contiguous committed cursor; crash rewinds delivery to the cursor (no
+  broker ledger object survives, the file is the ledger).
+
+The worker side is the epoch cycle verbatim: accept (dedup against the
+in-memory window, msg joins the bounded FIFO window, line joins the
+pending feed buffer, token joins the epoch), drain (bulk feed: pending →
+volatile engine state), commit (drain, then persist volatile state + the
+dedup window atomically, then ack the epoch's tokens — the persist/ack
+boundary is exposed so a crash can land between them), crash (volatile
+state lost, durable checkpoint restored, broker redelivers), bounce
+(broker restart only: worker memory survives, ledger requeues), and
+chaos duplicate delivery (same payload+msg_id+token replayed — the
+ChaosChannel ``dup_p`` seam).
+
+Invariants (checked at EVERY reachable state):
+
+- **no-double-effect**: no message's effect appears twice in durable
+  state.
+- **ack-implies-durable** (= no-loss): a message the broker has settled
+  (gone from queue+ledger / behind the spool cursor) must have its effect
+  in the durable checkpoint.
+
+Scope preconditions the model makes explicit (and DESIGN.md §9.4
+documents): the broker prefetch bound must not exceed the dedup window
+size — in-flight deliveries are capped at ``prefetch`` (basic_qos / the
+spool prefetch), which is what keeps every unacked message's id inside
+the bounded window. The FRONT-requeue order is also load-bearing: the
+``alo-requeue-at-back`` mutant shows a broker that requeues at the back
+can push a redelivered id out of the window before it is re-seen.
+
+Mutations (seeded protocol bugs — see mutations.py for the catalogue):
+``ack_before_persist``, ``dup_ack_early`` (the real PR 3 bug),
+``evict_on_persist``, ``skip_drain``, ``ack_on_failed_write``,
+``window_not_restored``, ``requeue_back``.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Iterator, Optional, Tuple
+
+# sent:     messages published so far (ids 0..sent-1, FIFO)
+# queue:    broker queue of msg ids (memory/amqp; spool delivers by index)
+# ledger:   unacked deliveries, delivery order: tuple of (gen, msg)
+# gen:      broker connection generation (stale-token discriminator)
+# cursor:   spool committed cursor (settled = idx < cursor)
+# ndeliv:   spool next delivery index
+# abeyond:  spool acked-but-not-contiguous indices (in-memory, lost on crash)
+# window:   in-memory dedup window, FIFO of msg ids, max W
+# pwindow:  dedup window persisted in the last durable checkpoint
+# pending:  accepted-not-yet-fed msgs (the _alo_pending buffer), sorted
+# vol:      per-msg volatile effect counts (engine state incl. restores)
+# dur:      per-msg durable effect counts (the checkpoint)
+# tokens:   current epoch's unacked tokens, sorted
+# to_ack:   tokens persisted-but-not-yet-acked (the commit→ack window)
+# crashes/bounces/dups/wfails: remaining fault budgets
+S = namedtuple(
+    "S",
+    "sent queue ledger gen cursor ndeliv abeyond window pwindow pending "
+    "vol dur tokens to_ack crashes bounces dups wfails",
+)
+
+_MUTATIONS = frozenset({
+    "ack_before_persist", "dup_ack_early", "evict_on_persist", "skip_drain",
+    "ack_on_failed_write", "window_not_restored", "requeue_back",
+})
+
+
+class AloModel:
+    def __init__(self, *, kind: str = "memory", n_msgs: int = 3,
+                 window: int = 2, prefetch: Optional[int] = None,
+                 crashes: int = 1, bounces: int = 1, dups: int = 1,
+                 wfails: int = 0, mutations: Tuple[str, ...] = ()):
+        if kind not in ("memory", "amqp", "spool"):
+            raise ValueError(f"unknown broker kind {kind!r}")
+        bad = set(mutations) - _MUTATIONS
+        if bad:
+            raise ValueError(f"unknown mutations: {sorted(bad)}")
+        self.kind = kind
+        self.n = n_msgs
+        self.w = window
+        self.prefetch = window if prefetch is None else prefetch
+        self.crashes = crashes
+        self.bounces = 0 if kind == "spool" else bounces
+        self.dups = dups
+        self.wfails = wfails if "ack_on_failed_write" in mutations else 0
+        self.mut = frozenset(mutations)
+        self.name = f"alo-{kind}" + (f"[{'+'.join(sorted(self.mut))}]" if self.mut else "")
+        self.scope = {
+            "broker": kind, "msgs": n_msgs, "window": window,
+            "prefetch": self.prefetch, "crashes": crashes,
+            "bounces": self.bounces, "dups": dups,
+        }
+
+    # -- state helpers -------------------------------------------------------
+    def initial(self) -> S:
+        z = (0,) * self.n
+        return S(0, (), (), 0, 0, 0, frozenset(), (), (), (), z, z, (), (),
+                 self.crashes, self.bounces, self.dups, self.wfails)
+
+    @staticmethod
+    def _bump(vec: tuple, m: int) -> tuple:
+        return vec[:m] + (min(2, vec[m] + 1),) + vec[m + 1:]
+
+    def _settle(self, s: S, tokens) -> S:
+        """Broker-side ack semantics for a batch of tokens (idempotent for
+        stale tokens — exactly the Channel.ack contract)."""
+        if self.kind == "spool":
+            cursor, abeyond = s.cursor, set(s.abeyond)
+            for idx in sorted(tokens):
+                if idx >= cursor:
+                    abeyond.add(idx)
+            while cursor in abeyond:
+                abeyond.discard(cursor)
+                cursor += 1
+            return s._replace(cursor=cursor, abeyond=frozenset(abeyond))
+        toks = set(tokens)
+        return s._replace(ledger=tuple(e for e in s.ledger if e not in toks))
+
+    def _requeue(self, s: S) -> S:
+        """Broker redelivery of everything unacked (crash / bounce)."""
+        if self.kind == "spool":
+            return s._replace(ndeliv=s.cursor, abeyond=frozenset())
+        redelivered = tuple(m for _g, m in s.ledger)
+        if "requeue_back" in self.mut:
+            queue = s.queue + redelivered
+        else:
+            queue = redelivered + s.queue  # FIFO-preserving front requeue
+        return s._replace(queue=queue, ledger=(), gen=s.gen + 1)
+
+    def _receive(self, s: S, m: int, token) -> S:
+        """One delivery (or chaos duplicate) reaching the worker's
+        _consume_at_least_once: dedup window check, absorb, token joins
+        the epoch."""
+        if m in s.window:
+            if "dup_ack_early" in self.mut:
+                # the PR 3 bug: the deduped copy's token is acked NOW,
+                # advancing the broker past an effect that is not durable
+                return self._settle(s, (token,))
+            if token in s.tokens:
+                return s
+            return s._replace(tokens=tuple(sorted(s.tokens + (token,))))
+        window = s.window + (m,)
+        if len(window) > self.w:
+            window = window[1:]  # bounded FIFO eviction
+        return s._replace(
+            window=window,
+            pending=tuple(sorted(s.pending + (m,))),
+            tokens=tuple(sorted(set(s.tokens) | {token})),
+        )
+
+    def _drain(self, s: S) -> S:
+        vol = s.vol
+        for m in s.pending:
+            vol = self._bump(vol, m)
+        return s._replace(vol=vol, pending=())
+
+    # -- transition relation -------------------------------------------------
+    def actions(self, s: S) -> Iterator[Tuple[str, S]]:
+        out = []
+        # publish: producer stamps the next msg_id and sends
+        if s.sent < self.n:
+            m = s.sent
+            ns = s._replace(sent=s.sent + 1)
+            if self.kind != "spool":
+                ns = ns._replace(queue=ns.queue + (m,))
+            out.append((f"publish(m{m})", ns))
+
+        # deliver: broker hands the next message + token to the consumer;
+        # prefetch bounds in-flight unacked deliveries (basic_qos)
+        if self.kind == "spool":
+            if s.ndeliv < s.sent and s.ndeliv - s.cursor < self.prefetch:
+                m = s.ndeliv
+                ns = s._replace(ndeliv=s.ndeliv + 1)
+                out.append((f"deliver(m{m})", self._receive(ns, m, m)))
+        elif s.queue and len(s.ledger) < self.prefetch:
+            m, rest = s.queue[0], s.queue[1:]
+            token = (s.gen, m)
+            ns = s._replace(queue=rest, ledger=s.ledger + (token,))
+            out.append((f"deliver(m{m})", self._receive(ns, m, token)))
+
+        # chaos duplicate: replay an in-flight delivery (same msg_id+token)
+        if s.dups > 0:
+            if self.kind == "spool":
+                inflight = [(i, i) for i in range(s.cursor, s.ndeliv)
+                            if i not in s.abeyond]
+            else:
+                inflight = [(m, tok) for tok in s.ledger for m in [tok[1]]]
+            for m, tok in inflight:
+                ns = self._receive(s._replace(dups=s.dups - 1), m, tok)
+                out.append((f"dup(m{m})", ns))
+
+        # drain: the feed timer / batch-full bulk feed (pending → engine)
+        if s.pending:
+            out.append(("drain", self._drain(s)))
+
+        # commit: the save_state epoch commit. Correct protocol: drain →
+        # persist (state + dedup window, atomically) → ack moves to to_ack
+        # (the ack itself is a separate transition so a crash can land in
+        # the commit→ack window).
+        if "ack_before_persist" in self.mut:
+            ns = self._drain(s)
+            ns = self._settle(ns, ns.tokens)._replace(tokens=())
+            out.append(("commit[ack-first]", ns))
+            # the (too-late) persist is its own action
+            out.append(("persist", s._replace(dur=s.vol, pwindow=s.window)))
+        else:
+            ns = s if "skip_drain" in self.mut else self._drain(s)
+            pwin = ns.window
+            if "evict_on_persist" in self.mut and pwin:
+                pwin = pwin[1:]  # persists the window minus its oldest id
+            ns = ns._replace(
+                dur=ns.vol, pwindow=pwin,
+                to_ack=tuple(sorted(set(ns.to_ack) | set(ns.tokens))),
+                tokens=(),
+            )
+            out.append(("commit", ns))
+
+        # failed checkpoint write that acks anyway (mutation only): the
+        # correct protocol keeps tokens on failure, which is a no-op state
+        if s.wfails > 0 and "ack_on_failed_write" in self.mut:
+            ns = self._drain(s._replace(wfails=s.wfails - 1))
+            ns = self._settle(ns, ns.tokens)._replace(tokens=())
+            out.append(("commit[write-failed,ack]", ns))
+
+        # ack: commit the epoch's tokens on the broker
+        if s.to_ack:
+            if self.kind == "amqp":
+                # marshalled basic_ack: one token per step (a crash can
+                # interleave a half-acked epoch)
+                tok = s.to_ack[0]
+                ns = self._settle(s, (tok,))._replace(to_ack=s.to_ack[1:])
+                out.append((f"ack({self._tok(tok)})", ns))
+            else:
+                ns = self._settle(s, s.to_ack)._replace(to_ack=())
+                out.append(("ack", ns))
+
+        # crash: kill −9 + restart. Worker volatile state is lost and the
+        # durable checkpoint restored; the broker redelivers every unacked
+        # message (front-requeue / cursor rewind).
+        if s.crashes > 0:
+            ns = s._replace(
+                crashes=s.crashes - 1,
+                vol=s.dur,
+                window=() if "window_not_restored" in self.mut else s.pwindow,
+                pending=(), tokens=(), to_ack=(),
+            )
+            out.append(("crash+recover", self._requeue(ns)))
+
+        # bounce: broker restart, worker survives (stale tokens appear)
+        if s.bounces > 0:
+            out.append(("bounce", self._requeue(s._replace(bounces=s.bounces - 1))))
+        return out
+
+    @staticmethod
+    def _tok(tok) -> str:
+        return f"m{tok}" if isinstance(tok, int) else f"g{tok[0]}:m{tok[1]}"
+
+    # -- invariants ----------------------------------------------------------
+    def invariant(self, s: S) -> Optional[str]:
+        for m in range(self.n):
+            if s.dur[m] >= 2:
+                return (f"m{m} effected {s.dur[m]}x in durable state "
+                        f"(no-double-effect violated)")
+        # settled = the broker will never deliver this message again
+        if self.kind == "spool":
+            settled = range(s.cursor)
+        else:
+            present = set(s.queue) | {m for _g, m in s.ledger}
+            settled = [m for m in range(s.sent) if m not in present]
+        for m in settled:
+            if s.dur[m] == 0:
+                return (f"m{m} is settled on the broker but has NO durable "
+                        f"effect (ack-implies-durable violated: the message "
+                        f"is lost)")
+        return None
+
+    def describe(self, s: S) -> str:
+        if self.kind == "spool":
+            broker = f"cur={s.cursor} nd={s.ndeliv}"
+        else:
+            q = ",".join(f"m{m}" for m in s.queue)
+            led = ",".join(self._tok(t) for t in s.ledger)
+            broker = f"q=[{q}] led=[{led}]"
+        win = ",".join(f"m{m}" for m in s.window)
+        pwin = ",".join(f"m{m}" for m in s.pwindow)
+        pend = ",".join(f"m{m}" for m in s.pending)
+        vol = "".join(str(c) for c in s.vol)
+        dur = "".join(str(c) for c in s.dur)
+        tok = ",".join(self._tok(t) for t in s.tokens)
+        ack = ",".join(self._tok(t) for t in s.to_ack)
+        return (f"sent={s.sent} {broker} win=[{win}] pwin=[{pwin}] "
+                f"pend=[{pend}] vol={vol} dur={dur} tok=[{tok}] toack=[{ack}]")

@@ -1,0 +1,203 @@
+"""Trace conformance: real protocol event logs replayed against the models.
+
+A model of the wrong protocol verifies the wrong thing. To pin the models
+to the implementation, the worker (``tpuEngine.protocolEventLog``) emits
+one JSONL event per protocol step — ``recover`` / ``deliver`` / ``feed``
+/ ``checkpoint`` / ``ack`` / ``compact`` — and the chaos harness appends
+``crash`` / ``corrupt`` markers at its kill−9 / hostile-storage
+injection points. :func:`check_protocol_trace` replays such a log as a
+path of the ALO + delta-chain models: a deterministic mirror of the
+dedup window, the epoch/chain watermarks, and the pending feed buffer
+steps through the events and reports every transition the models do not
+allow. An empty report means the run WAS a model path; a non-empty one
+means either a protocol regression in the implementation or model drift
+— both gate failures.
+
+The rules enforced (each cites the model transition it mirrors):
+
+- ``deliver(dedup=True)`` only for a message currently in the window
+  mirror; ``deliver(dedup=False)`` never for one that is (alo._receive);
+- a message whose effect is already durable is never re-absorbed
+  (no-double-effect);
+- ``checkpoint(ok)`` epochs are exactly +1 monotonic, and the pending
+  feed buffer is EMPTY at every commit (drain-before-commit);
+- delta-chain ``chain_epoch`` advances by exactly 1 per commit;
+- ``ack`` follows a successful checkpoint of the same epoch, with no
+  crash between (ack-after-checkpoint);
+- no worker events between a ``crash`` marker and the next ``recover``;
+- ``recover`` lands exactly on the last committed epoch — or, with
+  hostile-storage ``corrupt`` markers since the last boot, at most that
+  many epochs earlier, and never below the last ACKED epoch
+  (recovery-stops-at-last-committed-boundary + ack-implies-durable);
+- a ``redelivered`` flag only on messages that were delivered before.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+
+def read_event_log(path: str) -> List[dict]:
+    """Parse a protocol event log; a torn final line (the crash case the
+    log exists to capture) is tolerated and dropped."""
+    events: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue  # torn tail from a SIGKILL mid-write
+    except OSError:
+        pass
+    return events
+
+
+class _Mirror:
+    """Deterministic replay state: the model variables reconstructible
+    from the event stream."""
+
+    def __init__(self, window_size: int):
+        self.window_size = window_size
+        self.window: List[str] = []
+        self.committed: set = set()  # msgs with durable effects
+        self.absorbed: set = set()  # absorbed since the last commit
+        self.pending = 0  # accepted-not-yet-fed tx lines
+        self.epoch = 0
+        self.chain_epoch: Optional[int] = None
+        self.acked_epoch = 0
+        self.seen: set = set()  # every msg id ever delivered
+        self.dead = False
+        self.corrupts_since_boot = 0
+        # epoch -> (window snapshot, committed snapshot) at that commit
+        self.snapshots: Dict[int, tuple] = {}
+
+    def snapshot(self) -> None:
+        self.snapshots[self.epoch] = (tuple(self.window),
+                                      frozenset(self.committed))
+
+    def restore(self, epoch: int) -> None:
+        win, comm = self.snapshots.get(epoch, ((), frozenset()))
+        self.window = list(win)
+        self.committed = set(comm)
+        self.absorbed = set()
+        self.pending = 0
+        self.epoch = epoch
+
+
+def check_protocol_trace(events: List[dict], *,
+                         window_size: int = 65536) -> List[str]:
+    """Replay ``events``; returns violation strings (empty == conformant)."""
+    out: List[str] = []
+    m = _Mirror(window_size)
+    m.snapshot()  # epoch 0, empty state
+
+    def bad(i: int, ev: dict, msg: str) -> None:
+        out.append(f"event {i} {ev.get('ev')}: {msg}")
+
+    booted = False
+    for i, ev in enumerate(events):
+        kind = ev.get("ev")
+        if kind in ("deliver", "feed", "checkpoint", "ack", "compact",
+                    "recover") and m.dead and kind != "recover":
+            bad(i, ev, "worker event after a crash marker and before recover")
+            continue
+        if kind == "recover":
+            epoch = int(ev.get("epoch", 0))
+            if booted:
+                floor = m.epoch - m.corrupts_since_boot
+                if epoch > m.epoch:
+                    bad(i, ev, f"recovered to epoch {epoch} past the last "
+                               f"committed epoch {m.epoch}")
+                elif epoch < max(floor, m.acked_epoch):
+                    bad(i, ev, f"recovered to epoch {epoch}, below the "
+                               f"boundary (committed {m.epoch}, acked "
+                               f"{m.acked_epoch}, {m.corrupts_since_boot} "
+                               f"injected corruptions)")
+            ce = ev.get("chain_epoch")
+            m.restore(min(epoch, m.epoch) if booted else epoch)
+            m.epoch = epoch
+            m.chain_epoch = int(ce) if ce is not None else None
+            m.dead = False
+            m.corrupts_since_boot = 0
+            m.snapshot()
+            booted = True
+        elif kind == "deliver":
+            msg = ev.get("msg")
+            dedup = bool(ev.get("dedup"))
+            in_window = msg in m.window
+            if dedup and not in_window:
+                bad(i, ev, f"deduped {msg!r} which is NOT in the dedup "
+                           f"window mirror")
+            if not dedup:
+                if in_window:
+                    bad(i, ev, f"absorbed {msg!r} which IS in the dedup "
+                               f"window (should have been deduped)")
+                if msg in m.committed:
+                    bad(i, ev, f"re-absorbed {msg!r} whose effect is "
+                               f"already durable (double effect)")
+                if msg is not None:
+                    m.window.append(msg)
+                    if len(m.window) > m.window_size:
+                        m.window.pop(0)
+                    m.absorbed.add(msg)
+                if ev.get("tx"):
+                    m.pending += 1
+            if ev.get("redelivered") and msg not in m.seen:
+                # spool redelivered flags are a persisted high-water mark,
+                # so a missing flag is fine — a flag on a never-delivered
+                # message is not
+                bad(i, ev, f"{msg!r} flagged redelivered but never "
+                           f"delivered before")
+            if msg is not None:
+                m.seen.add(msg)
+        elif kind == "feed":
+            n = int(ev.get("n", 0))
+            if n > m.pending:
+                bad(i, ev, f"fed {n} lines but only {m.pending} pending")
+            m.pending = max(0, m.pending - n)
+        elif kind == "checkpoint":
+            if not ev.get("ok", True):
+                continue  # failed write: no state change, tokens kept
+            epoch = ev.get("epoch")
+            if epoch is not None:
+                epoch = int(epoch)
+                if epoch != m.epoch + 1:
+                    bad(i, ev, f"epoch jumped {m.epoch} -> {epoch} "
+                               f"(must be +1 monotonic)")
+                if m.pending:
+                    bad(i, ev, f"committed epoch {epoch} with {m.pending} "
+                               f"undrained pending-feed lines (tokens "
+                               f"would ack effects not in the snapshot)")
+                m.epoch = epoch
+            ce = ev.get("chain_epoch")
+            if ce is not None:
+                ce = int(ce)
+                if m.chain_epoch is not None and ce != m.chain_epoch + 1:
+                    bad(i, ev, f"chain epoch jumped {m.chain_epoch} -> {ce}")
+                m.chain_epoch = ce
+            m.committed |= m.absorbed
+            m.absorbed = set()
+            m.snapshot()
+        elif kind == "ack":
+            epoch = int(ev.get("epoch", -1))
+            if epoch != m.epoch:
+                bad(i, ev, f"acked epoch {epoch} but the last committed "
+                           f"checkpoint is epoch {m.epoch} "
+                           f"(ack-after-checkpoint violated)")
+            m.acked_epoch = max(m.acked_epoch, epoch)
+        elif kind == "compact":
+            ce = ev.get("chain_epoch")
+            if ce is not None and m.chain_epoch is not None \
+                    and int(ce) > m.chain_epoch:
+                bad(i, ev, f"compaction at chain epoch {ce} beyond the "
+                           f"committed tail {m.chain_epoch}")
+        elif kind == "crash":
+            m.dead = True
+        elif kind == "corrupt":
+            m.corrupts_since_boot += 1
+    return out

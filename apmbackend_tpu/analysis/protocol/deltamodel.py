@@ -1,0 +1,335 @@
+"""Model of the delta-chain commit/recovery protocol (deltachain.py).
+
+The on-disk directory is the state: delta segments (uid / prev_uid
+linkage, header epoch, CRC-intactness, content completeness), base
+snapshots, and the MANIFEST pointer. The writer appends one segment per
+epoch (tmp + rename IS the commit — a crash mid-append leaves nothing at
+the committed name), runs background compaction in its two crash windows
+(base written / manifest swapped / GC), and recovers with the exact
+``DeltaChain.load`` walk: manifest base first, then newer-to-older base
+fallback, then the contiguous valid delta chain — stopping at the first
+missing, torn, foreign-epoch, or linkage-broken segment.
+
+Hostile storage is modeled as the chaos harness injects it
+(``corrupt_chain_tail`` + ``APM_CHAOS_FS``): a torn/bit-rotted tail (the
+page-cache loss a SIGKILL cannot produce — it UN-commits that epoch, so
+the ghost ``committed`` watermark steps back with it, which is safe
+because the ALO ack for that epoch never happened), a stale duplicate
+tail (the tail copied one epoch forward, old header), a forged duplicate
+(plausible header epoch but stale ``prev_uid`` linkage — only the uid
+chain rejects it), and bit rot of the newest base (allowed only when an
+older generation exists: the keep-one-generation retention promise).
+
+Ghost variable: ``committed`` = the last epoch whose append durably
+returned (what the worker is allowed to ack up to). Invariant, checked at
+every recovery:
+
+- **recovery-stops-at-last-committed-boundary**: recovered epoch ==
+  committed at recovery time — less is loss of committed (acked!) epochs,
+  more means a stale/uncommitted tail was replayed past the boundary;
+- **state-intact**: the replayed chain never includes a torn, incomplete,
+  or foreign segment (recovered state is bit-identical to the committed
+  state).
+
+Mutations: ``gc_live_base`` (compaction GC deletes the fallback
+generation), ``skip_prev_uid`` / ``skip_epoch_check`` / ``skip_crc``
+(validation gaps), ``commit_before_rename`` (epoch reported committed
+before the rename lands), ``capture_reset_on_enospc`` (a failed append's
+capture window is dropped instead of retried as a superset).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Iterator, Optional, Tuple
+
+# segs:  tuple of (epoch, uid, prev_uid, hdr_epoch, intact, content_ok,
+#        complete), sorted — intact = CRC/footer valid; content_ok = the
+#        payload really is this epoch's delta (False for stale/forged
+#        duplicates); complete = the capture covered everything since the
+#        previous commit (False after the capture_reset mutant's gap)
+# bases: tuple of (epoch, uid, intact, clean), sorted
+# manifest: base epoch the MANIFEST points at (None = missing)
+# alive: writer process up
+# tail/tail_uid/wbase: writer memory (chain position + base for GC)
+# wclean: writer's live state is uncorrupted
+# nuid: fresh-uid counter
+# committed: ghost — last epoch durably committed (ackable watermark)
+# cuids: ghost — cuids[e] = uid of the write that last LEGITIMATELY
+#        committed epoch e (the identity a stale orphan base fails to match)
+# gprev: ghost — old_base of the last COMPLETED compaction (-1 before any);
+#        the retention contract promises a fallback generation from here
+# gap: a failed append's capture was dropped (capture_reset mutant)
+# cphase: in-flight compaction (stage, target_epoch, target_uid, old_base)
+# last_rec: (recovered_epoch, clean, committed_at_recovery) or None
+# crashes/corrupts/brots/compacts/enospcs: remaining budgets
+S = namedtuple(
+    "S",
+    "segs bases manifest alive tail tail_uid wbase wclean nuid committed "
+    "cuids gprev gap cphase last_rec crashes corrupts brots compacts enospcs",
+)
+
+_MUTATIONS = frozenset({
+    "gc_live_base", "skip_prev_uid", "skip_crc", "commit_before_rename",
+    "capture_reset_on_enospc", "fallback_first_chain", "fallback_stale_base",
+})
+
+
+class DeltaChainModel:
+    def __init__(self, *, max_epochs: int = 4, crashes: int = 2,
+                 corrupts: int = 1, base_rots: int = 1, compacts: int = 1,
+                 enospcs: int = 0, mutations: Tuple[str, ...] = ()):
+        bad = set(mutations) - _MUTATIONS
+        if bad:
+            raise ValueError(f"unknown mutations: {sorted(bad)}")
+        self.e = max_epochs
+        self.mut = frozenset(mutations)
+        self.crashes = crashes
+        self.corrupts = corrupts
+        self.base_rots = base_rots
+        self.compacts = compacts
+        self.enospcs = enospcs if "capture_reset_on_enospc" in self.mut else 0
+        self.name = "delta-chain" + (f"[{'+'.join(sorted(self.mut))}]" if self.mut else "")
+        self.scope = {
+            "epochs": max_epochs, "crashes": crashes, "corrupts": corrupts,
+            "base_rots": base_rots, "compactions": compacts,
+        }
+
+    def initial(self) -> S:
+        # initialize(): base at epoch 0 (uid 0) + MANIFEST — the first
+        # committed boundary, laid down before any ack can happen
+        return S(
+            segs=(), bases=((0, 0, True, True),), manifest=0, alive=True,
+            tail=0, tail_uid=0, wbase=0, wclean=True, nuid=1, committed=0,
+            cuids=(0,), gprev=-1, gap=False, cphase=None, last_rec=None,
+            crashes=self.crashes, corrupts=self.corrupts,
+            brots=self.base_rots, compacts=self.compacts,
+            enospcs=self.enospcs,
+        )
+
+    # -- file helpers --------------------------------------------------------
+    @staticmethod
+    def _put_seg(segs: tuple, seg: tuple) -> tuple:
+        """os.replace semantics: a new segment overwrites the file at the
+        same epoch name."""
+        return tuple(sorted(s for s in segs if s[0] != seg[0])) + (seg,)
+
+    @staticmethod
+    def _seg_at(segs: tuple, epoch: int):
+        for s in segs:
+            if s[0] == epoch:
+                return s
+        return None
+
+    # -- the load() walk -----------------------------------------------------
+    def _recover(self, s: S):
+        """DeltaChain.load(): every readable base is a candidate chain
+        start; the chain recovering the HIGHEST epoch wins (manifest-first
+        on ties), and a non-manifest fallback base is rejected when the
+        delta segment at its own epoch contradicts it (missing-or-matching
+        required: a valid delta with a different uid, or an unreadable
+        delta, marks the base a stale orphan from a dead compaction).
+        Returns (epoch, clean, base_used) or None when no base is
+        readable. ``clean`` additionally consults the ghost ``cuids`` so a
+        stale base accepted by a mutant is visibly wrong state."""
+        order = []
+        by_epoch = {b[0]: b for b in s.bases}
+        if s.manifest is not None and s.manifest in by_epoch:
+            order.append(s.manifest)
+        order.extend(e for e in sorted(by_epoch, reverse=True) if e not in order)
+        best = None
+        for be in order:
+            _e, uid, intact, base_clean = by_epoch[be]
+            if not intact:
+                continue  # unreadable base: fall back one generation
+            if be != s.manifest and "fallback_stale_base" not in self.mut:
+                own = self._seg_at(s.segs, be)
+                if own is not None and (not own[4] or own[1] != uid):
+                    continue  # stale orphan base (contradicted by delta)
+            # ghost staleness: the base's content is epoch `be` of SOME
+            # incarnation; it matches the committed history only when its
+            # uid is the one that last committed that epoch
+            ghost_ok = be < len(s.cuids) and s.cuids[be] == uid
+            epoch, clean = be, base_clean and ghost_ok
+            while True:
+                seg = self._seg_at(s.segs, epoch + 1)
+                if seg is None:
+                    break
+                _se, suid, sprev, shdr, sintact, scontent, scomplete = seg
+                if not sintact and "skip_crc" not in self.mut:
+                    break  # torn/rotted tail: stop at the boundary
+                if shdr != epoch + 1:
+                    break  # header/filename epoch mismatch (stale dup)
+                if sprev != uid and "skip_prev_uid" not in self.mut:
+                    break  # broken predecessor linkage (foreign tail)
+                clean = clean and sintact and scontent and scomplete
+                epoch, uid = epoch + 1, suid
+            cand = (epoch, clean, be)
+            if "fallback_first_chain" in self.mut:
+                return cand  # the pre-fix load(): first readable base wins
+            if best is None or epoch > best[0]:
+                best = cand
+        return best
+
+    # -- transition relation -------------------------------------------------
+    def actions(self, s: S) -> Iterator[Tuple[str, S]]:
+        out = []
+        if s.alive:
+            # append: commit one epoch (tmp + rename; the rename IS the
+            # durability point, so the ghost watermark moves only here)
+            if s.tail < self.e:
+                epoch, uid = s.tail + 1, s.nuid
+                seg = (epoch, uid, s.tail_uid, epoch, True, True, not s.gap)
+                out.append((f"append(e{epoch})", s._replace(
+                    segs=tuple(sorted(self._put_seg(s.segs, seg))),
+                    tail=epoch, tail_uid=uid, nuid=s.nuid + 1,
+                    committed=epoch, cuids=s.cuids[:epoch] + (uid,),
+                    gap=False,
+                )))
+                # crash mid-append: the tmp never renamed — no file at the
+                # committed name, watermark unchanged (the mutant reports
+                # success before the rename: watermark moves, file doesn't)
+                if s.crashes > 0:
+                    ns = s._replace(alive=False, cphase=None,
+                                    crashes=s.crashes - 1)
+                    if "commit_before_rename" in self.mut:
+                        ns = ns._replace(committed=epoch, nuid=s.nuid + 1)
+                    out.append((f"append(e{epoch})+crash-mid-write", ns))
+            # a failed append (ENOSPC): the chain tail is unchanged and the
+            # correct writer retries a SUPERSET capture — a no-op state.
+            # The mutant drops the capture window, so the next committed
+            # delta is missing those changes.
+            if s.enospcs > 0 and "capture_reset_on_enospc" in self.mut:
+                out.append(("append-enospc[capture-reset]", s._replace(
+                    enospcs=s.enospcs - 1, gap=True)))
+            # compaction (background thread), staged through its two crash
+            # windows: base published -> manifest swapped -> GC
+            if s.cphase is None and s.compacts > 0 and s.tail > s.wbase:
+                base = (s.tail, s.tail_uid, True, s.wclean)
+                out.append((f"compact-base(e{s.tail})", s._replace(
+                    bases=tuple(sorted(b for b in s.bases if b[0] != s.tail) + [base]),
+                    cphase=(1, s.tail, s.tail_uid, s.wbase),
+                    compacts=s.compacts - 1,
+                )))
+            elif s.cphase is not None and s.cphase[0] == 1:
+                _st, target, tuid, old_base = s.cphase
+                out.append((f"compact-manifest(e{target})", s._replace(
+                    manifest=target, cphase=(2, target, tuid, old_base))))
+            elif s.cphase is not None and s.cphase[0] == 2:
+                _st, target, _tuid, old_base = s.cphase
+                if "gc_live_base" in self.mut:
+                    # deletes the fallback generation: deltas <= the NEW
+                    # base and every older base
+                    segs = tuple(x for x in s.segs if x[0] > target)
+                    bases = tuple(b for b in s.bases if b[0] >= target)
+                else:
+                    # keep-one-generation retention: the previous base and
+                    # every delta above it survive this compaction
+                    segs = tuple(x for x in s.segs if x[0] > old_base)
+                    bases = tuple(b for b in s.bases if b[0] >= old_base)
+                out.append((f"compact-gc(e{target})", s._replace(
+                    segs=segs, bases=bases, wbase=target, cphase=None,
+                    gprev=old_base)))
+            # crash anywhere (including inside either compaction window —
+            # the kill:compact=pre_base/pre_manifest fault points)
+            if s.crashes > 0:
+                out.append(("crash", s._replace(
+                    alive=False, cphase=None, crashes=s.crashes - 1)))
+        else:
+            # hostile storage strikes while the process is down
+            if s.corrupts > 0 and s.segs:
+                tail = s.segs[-1]
+                te, tuid, tprev, thdr, _ti, tcont, tcomp = tail
+                torn = self._put_seg(
+                    s.segs, (te, tuid, tprev, thdr, False, tcont, tcomp))
+                # a torn tail means the LAST segment write never fully hit
+                # the platter: the epoch UN-commits, and its ALO ack never
+                # happened either (the coupled contract: fsync=True acks
+                # only after a durable rename; fsync=False narrows the
+                # fault model to process death, where tails cannot tear).
+                # Only physically possible while it IS the last durable
+                # write — any base file written after it (compaction
+                # fsyncs) proves the delta landed, so such tails are past
+                # the fault window.
+                if all(te > b[0] for b in s.bases):
+                    out.append((f"corrupt-torn-tail(e{te})", s._replace(
+                        segs=tuple(sorted(torn)), corrupts=s.corrupts - 1,
+                        committed=min(s.committed, te - 1))))
+                if te < self.e:
+                    dup = (te + 1, tuid, tprev, thdr, True, False, tcomp)
+                    out.append((f"corrupt-stale-dup(e{te}->e{te + 1})", s._replace(
+                        segs=tuple(sorted(self._put_seg(s.segs, dup))),
+                        corrupts=s.corrupts - 1)))
+                    forged = (te + 1, s.nuid, tprev, te + 1, True, False, True)
+                    out.append((f"corrupt-forged-dup(e{te + 1})", s._replace(
+                        segs=tuple(sorted(self._put_seg(s.segs, forged))),
+                        nuid=s.nuid + 1, corrupts=s.corrupts - 1)))
+            intact_bases = [b for b in s.bases if b[2]]
+            if s.brots > 0 and s.gprev >= 0 and intact_bases:
+                # newest base rots — survivable ONLY because a completed
+                # compaction's retention kept the previous generation (the
+                # promise gc_live_base breaks). Fault-model scope: one base
+                # rot per run — the keep-one-generation contract covers a
+                # single lost generation, not independent losses stacking
+                # across every generation (DESIGN.md §9.4).
+                be, buid, _bi, bclean = max(intact_bases, key=lambda b: b[0])
+                bases = tuple(sorted(
+                    tuple(b for b in s.bases if b[0] != be)
+                    + ((be, buid, False, bclean),)))
+                out.append((f"corrupt-base(e{be})", s._replace(
+                    bases=bases, brots=s.brots - 1)))
+            # restart + DeltaChain.load()
+            rec = self._recover(s)
+            if rec is None:
+                out.append(("recover[NO CHAIN]", s._replace(
+                    alive=True, last_rec=(-1, False, s.committed))))
+            else:
+                epoch, clean, base_used = rec
+                out.append((f"recover(e{epoch})", s._replace(
+                    alive=True, tail=epoch,
+                    tail_uid=self._uid_at(s, epoch, base_used),
+                    wbase=base_used, wclean=clean, gap=False,
+                    last_rec=(epoch, clean, s.committed))))
+        return out
+
+    def _uid_at(self, s: S, epoch: int, base_epoch: int) -> int:
+        if epoch == base_epoch:
+            for b in s.bases:
+                if b[0] == base_epoch:
+                    return b[1]
+        seg = self._seg_at(s.segs, epoch)
+        return seg[1] if seg is not None else -1
+
+    # -- invariants ----------------------------------------------------------
+    def invariant(self, s: S) -> Optional[str]:
+        if s.last_rec is None:
+            return None
+        epoch, clean, committed = s.last_rec
+        if epoch < committed:
+            what = "no readable base survived" if epoch < 0 else f"stopped at e{epoch}"
+            return (f"recovery lost committed epochs: {what} but e{committed} "
+                    f"was durably committed (acked effects gone)")
+        if not clean:
+            # covers both replaying a torn/incomplete segment AND walking
+            # past the committed boundary into a stale/forged duplicate —
+            # either way the recovered state matches no committed state
+            return (f"recovery replayed past the last committed boundary "
+                    f"(e{committed}): recovered 'e{epoch}' contains a "
+                    f"stale, torn, or incomplete segment — the state "
+                    f"matches no committed epoch")
+        # epoch > committed with CLEAN content is the benign
+        # rename-landed-before-success-observed window: the commit is real,
+        # the ack never happened, and the dedup window absorbs redelivery
+        return None
+
+    def describe(self, s: S) -> str:
+        segs = ",".join(
+            f"e{e}(u{u}<-u{p},h{h}{'' if i else ',TORN'}"
+            f"{'' if co else ',STALE'}{'' if c else ',GAP'})"
+            for e, u, p, h, i, co, c in s.segs)
+        bases = ",".join(
+            f"e{e}(u{u}{'' if i else ',ROT'})" for e, u, i, _c in s.bases)
+        st = "up" if s.alive else "DOWN"
+        cp = f" compact@{s.cphase[1]}:{s.cphase[0]}" if s.cphase else ""
+        return (f"{st} tail=e{s.tail} committed=e{s.committed} "
+                f"manifest=e{s.manifest} bases=[{bases}] segs=[{segs}]{cp}")

@@ -1,0 +1,155 @@
+"""The mutation tier: seeded protocol bugs the checker must catch.
+
+A model checker that has never failed is indistinguishable from one that
+checks nothing. Every entry here is a deliberately broken variant of one
+protocol model — each a bug class that either HAS happened in this
+codebase (``alo-dup-ack-early`` is the PR 3 one-message-loss bug, found
+then by the kill−9 chaos harness by luck, replayed here as a 3-step
+certainty) or is one refactor away from happening (ack-before-checkpoint,
+a GC that eats the fallback generation, a mid-epoch shard rebalance).
+``verify_mutants()`` requires a counterexample for every one; the tier-1
+suite asserts it, so the checker's teeth are themselves regression-tested.
+
+Each mutant's counterexample is a shortest schedule (BFS) — typically
+3–10 numbered steps — which doubles as documentation of WHY the
+corresponding line of production code is shaped the way it is.
+
+``BOUNDARY_MUTANTS`` are the negative result: recovery-order variants of
+the delta chain that the checker proves INDISTINGUISHABLE from the
+correct protocol within the documented single-fault storage contract
+(every candidate base of one linear history converges to the same tail
+unless a second fault strikes the same generation). They are pinned as
+still-verifying so the boundary stays explicit — the deltachain.py
+recovery hardening (best-chain selection + stale-orphan cross-check)
+matters only OUTSIDE that contract, and the model says so.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from .alo import AloModel
+from .checker import CheckResult, check
+from .deltamodel import DeltaChainModel
+from .shardmodel import ShardedEpochModel
+
+# name -> (description, model factory). Names are stable identifiers used
+# in tests, --json output, and the DESIGN.md §9.4 catalogue.
+MUTANTS: Dict[str, Tuple[str, Callable[[], object]]] = {
+    "alo-ack-before-checkpoint": (
+        "save_state acks the epoch's tokens before the checkpoint write — "
+        "a crash between them loses every message of the epoch",
+        lambda: AloModel(mutations=("ack_before_persist",)),
+    ),
+    "alo-dup-ack-early": (
+        "THE PR 3 BUG, replayed: a deduped in-flight duplicate's token is "
+        "acked immediately instead of joining the epoch; the duplicate "
+        "shares the original's broker ledger entry, so the ack advances "
+        "the broker past an effect that is not yet durable",
+        lambda: AloModel(mutations=("dup_ack_early",)),
+    ),
+    "alo-dedup-evict-before-commit": (
+        "the persisted dedup window drops its oldest id before the epoch "
+        "that absorbed it commits — a redelivered copy after restart "
+        "looks fresh and double-counts",
+        lambda: AloModel(mutations=("evict_on_persist",)),
+    ),
+    "alo-checkpoint-skips-feed-drain": (
+        "the epoch commit snapshots state WITHOUT draining the pending "
+        "feed buffer but still acks the buffered messages' tokens — "
+        "ack-implies-durable broken at the commit itself",
+        lambda: AloModel(mutations=("skip_drain",)),
+    ),
+    "alo-ack-on-failed-write": (
+        "a failed checkpoint write (ENOSPC) acks anyway instead of "
+        "keeping the tokens for redelivery",
+        lambda: AloModel(mutations=("ack_on_failed_write",), wfails=1),
+    ),
+    "alo-dedup-window-not-restored": (
+        "restart ignores the persisted dedup window (_seed_delivery "
+        "skipped) — committed messages redelivered after a crash "
+        "double-count",
+        lambda: AloModel(mutations=("window_not_restored",)),
+    ),
+    "alo-requeue-at-back": (
+        "the broker requeues unacked deliveries at the BACK of the queue "
+        "instead of the front — newer absorbs push a committed-but-"
+        "unacked id out of the bounded window before its redelivery is "
+        "re-seen (why transport/memory.py front-requeues)",
+        lambda: AloModel(mutations=("requeue_back",)),
+    ),
+    "dc-compaction-gc-live-base": (
+        "compaction GC deletes the previous base generation and its "
+        "deltas immediately — a new base that later proves unreadable "
+        "has no fallback and committed (acked) epochs are gone",
+        lambda: DeltaChainModel(mutations=("gc_live_base",)),
+    ),
+    "dc-skip-prev-uid-check": (
+        "recovery accepts a tail segment whose prev_uid does not match "
+        "the chain — a forged/zombie duplicate replays past the last "
+        "committed boundary",
+        lambda: DeltaChainModel(mutations=("skip_prev_uid",)),
+    ),
+    "dc-skip-crc-validation": (
+        "recovery replays a torn/bit-rotted segment instead of stopping "
+        "at the boundary — recovered state matches no committed state",
+        lambda: DeltaChainModel(mutations=("skip_crc",)),
+    ),
+    "dc-commit-before-rename": (
+        "append reports the epoch committed (and the worker acks) before "
+        "the tmp→seg rename lands — a crash mid-write loses an acked "
+        "epoch",
+        lambda: DeltaChainModel(mutations=("commit_before_rename",)),
+    ),
+    "dc-capture-reset-on-enospc": (
+        "a failed segment write drops its capture window instead of "
+        "retrying a superset — the next committed delta silently misses "
+        "those changes and recovery diverges",
+        lambda: DeltaChainModel(
+            mutations=("capture_reset_on_enospc",), enospcs=1),
+    ),
+    "shard-rebalance-mid-epoch": (
+        "partition ownership moves while deliveries are in flight, with "
+        "no state/window handoff — the old owner commits its absorb "
+        "while the new owner absorbs the redelivery: one message, two "
+        "durable effects",
+        lambda: ShardedEpochModel(mutations=("rebalance_mid_epoch",)),
+    ),
+    "shard-rebalance-drops-window": (
+        "the rebalance hands off state rows but not the dedup window — "
+        "redelivered messages look fresh to the new owner",
+        lambda: ShardedEpochModel(mutations=("rebalance_drops_window",)),
+    ),
+}
+
+# Proven-indistinguishable variants (see module docstring): these MUST
+# still verify clean at the contract scope — a counterexample appearing
+# here means the fault model widened and the docs need updating.
+BOUNDARY_MUTANTS: Dict[str, Tuple[str, Callable[[], object]]] = {
+    "dc-fallback-first-chain": (
+        "recovery takes the first readable base's chain instead of the "
+        "best — within the single-fault contract all candidate chains "
+        "of one linear history converge, so this is unobservable",
+        lambda: DeltaChainModel(
+            mutations=("fallback_first_chain",),
+            corrupts=2, crashes=3, compacts=2, max_epochs=5),
+    ),
+    "dc-fallback-stale-base": (
+        "recovery skips the stale-orphan base cross-check — also "
+        "unobservable within the contract (an orphan base can only go "
+        "stale through a second same-generation fault)",
+        lambda: DeltaChainModel(
+            mutations=("fallback_stale_base",),
+            corrupts=2, crashes=3, compacts=2, max_epochs=5),
+    ),
+}
+
+
+def verify_mutants(names=None) -> List[Tuple[str, str, CheckResult]]:
+    """Run every catalogued mutant; returns [(name, description, result)].
+    The gate requires ``not result.ok`` (a counterexample) for each."""
+    out = []
+    for name in (MUTANTS if names is None else names):
+        desc, factory = MUTANTS[name]
+        out.append((name, desc, check(factory())))
+    return out

@@ -1,0 +1,291 @@
+"""Model of pod-scale sharded epochs — the ROADMAP spine, pre-verified.
+
+N workers consume service-hash partitions of the ``transactions`` queue
+(the producer shards by service key; one transport queue per partition),
+each running its OWN at-least-once epoch cycle with a per-shard dedup
+window and per-shard delta chain. The fleet-level invariant the pod-scale
+item needs certified before it is built:
+
+- **fleet-exactly-once**: every message's effect lands in durable state
+  exactly once across ALL shards (a per-shard dedup window cannot see
+  another shard's absorbs — routing discipline is what keeps the windows
+  sufficient);
+- **owner-locality** (at quiescence): the effect lives on the shard that
+  owns the message's partition under the final map — reads/serving hit
+  the owner, so an effect stranded on a previous owner is a lost write.
+
+The per-shard cycle is deliberately coarser than alo.py (atomic
+persist+ack commit, no feed buffer): those interleavings are verified
+there; this model isolates what sharding ADDS — routing, redelivery
+across ownership changes, and the rebalance protocol. A correct rebalance
+of partition p from shard a to b is modeled as the quiesced handoff the
+per-shard chain manifests enable (parallel/checkpoint.py orbax meta):
+wait until a has NO unacked deliveries, then move p's ownership together
+with its dedup-window entries and its rows of durable/volatile state.
+
+Mutations: ``rebalance_mid_epoch`` (ownership moves while deliveries are
+in flight, no handoff — the original shard absorbs and commits a message
+whose redelivery the new owner also absorbs), ``rebalance_drops_window``
+(state rows move but the dedup window does not — redelivered messages
+look fresh to the new owner).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Iterator, Optional, Tuple
+
+# pmap:    partition -> owning shard
+# queues:  per-partition FIFO of msg ids
+# ledgers: per-shard tuple of (gen, msg) unacked deliveries
+# gens:    per-shard broker connection generation
+# windows/pwindows: per-shard dedup windows (in-memory / persisted)
+# vol/dur: per-shard per-msg effect counts
+# crashes/bounces/dups/rebalances: remaining budgets
+S = namedtuple(
+    "S",
+    "sent pmap queues ledgers gens windows pwindows tokens vol dur "
+    "crashes bounces dups rebalances",
+)
+
+_MUTATIONS = frozenset({"rebalance_mid_epoch", "rebalance_drops_window"})
+
+
+class ShardedEpochModel:
+    def __init__(self, *, n_shards: int = 2, n_msgs: int = 3,
+                 window: Optional[int] = None, crashes: int = 1,
+                 bounces: int = 1, dups: int = 1, rebalances: int = 1,
+                 mutations: Tuple[str, ...] = ()):
+        bad = set(mutations) - _MUTATIONS
+        if bad:
+            raise ValueError(f"unknown mutations: {sorted(bad)}")
+        self.k = n_shards
+        self.n = n_msgs
+        self.w = n_msgs if window is None else window
+        self.crashes = crashes
+        self.bounces = bounces
+        self.dups = dups
+        self.rebalances = rebalances
+        self.mut = frozenset(mutations)
+        self.name = "sharded-epochs" + (
+            f"[{'+'.join(sorted(self.mut))}]" if self.mut else "")
+        self.scope = {
+            "shards": n_shards, "msgs": n_msgs, "window": self.w,
+            "crashes": crashes, "bounces": bounces, "dups": dups,
+            "rebalances": rebalances,
+        }
+
+    def part(self, m: int) -> int:
+        """The service-hash partition of message m."""
+        return m % self.k
+
+    def initial(self) -> S:
+        zrow = (0,) * self.n
+        return S(
+            sent=0,
+            pmap=tuple(range(self.k)),
+            queues=((),) * self.k,
+            ledgers=((),) * self.k,
+            gens=(0,) * self.k,
+            windows=((),) * self.k,
+            pwindows=((),) * self.k,
+            tokens=((),) * self.k,
+            vol=(zrow,) * self.k,
+            dur=(zrow,) * self.k,
+            crashes=self.crashes, bounces=self.bounces, dups=self.dups,
+            rebalances=self.rebalances,
+        )
+
+    # -- tuple surgery -------------------------------------------------------
+    @staticmethod
+    def _set(t: tuple, i: int, v) -> tuple:
+        return t[:i] + (v,) + t[i + 1:]
+
+    @classmethod
+    def _bump(cls, mat: tuple, sh: int, m: int) -> tuple:
+        row = mat[sh]
+        return cls._set(mat, sh, cls._set(row, m, min(2, row[m] + 1)))
+
+    def _receive(self, s: S, sh: int, m: int, token) -> S:
+        """Delivery (or chaos dup) reaching shard ``sh``'s worker."""
+        if m in s.windows[sh]:
+            toks = s.tokens[sh]
+            if token in toks:
+                return s
+            return s._replace(
+                tokens=self._set(s.tokens, sh, tuple(sorted(toks + (token,)))))
+        win = s.windows[sh] + (m,)
+        if len(win) > self.w:
+            win = win[1:]
+        return s._replace(
+            windows=self._set(s.windows, sh, win),
+            vol=self._bump(s.vol, sh, m),
+            tokens=self._set(
+                s.tokens, sh, tuple(sorted(set(s.tokens[sh]) | {token}))),
+        )
+
+    def _requeue_shard(self, s: S, sh: int) -> S:
+        """Shard sh's unacked deliveries go back to their partition queues
+        (front, original order) — routing happens again at redelivery, per
+        the CURRENT map."""
+        queues = list(s.queues)
+        for _g, m in reversed(s.ledgers[sh]):
+            p = self.part(m)
+            queues[p] = (m,) + queues[p]
+        return s._replace(
+            queues=tuple(queues),
+            ledgers=self._set(s.ledgers, sh, ()),
+            gens=self._set(s.gens, sh, s.gens[sh] + 1),
+        )
+
+    # -- transition relation -------------------------------------------------
+    def actions(self, s: S) -> Iterator[Tuple[str, S]]:
+        out = []
+        if s.sent < self.n:
+            m = s.sent
+            p = self.part(m)
+            out.append((f"publish(m{m}->q{p})", s._replace(
+                sent=s.sent + 1,
+                queues=self._set(s.queues, p, s.queues[p] + (m,)))))
+
+        for sh in range(self.k):
+            # deliver: shard sh pops the front of a partition queue it owns
+            if len(s.ledgers[sh]) < self.w:
+                for p in range(self.k):
+                    if s.pmap[p] != sh or not s.queues[p]:
+                        continue
+                    m, rest = s.queues[p][0], s.queues[p][1:]
+                    token = (s.gens[sh], m)
+                    ns = s._replace(
+                        queues=self._set(s.queues, p, rest),
+                        ledgers=self._set(s.ledgers, sh, s.ledgers[sh] + (token,)))
+                    out.append((f"deliver(m{m}->s{sh})",
+                                self._receive(ns, sh, m, token)))
+            # chaos duplicate of an in-flight delivery on this shard
+            if s.dups > 0:
+                for g, m in s.ledgers[sh]:
+                    ns = self._receive(s._replace(dups=s.dups - 1), sh, m, (g, m))
+                    out.append((f"dup(m{m}->s{sh})", ns))
+            # epoch commit: persist state + window, ack the epoch (atomic
+            # here — the persist/ack interleavings are alo.py's job)
+            if s.tokens[sh] or s.vol[sh] != s.dur[sh] \
+                    or s.windows[sh] != s.pwindows[sh]:
+                toks = set(s.tokens[sh])
+                ns = s._replace(
+                    dur=self._set(s.dur, sh, s.vol[sh]),
+                    pwindows=self._set(s.pwindows, sh, s.windows[sh]),
+                    ledgers=self._set(
+                        s.ledgers, sh,
+                        tuple(e for e in s.ledgers[sh] if e not in toks)),
+                    tokens=self._set(s.tokens, sh, ()),
+                )
+                out.append((f"commit(s{sh})", ns))
+            # kill −9 + restart of one shard worker
+            if s.crashes > 0:
+                ns = s._replace(
+                    crashes=s.crashes - 1,
+                    vol=self._set(s.vol, sh, s.dur[sh]),
+                    windows=self._set(s.windows, sh, s.pwindows[sh]),
+                    tokens=self._set(s.tokens, sh, ()),
+                )
+                out.append((f"crash(s{sh})", self._requeue_shard(ns, sh)))
+
+        # broker bounce: every shard's unacked deliveries requeue; workers
+        # keep their volatile state and stale tokens
+        if s.bounces > 0:
+            ns = s._replace(bounces=s.bounces - 1)
+            for sh in range(self.k):
+                ns = self._requeue_shard(ns, sh)
+            out.append(("bounce", ns))
+
+        # rebalance: partition p moves a -> b. The CORRECT protocol is a
+        # quiesced handoff: a has nothing unacked, and p's dedup-window
+        # entries + state rows move with the ownership (per-shard chain
+        # manifest handoff). The mutants break exactly those two clauses.
+        if s.rebalances > 0:
+            for p in range(self.k):
+                a = s.pmap[p]
+                for b in range(self.k):
+                    if b == a:
+                        continue
+                    mid_epoch = "rebalance_mid_epoch" in self.mut
+                    if s.ledgers[a] and not mid_epoch:
+                        continue  # not quiesced: handoff must wait
+                    ns = s._replace(
+                        rebalances=s.rebalances - 1,
+                        pmap=self._set(s.pmap, p, b))
+                    if not mid_epoch and "rebalance_drops_window" not in self.mut:
+                        moved = tuple(m for m in s.windows[a] if self.part(m) == p)
+                        kept = tuple(m for m in s.windows[a] if self.part(m) != p)
+                        ns = ns._replace(
+                            windows=self._set(
+                                self._set(ns.windows, a, kept),
+                                b, ns.windows[b] + moved))
+                        pmoved = tuple(m for m in s.pwindows[a] if self.part(m) == p)
+                        pkept = tuple(m for m in s.pwindows[a] if self.part(m) != p)
+                        ns = ns._replace(
+                            pwindows=self._set(
+                                self._set(ns.pwindows, a, pkept),
+                                b, ns.pwindows[b] + pmoved))
+                    if not mid_epoch:
+                        # state-row handoff (vol == dur for p's msgs after
+                        # quiesce; move both so restores stay consistent)
+                        vol, dur = ns.vol, ns.dur
+                        for m in range(self.n):
+                            if self.part(m) != p:
+                                continue
+                            for mat_name in ("vol", "dur"):
+                                mat = vol if mat_name == "vol" else dur
+                                moved_v = min(2, mat[b][m] + mat[a][m])
+                                mat = self._set(
+                                    mat, b, self._set(mat[b], m, moved_v))
+                                mat = self._set(
+                                    mat, a, self._set(mat[a], m, 0))
+                                if mat_name == "vol":
+                                    vol = mat
+                                else:
+                                    dur = mat
+                        ns = ns._replace(vol=vol, dur=dur)
+                    out.append((f"rebalance(q{p}:s{a}->s{b})", ns))
+        return out
+
+    # -- invariants ----------------------------------------------------------
+    def invariant(self, s: S) -> Optional[str]:
+        for m in range(self.n):
+            total = sum(s.dur[sh][m] for sh in range(self.k))
+            if total >= 2:
+                where = ",".join(
+                    f"s{sh}" for sh in range(self.k) if s.dur[sh][m])
+                return (f"m{m} effected {total}x across shards [{where}] "
+                        f"(fleet exactly-once violated)")
+        # owner-locality at quiescence: everything delivered, absorbed,
+        # committed and acked — effects must sit on the owning shard
+        quiescent = (
+            s.sent == self.n
+            and not any(s.queues) and not any(s.ledgers)
+            and not any(s.tokens)
+            and s.vol == s.dur
+        )
+        if quiescent:
+            for m in range(self.n):
+                owner = s.pmap[self.part(m)]
+                if s.dur[owner][m] != 1 and sum(
+                        s.dur[sh][m] for sh in range(self.k)) == 1:
+                    holder = next(
+                        sh for sh in range(self.k) if s.dur[sh][m])
+                    return (f"m{m}'s effect is stranded on s{holder} but "
+                            f"partition q{self.part(m)} is owned by "
+                            f"s{owner} (owner-locality violated: serving "
+                            f"reads miss the write)")
+        return None
+
+    def describe(self, s: S) -> str:
+        qs = " ".join(
+            f"q{p}[{','.join(f'm{m}' for m in q)}]->s{s.pmap[p]}"
+            for p, q in enumerate(s.queues))
+        shards = " ".join(
+            f"s{sh}(led={len(s.ledgers[sh])} win=[{','.join(f'm{m}' for m in s.windows[sh])}] "
+            f"vol={''.join(str(c) for c in s.vol[sh])} "
+            f"dur={''.join(str(c) for c in s.dur[sh])})"
+            for sh in range(self.k))
+        return f"sent={s.sent} {qs} {shards}"

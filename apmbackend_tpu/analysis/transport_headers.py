@@ -1,0 +1,196 @@
+"""Transport-header drift check: stamped vs round-tripped vs read.
+
+Message headers are the side channel everything above the transport
+quietly depends on: ``msg_id`` is the at-least-once dedup key,
+``ingest_ts`` anchors the e2e latency series, ``trace_id`` carries the
+distributed trace, and ``redelivered`` flags the crash-recovery hop. The
+producer stamps them once (``ProducerQueue.write_line``), but each
+transport serializes them its own way — memory tuples, AMQP
+BasicProperties, spool JSON — and a header that rides two of three
+transports is exactly how trace_id-over-spool drift would slip in: every
+test on the memory broker stays green while the spool deployment
+silently loses the field.
+
+Three checks, all from string-literal/AST evidence:
+
+- **carry**: every transport backend's ``send`` must reference its
+  ``headers`` parameter (opaque pass-through of the whole dict — the
+  contract all three backends implement). A send that ignores headers
+  drops every stamped key on that transport.
+- **synthesized drift**: a header key a transport backend *adds* on its
+  own (``headers["redelivered"] = True`` on redelivery) must be
+  synthesized by EVERY transport backend — consumers read one key, not
+  one-key-per-backend. This is the check that caught the real
+  redelivered-over-spool gap (see transport/spool.py).
+- **read-but-never-stamped**: a header key consumers read
+  (``headers.get("k")`` / ``h["k"]``) must be stamped by the producer or
+  synthesized by the transports — a typo'd key silently reads None
+  forever.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Project, SourceFile, rule
+
+# names treated as header dicts at read sites (worker uses `h` for the
+# `headers or {}` rebind); anything else is out of scope to keep the rule
+# near-zero false positive
+_HEADER_NAMES = {"headers", "h"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _stamped(project: Project) -> Dict[str, Tuple[str, int]]:
+    """Header keys stamped at ``ProducerQueue.write_line`` (the single
+    transport-entry point): dict-literal keys of ``headers = {...}`` plus
+    ``headers["k"] = ...`` subscript assigns inside the function."""
+    def build() -> Dict[str, Tuple[str, int]]:
+        out: Dict[str, Tuple[str, int]] = {}
+        sf = project.file("transport/base.py")
+        if sf is None:
+            return out
+        fn = None
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "write_line":
+                fn = node
+                break
+        if fn is None:
+            return out
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict)
+                    and any(isinstance(t, ast.Name) and t.id in _HEADER_NAMES
+                            for t in node.targets)):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        out.setdefault(key.value, (sf.rel, node.lineno))
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in _HEADER_NAMES
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)):
+                        out.setdefault(t.slice.value, (sf.rel, node.lineno))
+        return out
+    return project.cached("headers.stamped", build)
+
+
+def _transport_backends(project: Project) -> List[SourceFile]:
+    sep = "/"
+    out = []
+    for sf in project.files:
+        rel = sf.rel.replace(os.sep, sep)
+        parts = rel.split(sep)
+        if "transport" in parts[:-1] and parts[-1] not in ("base.py", "__init__.py"):
+            out.append(sf)
+    return out
+
+
+def _synthesized(sf: SourceFile) -> Dict[str, int]:
+    """{key: line} for ``<headers-ish>["k"] = ...`` assigns in a module."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in _HEADER_NAMES
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)):
+                out.setdefault(t.slice.value, node.lineno)
+    return out
+
+
+def _reads(project: Project) -> List[Tuple[str, str, int]]:
+    """[(key, file, line)] for consumer-side header reads:
+    ``headers.get("k")`` / ``h.get("k")`` (incl. the ``(headers or
+    {}).get`` shape) and ``headers["k"]`` loads."""
+    def build() -> List[Tuple[str, str, int]]:
+        out: List[Tuple[str, str, int]] = []
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                key = None
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "get"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and _names_in(node.func.value) & _HEADER_NAMES
+                        and not isinstance(node.func.value, ast.Attribute)):
+                    key = node.args[0].value
+                elif (isinstance(node, ast.Subscript)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in _HEADER_NAMES
+                        and isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, str)):
+                    key = node.slice.value
+                if key is not None:
+                    out.append((key, sf.rel, node.lineno))
+        return out
+    return project.cached("headers.reads", build)
+
+
+@rule("transport-header-drift",
+      "message headers must ride every transport and resolve to a stamp")
+def check_transport_headers(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    stamped = _stamped(project)
+    backends = _transport_backends(project)
+    if not backends:
+        return findings
+
+    # carry: every backend's send() must pass the headers dict through
+    for sf in backends:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.FunctionDef) and node.name == "send"):
+                continue
+            params = {a.arg for a in node.args.args}
+            if "headers" not in params:
+                continue
+            used = any(
+                isinstance(n, ast.Name) and n.id == "headers"
+                and isinstance(n.ctx, ast.Load)
+                for stmt in node.body for n in ast.walk(stmt))
+            if not used:
+                findings.append(Finding(
+                    "transport-header-drift", sf.rel, node.lineno,
+                    "send() ignores its headers parameter — every stamped "
+                    "header (msg_id, ingest_ts, trace_id) is dropped on "
+                    "this transport"))
+
+    # synthesized drift: transport-added keys must exist on ALL backends
+    per_backend = {sf.rel: _synthesized(sf) for sf in backends}
+    all_synth: Set[str] = set()
+    for keys in per_backend.values():
+        all_synth |= set(keys)
+    for key in sorted(all_synth):
+        have = [rel for rel, keys in per_backend.items() if key in keys]
+        for sf in backends:
+            if key in per_backend[sf.rel]:
+                continue
+            findings.append(Finding(
+                "transport-header-drift", sf.rel, 1,
+                f"header {key!r} is synthesized by {', '.join(sorted(have))} "
+                f"but not by this transport — consumers reading it get "
+                f"transport-dependent behavior"))
+
+    # read-but-never-stamped
+    known = set(stamped) | all_synth
+    if known:  # no stamp site found at all: skip (fixture projects)
+        for key, rel, line in _reads(project):
+            if key not in known:
+                findings.append(Finding(
+                    "transport-header-drift", rel, line,
+                    f"header {key!r} is read here but no producer stamps "
+                    f"it and no transport synthesizes it — this read is "
+                    f"always None"))
+    return findings

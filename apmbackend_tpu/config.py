@@ -544,6 +544,14 @@ _DEFAULT_CONFIG: dict = {
         # recently absorbed ids persisted inside every snapshot.
         "deliveryMode": "atMostOnce",
         "dedupWindowSize": 65536,
+        # Protocol event log (DESIGN.md §9.4 trace conformance): when set
+        # to a path, the worker appends one JSONL event per protocol step
+        # (recover/deliver/feed/checkpoint/ack/compact); the model
+        # checker's conformance tier replays the log as a path of the
+        # at-least-once + delta-chain models. Off in production unless a
+        # protocol flight log is wanted — cost is one json.dumps + write
+        # per delivery.
+        "protocolEventLog": None,
         # at-least-once intake batching: accepted deliveries buffer up to
         # this many lines and reach the engine as one bulk feed (the native
         # CSV decode path) instead of per-message object feeds; drained on

@@ -539,29 +539,48 @@ class DeltaChain:
         the keep-one-generation fallback), then replay the contiguous valid
         delta chain from it. Returns None when no readable chain exists —
         the caller starts fresh, never crashes (load_resume contract). The
-        writer continues from the recovered tail."""
+        writer continues from the recovered tail.
+
+        Candidate selection (hardened after protocol model checking,
+        DESIGN.md §9.4): every readable base is evaluated and the chain
+        recovering the HIGHEST epoch wins (ties go to the manifest base).
+        A non-manifest fallback base is additionally cross-checked against
+        the delta segment at its own epoch — a compaction that crashed
+        before the manifest swap leaves an orphan base, and if the chain
+        was later rewritten below it the orphan's content matches no
+        committed state; a valid same-epoch delta with a different uid
+        (or an unreadable one) exposes it. Within the single-fault storage
+        contract the model proves all candidates converge, so this changes
+        nothing there — it is defense in depth for multi-fault excursions,
+        which degrade to the best surviving boundary instead of the first
+        readable one."""
         bases = self._scan_bases()
         manifest = self._read_manifest()
         order: List[int] = []
         if manifest is not None and manifest["base_epoch"] in bases:
             order.append(manifest["base_epoch"])
         order.extend(e for e in sorted(bases, reverse=True) if e not in order)
+        best: Optional[RecoveredChain] = None
         for base_epoch in order:
-            rec = self._try_chain(base_epoch)
+            authoritative = manifest is not None and base_epoch == manifest["base_epoch"]
+            rec = self._try_chain(base_epoch, authoritative=authoritative)
             if rec is None:
                 continue
-            with self._lock:
-                self._chain_id = rec.chain_id
-                self._base_epoch = base_epoch
-                self._tail_epoch = rec.epoch
-                self._tail_uid = rec.tail_uid
-            if rec.dropped and self.logger:
-                self.logger.warning(
-                    f"Checkpoint chain recovered to epoch {rec.epoch}; dropped "
-                    f"uncommitted/invalid tail: {', '.join(rec.dropped)}"
-                )
-            return rec
-        return None
+            if best is None or rec.epoch > best.epoch:
+                best = rec
+        if best is None:
+            return None
+        with self._lock:
+            self._chain_id = best.chain_id
+            self._base_epoch = best.base_epoch
+            self._tail_epoch = best.epoch
+            self._tail_uid = best.tail_uid
+        if best.dropped and self.logger:
+            self.logger.warning(
+                f"Checkpoint chain recovered to epoch {best.epoch}; dropped "
+                f"uncommitted/invalid tail: {', '.join(best.dropped)}"
+            )
+        return best
 
     def _scan_bases(self) -> Dict[int, str]:
         out: Dict[int, str] = {}
@@ -584,7 +603,8 @@ class DeltaChain:
         except Exception:
             return None
 
-    def _try_chain(self, base_epoch: int) -> Optional[RecoveredChain]:
+    def _try_chain(self, base_epoch: int,
+                   authoritative: bool = True) -> Optional[RecoveredChain]:
         path = self._base_path(base_epoch)
         try:
             with np.load(path, allow_pickle=True) as npz:
@@ -595,6 +615,30 @@ class DeltaChain:
             if self.logger:
                 self.logger.error(f"Checkpoint base unreadable (falling back): {path}: {e}")
             return None
+        if not authoritative and base_epoch > 0:
+            # stale-orphan cross-check: a fallback base must agree with the
+            # delta segment that committed its epoch. An orphan base from a
+            # dead compaction, stranded above a rewritten chain, carries a
+            # uid no longer on the chain — reject it rather than recover a
+            # state no commit ever produced. (Absent segment = the epoch's
+            # delta was GC'd below a completed compaction: a legitimate
+            # previous-generation base.)
+            own = self._seg_path(base_epoch)
+            if os.path.exists(own):
+                try:
+                    with open(own, "rb") as fh:
+                        own_header, _ = _decode_segment(fh.read())
+                    own_uid = own_header.get("uid", "")
+                except (InvalidSegment, OSError):
+                    own_uid = None  # unreadable delta: ambiguous, reject
+                if own_uid != uid:
+                    if self.logger:
+                        self.logger.warning(
+                            f"Checkpoint base {os.path.basename(path)} is a "
+                            f"stale orphan (delta-{base_epoch:012d}.seg "
+                            f"contradicts its uid); skipping"
+                        )
+                    return None
         epoch = base_epoch
         dropped: List[str] = []
         while True:

@@ -104,6 +104,20 @@ class WorkerApp:
         self._dedup_added_epoch: list = []  # guarded-by: _driver_lock
         self._dedup_evicted_epoch = 0  # guarded-by: _driver_lock
 
+        # protocol event log (analysis/protocol conformance): every
+        # deliver/feed/checkpoint/ack/compact/recover step appended as one
+        # JSONL line, replayed by the model checker's trace-conformance
+        # tier as a path of the ALO + delta-chain models. Off (None) in
+        # production unless an operator wants a protocol flight log.
+        self._ev_fh = None
+        self._ev_lock = threading.Lock()
+        ev_path = eng_cfg.get("protocolEventLog")
+        if ev_path:
+            import os as _os
+
+            _os.makedirs(_os.path.dirname(_os.path.abspath(ev_path)), exist_ok=True)
+            self._ev_fh = open(ev_path, "a", encoding="utf-8")
+
         # -- checkpoint plane (full npz vs delta chain + failure policy) -----
         ck_mode = str(eng_cfg.get("checkpointMode", "full"))
         if ck_mode not in ("full", "delta"):
@@ -309,6 +323,16 @@ class WorkerApp:
             self._seed_delivery(in_queue_name)
         if self.alerts_resume:
             self.alerts_manager.load_resume(self.alerts_resume)
+        # conformance: the boot boundary — what epoch the durable state
+        # restored to (0 = fresh) and, in delta mode, the chain position
+        self._emit_event(
+            "recover",
+            epoch=self._delivery_epoch,
+            chain_epoch=(self._ckpt_chain.tail_epoch
+                         if self._ckpt_chain is not None else None),
+            mode=self._ckpt_mode,
+            window=len(self._dedup_fifo),
+        )
 
         # float + floor: the chaos tier runs sub-second epoch cadences, and
         # int() would truncate 0.4 to a zero-interval busy loop
@@ -383,6 +407,24 @@ class WorkerApp:
                 if self.driver._tracer is not None else [],
             )
             flight.add_source("engine_health", self._health)
+
+    def _emit_event(self, ev: str, **fields) -> None:
+        """Append one protocol event (JSONL) — the trace-conformance feed.
+        Failures never touch the protocol itself (best-effort log)."""
+        fh = self._ev_fh
+        if fh is None:
+            return
+        import json as _json
+
+        fields["ev"] = ev
+        fields["ts"] = time.time()
+        try:
+            line = _json.dumps(fields, separators=(",", ":"))
+            with self._ev_lock:
+                fh.write(line + "\n")
+                fh.flush()
+        except Exception:
+            pass
 
     def _seed_delivery(self, in_queue_name: str) -> None:
         """Seed the dedup window / epoch watermark from a restored snapshot
@@ -693,6 +735,13 @@ class WorkerApp:
         reprocess against the pre-epoch state)."""
         msg_id = (headers or {}).get("msg_id")
         with self._driver_lock:
+            if self._ev_fh is not None:
+                self._emit_event(
+                    "deliver", msg=msg_id,
+                    dedup=msg_id is not None and msg_id in self._dedup_set,
+                    tx=line.startswith("tx|"),
+                    redelivered=bool((headers or {}).get("redelivered")),
+                )
             if msg_id is not None and msg_id in self._dedup_set:
                 # already absorbed: a broker redelivery or an in-flight
                 # duplicate. Skip the feed, count it — but do NOT ack now:
@@ -731,7 +780,7 @@ class WorkerApp:
                         if tid is not None and self.driver._trace is not None
                         else None
                     )
-                    self._alo_pending.append((line, ts, ctx))
+                    self._alo_pending.append((line, ts, ctx, msg_id))
                     if len(self._alo_pending) >= self._alo_batch:
                         self._drain_alo_pending_locked()
                 else:
@@ -748,21 +797,59 @@ class WorkerApp:
     # apm: holds(_driver_lock): every caller acquires it (accept path, drain timer, save_state)
     def _drain_alo_pending_locked(self) -> None:
         """Feed the buffered at-least-once deliveries as one bulk batch
-        (caller holds the driver lock)."""
+        (caller holds the driver lock).
+
+        Failure path (protocol model checking, DESIGN.md §9.4): the dedup
+        window's invariant is "membership ⇒ the message's effect reached
+        the engine". The batch's ids were added at ACCEPT time, so if the
+        bulk feed raises, leaving them in the window would turn a dropped
+        batch into messages that are silently deduped forever — even
+        their crash redeliveries would be skipped. On failure the batch's
+        ids are withdrawn from the window (and from the delta-commit
+        incremental record): a crash before the epoch commit then
+        redelivers and reprocesses them; without a crash they are dropped
+        loudly, same policy as the at-most-once feed path."""
         pending = self._alo_pending
         if not pending:
             return
         self._alo_pending = []
         if self.driver._tracer is not None:
-            oldest = min((ts for _l, ts, _c in pending if ts is not None), default=None)
+            oldest = min((ts for _l, ts, _c, _m in pending if ts is not None),
+                         default=None)
             if oldest is not None:
                 self.driver.note_intake_time(oldest)
-            for _l, _ts, ctx in pending:
+            for _l, _ts, ctx, _m in pending:
                 # register sampled traces BEFORE the feed: the tick that
                 # closes their bucket may fire inside this very batch
                 if ctx is not None:
                     self._note_trace_now(ctx)
-        self.driver.feed_csv_batch([line for line, _ts, _c in pending])
+        try:
+            self.driver.feed_csv_batch([line for line, _ts, _c, _m in pending])
+        except Exception:
+            import traceback
+
+            batch_ids = {m for _l, _ts, _c, m in pending if m is not None}
+            if batch_ids:
+                self._dedup_set -= batch_ids
+                self._dedup_fifo = type(self._dedup_fifo)(
+                    m for m in self._dedup_fifo if m not in batch_ids)
+                if self._ckpt_chain is not None:
+                    self._dedup_added_epoch = [
+                        m for m in self._dedup_added_epoch
+                        if m not in batch_ids]
+            self.runtime.logger.error(
+                f"ALO bulk feed failed; {len(pending)} lines dropped and "
+                f"their ids withdrawn from the dedup window (crash-"
+                f"redelivery will reprocess them):\n" + traceback.format_exc()
+            )
+            flight = getattr(self.runtime, "flight", None)
+            if flight is not None:
+                try:
+                    flight.dump("worker_feed_exception")
+                except Exception:
+                    pass
+            return
+        self._emit_event("feed", n=len(pending))
 
     def drain_delivery_pending(self) -> None:
         """Public drain hook (feed-delay timer + tests)."""
@@ -1039,10 +1126,18 @@ class WorkerApp:
                 self.driver.save_resume(self.engine_resume, delivery=delivery)
         except (CheckpointWriteError, OSError) as e:
             self._ckpt_write_failed(e)
+            self._emit_event("checkpoint", ok=False, mode=self._ckpt_mode,
+                             epoch=self._delivery_epoch)
             return False
         if epoch_commit:
             self._delivery_epoch = next_epoch
         self._ckpt_write_ok()
+        self._emit_event(
+            "checkpoint", ok=True, mode=self._ckpt_mode,
+            epoch=self._delivery_epoch if epoch_commit else None,
+            chain_epoch=(self._ckpt_chain.tail_epoch
+                         if self._ckpt_chain is not None else None),
+        )
         return True
 
     # apm: holds(_driver_lock): called only from _commit_checkpoint_locked
@@ -1078,6 +1173,7 @@ class WorkerApp:
         }
         if self._ckpt_chain.compact_async(chain_epoch, arrays):
             self._ckpt_last_compact = chain_epoch
+            self._emit_event("compact", chain_epoch=chain_epoch)
 
     def save_state(self, force: bool = False) -> None:
         """Snapshot device + alert state; in at-least-once mode this IS the
@@ -1091,6 +1187,7 @@ class WorkerApp:
         in_queue = getattr(self, "in_queue", None)
         tokens: list = []
         committed = True
+        epoch_now = 0
         with self._driver_lock:
             if self._at_least_once:
                 # batched intake MUST reach the engine before the snapshot:
@@ -1116,9 +1213,11 @@ class WorkerApp:
                     tokens = []  # unacked => redelivered; dedup absorbs
             elif has_ckpt:
                 committed = self._commit_checkpoint_locked(None)
+            epoch_now = self._delivery_epoch
         if tokens and committed:
             try:
                 in_queue.ack(tokens)
+                self._emit_event("ack", n=len(tokens), epoch=epoch_now)
             except Exception as e:
                 # unacked => redelivered later; the saved dedup window makes
                 # that a skip, not a double count
@@ -1162,6 +1261,13 @@ class WorkerApp:
             # a compaction still running is crash-safe to abandon (the old
             # manifest stays valid), but an orderly exit gives it a moment
             self._ckpt_chain.wait_compaction(timeout_s=30.0)
+        if self._ev_fh is not None:
+            fh, self._ev_fh = self._ev_fh, None
+            with self._ev_lock:
+                try:
+                    fh.close()
+                except Exception:
+                    pass
 
 
 def build(runtime) -> WorkerApp:

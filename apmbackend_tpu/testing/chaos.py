@@ -221,7 +221,7 @@ class ChaosWorkerHarness:
     def __init__(self, workdir: str, *, dup_p: float = 0.0, seed: int = 0,
                  capacity: int = 64, save_every_s: float = 0.4,
                  checkpoint_mode: str = "full", compact_every: int = 0,
-                 fault_env=None):
+                 fault_env=None, event_log: bool = False):
         import sys
 
         self.workdir = os.path.abspath(workdir)
@@ -243,11 +243,31 @@ class ChaosWorkerHarness:
         # here on a fast cadence; a kill−9 leaves journal+sentinel behind
         # and the RESTARTED child promotes them into a ...-crash.json bundle
         self.flight_dir = os.path.join(self.workdir, "flight")
+        # protocol event log (analysis/protocol conformance): the child
+        # appends worker events; the harness appends crash/corrupt markers
+        # at its injection points so the replay knows what was done to it
+        self.event_log_path = (
+            os.path.join(self.workdir, "events.jsonl") if event_log else None)
         self.python = sys.executable
         self.proc = None
         self.generation = 0
         self._seq = 0
         self._producer = SpoolChannel(self.spool_dir)
+
+    def _mark_event(self, ev: str, **fields) -> None:
+        if self.event_log_path is None:
+            return
+        fields["ev"] = ev
+        fields["ts"] = time.time()
+        with open(self.event_log_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(fields, separators=(",", ":")) + "\n")
+
+    def events(self) -> list:
+        """The protocol event log so far (torn tail tolerated)."""
+        from ..analysis.protocol.conformance import read_event_log
+
+        assert self.event_log_path is not None, "harness built without event_log"
+        return read_event_log(self.event_log_path)
 
     def flight_bundles(self) -> list:
         """(path, parsed body) for every flight bundle the child produced —
@@ -298,6 +318,8 @@ class ChaosWorkerHarness:
             "--chain-dir", self.chain_dir,
             "--compact-every", str(self.compact_every),
         ]
+        if self.event_log_path:
+            argv += ["--event-log", self.event_log_path]
         log_fh = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
             argv,
@@ -315,12 +337,16 @@ class ChaosWorkerHarness:
         if self.proc is not None and self.proc.poll() is None:
             os.kill(self.proc.pid, _signal.SIGKILL)
             self.proc.wait(timeout=30)
+            self._mark_event("crash", gen=self.generation)
 
     def wait_child_death(self, timeout_s: float = 120.0) -> int:
         """Block until the child dies on its own — the fault-plan SIGKILL
         scenarios (kill:compact=...) where the child, not the harness, picks
         the crash instant. Returns the (negative-signal) exit code."""
-        return self.proc.wait(timeout=timeout_s)
+        rc = self.proc.wait(timeout=timeout_s)
+        if rc != 0:
+            self._mark_event("crash", gen=self.generation)
+        return rc
 
     def acked(self) -> int:
         return read_spool_cursor(self.spool_dir, self.QUEUE)
@@ -345,19 +371,24 @@ class ChaosWorkerHarness:
         assert seg is not None, "no delta segment to corrupt"
         blob = open(seg, "rb").read()
         if mode == "truncate":
+            # apm: allow(durability-discipline): deliberately torn bytes — this IS the hostile-storage injector
             open(seg, "wb").write(blob[: max(1, len(blob) // 2)])
         elif mode == "header":
+            # apm: allow(durability-discipline): deliberately torn header framing — hostile-storage injector
             open(seg, "wb").write(blob[: len(b"APMDCSG1") + 5])
         elif mode == "garbage":
             mid = len(blob) // 2  # 0xA5: never a no-op over real segment bytes
+            # apm: allow(durability-discipline): deliberate bit rot — hostile-storage injector
             open(seg, "wb").write(blob[:mid] + b"\xa5" * 16 + blob[mid + 16:])
         elif mode == "stale-dup":
             epoch = int(os.path.basename(seg)[6:-4])
             dup = os.path.join(self.chain_dir, f"delta-{epoch + 1:012d}.seg")
             open(dup, "wb").write(blob)
+            self._mark_event("corrupt", mode=mode)
             return dup
         else:
             raise ValueError(f"unknown corruption mode {mode!r}")
+        self._mark_event("corrupt", mode=mode)
         return seg
 
     def wait_acked(self, n: int, timeout_s: float = 120.0) -> int:
@@ -418,6 +449,7 @@ def _child_main(argv=None) -> int:
     ap.add_argument("--checkpoint-mode", default="full", choices=("full", "delta"))
     ap.add_argument("--chain-dir", default=None)
     ap.add_argument("--compact-every", type=int, default=0)
+    ap.add_argument("--event-log", default=None)
     args = ap.parse_args(argv)
 
     from ..config import default_config
@@ -444,6 +476,11 @@ def _child_main(argv=None) -> int:
         eng["checkpointWriteRetryMaxSeconds"] = 0.5
     else:
         eng["resumeFileFullPath"] = args.resume
+    if args.event_log:
+        # protocol event log for the trace-conformance tier: the REAL
+        # worker's deliver/feed/checkpoint/ack stream, replayed against
+        # the models by tests/test_protocol_conformance.py
+        eng["protocolEventLog"] = args.event_log
     cfg["streamCalcZScore"]["defaults"] = [{"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
     cfg["streamCalcStats"]["inQueue"] = args.queue
     # the resume-save timer IS the epoch cadence: short, so SIGKILLs land at

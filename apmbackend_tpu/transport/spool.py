@@ -47,13 +47,27 @@ class _SpoolQueue:
         self.acked_upto = 0  # records [0, acked_upto) are committed
         self._acked_set: set = set()
         self.next_deliver = 0
+        # delivered high-water mark, persisted beside the cursor: records
+        # below it were handed to a consumer by SOME incarnation, so a
+        # post-restart re-delivery must carry headers["redelivered"] like
+        # the memory broker and AMQP do (the transport-header-drift rule
+        # caught this field riding only two of three transports). Best-
+        # effort by design: the hwm is persisted only on ack-driven cursor
+        # writes, so deliveries after the last persist lose the flag — the
+        # dedup window never depends on it, only trace annotation does.
+        self.delivered_hwm = 0
+        self.boot_redeliver = 0  # indexes below this flag redelivered
         if os.path.exists(self.cursor_path):
             try:
                 with open(self.cursor_path, "r", encoding="utf-8") as fh:
-                    self.acked_upto = int(json.load(fh)["acked"])
+                    cur = json.load(fh)
+                self.acked_upto = int(cur["acked"])
+                self.delivered_hwm = int(cur.get("delivered", cur["acked"]))
             except Exception:
                 self.acked_upto = 0  # torn cursor: redeliver from zero (safe)
+                self.delivered_hwm = 0
         self.next_deliver = self.acked_upto
+        self.boot_redeliver = self.delivered_hwm
 
     def poll(self) -> None:
         """Parse any newly appended COMPLETE records (a concurrently writing
@@ -97,8 +111,10 @@ class _SpoolQueue:
         # previous cursor intact, and a zombie predecessor cannot share (and
         # corrupt) the tmp a restarted consumer is writing
         tmp = f"{self.cursor_path}.{os.getpid()}.tmp"
+        self.delivered_hwm = max(self.delivered_hwm, self.next_deliver)
         with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump({"acked": self.acked_upto}, fh)
+            json.dump({"acked": self.acked_upto,
+                       "delivered": self.delivered_hwm}, fh)
             if self.fsync:
                 fh.flush()
                 os.fsync(fh.fileno())
@@ -222,6 +238,12 @@ class SpoolChannel(Channel):
                     payload, headers = q.records[q.next_deliver]
                     index = q.next_deliver
                     q.next_deliver += 1
+                    if index < q.boot_redeliver:
+                        # delivered by a previous incarnation and never
+                        # acked: the same crash-redelivery hop the memory
+                        # broker and AMQP flag
+                        headers = dict(headers or {})
+                        headers["redelivered"] = True
                     if not manual and q.ack(index):
                         q.persist_cursor()
                     batch.append((cb, payload, headers, manual, (name, index)))

@@ -23,16 +23,20 @@ fi
 # --lint: the static-correctness gate, ALL hard requirements (the PR-2
 # pyflakes soft-skip is gone): byte-compile everything, run the in-repo
 # analyzer (JAX hot-path, lock discipline, config keys, metric catalogue,
-# pyflakes-lite — see DESIGN.md §9), and run real pyflakes when the
-# environment ships it (its undefined-name pass goes beyond pyflakes-lite;
-# when absent, the in-repo analyzer IS the hard lint floor). Consumed
-# standalone (CI lint stage) or before the suite:
+# transport headers, durability discipline, pyflakes-lite — see DESIGN.md
+# §9) INCLUDING the protocol model checker at its small scopes (the
+# delivery/delta-chain/sharded-epoch models verified exhaustively in
+# well under 10 s, DESIGN.md §9.4 — a violated invariant prints its
+# counterexample schedule and fails the gate), and run real pyflakes when
+# the environment ships it (its undefined-name pass goes beyond
+# pyflakes-lite; when absent, the in-repo analyzer IS the hard lint
+# floor). Consumed standalone (CI lint stage) or before the suite:
 # ./run_tests.sh --lint [pytest args...].
 if [ "$1" = "--lint" ]; then
     shift
     echo "lint: python -m compileall apmbackend_tpu benchmarks tests"
     python -m compileall -q apmbackend_tpu benchmarks tests || exit 1
-    echo "lint: python -m apmbackend_tpu.analysis"
+    echo "lint: python -m apmbackend_tpu.analysis (rules + small-scope protocol models)"
     env -u PYTHONPATH python -m apmbackend_tpu.analysis || exit 1
     if python -c "import pyflakes" 2>/dev/null; then
         echo "lint: python -m pyflakes apmbackend_tpu"
@@ -40,6 +44,25 @@ if [ "$1" = "--lint" ]; then
     fi
     # --lint alone: stop after linting; with more args fall through to pytest
     [ $# -eq 0 ] && exit 0
+fi
+
+# --model: the deep protocol-verification tier — the model checker at its
+# deep scopes (larger message counts and fault budgets; minutes, not
+# seconds), the full mutation catalogue (every seeded protocol bug must
+# yield a counterexample), and the protocol test suite including the
+# slow trace-conformance scenarios (kill−9 chaos runs replayed as model
+# paths). Run before touching worker.py's epoch cycle, deltachain.py's
+# recovery, or any transport's ack semantics:
+# ./run_tests.sh --model [pytest args...].
+if [ "$1" = "--model" ]; then
+    shift
+    echo "model: python -m apmbackend_tpu.analysis --models deep (deep scopes + mutants)"
+    env -u PYTHONPATH python -m apmbackend_tpu.analysis -q --models deep || exit 1
+    exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_protocol_models.py \
+        tests/test_protocol_conformance.py \
+        -m "slow or not slow" "$@"
 fi
 
 # --sanitize: rebuild every native component with ASan+UBSan (make
@@ -83,6 +106,7 @@ if [ "$1" = "--chaos" ]; then
         tests/test_chaos_storage.py tests/test_delta_chain.py \
         tests/test_spool_durability.py \
         tests/test_at_least_once.py tests/test_trace_plane.py \
+        tests/test_protocol_conformance.py \
         -m "slow or not slow" "$@"
 fi
 

@@ -426,6 +426,102 @@ def test_redefinition_flagged_property_stack_clean(tmp_path):
     assert "'go'" in f[0].message
 
 
+# ------------------------------------------------- transport-header-drift
+
+_XPORT_BASE = """
+    class ProducerQueue:
+        def write_line(self, line):
+            headers = {"ingest_ts": 1.0, "msg_id": "x"}
+            headers["trace_id"] = "t"
+            self.channel.send(self.queue_name, line, headers)
+"""
+
+_XPORT_OK = """
+    class Chan:
+        def send(self, name, payload, headers=None):
+            self.items.append((payload, headers))
+
+        def requeue(self):
+            for payload, headers in self.items:
+                headers["redelivered"] = True
+"""
+
+
+def test_header_drift_clean_when_all_transports_synthesize(tmp_path):
+    files = {
+        "transport/base.py": _XPORT_BASE,
+        "transport/memory.py": _XPORT_OK,
+        "transport/spool.py": _XPORT_OK,
+        "consumer.py": "def on(headers):\n    return headers.get('msg_id')\n",
+    }
+    assert run_rules(tmp_path, files, ["transport-header-drift"]) == []
+
+
+def test_header_drift_flags_missing_synthesis_and_unknown_read(tmp_path):
+    files = {
+        "transport/base.py": _XPORT_BASE,
+        "transport/memory.py": _XPORT_OK,
+        # spool never sets redelivered AND its send ignores headers
+        "transport/spool.py": """
+            class Chan:
+                def send(self, name, payload, headers=None):
+                    self.items.append(payload)
+        """,
+        "consumer.py": "def on(headers):\n    return headers.get('not_a_header')\n",
+    }
+    f = run_rules(tmp_path, files, ["transport-header-drift"])
+    msgs = "\n".join(x.message for x in f)
+    assert "ignores its headers parameter" in msgs
+    assert "'redelivered' is synthesized by" in msgs
+    assert "'not_a_header' is read here" in msgs
+    assert {x.path for x in f} == {"pkg/transport/spool.py", "pkg/consumer.py"}
+
+
+# ------------------------------------------------- durability-discipline
+
+def test_durability_raw_write_flagged_atomic_helper_clean(tmp_path):
+    files = {
+        "store.py": """
+            import os
+
+            def bad(path):
+                with open(path + ".cursor", "w") as fh:
+                    fh.write("1")
+
+            def good(path):
+                tmp = path + ".cursor.tmp"
+                with open(tmp, "w") as fh:
+                    fh.write("1")
+                os.replace(tmp, path + ".cursor")
+        """,
+    }
+    f = run_rules(tmp_path, files, ["durability-discipline"])
+    assert [x.rule for x in f] == ["durability-discipline"]
+    assert f[0].line == 5  # the raw open in bad(); good() is sanctioned
+
+
+def test_durability_owner_module_scope_and_pragma(tmp_path):
+    files = {
+        "deltachain.py": """
+            import os
+
+            def sideways(a, b):
+                os.rename(a, b)  # apm: allow(durability-discipline): test fixture reason
+        """,
+        "other.py": "import os\n\ndef mv(a, b):\n    os.rename(a, b)\n",
+    }
+    # owner module: flagged (then suppressed by the pragma); non-owner
+    # module with no durable token in the path: not flagged at all
+    assert run_rules(tmp_path, files, ["durability-discipline"]) == []
+
+
+def test_durability_append_mode_not_flagged(tmp_path):
+    files = {
+        "journal.py": "def log(p):\n    open(p + '.spool', 'ab').write(b'x')\n",
+    }
+    assert run_rules(tmp_path, files, ["durability-discipline"]) == []
+
+
 # ---------------------------------------------------------- pragma grammar
 
 def test_allow_pragma_suppresses_with_reason(tmp_path):

@@ -1,0 +1,250 @@
+"""Trace conformance (ISSUE 8 satellite): real worker runs replay as
+valid paths of the protocol models.
+
+The models verify the protocol; this suite pins the models to the
+IMPLEMENTATION. The worker, run for real (in-process over the memory
+broker in tier-1; as a kill−9'd subprocess over the durable spool in the
+``slow`` tier), emits a protocol event log; the conformance checker
+(analysis/protocol/conformance.py) steps a deterministic mirror of the
+ALO + delta-chain model semantics through it and reports every
+transition the models do not allow. Green means the chaos runs ARE model
+paths; the negative tests prove the checker rejects the classic broken
+orderings, so green is not vacuous.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from apmbackend_tpu.analysis.protocol import check_protocol_trace, read_event_log
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.runtime.module_base import ModuleRuntime
+from apmbackend_tpu.runtime.worker import WorkerApp
+from apmbackend_tpu.testing.chaos import ChaosChannel, ChaosWorkerHarness
+from apmbackend_tpu.transport.base import QueueManager
+from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+from test_chaos_harness import make_stream
+
+
+def _mk_worker(tmp_path, broker, *, dup_p=0.0):
+    ev = str(tmp_path / "events.jsonl")
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 32
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["metricsPort"] = None
+    eng["protocolEventLog"] = ev
+    eng["resumeFileFullPath"] = str(tmp_path / "resume.npz")
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+
+    runtime = ModuleRuntime("tpuEngine", config=cfg, broker=broker)
+
+    def factory(direction):
+        ch = MemoryChannel(broker)
+        if direction == "c" and dup_p > 0:
+            return ChaosChannel(ch, dup_p=dup_p, seed=11)
+        return ch
+
+    runtime.qm = QueueManager(factory, 3600, logger=runtime.logger)
+    worker = WorkerApp(runtime)
+    return worker, runtime, ev
+
+
+# ----------------------------------------------------------- fast (tier-1)
+
+def test_clean_run_replays_as_model_path(tmp_path):
+    broker = MemoryBroker()
+    worker, runtime, ev = _mk_worker(tmp_path, broker)
+    prod = QueueManager(lambda d: MemoryChannel(broker), 3600).get_queue(
+        "transactions", "p")
+    for line in make_stream(n_labels=3, per_label=20):
+        prod.write_line(line)
+    broker.pump()
+    worker.save_state()
+    worker.shutdown()
+    runtime.stop_timers()
+
+    events = read_event_log(ev)
+    kinds = {e["ev"] for e in events}
+    assert {"recover", "deliver", "feed", "checkpoint", "ack"} <= kinds
+    assert check_protocol_trace(events) == []
+
+
+def test_bounce_redelivery_and_dups_replay_as_model_path(tmp_path):
+    """Redelivery + chaos duplicates — the interleavings the ALO model
+    enumerates — conform when the real worker produces them."""
+    broker = MemoryBroker()
+    worker, runtime, ev = _mk_worker(tmp_path, broker, dup_p=0.5)
+    prod = QueueManager(lambda d: MemoryChannel(broker), 3600).get_queue(
+        "transactions", "p")
+    lines = make_stream(n_labels=3, per_label=15)
+    half = len(lines) // 2
+    for line in lines[:half]:
+        prod.write_line(line)
+    broker.pump()
+    worker.save_state()  # epoch 1: committed + acked
+    for line in lines[half:]:
+        prod.write_line(line)
+    broker.pump()
+    broker.bounce()  # redeliver the unacked second half
+    broker.pump()
+    worker.save_state()  # epoch 2
+    worker.shutdown()
+    runtime.stop_timers()
+
+    events = read_event_log(ev)
+    deliv = [e for e in events if e["ev"] == "deliver"]
+    assert any(e["dedup"] for e in deliv), "chaos produced no duplicates?"
+    assert any(e.get("redelivered") for e in deliv)
+    assert check_protocol_trace(events) == []
+
+
+def test_conformance_rejects_broken_orderings():
+    """The checker's teeth: each classic protocol violation is reported
+    when spliced into an otherwise-plausible log."""
+    base = [{"ev": "recover", "epoch": 0, "chain_epoch": None}]
+
+    # ack before any checkpoint of that epoch
+    v = check_protocol_trace(base + [{"ev": "ack", "n": 1, "epoch": 1}])
+    assert any("ack-after-checkpoint" in x for x in v)
+
+    # epoch jump
+    v = check_protocol_trace(base + [
+        {"ev": "checkpoint", "ok": True, "epoch": 2}])
+    assert any("monotonic" in x for x in v)
+
+    # commit with undrained pending feed
+    v = check_protocol_trace(base + [
+        {"ev": "deliver", "msg": "a", "dedup": False, "tx": True},
+        {"ev": "checkpoint", "ok": True, "epoch": 1}])
+    assert any("undrained" in x for x in v)
+
+    # dedup of an unknown message
+    v = check_protocol_trace(base + [
+        {"ev": "deliver", "msg": "ghost", "dedup": True, "tx": True}])
+    assert any("NOT in the dedup window" in x for x in v)
+
+    # double absorb of a committed message (the double-effect shape)
+    v = check_protocol_trace(base + [
+        {"ev": "deliver", "msg": "a", "dedup": False, "tx": True},
+        {"ev": "feed", "n": 1},
+        {"ev": "checkpoint", "ok": True, "epoch": 1},
+        {"ev": "crash"},
+        {"ev": "recover", "epoch": 1},
+        {"ev": "deliver", "msg": "a", "dedup": False, "tx": True}])
+    assert any("double effect" in x or "already in the window" in x for x in v)
+
+    # worker events from a dead process
+    v = check_protocol_trace(base + [
+        {"ev": "crash"},
+        {"ev": "deliver", "msg": "a", "dedup": False, "tx": True}])
+    assert any("after a crash marker" in x for x in v)
+
+    # recovery past the committed boundary
+    v = check_protocol_trace(base + [
+        {"ev": "checkpoint", "ok": True, "epoch": 1},
+        {"ev": "crash"},
+        {"ev": "recover", "epoch": 3}])
+    assert any("past the last committed" in x for x in v)
+
+    # recovery losing committed epochs without an injected corruption
+    v = check_protocol_trace(base + [
+        {"ev": "checkpoint", "ok": True, "epoch": 1},
+        {"ev": "ack", "n": 1, "epoch": 1},
+        {"ev": "crash"},
+        {"ev": "recover", "epoch": 0}])
+    assert any("below the boundary" in x for x in v)
+
+
+def test_conformance_allows_one_epoch_back_per_corruption():
+    events = [
+        {"ev": "recover", "epoch": 0, "chain_epoch": 0},
+        {"ev": "checkpoint", "ok": True, "epoch": 1, "chain_epoch": 1},
+        {"ev": "crash"},
+        {"ev": "corrupt", "mode": "truncate"},
+        {"ev": "recover", "epoch": 0, "chain_epoch": 0},
+    ]
+    assert check_protocol_trace(events) == []
+
+
+def test_torn_event_log_tail_is_tolerated(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text('{"ev":"recover","epoch":0}\n{"ev":"deliver","ms')
+    events = read_event_log(str(p))
+    assert [e["ev"] for e in events] == ["recover"]
+
+
+# --------------------------------------------------- slow: kill−9 subprocess
+
+@pytest.mark.slow
+def test_kill9_chaos_run_replays_as_model_path(tmp_path):
+    """The acceptance scenario: the REAL worker subprocess, killed −9
+    twice mid-stream under duplicate injection, restarted, run to
+    completion — its protocol event log is a valid path of the models."""
+    lines = make_stream(n_labels=6, per_label=80)
+    h = ChaosWorkerHarness(str(tmp_path / "work"), dup_p=0.03, seed=5,
+                           save_every_s=0.3, event_log=True)
+    try:
+        for line in lines:
+            h.send_line(line)
+        h.start()
+        h.wait_acked(len(lines) // 3)
+        h.kill9()
+        h.start()
+        h.wait_acked(2 * len(lines) // 3)
+        h.kill9()
+        h.start()
+        stats = h.finish(timeout_s=240)
+    finally:
+        h.close()
+    assert stats["acked"] == len(lines)
+
+    events = h.events()
+    kinds = {e["ev"] for e in events}
+    assert "crash" in kinds and "recover" in kinds
+    # three boots: the initial one + one per kill
+    assert sum(1 for e in events if e["ev"] == "recover") == 3
+    violations = check_protocol_trace(events)
+    assert violations == [], "\n".join(violations)
+
+
+@pytest.mark.slow
+def test_kill9_delta_chain_with_stale_dup_replays_as_model_path(tmp_path):
+    """Hostile storage on the delta chain: kill −9, plant a stale
+    duplicate tail between generations, restart — recovery must REJECT
+    the dup (uid/epoch linkage) and continue from the true committed
+    tail, and the event log (with the harness's corrupt marker) replays
+    as a model path.
+
+    Note the scenario choice: a TORN tail is only within the storage
+    contract in the commit-without-ack window (test_chaos_storage
+    constructs that window explicitly) — tearing an acked epoch's
+    segment is real loss, and the conformance checker rightly flags it
+    (that is exactly its job). A stale dup is safe to inject at any
+    boundary because recovery never replays it."""
+    lines = make_stream(n_labels=6, per_label=80)
+    h = ChaosWorkerHarness(str(tmp_path / "work"), seed=7, save_every_s=0.3,
+                           checkpoint_mode="delta", event_log=True)
+    try:
+        for line in lines:
+            h.send_line(line)
+        h.start()
+        h.wait_acked(len(lines) // 2)
+        h.kill9()
+        h.corrupt_chain_tail("stale-dup")
+        h.start()
+        stats = h.finish(timeout_s=240)
+    finally:
+        h.close()
+    assert stats["acked"] == len(lines)
+    assert stats["checkpoint_mode"] == "delta"
+
+    events = h.events()
+    assert any(e["ev"] == "corrupt" for e in events)
+    violations = check_protocol_trace(events)
+    assert violations == [], "\n".join(violations)
