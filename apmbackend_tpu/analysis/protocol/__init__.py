@@ -37,7 +37,8 @@ from typing import List
 
 from .alo import AloModel
 from .checker import CheckResult, check
-from .conformance import check_protocol_trace, read_event_log
+from .conformance import (check_fleet_trace, check_protocol_trace,
+                          read_event_log)
 from .deltamodel import DeltaChainModel
 from .mutations import BOUNDARY_MUTANTS, MUTANTS, verify_mutants
 from .shardmodel import ShardedEpochModel
@@ -83,5 +84,6 @@ def run_model_checks(tier: str = "small") -> List[CheckResult]:
 __all__ = [
     "AloModel", "DeltaChainModel", "ShardedEpochModel", "CheckResult",
     "check", "run_model_checks", "SCOPES", "MUTANTS", "BOUNDARY_MUTANTS",
-    "verify_mutants", "check_protocol_trace", "read_event_log",
+    "verify_mutants", "check_protocol_trace", "check_fleet_trace",
+    "read_event_log",
 ]

@@ -29,7 +29,21 @@ The rules enforced (each cites the model transition it mirrors):
   hostile-storage ``corrupt`` markers since the last boot, at most that
   many epochs earlier, and never below the last ACKED epoch
   (recovery-stops-at-last-committed-boundary + ack-implies-durable);
-- a ``redelivered`` flag only on messages that were delivered before.
+- a ``redelivered`` flag only on messages that were delivered before;
+- fleet handoffs (PR 9, shardmodel.py): ``handoff_export`` only with an
+  EMPTY unacked ledger (quiesce) and an empty pending-feed buffer, its
+  window ids leaving the mirror; ``handoff_import`` bringing ids in; a
+  handoff ``checkpoint`` (the sync base rewrite) keeps the chain epoch
+  instead of advancing it; ``deliver(mismatch=True)`` (partition-header
+  defense) absorbs nothing.
+
+:func:`check_fleet_trace` replays the MERGED logs of every shard (plus
+the harness's ``rebalance`` markers) against the fleet-level invariants
+of the sharded-epoch model: fleet exactly-once (no message's effect
+commits durably on two shards), quiesced handoffs (export ids == import
+ids, nobody consumes the partition queue between them), and owner-
+locality of consumption (a shard only takes deliveries from queues it
+currently owns).
 """
 
 from __future__ import annotations
@@ -128,6 +142,13 @@ def check_protocol_trace(events: List[dict], *,
             booted = True
         elif kind == "deliver":
             msg = ev.get("msg")
+            if ev.get("mismatch"):
+                # partition-header defense: rejected, counted, acked at the
+                # epoch — but NEVER absorbed, so the mirror state is
+                # untouched (an absorb here would be the mutant's bug)
+                if msg is not None:
+                    m.seen.add(msg)
+                continue
             dedup = bool(ev.get("dedup"))
             in_window = msg in m.window
             if dedup and not in_window:
@@ -163,6 +184,7 @@ def check_protocol_trace(events: List[dict], *,
         elif kind == "checkpoint":
             if not ev.get("ok", True):
                 continue  # failed write: no state change, tokens kept
+            handoff = bool(ev.get("handoff"))
             epoch = ev.get("epoch")
             if epoch is not None:
                 epoch = int(epoch)
@@ -177,12 +199,49 @@ def check_protocol_trace(events: List[dict], *,
             ce = ev.get("chain_epoch")
             if ce is not None:
                 ce = int(ce)
-                if m.chain_epoch is not None and ce != m.chain_epoch + 1:
+                if handoff:
+                    # a handoff commit rewrites the BASE at the current
+                    # tail (sync compaction) — the chain epoch must NOT
+                    # advance (rows moved wholesale; a delta cannot carry
+                    # that, and an advancing epoch here would mean one did)
+                    if m.chain_epoch is not None and ce != m.chain_epoch:
+                        bad(i, ev, f"handoff commit moved the chain epoch "
+                                   f"{m.chain_epoch} -> {ce} (must rewrite "
+                                   f"the base in place)")
+                elif m.chain_epoch is not None and ce != m.chain_epoch + 1:
                     bad(i, ev, f"chain epoch jumped {m.chain_epoch} -> {ce}")
                 m.chain_epoch = ce
             m.committed |= m.absorbed
             m.absorbed = set()
             m.snapshot()
+        elif kind == "handoff_export":
+            if int(ev.get("unacked", 0)) != 0:
+                bad(i, ev, f"handoff export with {ev.get('unacked')} unacked "
+                           f"deliveries (quiesce violated)")
+            if m.pending:
+                bad(i, ev, f"handoff export with {m.pending} undrained "
+                           f"pending-feed lines")
+            ids = set(ev.get("ids") or ())
+            missing = ids - set(m.window)
+            if missing:
+                bad(i, ev, f"exported {len(missing)} window ids the mirror "
+                           f"never absorbed (first: {sorted(missing)[0]!r})")
+            m.window = [x for x in m.window if x not in ids]
+            m.committed -= ids
+            m.absorbed -= ids
+        elif kind in ("handoff_import", "handoff_abort"):
+            ids = list(ev.get("ids") or ())
+            if kind == "handoff_import":
+                for x in ids:
+                    if x not in m.window:
+                        m.window.append(x)
+                        if len(m.window) > m.window_size:
+                            m.window.pop(0)
+                m.committed |= set(ids)
+            else:
+                drop = set(ids)
+                m.window = [x for x in m.window if x not in drop]
+                m.committed -= drop
         elif kind == "ack":
             epoch = int(ev.get("epoch", -1))
             if epoch != m.epoch:
@@ -200,4 +259,104 @@ def check_protocol_trace(events: List[dict], *,
             m.dead = True
         elif kind == "corrupt":
             m.corrupts_since_boot += 1
+    return out
+
+
+def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
+                      base: str = "transactions") -> List[str]:
+    """Replay MERGED shard logs (each event carrying ``shard``, plus the
+    harness's ``rebalance``/``crash`` markers) against the fleet-level
+    invariants of the sharded-epoch model (shardmodel.py):
+
+    - **fleet exactly-once**: no message's effect becomes durable on two
+      shards. Per-shard absorbs are provisional until that shard's next
+      successful ``checkpoint``; a ``crash`` discards its provisional set
+      (the implementation rolls those effects back at recovery, proven
+      bit-identical by the chaos tier).
+    - **owner-locality of consumption**: a shard only takes deliveries
+      from partition queues it currently owns — initially the identity
+      map, then per completed ``handoff_import``.
+    - **quiesced handoff pairing**: every ``handoff_import`` matches the
+      latest ``handoff_export`` of that partition (same id set), nothing
+      consumes the partition queue between the two, and exports state
+      ``unacked == 0``.
+
+    Events are merged by wall clock (one host; the harness phases are
+    coarse enough that clock skew cannot reorder a handoff pair).
+    """
+    out: List[str] = []
+
+    def bad(i: int, ev: dict, msg: str) -> None:
+        out.append(f"event {i} {ev.get('ev')} (s{ev.get('shard')}): {msg}")
+
+    owner: Dict[int, int] = {}  # partition -> shard
+    in_flight: Dict[int, tuple] = {}  # partition -> (from_shard, ids)
+    committed: Dict[str, int] = {}  # msg -> shard whose effect is durable
+    provisional: Dict[int, set] = {}  # shard -> absorbed-not-yet-committed
+
+    def partition_of(queue: Optional[str]) -> Optional[int]:
+        prefix = f"{base}.p"
+        if not queue or not queue.startswith(prefix):
+            return None
+        tail = queue[len(prefix):]
+        return int(tail) if tail.isdigit() else None
+
+    for i, ev in enumerate(events):
+        kind = ev.get("ev")
+        sh = ev.get("shard")
+        if kind == "deliver":
+            p = partition_of(ev.get("queue"))
+            if p is not None:
+                cur = owner.get(p, p)  # identity map until a handoff lands
+                if p in in_flight:
+                    bad(i, ev, f"delivery from q.p{p} during its handoff "
+                               f"window (released, not yet adopted)")
+                elif sh is not None and cur != sh:
+                    bad(i, ev, f"delivery from q.p{p} owned by s{cur}")
+            if ev.get("mismatch") or ev.get("dedup"):
+                continue
+            msg = ev.get("msg")
+            if msg is None:
+                continue
+            if msg in committed:
+                bad(i, ev, f"absorbed {msg!r} whose effect is already "
+                           f"durable on s{committed[msg]} (fleet "
+                           f"exactly-once violated)")
+            provisional.setdefault(sh, set()).add(msg)
+        elif kind == "checkpoint" and ev.get("ok", True):
+            for msg in provisional.pop(sh, set()):
+                committed[msg] = sh
+        elif kind == "crash":
+            provisional.pop(sh, None)
+        elif kind == "handoff_export":
+            p = int(ev.get("partition", -1))
+            ids = frozenset(ev.get("ids") or ())
+            if int(ev.get("unacked", 0)) != 0:
+                bad(i, ev, f"export of p{p} with a non-empty unacked ledger")
+            if owner.get(p, p) != sh:
+                bad(i, ev, f"s{sh} exported p{p} owned by s{owner.get(p, p)}")
+            in_flight[p] = (sh, ids)
+        elif kind == "handoff_import":
+            p = int(ev.get("partition", -1))
+            ids = frozenset(ev.get("ids") or ())
+            flight = in_flight.pop(p, None)
+            if flight is None:
+                bad(i, ev, f"import of p{p} without a pending export")
+            else:
+                frm, exported = flight
+                if exported != ids:
+                    bad(i, ev, f"import of p{p} carries {len(ids)} window "
+                               f"ids but the export carried {len(exported)} "
+                               f"(window dropped/forged in transit)")
+                # the window's committed effects move with the rows
+                for msg in exported:
+                    if msg in committed:
+                        committed[msg] = sh
+            owner[p] = sh
+        elif kind == "handoff_abort":
+            p = int(ev.get("partition", -1))
+            # adopter rolled back: ownership stays in flight (controller
+            # must retry adopt); re-arm the export record
+            ids = frozenset(ev.get("ids") or ())
+            in_flight[p] = (owner.get(p, p), ids)
     return out

@@ -120,6 +120,16 @@ MUTANTS: Dict[str, Tuple[str, Callable[[], object]]] = {
         "redelivered messages look fresh to the new owner",
         lambda: ShardedEpochModel(mutations=("rebalance_drops_window",)),
     ),
+    "shard-partition-header-mismatch": (
+        "a drifted producer stamps/routes a message by the wrong partition "
+        "hash — at best its effect strands on a non-owner (serving reads "
+        "miss the write), and a broker bounce redelivers it onto the "
+        "CORRECT queue where the owner's dedup window has never seen it: "
+        "one message, two shards' durable effects (why the fleet worker "
+        "verifies the partition header against its queue and rejects "
+        "mismatches instead of absorbing them)",
+        lambda: ShardedEpochModel(mutations=("partition_header_mismatch",)),
+    ),
 }
 
 # Proven-indistinguishable variants (see module docstring): these MUST

@@ -27,7 +27,23 @@ Mutations: ``rebalance_mid_epoch`` (ownership moves while deliveries are
 in flight, no handoff — the original shard absorbs and commits a message
 whose redelivery the new owner also absorbs), ``rebalance_drops_window``
 (state rows move but the dedup window does not — redelivered messages
-look fresh to the new owner).
+look fresh to the new owner), ``partition_header_mismatch`` (the producer
+stamps/routes by a wrong partition hash — one drifted partitioner build
+in a fleet — so a message lands on a queue whose owner is not the
+service's owner; its effect strands off-owner and serving reads miss it).
+
+IMPLEMENTED by ``parallel/fleet.py`` + ``runtime/worker.py`` (PR 9), kept
+in sync per the README "verifying a protocol change" workflow: publish =
+``FleetPartitioner.write_line`` (stable FNV-1a ``service_partition``,
+partition id stamped in headers); the per-shard cycle = the fleet-mode
+``WorkerApp`` epoch cycle with per-queue ``_DedupWindow``s; the quiesced
+rebalance = ``WorkerApp.release_partition`` (pause → commit+ack until the
+ledger is empty → export rows+window → drop → release commit) then
+``WorkerApp.adopt_partition`` (import rows+window → import commit →
+consume), the two commits being the linearization points the model's
+atomic ``rebalance`` transition abstracts. The header-mismatch defense in
+``_consume_at_least_once`` (reject + count, never absorb) is why the
+mismatch mutant's violation cannot happen in the live fleet.
 """
 
 from __future__ import annotations
@@ -48,7 +64,8 @@ S = namedtuple(
     "crashes bounces dups rebalances",
 )
 
-_MUTATIONS = frozenset({"rebalance_mid_epoch", "rebalance_drops_window"})
+_MUTATIONS = frozenset({"rebalance_mid_epoch", "rebalance_drops_window",
+                        "partition_header_mismatch"})
 
 
 class ShardedEpochModel:
@@ -144,6 +161,11 @@ class ShardedEpochModel:
         if s.sent < self.n:
             m = s.sent
             p = self.part(m)
+            if "partition_header_mismatch" in self.mut:
+                # a drifted producer stamps (and therefore routes by) the
+                # wrong partition: the message reaches a queue whose owner
+                # is NOT the owner of the service's real partition
+                p = (p + 1) % self.k
             out.append((f"publish(m{m}->q{p})", s._replace(
                 sent=s.sent + 1,
                 queues=self._set(s.queues, p, s.queues[p] + (m,)))))

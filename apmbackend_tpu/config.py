@@ -463,6 +463,27 @@ _DEFAULT_CONFIG: dict = {
         "renderExtraParams": "&autofitpanels",
         "renderTimeout": 90000,
     },
+    # Pod-scale sharded serving spine (parallel/fleet.py, DESIGN.md §10):
+    # shards > 0 switches the producer side to service-hash partitioning
+    # (the `transactions` queue becomes one `transactions.p<K>` channel per
+    # partition, partition id stamped in headers) and the worker side to
+    # per-partition epoch cycles — each shard process (identity from
+    # APM_SHARD_ID, or fleet.shardId for embedders) consumes the partition
+    # queues it owns with a per-queue dedup window and its own delta chain.
+    # partitionKey picks the stable-hash routing key field of a tx line
+    # ("service" | "server"). epochStallSeconds: a shard that has intake
+    # (unacked/pending) but no committed epoch for this long reports
+    # healthz 503 (the manager /fleet plane degrades with it). Rebalance
+    # is the quiesced handoff verified by analysis/protocol/shardmodel.py:
+    # ownership of a partition moves only with an empty unacked ledger and
+    # carries the partition queue's dedup-window ids + the partition's
+    # state rows (WorkerApp.release_partition / adopt_partition).
+    "fleet": {
+        "shards": 0,
+        "partitionKey": "service",
+        "shardId": None,
+        "epochStallSeconds": 300.0,
+    },
     # TPU-native engine settings (no reference equivalent: this is the device
     # configuration for the batched step function that replaces the per-message
     # stream_calc_stats/z_score/process_alerts event loops).
@@ -499,7 +520,12 @@ _DEFAULT_CONFIG: dict = {
         # checkpointWriteRetryBaseSeconds and checkpointWriteRetryMaxSeconds;
         # after checkpointWriteMaxRetries consecutive failures the worker
         # degrades: flight bundle, operator alert, intake paused until a
-        # write lands (healthz 503, apm_checkpoint_degraded).
+        # write lands (healthz 503, apm_checkpoint_degraded). In fleet mode
+        # (fleet.shards > 0) checkpointChainDir / resumeFileFullPath /
+        # protocolEventLog may carry a "{shard}" placeholder, substituted
+        # with the shard id so N shards of one shared config file get
+        # disjoint chains (per-shard chain dirs are the handoff unit the
+        # rebalance protocol moves ownership between).
         "checkpointMode": "full",
         "checkpointChainDir": "save/tpu_engine.chain",
         "checkpointCompactEveryEpochs": 64,

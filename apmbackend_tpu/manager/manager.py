@@ -56,6 +56,40 @@ def cmdline_pattern_for(module: str) -> str:
     return "|".join(f"(?:{p})" for p in pats)
 
 
+def expand_module_settings(module_settings: List[dict]) -> List[tuple]:
+    """Expand every moduleSettings entry into its child processes:
+    ``[(setting, extra_env, sweep_stale)]``.
+
+    An entry with ``"shards": N`` (N > 0) becomes N children of the SAME
+    module — the pod-scale fleet (DESIGN.md §10): each child gets
+    ``APM_SHARD_ID=<k>`` in its environment (the worker derives partition
+    ownership and ``{shard}``-templated chain paths from it), a per-shard
+    ``name`` (``worker0``..) for logs/metrics/watchdog bookkeeping, and a
+    per-shard ``metricsPort`` (base + k) so the /fleet plane scrapes each
+    shard separately. Only shard 0 sweeps stale PIDs — the siblings share
+    one cmdline pattern and must not SIGTERM each other at boot."""
+    out = []
+    for ms in module_settings:
+        shards = int(ms.get("shards", 0) or 0)
+        if shards <= 0:
+            out.append((ms, {}, True))
+            continue
+        base_name = ms.get("name") or ms["module"].rsplit(".", 1)[-1]
+        base_port = ms.get("metricsPort")
+        for k in range(shards):
+            child = dict(ms)
+            child["name"] = f"{base_name}{k}"
+            env = {"APM_SHARD_ID": str(k)}
+            if base_port:
+                # shards share one config file, so the per-shard exporter
+                # port rides the environment (ModuleRuntime honors
+                # APM_METRICS_PORT over the config section's metricsPort)
+                child["metricsPort"] = int(base_port) + k
+                env["APM_METRICS_PORT"] = str(int(base_port) + k)
+            out.append((child, env, k == 0))
+    return out
+
+
 class ManagerAlerts:
     """Operational alert batching with interval doubling
     (apm_manager.js:42-132). Buffers plain strings, emails them joined."""
@@ -154,6 +188,7 @@ class ModuleProc:
         clock: Callable[[], float] = time.monotonic,
         python: str = sys.executable,
         extra_env: Optional[dict] = None,
+        sweep_stale: bool = True,
     ):
         self.module = module_setting["module"]  # e.g. "apmbackend_tpu.runtime.worker"
         self.setting = module_setting
@@ -164,13 +199,19 @@ class ModuleProc:
         self.clock = clock
         self.python = python
         self.extra_env = extra_env or {}
+        # shard siblings share one cmdline pattern: only the designated
+        # sweeper (shard 0) may kill stale pids, or N shards would
+        # SIGTERM each other at boot (expand_module_settings)
+        self.sweep_stale = sweep_stale
         self.proc: Optional[subprocess.Popen] = None
         self.last_start_time: float = 0.0
         self.restart_pending_until: float = 0.0
 
     @property
     def name(self) -> str:
-        return self.module.rsplit(".", 1)[-1]
+        # fleet shards override the name (worker0, worker1, ...) so log
+        # files, metrics relabeling, and watchdog streaks stay per-shard
+        return self.setting.get("name") or self.module.rsplit(".", 1)[-1]
 
     @property
     def pid(self) -> Optional[int]:
@@ -183,6 +224,8 @@ class ModuleProc:
         """Stale-PID cleanup before forking (killExistingPIDs role)."""
         from .pid_stats import pid_exists, pids_matching_cmdline
 
+        if not self.sweep_stale:
+            return 0
         killed = 0
         for pid in pids_matching_cmdline(self.cmdline_pattern()):
             try:
@@ -331,8 +374,12 @@ class ManagerApp:
                 config_path=runtime.config_path,
                 logger=logger,
                 on_exit_alert=self._on_child_exit_alert,
+                extra_env=env,
+                sweep_stale=sweep,
             )
-            for ms in self.mconfig.get("moduleSettings", [])
+            for ms, env, sweep in expand_module_settings(
+                self.mconfig.get("moduleSettings", [])
+            )
         ]
 
         # -- telemetry: restart/GC/exit event counters + the fleet scrape ----
@@ -341,8 +388,10 @@ class ManagerApp:
         from ..obs import get_registry
 
         reg = get_registry()
+        # keyed by mod.name (not module path): fleet shards share one
+        # module path but are independent children with their own counters
         self._m_restarts = {
-            mod.module: reg.counter(
+            mod.name: reg.counter(
                 "apm_manager_child_restarts_total",
                 "Child module restarts by the supervisor",
                 labels={"module": mod.name},
@@ -350,7 +399,7 @@ class ManagerApp:
             for mod in self.modules
         }
         self._m_exits = {
-            mod.module: reg.counter(
+            mod.name: reg.counter(
                 "apm_manager_child_exits_total",
                 "Child module exits observed by the supervisor",
                 labels={"module": mod.name},
@@ -358,7 +407,7 @@ class ManagerApp:
             for mod in self.modules
         }
         self._m_gcs = {
-            mod.module: reg.counter(
+            mod.name: reg.counter(
                 "apm_manager_gc_requests_total",
                 "GC requests (SIGUSR1) sent to the child",
                 labels={"module": mod.name},
@@ -366,7 +415,7 @@ class ManagerApp:
             for mod in self.modules
         }
         self._m_watchdog = {
-            mod.module: reg.counter(
+            mod.name: reg.counter(
                 "apm_manager_watchdog_restarts_total",
                 "Wedged-but-alive children force-restarted by the healthz watchdog",
                 labels={"module": mod.name},
@@ -374,8 +423,8 @@ class ManagerApp:
             for mod in self.modules
         }
         # hung-tick watchdog bookkeeping: consecutive failed /healthz probes
-        # per module (reset on success, on restart, and while no process)
-        self._health_streaks = {mod.module: 0 for mod in self.modules}
+        # per child (reset on success, on restart, and while no process)
+        self._health_streaks = {mod.name: 0 for mod in self.modules}
         if getattr(runtime, "telemetry", None) is not None:
             runtime.telemetry.add_route("/fleet", self._fleet_route)
             # overrides the exporter's per-process /trace: the manager's view
@@ -426,12 +475,12 @@ class ManagerApp:
         for mod in self.modules:
             event = mod.tick()
             if event == "restarted":
-                self._m_restarts[mod.module].inc()
+                self._m_restarts[mod.name].inc()
                 self.alerts.send_email(
                     "APM manager alert", f"Process restarted via startProcess: {mod.module}"
                 )
             elif event == "exited":
-                self._m_exits[mod.module].inc()
+                self._m_exits[mod.name].inc()
 
     def module_setting(self, mod: ModuleProc, name: str):
         """Per-module override falling back to the manager default
@@ -471,7 +520,7 @@ class ManagerApp:
                 trigger_gc = True
             if trigger_gc:
                 self.runtime.logger.info(f"Sending garbage collection request to module: {mod.module}")
-                self._m_gcs[mod.module].inc()
+                self._m_gcs[mod.name].inc()
                 mod.request_gc()
 
     def _probe_child_health(self, url: str, timeout_s: float) -> bool:
@@ -503,23 +552,23 @@ class ManagerApp:
         for mod in self.modules:
             url = targets.get(mod.name)
             if url is None or mod.pid is None or not pid_exists(mod.pid):
-                self._health_streaks[mod.module] = 0  # exit path handles it
+                self._health_streaks[mod.name] = 0  # exit path handles it
                 continue
             if self._probe_child_health(url, timeout_s):
-                self._health_streaks[mod.module] = 0
+                self._health_streaks[mod.name] = 0
                 continue
-            self._health_streaks[mod.module] += 1
-            streak = self._health_streaks[mod.module]
+            self._health_streaks[mod.name] += 1
+            streak = self._health_streaks[mod.name]
             if streak < threshold:
                 continue
-            self._health_streaks[mod.module] = 0
+            self._health_streaks[mod.name] = 0
             msg = (
                 f"Child module wedged (healthz failed {streak} consecutive "
                 f"inspections) - restarting through damped path: {mod.module}"
             )
             self.annotate(msg)
             self.alerts.add(msg)
-            self._m_watchdog[mod.module].inc()
+            self._m_watchdog[mod.name].inc()
             # last-words pull: a wedged-but-serving child can still dump a
             # flight bundle — request one before the SIGTERM destroys the
             # evidence (best effort; a fully dead HTTP thread just times out)
@@ -650,11 +699,29 @@ class ManagerApp:
                 info["restart_pending"] = bool(mod.restart_pending_until)
             url = targets.get(mod.name)
             if alive and url:
+                import urllib.error
+
                 try:
                     with urllib.request.urlopen(f"{url}/healthz", timeout=2.0) as resp:
                         info["healthz"] = _json.loads(resp.read().decode("utf-8")).get("status")
+                except urllib.error.HTTPError as e:
+                    # a degraded child answers 503 WITH its status body —
+                    # parse it rather than flattening to an opaque error
+                    try:
+                        info["healthz"] = _json.loads(
+                            e.read().decode("utf-8")).get("status")
+                    except Exception:
+                        info["healthz_error"] = repr(e)
                 except Exception as e:
                     info["healthz_error"] = repr(e)
+                if info.get("healthz", "ok") != "ok" or "healthz_error" in info:
+                    # a degraded child degrades the fleet: a shard whose
+                    # epoch stalls (or whose checkpoint volume died) answers
+                    # 503 with status "degraded", and the manager's own
+                    # /healthz must go 503 with it — the fleet is not
+                    # serving its SLO while any partition's effects cannot
+                    # commit (DESIGN.md §10)
+                    ok = False
             children[mod.name] = info
         return {"ok": ok, "children": children}
 

@@ -2543,6 +2543,27 @@ class PipelineDriver:
             meta["delivery_delta"] = delivery_delta
         return arrays, meta
 
+    @property
+    def has_uncheckpointed_changes(self) -> bool:
+        """True when delta-capture tracking has recorded engine changes
+        since the last committed epoch (dirty cells, executed ticks,
+        registry growth, or pending ordered-tx). False only under active
+        tracking — with tracking off, idleness cannot be proven and the
+        caller must not skip its checkpoint. Lets an idle worker's save
+        cadence skip no-op commits instead of appending empty deltas
+        (chains otherwise grow one segment per interval — and one per
+        boot — while serving nothing)."""
+        if not self._delta_track:
+            return True
+        # NOTE: heap/backlog content is deliberately NOT consulted — a
+        # restored engine re-seeds its pending-tx buffer from the last
+        # commit, and every path that grows it also dirties cells or
+        # executes ticks, so the buffer alone never constitutes a change
+        return bool(
+            self._dirty_cells or self._delta_ticks
+            or self.registry.count != self._delta_reg_base
+        )
+
     def save_resume_delta(self, chain, *, delivery_delta: Optional[dict] = None) -> int:
         """Commit one epoch as a delta segment appended to ``chain``
         (deltachain.DeltaChain). The delta + the worker's incremental dedup
@@ -2735,3 +2756,166 @@ class PipelineDriver:
                             pass
         self._refresh_params()
         return True
+
+    # -- partition row handoff (parallel/fleet.py, DESIGN.md §10) ------------
+    # The quiesced-rebalance primitives: a partition's service rows leave one
+    # engine and join another as npz-schema dicts, through the SAME install
+    # path checkpoints restore through — so a handed-off row is bit-identical
+    # to one that was checkpointed and restored. All three are epoch-cadence
+    # operations (full capture + reinstall): rebalances are rare control-plane
+    # events, and reusing the battle-tested snapshot path beats a bespoke
+    # incremental row mover that would need its own bit-identity proofs.
+
+    def _row_array_names(self, data: dict) -> List[str]:
+        """Capture keys indexed by service row (first axis == capacity):
+        stats planes, z rings/fill/counters, EWMA planes — everything except
+        the 0-d cursors (latest_bucket, z pos) and object arrays (registry,
+        pending_tx, delivery_state)."""
+        return [
+            k for k, a in data.items()
+            if isinstance(a, np.ndarray) and a.dtype != np.dtype(object)
+            and a.ndim >= 1 and a.shape[0] == self.cfg.capacity
+        ]
+
+    def export_service_rows(self, pred) -> dict:
+        """Snapshot the rows whose ``(server, service)`` key satisfies
+        ``pred`` as a self-contained npz-schema dict (cursors included, so
+        the importer can re-align ring rotation), WITHOUT mutating this
+        engine. Pending ordered-tx lines of those services ride along."""
+        self.flush()
+        self.drain_emission()
+        data = self._capture_resume_arrays(None)
+        keys = self.registry.rows()
+        take = [i for i, (srv, svc) in enumerate(keys) if pred(srv, svc)]
+        idx = np.asarray(take, np.intp)
+        out = {k: np.array(data[k][idx]) for k in self._row_array_names(data)}
+        out["latest_bucket"] = np.asarray(data["latest_bucket"])
+        for spec in self.cfg.lags:
+            out[f"z{spec.lag}_pos"] = np.asarray(data[f"z{spec.lag}_pos"])
+        out["registry"] = np.array(
+            ["\x00".join(keys[i]) for i in take], dtype=object
+        )
+        out["pending_tx"] = np.array(
+            [ln for ln in data["pending_tx"].tolist()
+             if self._pending_line_matches(ln, pred)],
+            dtype=object,
+        )
+        return out
+
+    @staticmethod
+    def _pending_line_matches(line: str, pred) -> bool:
+        p = line.split("|", 3)
+        return len(p) >= 3 and pred(p[1], p[2])
+
+    def remove_service_rows(self, pred) -> int:
+        """Drop the rows whose key satisfies ``pred`` (the release half of a
+        handoff): the remaining rows are re-installed through the resume
+        path, so row indices compact and derived aggregates rebuild exactly
+        as a restore would. Returns the number of rows removed."""
+        self.flush()
+        self.drain_emission()
+        data = self._capture_resume_arrays(None)
+        keys = self.registry.rows()
+        keep = [i for i, (srv, svc) in enumerate(keys) if not pred(srv, svc)]
+        removed = len(keys) - len(keep)
+        if removed == 0:
+            return 0
+        idx = np.asarray(keep, np.intp)
+        for k in self._row_array_names(data):
+            data[k] = np.array(data[k][idx])
+        data["registry"] = np.array(
+            ["\x00".join(keys[i]) for i in keep], dtype=object
+        )
+        data["pending_tx"] = np.array(
+            [ln for ln in data["pending_tx"].tolist()
+             if not self._pending_line_matches(ln, pred)],
+            dtype=object,
+        )
+        if not self._install_resume_data(data, "partition-release"):
+            raise RuntimeError("row removal re-install failed")
+        if self._delta_track:
+            self._delta_reset_capture()
+        return removed
+
+    def import_service_rows(self, incoming: dict) -> int:
+        """Merge an :meth:`export_service_rows` dict into this engine (the
+        adopt half of a handoff). Incoming z-ring columns are rotated from
+        the exporter's shared cursor/label onto this engine's, so a row's
+        window reads the same label sequence it would have on its old owner;
+        stats/EWMA planes are label-slot indexed and merge as-is, with cells
+        older than the merged bucket window cleared. Duplicate service keys
+        are a routing-discipline violation and raise (one partition, one
+        owner — shardmodel owner-locality)."""
+        self.flush()
+        self.drain_emission()
+        in_keys = [tuple(k.split("\x00", 1))
+                   for k in incoming["registry"].tolist()]
+        if not in_keys:
+            return 0
+        cur = self._capture_resume_arrays(None)
+        cur_keys = self.registry.rows()
+        dup = set(cur_keys) & set(in_keys)
+        if dup:
+            raise ValueError(
+                f"import_service_rows: {len(dup)} keys already live here "
+                f"(first: {sorted(dup)[0]}) — a partition can only have one "
+                f"owner"
+            )
+        cur_label = int(cur["latest_bucket"])
+        in_label = int(incoming["latest_bucket"])
+        new_label = max(cur_label, in_label)
+        nb = self.cfg.stats.num_buckets
+        n_cur = len(cur_keys)
+        merged: dict = {}
+        for k in self._row_array_names(cur):
+            inc = np.array(incoming[k])
+            merged[k] = np.concatenate([np.array(cur[k][:n_cur]), inc], axis=0)
+        # ring rotation: column of label t sits at (pos - 1 - (label - t))
+        # mod L, so aligning the two histories shifts incoming columns by
+        # (cur_pos - in_pos - (cur_label - in_label)) mod L
+        for spec in self.cfg.lags:
+            L = spec.lag
+            cur_pos = int(np.asarray(cur[f"z{L}_pos"]))
+            in_pos = int(np.asarray(incoming[f"z{L}_pos"]))
+            d = (cur_pos - in_pos - (cur_label - in_label)) % L
+            if d:
+                vk = f"z{L}_values"
+                merged[vk][n_cur:] = np.roll(
+                    np.array(incoming[vk]), d, axis=-1
+                )
+        # bucket-slot hygiene across a label skew: slot s last held label
+        # latest - ((latest - s) % nb); anything at or below new_label - nb
+        # is outside the merged window and must read empty (the live engine
+        # clears those slots as it advances — a handoff must not resurrect
+        # them)
+        if in_label != cur_label:
+            slots = np.arange(nb)
+            for label0, rows in ((in_label, slice(n_cur, None)),
+                                 (cur_label, slice(0, n_cur))):
+                dead = (label0 - ((label0 - slots) % nb)) <= new_label - nb
+                if not dead.any():
+                    continue
+                for k in ("counts", "sums", "nsamples", "samples"):
+                    merged[k][rows, dead] = 0
+        # keep the cursor dtype of the capture (int32): a bare python int
+        # would become int64 and poison every label-indexed dynamic slice
+        # under x64
+        merged["latest_bucket"] = np.asarray(
+            new_label, dtype=np.asarray(cur["latest_bucket"]).dtype
+        )
+        for spec in self.cfg.lags:
+            merged[f"z{spec.lag}_pos"] = np.asarray(cur[f"z{spec.lag}_pos"])
+        merged["registry"] = np.array(
+            ["\x00".join(k) for k in list(cur_keys) + in_keys], dtype=object
+        )
+        merged["pending_tx"] = np.array(
+            cur["pending_tx"].tolist() + incoming["pending_tx"].tolist(),
+            dtype=object,
+        )
+        if "delivery_state" in cur:
+            merged["delivery_state"] = cur["delivery_state"]
+        if not self._install_resume_data(merged, "partition-adopt"):
+            raise RuntimeError("row import re-install failed")
+        if self._delta_track:
+            self._delta_reset_capture()
+        return len(in_keys)

@@ -126,7 +126,12 @@ class ModuleRuntime:
             from ..obs.views import register_queue_stats
 
             register_queue_stats(self.qm.queue_stats, section)
-            metrics_port = self.module_config.get("metricsPort")
+            # fleet shards share one config file: the supervisor hands each
+            # child its own exporter port via APM_METRICS_PORT (manager
+            # expand_module_settings), overriding the section's metricsPort
+            metrics_port = os.environ.get(
+                "APM_METRICS_PORT", self.module_config.get("metricsPort")
+            )
             if metrics_port is not None:
                 from ..obs.exporter import TelemetryServer
 

@@ -38,9 +38,10 @@ the whole service fleet. The process:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -49,6 +50,26 @@ from ..integrations import EmailSender, GrafanaClient
 from ..ops.alerts import AlertsManager
 from ..pipeline import PipelineDriver
 from ..transport.memory import MemoryBroker
+
+
+class _DedupWindow:
+    """One queue's at-least-once dedup window + the incremental record a
+    delta commit persists (added ids / evicted count since the last epoch).
+    A fleet shard keeps one per owned partition queue — the window IS the
+    unit the quiesced rebalance hands to the next owner (shardmodel.py);
+    the single-queue worker is the one-entry case. All fields are
+    guarded-by the owning worker's _driver_lock."""
+
+    __slots__ = ("ids", "fifo", "added", "evicted", "deduped")
+
+    def __init__(self):
+        import collections
+
+        self.ids: set = set()
+        self.fifo: "collections.deque" = collections.deque()
+        self.added: list = []
+        self.evicted = 0
+        self.deduped = 0  # redeliveries this window absorbed (persisted)
 
 
 class WorkerApp:
@@ -75,13 +96,46 @@ class WorkerApp:
         in_queue_name = stats_cfg.get("inQueue", "transactions")
         import collections
 
-        # bounded dedup window: ids of recently ABSORBED messages (persisted
-        # with every checkpoint; membership = "this message's effect is
-        # already in durable state, skip it"). Sized to cover the broker's
-        # redelivery span (<= prefetch) plus injected duplicates.
+        # -- fleet identity (pod-scale sharding, DESIGN.md §10) --------------
+        # fleet.shards > 0 turns this worker into ONE shard of a service-hash
+        # partitioned fleet: it consumes the partition queues it owns
+        # (`<inQueue>.p<K>`), each with its own dedup window, and its
+        # checkpoint paths are {shard}-templated so N shards share one
+        # config file with disjoint chains. Shard identity comes from the
+        # APM_SHARD_ID env (the manager/harness stamp it per child) or
+        # fleet.shardId for embedders.
+        fleet_cfg = config.get("fleet", {}) or {}
+        self._fleet_shards = int(fleet_cfg.get("shards", 0) or 0)
+        sid = os.environ.get("APM_SHARD_ID")
+        if sid is None:
+            sid = fleet_cfg.get("shardId")
+        self.shard_id: Optional[int] = int(sid) if sid is not None else None
+        self._fleet = self._fleet_shards > 0 and self.shard_id is not None
+        if self._fleet:
+            if not self._at_least_once:
+                raise ValueError(
+                    "fleet.shards > 0 requires tpuEngine.deliveryMode: "
+                    "atLeastOnce (the epoch cycle IS the sharded protocol)"
+                )
+            if not (0 <= self.shard_id < self._fleet_shards):
+                raise ValueError(
+                    f"shard id {self.shard_id} out of range for "
+                    f"fleet.shards={self._fleet_shards}"
+                )
+        self._partition_key = str(fleet_cfg.get("partitionKey", "service"))
+        self._partition_base = in_queue_name
+        self._epoch_stall_s = float(fleet_cfg.get("epochStallSeconds", 300.0) or 0.0)
+        self._partition_mismatch_total = 0  # guarded-by: _driver_lock
+        self._rebalances_total = 0  # guarded-by: _driver_lock
+        self._last_epoch_commit = time.monotonic()  # guarded-by: _driver_lock
+
+        # bounded dedup windows, one per consumed queue: ids of recently
+        # ABSORBED messages (persisted with every checkpoint; membership =
+        # "this message's effect is already in durable state, skip it").
+        # Sized to cover the broker's redelivery span (<= prefetch) plus
+        # injected duplicates. The single-queue worker keeps exactly one.
         self._dedup_max = int(eng_cfg.get("dedupWindowSize", 65536))
-        self._dedup_set: set = set()  # guarded-by: _driver_lock
-        self._dedup_fifo: collections.deque = collections.deque()  # guarded-by: _driver_lock
+        self._windows: Dict[str, _DedupWindow] = {}  # guarded-by: _driver_lock
         self._epoch_tokens: list = []  # guarded-by: _driver_lock (absorbed, unacked delivery tokens)
         self._delivery_epoch = 0  # guarded-by: _driver_lock
         self._deduped_total = 0  # guarded-by: _driver_lock (apm_redelivered_deduped_total)
@@ -95,14 +149,9 @@ class WorkerApp:
         # line's effect is in the snapshot. Dedup-window ids are added at
         # ACCEPT time, which is safe for the same reason (the window is
         # only persisted by save_state, after the drain).
-        self._alo_pending: list = []  # guarded-by: _driver_lock ((line, ingest_ts|None, ctx))
+        self._alo_pending: list = []  # guarded-by: _driver_lock ((line, ingest_ts|None, ctx, msg_id, queue))
         self._alo_batch = max(1, int(eng_cfg.get("deliveryBatchSize", 256)))
         self._alo_drain_s = float(eng_cfg.get("deliveryFeedMaxDelaySeconds", 0.25))
-        # incremental dedup-window record for delta commits (deltachain):
-        # ids appended / evicted since the last committed epoch — the
-        # rate-proportional equivalent of serializing the whole window
-        self._dedup_added_epoch: list = []  # guarded-by: _driver_lock
-        self._dedup_evicted_epoch = 0  # guarded-by: _driver_lock
 
         # protocol event log (analysis/protocol conformance): every
         # deliver/feed/checkpoint/ack/compact/recover step appended as one
@@ -111,11 +160,9 @@ class WorkerApp:
         # production unless an operator wants a protocol flight log.
         self._ev_fh = None
         self._ev_lock = threading.Lock()
-        ev_path = eng_cfg.get("protocolEventLog")
+        ev_path = self._shard_path(eng_cfg.get("protocolEventLog"))
         if ev_path:
-            import os as _os
-
-            _os.makedirs(_os.path.dirname(_os.path.abspath(ev_path)), exist_ok=True)
+            os.makedirs(os.path.dirname(os.path.abspath(ev_path)), exist_ok=True)
             self._ev_fh = open(ev_path, "a", encoding="utf-8")
 
         # -- checkpoint plane (full npz vs delta chain + failure policy) -----
@@ -288,12 +335,16 @@ class WorkerApp:
             self._ring_thread.start()
 
         # -- resume ----------------------------------------------------------
-        self.engine_resume = eng_cfg.get("resumeFileFullPath")
-        self.alerts_resume = alerts_cfg.get("alertsResumeFileFullPath")
+        self.engine_resume = self._shard_path(eng_cfg.get("resumeFileFullPath"))
+        self.alerts_resume = self._shard_path(
+            alerts_cfg.get("alertsResumeFileFullPath")
+        )
         if self._ckpt_mode == "delta":
             from ..deltachain import CheckpointWriteError, DeltaChain
 
-            chain_dir = eng_cfg.get("checkpointChainDir") or "save/tpu_engine.chain"
+            chain_dir = self._shard_path(
+                eng_cfg.get("checkpointChainDir") or "save/tpu_engine.chain"
+            )
             self._ckpt_chain = DeltaChain(
                 chain_dir,
                 fsync=bool(eng_cfg.get("checkpointFsync", True)),
@@ -304,7 +355,7 @@ class WorkerApp:
                     f"Engine state resumed from delta chain {chain_dir} "
                     f"(epoch {self._ckpt_chain.tail_epoch})"
                 )
-                self._seed_delivery(in_queue_name)
+                self._seed_delivery()
             else:
                 # fresh chain: the initial base IS the first committed epoch
                 # boundary (an empty engine) — written before any ack can
@@ -320,7 +371,7 @@ class WorkerApp:
             self.driver.enable_delta_capture()
         elif self.engine_resume and self.driver.load_resume(self.engine_resume):
             logger.info(f"Engine state resumed from {self.engine_resume}")
-            self._seed_delivery(in_queue_name)
+            self._seed_delivery()
         if self.alerts_resume:
             self.alerts_manager.load_resume(self.alerts_resume)
         # conformance: the boot boundary — what epoch the durable state
@@ -331,7 +382,7 @@ class WorkerApp:
             chain_epoch=(self._ckpt_chain.tail_epoch
                          if self._ckpt_chain is not None else None),
             mode=self._ckpt_mode,
-            window=len(self._dedup_fifo),
+            window=self._window_total_locked(),
         )
 
         # float + floor: the chaos tier runs sub-second epoch cadences, and
@@ -366,15 +417,31 @@ class WorkerApp:
         runtime.every(stat_s, self._check_device_memory, name="hbm-watchdog")
 
         # -- intake ----------------------------------------------------------
+        # One consumer per owned queue. Non-fleet: the single in-queue.
+        # Fleet: one partition queue per owned partition — ownership is
+        # whatever the restored delivery tree says (a shard that handed a
+        # partition away and restarted must NOT re-own it), defaulting to
+        # the identity partition on a fresh boot.
         self._factory = EntryFactory()
-        self.in_queue = qm.get_queue(
-            in_queue_name, "c", self._consume, manual_ack=self._at_least_once
-        )
+        self.in_queues: Dict[str, object] = {}
+        if self._fleet:
+            for p in sorted(self._initial_partitions()):
+                self._open_partition_queue(p)
+        else:
+            if self._at_least_once:
+                with self._driver_lock:
+                    self._windows.setdefault(in_queue_name, _DedupWindow())
+            self.in_queues[in_queue_name] = qm.get_queue(
+                in_queue_name, "c", self._make_consume_cb(in_queue_name),
+                manual_ack=self._at_least_once,
+            )
+        # primary queue handle (ack fan-in + single-queue compatibility)
+        self.in_queue = next(iter(self.in_queues.values()), None)
         self._consume_enabled = bool(stats_cfg.get("consumeQueue", True))
         if self._consume_enabled:
-            self.in_queue.start_consume()
-        qm.on("pause", self.in_queue.stop_consume)
-        qm.on("resume", lambda: self.in_queue.start_consume() if self._consume_enabled else None)
+            self._start_all_consume()
+        qm.on("pause", self._stop_all_consume)
+        qm.on("resume", lambda: self._start_all_consume() if self._consume_enabled else None)
 
         # -- alert sender recursion (stream_process_alerts.js:269-333) -------
         self._alert_timer: Optional[threading.Timer] = None
@@ -418,6 +485,8 @@ class WorkerApp:
 
         fields["ev"] = ev
         fields["ts"] = time.time()
+        if self._fleet:
+            fields.setdefault("shard", self.shard_id)
         try:
             line = _json.dumps(fields, separators=(",", ":"))
             with self._ev_lock:
@@ -426,23 +495,127 @@ class WorkerApp:
         except Exception:
             pass
 
-    def _seed_delivery(self, in_queue_name: str) -> None:
-        """Seed the dedup window / epoch watermark from a restored snapshot
-        or chain: redeliveries of messages the checkpoint already absorbed
-        are skipped."""
-        dstate = (self.driver.delivery_state or {}).get(in_queue_name)
+    # -- fleet plumbing ------------------------------------------------------
+    def _shard_path(self, path):
+        """``{shard}``-template a configured path with this worker's shard
+        id, so N shards of one shared config get disjoint chains/resumes."""
+        if path and self.shard_id is not None:
+            return str(path).replace("{shard}", str(self.shard_id))
+        return path
+
+    def _queue_partition(self, qname: str) -> Optional[int]:
+        from ..parallel.fleet import parse_partition
+
+        return parse_partition(qname, self._partition_base)
+
+    def _partition_pred(self, p: int):
+        """(server, service) -> bool for rows routed to partition ``p``
+        under the configured key — the SAME stable hash the producer-side
+        partitioner routes by (routing discipline keeps per-shard dedup
+        windows sufficient, shardmodel fleet-exactly-once)."""
+        from ..parallel.fleet import service_partition
+
+        key_is_service = self._partition_key != "server"
+        shards = self._fleet_shards
+
+        def pred(server: str, service: str) -> bool:
+            return service_partition(
+                service if key_is_service else server, shards
+            ) == p
+
+        return pred
+
+    def _make_consume_cb(self, qname: str):
+        def cb(line, headers=None, token=None):
+            self._consume(line, headers, token, qname)
+
+        return cb
+
+    def _open_partition_queue(self, p: int):
+        from ..parallel.fleet import partition_queue
+
+        qname = partition_queue(self._partition_base, p)
+        with self._driver_lock:
+            if qname not in self._windows:
+                self._windows[qname] = _DedupWindow()
+        consumer = self.runtime.qm.get_queue(
+            qname, "c", self._make_consume_cb(qname), manual_ack=True
+        )
+        self.in_queues[qname] = consumer
+        return consumer
+
+    def _initial_partitions(self) -> set:
+        """Partitions this shard owns at boot: whatever queues the restored
+        delivery tree carries (ownership rides the checkpoint — a released
+        partition must stay released across a crash), or the identity
+        partition on a fresh boot (no delivery state ever committed)."""
+        if self.driver.delivery_state is None:
+            return {self.shard_id}
+        with self._driver_lock:
+            owned = {
+                self._queue_partition(q) for q in self._windows
+            } - {None}
+        return owned
+
+    def _stop_all_consume(self) -> None:
+        for q in list(getattr(self, "in_queues", {}).values()):
+            q.stop_consume()
+
+    def _start_all_consume(self) -> None:
+        for q in list(getattr(self, "in_queues", {}).values()):
+            q.start_consume()
+
+    # apm: holds(_driver_lock): every caller acquires it (boot recover event, healthz, metrics)
+    def _window_total_locked(self) -> int:
+        return sum(len(w.fifo) for w in self._windows.values())
+
+    @property
+    def _dedup_fifo(self):
+        """Primary queue's dedup FIFO — the single-queue view tests and the
+        chaos harness predate the per-queue windows with."""
+        q = self.in_queue.queue_name if self.in_queue is not None \
+            else self._partition_base
+        # apm: allow(lock-guard): read-only compatibility view for single-threaded test probes
+        return self._windows.setdefault(q, _DedupWindow()).fifo
+
+    @property
+    def _dedup_set(self):
+        q = self.in_queue.queue_name if self.in_queue is not None \
+            else self._partition_base
+        # apm: allow(lock-guard): read-only compatibility view for single-threaded test probes
+        return self._windows.setdefault(q, _DedupWindow()).ids
+
+    def _seed_delivery(self) -> None:
+        """Seed the per-queue dedup windows / epoch watermark from a
+        restored snapshot or chain: redeliveries of messages the checkpoint
+        already absorbed are skipped. In fleet mode the set of restored
+        queue records IS the shard's partition ownership."""
+        dstate = self.driver.delivery_state or {}
         if not (self._at_least_once and dstate):
             return
         with self._driver_lock:  # boot wiring, but cheap to be rigorous
-            epoch = self._delivery_epoch = int(dstate.get("epoch", 0))
-            self._deduped_total = int(dstate.get("deduped_total", 0))
-            for mid in dstate.get("dedup", []):
-                if mid not in self._dedup_set:
-                    self._dedup_set.add(mid)
-                    self._dedup_fifo.append(mid)
-            n_window = len(self._dedup_fifo)
+            epoch = 0
+            deduped = 0
+            for qname, rec in dstate.items():
+                if self._fleet and self._queue_partition(qname) is None:
+                    continue  # foreign record (e.g. pre-fleet snapshot)
+                if not self._fleet and qname != self._partition_base:
+                    continue  # another queue's record: not ours to consume
+                w = self._windows.setdefault(qname, _DedupWindow())
+                for mid in rec.get("dedup", []):
+                    if mid not in w.ids:
+                        w.ids.add(mid)
+                        w.fifo.append(mid)
+                w.deduped = int(rec.get("deduped_total", 0))
+                epoch = max(epoch, int(rec.get("epoch", 0)))
+                deduped += w.deduped
+            self._delivery_epoch = epoch
+            self._deduped_total = deduped
+            n_window = self._window_total_locked()
+            n_queues = len(self._windows)
         self.runtime.logger.info(
-            f"Delivery state resumed: epoch {epoch}, dedup window {n_window} ids"
+            f"Delivery state resumed: epoch {epoch}, dedup window {n_window} "
+            f"ids across {n_queues} queue(s)"
         )
 
     def _collect_metrics(self):
@@ -484,22 +657,48 @@ class WorkerApp:
                          "Delta-chain full-snapshot compactions completed")
         if self._at_least_once:
             # consistent snapshot: the scrape must not interleave with an
-            # epoch commit swapping the token list (RLock, scrape cadence)
+            # epoch commit swapping the token list (RLock, scrape cadence).
+            # In fleet mode every delivery/epoch series carries the
+            # apm_shard_id label so the manager /fleet plane can pivot the
+            # whole fleet per shard (DESIGN.md §8/§10).
+            lbl = {"apm_shard_id": str(self.shard_id)} if self._fleet else {}
             with self._driver_lock:
                 epoch = self._delivery_epoch
                 deduped = self._deduped_total
                 unacked = len(self._epoch_tokens)
                 pending = len(self._alo_pending)
-            yield Sample("apm_delivery_epoch", {}, epoch, "gauge",
+                window = self._window_total_locked()
+                per_queue = {q: len(w.fifo) for q, w in self._windows.items()}
+                mismatches = self._partition_mismatch_total
+                rebalances = self._rebalances_total
+                epoch_age = time.monotonic() - self._last_epoch_commit
+            yield Sample("apm_delivery_epoch", lbl, epoch, "gauge",
                          "At-least-once epoch watermark (checkpoints committed)")
-            yield Sample("apm_redelivered_deduped_total", {}, deduped,
+            yield Sample("apm_redelivered_deduped_total", lbl, deduped,
                          "counter",
                          "Redelivered/duplicate messages skipped by the dedup window")
-            yield Sample("apm_delivery_unacked", {}, unacked, "gauge",
+            yield Sample("apm_delivery_unacked", lbl, unacked, "gauge",
                          "Absorbed-but-unacked deliveries in the open epoch")
-            yield Sample("apm_delivery_pending_feed", {}, pending,
+            yield Sample("apm_delivery_pending_feed", lbl, pending,
                          "gauge",
                          "Accepted deliveries buffered for the next bulk feed")
+            for q, n in per_queue.items():
+                yield Sample("apm_delivery_dedup_window", dict(lbl, queue=q),
+                             n, "gauge",
+                             "Dedup-window occupancy (ids) per consumed queue")
+            if self._fleet:
+                yield Sample("apm_delivery_epoch_age_seconds", lbl,
+                             epoch_age, "gauge",
+                             "Seconds since the last committed epoch (stall lag)")
+                yield Sample("apm_fleet_partition_mismatch_total", lbl,
+                             mismatches, "counter",
+                             "Deliveries whose partition header contradicted their queue (rejected)")
+                yield Sample("apm_shard_rebalances_total", lbl, rebalances,
+                             "counter",
+                             "Partition handoffs (release + adopt) this shard completed")
+                yield Sample("apm_shard_owned_partitions", lbl,
+                             len(per_queue), "gauge",
+                             "Partition queues this shard currently owns")
 
     def _health(self) -> dict:
         """The /healthz engine section: tick liveness, emission/intake
@@ -536,14 +735,38 @@ class WorkerApp:
         out["checkpoint"] = ck
         if self._at_least_once:
             with self._driver_lock:  # consistent healthz delivery block
-                out["delivery"] = {
+                delivery = {
                     "mode": "atLeastOnce",
                     "epoch": self._delivery_epoch,
                     "unacked": len(self._epoch_tokens),
                     "pending_feed": len(self._alo_pending),
                     "deduped_total": self._deduped_total,
-                    "dedup_window": len(self._dedup_fifo),
+                    "dedup_window": self._window_total_locked(),
                 }
+                if self._fleet:
+                    delivery["shard"] = self.shard_id
+                    delivery["owned_partitions"] = sorted(
+                        p for p in (
+                            self._queue_partition(q) for q in self._windows
+                        ) if p is not None
+                    )
+                    delivery["windows"] = {
+                        q: len(w.fifo) for q, w in self._windows.items()
+                    }
+                    delivery["partition_mismatches"] = self._partition_mismatch_total
+                # epoch-stall watchdog: intake exists but no epoch has
+                # committed for epochStallSeconds — the shard is wedged (or
+                # its disk is), and the manager /fleet plane must see 503
+                stalled = (
+                    self._epoch_stall_s > 0
+                    and (self._epoch_tokens or self._alo_pending)
+                    and time.monotonic() - self._last_epoch_commit
+                    > self._epoch_stall_s
+                )
+                if stalled:
+                    delivery["epoch_stalled"] = True
+                    out["ok"] = False
+                out["delivery"] = delivery
         if tracer is not None:
             out.update(tracer.summary())
         try:
@@ -670,9 +893,9 @@ class WorkerApp:
             _seq, ctx = fifo.popleft()
             self._note_trace_now(ctx)
 
-    def _consume(self, line: str, headers=None, token=None) -> None:
+    def _consume(self, line: str, headers=None, token=None, qname=None) -> None:
         if self._at_least_once:
-            self._consume_at_least_once(line, headers, token)
+            self._consume_at_least_once(line, headers, token, qname)
             return
         # transport ingest stamp (ProducerQueue header): queue it for the
         # feed-time handoff that anchors the ingest->emit/alert series.
@@ -724,25 +947,56 @@ class WorkerApp:
         with self._driver_lock:
             self.driver.feed(entry)
 
-    def _consume_at_least_once(self, line: str, headers, token) -> None:
-        """One manual-ack delivery: dedup, absorb, remember the token.
+    def _consume_at_least_once(self, line: str, headers, token, qname=None) -> None:
+        """One manual-ack delivery: dedup against its queue's window,
+        absorb, remember the token.
 
         Everything happens under the driver lock so the epoch commit
-        (save_state) sees a consistent pair: the dedup window it snapshots
-        lists exactly the messages whose effects are in the state it saves —
+        (save_state) sees a consistent pair: the dedup windows it snapshots
+        list exactly the messages whose effects are in the state it saves —
         the invariant that makes a crash between checkpoint and ack safe
         (redelivery → skip) AND a crash before checkpoint safe (redelivery →
         reprocess against the pre-epoch state)."""
         msg_id = (headers or {}).get("msg_id")
+        if qname is None:
+            qname = self._partition_base
         with self._driver_lock:
+            w = self._windows.get(qname)
+            if w is None:
+                w = self._windows[qname] = _DedupWindow()
+            if self._fleet:
+                # routing discipline (shardmodel partition_header_mismatch
+                # mutant): a message whose stamped partition contradicts the
+                # queue it arrived on would strand its effect on a non-owner
+                # — reject it LOUDLY (count + log), ack it at the epoch so
+                # it cannot loop, and never absorb it.
+                hp = (headers or {}).get("partition")
+                expected = self._queue_partition(qname)
+                if hp is not None and expected is not None \
+                        and int(hp) != expected:
+                    self._partition_mismatch_total += 1
+                    if self._ev_fh is not None:
+                        self._emit_event(
+                            "deliver", msg=msg_id, queue=qname,
+                            mismatch=True, dedup=False, tx=False,
+                            redelivered=bool((headers or {}).get("redelivered")),
+                        )
+                    self.runtime.logger.error(
+                        f"Partition header mismatch on {qname}: stamped "
+                        f"p{hp}, queue is p{expected} — delivery rejected "
+                        f"(producer partitioner drift?)"
+                    )
+                    if token is not None:
+                        self._epoch_tokens.append(token)
+                    return
             if self._ev_fh is not None:
                 self._emit_event(
-                    "deliver", msg=msg_id,
-                    dedup=msg_id is not None and msg_id in self._dedup_set,
+                    "deliver", msg=msg_id, queue=qname,
+                    dedup=msg_id is not None and msg_id in w.ids,
                     tx=line.startswith("tx|"),
                     redelivered=bool((headers or {}).get("redelivered")),
                 )
-            if msg_id is not None and msg_id in self._dedup_set:
+            if msg_id is not None and msg_id in w.ids:
                 # already absorbed: a broker redelivery or an in-flight
                 # duplicate. Skip the feed, count it — but do NOT ack now:
                 # an in-flight dup of a message absorbed in the CURRENT
@@ -752,20 +1006,21 @@ class WorkerApp:
                 # harness: one message lost per dup-then-crash). The token
                 # joins the epoch and commits with everyone else.
                 self._deduped_total += 1
+                w.deduped += 1
                 if token is not None:
                     self._epoch_tokens.append(token)
             else:
                 if msg_id is not None:
-                    self._dedup_set.add(msg_id)
-                    self._dedup_fifo.append(msg_id)
+                    w.ids.add(msg_id)
+                    w.fifo.append(msg_id)
                     if self._ckpt_chain is not None:
                         # incremental window record for the delta commit:
                         # replay = (old + added)[evicted:]
-                        self._dedup_added_epoch.append(msg_id)
-                    if len(self._dedup_fifo) > self._dedup_max:
-                        self._dedup_set.discard(self._dedup_fifo.popleft())
+                        w.added.append(msg_id)
+                    if len(w.fifo) > self._dedup_max:
+                        w.ids.discard(w.fifo.popleft())
                         if self._ckpt_chain is not None:
-                            self._dedup_evicted_epoch += 1
+                            w.evicted += 1
                 if line.startswith("tx|"):
                     h = headers or {}
                     ts = h.get("ingest_ts")
@@ -780,7 +1035,7 @@ class WorkerApp:
                         if tid is not None and self.driver._trace is not None
                         else None
                     )
-                    self._alo_pending.append((line, ts, ctx, msg_id))
+                    self._alo_pending.append((line, ts, ctx, msg_id, qname))
                     if len(self._alo_pending) >= self._alo_batch:
                         self._drain_alo_pending_locked()
                 else:
@@ -814,29 +1069,35 @@ class WorkerApp:
             return
         self._alo_pending = []
         if self.driver._tracer is not None:
-            oldest = min((ts for _l, ts, _c, _m in pending if ts is not None),
+            oldest = min((ts for _l, ts, _c, _m, _q in pending if ts is not None),
                          default=None)
             if oldest is not None:
                 self.driver.note_intake_time(oldest)
-            for _l, _ts, ctx, _m in pending:
+            for _l, _ts, ctx, _m, _q in pending:
                 # register sampled traces BEFORE the feed: the tick that
                 # closes their bucket may fire inside this very batch
                 if ctx is not None:
                     self._note_trace_now(ctx)
         try:
-            self.driver.feed_csv_batch([line for line, _ts, _c, _m in pending])
+            self.driver.feed_csv_batch([line for line, _ts, _c, _m, _q in pending])
         except Exception:
             import traceback
 
-            batch_ids = {m for _l, _ts, _c, m in pending if m is not None}
-            if batch_ids:
-                self._dedup_set -= batch_ids
-                self._dedup_fifo = type(self._dedup_fifo)(
-                    m for m in self._dedup_fifo if m not in batch_ids)
+            import collections as _collections
+
+            by_q: dict = {}
+            for _l, _ts, _c, m, q in pending:
+                if m is not None:
+                    by_q.setdefault(q, set()).add(m)
+            for q, ids in by_q.items():
+                w = self._windows.get(q)
+                if w is None:
+                    continue
+                w.ids -= ids
+                w.fifo = _collections.deque(
+                    m for m in w.fifo if m not in ids)
                 if self._ckpt_chain is not None:
-                    self._dedup_added_epoch = [
-                        m for m in self._dedup_added_epoch
-                        if m not in batch_ids]
+                    w.added = [m for m in w.added if m not in ids]
             self.runtime.logger.error(
                 f"ALO bulk feed failed; {len(pending)} lines dropped and "
                 f"their ids withdrawn from the dedup window (crash-"
@@ -1004,9 +1265,9 @@ class WorkerApp:
         if consume != self._consume_enabled:
             self._consume_enabled = consume
             if consume:
-                self.in_queue.start_consume()
+                self._start_all_consume()
             else:
-                self.in_queue.stop_consume()
+                self._stop_all_consume()
         self.alerts_manager.set_config(alerts_cfg)
 
     # -- state ---------------------------------------------------------------
@@ -1052,10 +1313,9 @@ class WorkerApp:
             f"{self._ckpt_backoff_max:.0f}s. Free disk space / fix the "
             f"checkpoint volume to resume."
         )
-        in_queue = getattr(self, "in_queue", None)
-        if in_queue is not None and self._consume_enabled:
+        if getattr(self, "in_queues", None) and self._consume_enabled:
             try:
-                in_queue.stop_consume()
+                self._stop_all_consume()
                 self._ckpt_paused_intake = True
             except Exception as e:
                 self.runtime.logger.error(f"Degradation intake pause failed: {e}")
@@ -1075,19 +1335,41 @@ class WorkerApp:
             self.ops_alerts.add("Checkpoint writes recovered; intake resumed.")
             if self._ckpt_paused_intake and self._consume_enabled:
                 try:
-                    self.in_queue.start_consume()
+                    self._start_all_consume()
                 except Exception as e:
                     self.runtime.logger.error(f"Degradation intake resume failed: {e}")
             self._ckpt_paused_intake = False
 
+    # apm: holds(_driver_lock): every caller acquires it (commit paths, handoff)
+    def _delivery_records_locked(self, next_epoch: int, incremental: bool) -> dict:
+        """The per-queue delivery tree one commit persists: every owned
+        queue's dedup window (full list, or the added/evicted incremental
+        record for delta commits) stamped with the committing epoch. The
+        set of records IS partition ownership in fleet mode."""
+        out = {}
+        for qname, w in self._windows.items():
+            rec = {"epoch": next_epoch, "deduped_total": w.deduped}
+            if incremental:
+                rec["added"] = list(w.added)
+                rec["evicted"] = w.evicted
+            else:
+                rec["dedup"] = list(w.fifo)
+            out[qname] = rec
+        return out
+
+    # apm: holds(_driver_lock): every caller acquires it (commit paths)
+    def _reset_window_increments_locked(self) -> None:
+        for w in self._windows.values():
+            w.added = []
+            w.evicted = 0
+
     # apm: holds(_driver_lock): called only from save_state's locked section
-    def _commit_checkpoint_locked(self, in_queue) -> bool:
+    def _commit_checkpoint_locked(self, epoch_commit: bool) -> bool:
         """Write one checkpoint (delta append or full npz) with the delivery
         tree when an epoch is committing. Returns True when the write landed
         durably; False routes through the failure policy and MUST NOT ack."""
         from ..deltachain import CheckpointWriteError
 
-        epoch_commit = self._at_least_once and in_queue is not None
         next_epoch = self._delivery_epoch + 1 if epoch_commit else self._delivery_epoch
         try:
             if self._ckpt_chain is not None:
@@ -1099,30 +1381,16 @@ class WorkerApp:
                     )
                 dd = None
                 if epoch_commit:
-                    dd = {
-                        in_queue.queue_name: {
-                            "epoch": next_epoch,
-                            "added": list(self._dedup_added_epoch),
-                            "evicted": self._dedup_evicted_epoch,
-                            "deduped_total": self._deduped_total,
-                        }
-                    }
+                    dd = self._delivery_records_locked(next_epoch, True)
                 chain_epoch = self.driver.save_resume_delta(
                     self._ckpt_chain, delivery_delta=dd
                 )
-                self._dedup_added_epoch = []
-                self._dedup_evicted_epoch = 0
-                self._maybe_compact_locked(chain_epoch, in_queue, next_epoch)
+                self._reset_window_increments_locked()
+                self._maybe_compact_locked(chain_epoch, epoch_commit, next_epoch)
             else:
                 delivery = None
                 if epoch_commit:
-                    delivery = {
-                        in_queue.queue_name: {
-                            "epoch": next_epoch,
-                            "dedup": list(self._dedup_fifo),
-                            "deduped_total": self._deduped_total,
-                        }
-                    }
+                    delivery = self._delivery_records_locked(next_epoch, False)
                 self.driver.save_resume(self.engine_resume, delivery=delivery)
         except (CheckpointWriteError, OSError) as e:
             self._ckpt_write_failed(e)
@@ -1131,6 +1399,7 @@ class WorkerApp:
             return False
         if epoch_commit:
             self._delivery_epoch = next_epoch
+            self._last_epoch_commit = time.monotonic()
         self._ckpt_write_ok()
         self._emit_event(
             "checkpoint", ok=True, mode=self._ckpt_mode,
@@ -1141,7 +1410,7 @@ class WorkerApp:
         return True
 
     # apm: holds(_driver_lock): called only from _commit_checkpoint_locked
-    def _maybe_compact_locked(self, chain_epoch: int, in_queue, next_epoch: int) -> None:
+    def _maybe_compact_locked(self, chain_epoch: int, epoch_commit: bool, next_epoch: int) -> None:
         """Kick the periodic full-snapshot compaction OFF the hot path: the
         locked section only captures the state arrays (device gathers); the
         compress + write + manifest swap + GC run on the chain's background
@@ -1152,14 +1421,8 @@ class WorkerApp:
         ):
             return
         delivery = None
-        if self._at_least_once and in_queue is not None:
-            delivery = {
-                in_queue.queue_name: {
-                    "epoch": next_epoch,
-                    "dedup": list(self._dedup_fifo),
-                    "deduped_total": self._deduped_total,
-                }
-            }
+        if self._at_least_once and epoch_commit:
+            delivery = self._delivery_records_locked(next_epoch, False)
         arrays = self.driver._capture_resume_arrays(delivery)
         # DEEP-COPY before handing off: np.asarray over CPU device buffers
         # can be zero-copy, and the tick loop's donated dispatches recycle
@@ -1201,18 +1464,33 @@ class WorkerApp:
             ):
                 return  # backoff window after a failed checkpoint write
             has_ckpt = self._ckpt_chain is not None or self.engine_resume
+            # idle skip (delta mode): an untouched engine with an empty
+            # ledger has nothing to commit — appending empty delta segments
+            # would grow every idle worker's chain once per save interval
+            # and once per boot, for zero durability gain
+            if (
+                not force
+                and self._ckpt_chain is not None
+                and not self._epoch_tokens
+                and not self._alo_pending
+                and not self.driver.has_uncheckpointed_changes
+                and not any(w.added or w.evicted for w in self._windows.values())
+            ):
+                return
             if self._at_least_once and in_queue is not None:
                 tokens = self._epoch_tokens
                 if has_ckpt:
-                    committed = self._commit_checkpoint_locked(in_queue)
+                    committed = self._commit_checkpoint_locked(True)
                 # no checkpoint configured: the "checkpoint" is process
                 # memory — still ack per epoch (commit-to-memory batching)
                 if committed:
                     self._epoch_tokens = []
+                    if not has_ckpt:
+                        self._last_epoch_commit = time.monotonic()
                 else:
                     tokens = []  # unacked => redelivered; dedup absorbs
             elif has_ckpt:
-                committed = self._commit_checkpoint_locked(None)
+                committed = self._commit_checkpoint_locked(False)
             epoch_now = self._delivery_epoch
         if tokens and committed:
             try:
@@ -1224,6 +1502,208 @@ class WorkerApp:
                 self.runtime.logger.error(f"Epoch ack failed (will redeliver): {e}")
         if self.alerts_resume:
             self.alerts_manager.save_resume(self.alerts_resume)
+
+    # -- quiesced rebalance handoff (shardmodel.py, DESIGN.md §10) -----------
+    # The protocol implemented EXACTLY as pre-verified by the model checker:
+    # ownership of partition p moves only when the releasing shard's unacked
+    # ledger is empty (quiesce), and it moves TOGETHER with p's dedup-window
+    # ids and p's state rows. The two commits are the linearization points —
+    # the controller hands the handoff file to the adopter only after the
+    # release commit lands, and the adopter owns p only once its import
+    # commit lands; a crash on either side of either commit leaves the
+    # partition in exactly one durable place (see the §10 failure matrix).
+
+    # apm: holds(_driver_lock): called only from release/adopt locked sections
+    def _handoff_commit_locked(self) -> bool:
+        """Durably commit a handoff-mutated engine (rows removed or
+        imported) + the new delivery tree. A wholesale row move is not
+        representable as a dirty-cell delta, so delta mode writes a fresh
+        full BASE at the current chain tail (sync compaction: the manifest
+        swap IS the commit); full mode is a normal snapshot."""
+        from ..deltachain import CheckpointWriteError
+
+        next_epoch = self._delivery_epoch + 1
+        delivery = self._delivery_records_locked(next_epoch, False)
+        try:
+            if self._ckpt_chain is not None:
+                arrays = self.driver._capture_resume_arrays(delivery)
+                arrays = {
+                    k: np.array(v, copy=True) if isinstance(v, np.ndarray) else v
+                    for k, v in arrays.items()
+                }
+                self._ckpt_chain.wait_compaction(timeout_s=60.0)
+                self._ckpt_chain.compact(self._ckpt_chain.tail_epoch, arrays)
+                self._ckpt_last_compact = self._ckpt_chain.tail_epoch
+                self.driver._delta_reset_capture()
+            elif self.engine_resume:
+                self.driver.save_resume(self.engine_resume, delivery=delivery)
+            # no checkpoint configured: process memory IS the state store
+            # (test topologies); the in-memory windows moved already
+        except (CheckpointWriteError, OSError) as e:
+            self._ckpt_write_failed(e)
+            self._emit_event("checkpoint", ok=False, mode=self._ckpt_mode,
+                             epoch=self._delivery_epoch, handoff=True)
+            return False
+        self._delivery_epoch = next_epoch
+        self._last_epoch_commit = time.monotonic()
+        self._reset_window_increments_locked()
+        self._ckpt_write_ok()
+        self._emit_event(
+            "checkpoint", ok=True, mode=self._ckpt_mode, epoch=next_epoch,
+            chain_epoch=(self._ckpt_chain.tail_epoch
+                         if self._ckpt_chain is not None else None),
+            handoff=True,
+        )
+        return True
+
+    def release_partition(self, p: int, out_path: str,
+                          quiesce_timeout_s: float = 60.0) -> dict:
+        """Release partition ``p``: quiesce (commit + ack until the unacked
+        ledger is empty), write the handoff record (rows + window + chain
+        manifest) to ``out_path``, then drop the rows/window/ownership and
+        commit. Returns the handoff summary ONLY after the release commit
+        landed — the file is inert (must be discarded) if this raises."""
+        if not self._fleet:
+            raise RuntimeError("release_partition requires fleet mode")
+        from ..parallel.fleet import partition_queue, write_handoff
+
+        qname = partition_queue(self._partition_base, p)
+        if qname not in self.in_queues:
+            raise ValueError(f"shard s{self.shard_id} does not own partition p{p}")
+        # quiesce needs the WHOLE shard ledger empty (shardmodel: handoff
+        # waits for `not s.ledgers[a]`), so all intake pauses briefly
+        self._stop_all_consume()
+        try:
+            deadline = time.monotonic() + quiesce_timeout_s
+            while True:
+                self.save_state()
+                with self._driver_lock:
+                    quiesced = not self._epoch_tokens and not self._alo_pending
+                if quiesced:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"partition p{p} release: quiesce did not complete "
+                        f"within {quiesce_timeout_s}s (checkpoint degraded?)"
+                    )
+                time.sleep(0.01)
+            pred = self._partition_pred(p)
+            with self._driver_lock:
+                data = self.driver.export_service_rows(pred)
+                w = self._windows.get(qname) or _DedupWindow()
+                meta = {
+                    "partition": p,
+                    "queue": qname,
+                    "base": self._partition_base,
+                    "key": self._partition_key,
+                    "shards": self._fleet_shards,
+                    "from_shard": self.shard_id,
+                    "epoch": self._delivery_epoch,
+                    "window": list(w.fifo),
+                    "deduped_total": w.deduped,
+                    "rows": int(data["registry"].shape[0]),
+                    "chain": (self._ckpt_chain.manifest_record()
+                              if self._ckpt_chain is not None else None),
+                }
+                write_handoff(out_path, data, meta)
+                self._emit_event(
+                    "handoff_export", partition=p, queue=qname,
+                    ids=list(w.fifo), rows=meta["rows"],
+                    epoch=self._delivery_epoch,
+                    unacked=len(self._epoch_tokens),
+                )
+                # the release: rows + window + ownership leave this shard,
+                # then the commit makes it real
+                self.driver.remove_service_rows(pred)
+                self._windows.pop(qname, None)
+                self.in_queues.pop(qname, None)
+                if not self._handoff_commit_locked():
+                    raise RuntimeError(
+                        f"partition p{p} release commit failed (checkpoint "
+                        f"error) — handoff file must be discarded"
+                    )
+                self._rebalances_total += 1
+            if self.in_queues:
+                self.in_queue = next(iter(self.in_queues.values()))
+            self.runtime.logger.info(
+                f"Released partition p{p} ({meta['rows']} rows, "
+                f"{len(meta['window'])} window ids) -> {out_path}"
+            )
+            return meta
+        finally:
+            if self._consume_enabled:
+                self._start_all_consume()
+
+    def adopt_partition(self, p: int, in_path: str) -> dict:
+        """Adopt partition ``p`` from a handoff record: import its state
+        rows + dedup window, commit, and start consuming its queue. Safe to
+        retry — a re-adopt of an already-owned partition (the controller
+        retrying after an adopter crash that landed past the import commit)
+        is a no-op."""
+        if not self._fleet:
+            raise RuntimeError("adopt_partition requires fleet mode")
+        from ..parallel.fleet import partition_queue, read_handoff
+
+        qname = partition_queue(self._partition_base, p)
+        if qname in self.in_queues:
+            if self._consume_enabled:
+                self.in_queues[qname].start_consume()
+            return {"partition": p, "rows": 0, "already_owned": True}
+        data, meta = read_handoff(in_path)
+        if meta.get("base") != self._partition_base \
+                or int(meta.get("partition", -1)) != p:
+            raise ValueError(
+                f"handoff record mismatch: expected partition p{p} of "
+                f"{self._partition_base!r}, file carries "
+                f"p{meta.get('partition')} of {meta.get('base')!r}"
+            )
+        with self._driver_lock:
+            # pending feeds of OUR queues must reach the engine before the
+            # import commit snapshots it (drain-before-commit invariant)
+            self._drain_alo_pending_locked()
+            n_rows = self.driver.import_service_rows(data)
+            w = _DedupWindow()
+            for mid in meta.get("window", []):
+                if mid not in w.ids:
+                    w.ids.add(mid)
+                    w.fifo.append(mid)
+            w.deduped = int(meta.get("deduped_total", 0))
+            self._windows[qname] = w
+            self._emit_event(
+                "handoff_import", partition=p, queue=qname,
+                ids=list(w.fifo), rows=n_rows,
+            )
+            if not self._handoff_commit_locked():
+                # roll the import back: the adopter must not serve rows it
+                # cannot commit (the controller will retry the adopt)
+                self._windows.pop(qname, None)
+                pred = self._partition_pred(p)
+                self.driver.remove_service_rows(pred)
+                self._emit_event(
+                    "handoff_abort", partition=p, queue=qname,
+                    ids=list(w.fifo),
+                )
+                raise RuntimeError(
+                    f"partition p{p} adopt commit failed (checkpoint error) "
+                    f"— import rolled back, retry the adopt"
+                )
+            self._rebalances_total += 1
+        consumer = self._open_partition_queue(p)
+        if self._consume_enabled:
+            consumer.start_consume()
+        self.runtime.logger.info(
+            f"Adopted partition p{p} ({n_rows} rows, "
+            f"{len(meta.get('window', []))} window ids) from s"
+            f"{meta.get('from_shard')}"
+        )
+        return {"partition": p, "rows": n_rows, "from_shard": meta.get("from_shard")}
+
+    def owned_partitions(self) -> list:
+        """Sorted partition ids this shard currently owns (fleet mode)."""
+        return sorted(
+            p for p in (self._queue_partition(q) for q in self.in_queues)
+            if p is not None
+        )
 
     def shutdown(self) -> None:
         if self._closed:

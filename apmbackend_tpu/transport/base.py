@@ -131,6 +131,14 @@ class ProducerQueue(EventEmitter):
         # it). One string concat per line; at-most-once consumers ignore it.
         self._msg_prefix = f"{os.getpid():x}-{os.urandom(4).hex()}-"
         self._msg_seq = 0  # guarded-by: _lock
+        # fleet partitioning (parallel/fleet.py): when this producer queue is
+        # one service-hash partition channel of a sharded `transactions`
+        # fabric, the partition id is stamped into every message's headers so
+        # the consuming shard can verify routing discipline (the shardmodel
+        # `partition_header_mismatch` mutant shows what an unstamped or
+        # wrongly-routed message costs: owner-locality breaks silently).
+        # Set once by FleetPartitioner before the first write_line.
+        self.partition: Optional[int] = None
         # the trace plane (obs/trace): this producer IS the transport-entry
         # ingest boundary; every sample_rate-th message gets a trace_id
         # header + an ingest span. The singleton is configured in place by
@@ -188,6 +196,8 @@ class ProducerQueue(EventEmitter):
             seq = self._msg_seq
             now = time.time()
             headers = {"ingest_ts": now, "msg_id": self._msg_prefix + str(seq)}
+            if self.partition is not None:
+                headers["partition"] = self.partition
             tr = self._tracer
             if tr.rate > 0 and seq % tr.rate == 0:
                 # head-sampled trace context: deterministic in the message

@@ -15,10 +15,11 @@ production scale).
 | multiwindow | 4: multi-window seasonal/EWMA baselining + alert eval on device |
 | pallas      | (extra) selection-kernel hardware proof: parity + timing vs XLA sort |
 | dispatch    | (extra) per-tick dispatch-floor microbench at the rolling shape |
+| fleet       | (extra) pod-scale sharded spine: N worker shards end to end (DESIGN.md §10) |
 """
 
-from . import (bench_dispatch, bench_jmx, bench_multiwindow, bench_pallas,
-               bench_podshard, bench_replay, bench_rolling)
+from . import (bench_dispatch, bench_fleet, bench_jmx, bench_multiwindow,
+               bench_pallas, bench_podshard, bench_replay, bench_rolling)
 
 REGISTRY = {
     "replay": bench_replay.run,
@@ -28,4 +29,5 @@ REGISTRY = {
     "multiwindow": bench_multiwindow.run,
     "pallas": bench_pallas.run,
     "dispatch": bench_dispatch.run,
+    "fleet": bench_fleet.run,
 }

@@ -59,7 +59,32 @@ def main(argv=None) -> int:
     ap.add_argument("--repeat", type=int, default=None,
                     help="run each config N times, report the median-by-value "
                     "run (default: 3 for podshard, 1 otherwise)")
+    ap.add_argument("--fleet", type=int, metavar="N", default=None,
+                    help="run ONLY the fleet spine bench with N shards and "
+                    "record the certified row into BENCH_r09.json "
+                    "(the pod-scale acceptance artifact)")
     args = ap.parse_args(argv)
+
+    if args.fleet is not None:
+        # the fleet bench orchestrates its own subprocesses (one per
+        # shard), so it runs in-process here; the result row is both
+        # printed and recorded as the BENCH_r09 certification artifact
+        from .bench_fleet import run as fleet_run
+
+        res = fleet_run(quick=args.quick, shards=args.fleet)
+        line = json.dumps(res)
+        print(line, flush=True)
+        import os
+
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_r09.json")
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+        d = res.get("details", {})
+        ok = bool(d.get("meets_1m_aggregate")) and bool(d.get("meets_100ms_budget")) \
+            and bool(d.get("rebalance", {}).get("zero_loss")) \
+            and bool(d.get("rebalance", {}).get("conformance_clean"))
+        return 0 if ok else 1
 
     names = args.config or sorted(REGISTRY)
     failed = 0

@@ -90,6 +90,22 @@ if [ "$1" = "--sanitize" ]; then
         -q -m "not slow" "$@"
 fi
 
+# --fleet: the pod-scale sharded-spine tier — the slow multi-process
+# scenarios (N real worker shards over a durable spool: kill −9 one shard
+# mid-stream with bit-identical recovery, live-traffic quiesced rebalance
+# with fleet trace conformance) plus every fast in-process fleet test.
+# Tier-1 keeps only the in-process fast paths; run this before touching
+# parallel/fleet.py, the worker's partition handoff, or shardmodel.py:
+# ./run_tests.sh --fleet [pytest args...].
+if [ "$1" = "--fleet" ]; then
+    shift
+    exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_fleet.py tests/test_fleet_chaos.py \
+        tests/test_protocol_models.py \
+        -m "slow or not slow" "$@"
+fi
+
 # --chaos: the crash-consistency tier explicitly — the kill−9/restart
 # subprocess scenarios (marked `slow`, now also asserting crash flight
 # bundles are produced and parseable after SIGKILL), the hostile-storage

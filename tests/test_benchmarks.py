@@ -28,11 +28,11 @@ def test_bench_quick(name):
 
 
 def test_registry_covers_all_five_configs():
-    # the five BASELINE.json configs plus the pallas hardware-proof and
-    # dispatch-floor extras
+    # the five BASELINE.json configs plus the pallas hardware-proof,
+    # dispatch-floor, and fleet-spine extras
     assert set(REGISTRY) == {
         "replay", "rolling", "jmx", "podshard", "multiwindow", "pallas",
-        "dispatch",
+        "dispatch", "fleet",
     }
 
 

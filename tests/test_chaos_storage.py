@@ -174,12 +174,9 @@ def test_torn_tail_before_ack_recovers_and_redelivers(tmp_path, mode):
         w1.driver.flush()
         w1.driver.save_resume_delta(
             w1._ckpt_chain,
-            delivery_delta={"transactions": {
-                "epoch": w1._delivery_epoch + 1,
-                "added": list(w1._dedup_added_epoch),
-                "evicted": w1._dedup_evicted_epoch,
-                "deduped_total": w1._deduped_total,
-            }},
+            delivery_delta=w1._delivery_records_locked(
+                w1._delivery_epoch + 1, True
+            ),
         )
     torn_epoch = w1._ckpt_chain.tail_epoch
     rt1.stop_timers()
@@ -343,7 +340,13 @@ def test_crash_during_compaction_subprocess(tmp_path, point):
     """Deterministic SIGKILL inside the compaction window (before the new
     base lands / after it lands but before the MANIFEST swap): the restart
     recovers through the surviving generation and converges bit-identically
-    to the FULL-mode golden run."""
+    to the FULL-mode golden run.
+
+    The chaos child runs a FAST epoch cadence so the chain crosses
+    compact_every while the stream is still feeding: since the idle-skip
+    (PR 9) an untouched engine commits no empty delta segments, so a
+    drained stream no longer walks the chain epoch toward the compaction
+    boundary by itself."""
     lines = make_stream(n_labels=8, per_label=100)
 
     golden = ChaosWorkerHarness(str(tmp_path / "golden"), dup_p=0.0, seed=2)
@@ -355,14 +358,28 @@ def test_crash_during_compaction_subprocess(tmp_path, point):
 
     chaos = ChaosWorkerHarness(
         str(tmp_path / "chaos"), dup_p=0.0, seed=3,
-        checkpoint_mode="delta", compact_every=3,
+        checkpoint_mode="delta", compact_every=3, save_every_s=0.05,
         fault_env={1: f"kill:compact={point}"},
     )
-    for line in lines:
-        chaos.send_line(line)
     chaos.start()
+    # PACED feed: each chunk waits for acks, so every chunk spans at least
+    # one epoch commit and the chain crosses compact_every under live load
+    # — the point where the fault plan kills gen 1 (a pre-fed spool would
+    # drain inside the post-compile first commits and never compact)
+    for lo in range(0, len(lines), 40):
+        for line in lines[lo:lo + 40]:
+            chaos.send_line(line)
+        deadline = time.monotonic() + 120
+        while (chaos.proc.poll() is None
+               and chaos.acked() < chaos.sent - 80):
+            assert time.monotonic() < deadline, "chaos child stalled"
+            time.sleep(0.01)
+        if chaos.proc.poll() is not None:
+            break
     rc = chaos.wait_child_death(timeout_s=120)  # the fault plan kills gen 1
     assert rc != 0
+    for line in lines[chaos.sent:]:  # the rest of the stream post-crash
+        chaos.send_line(line)
     chaos.start()  # gen 2: no faults, finishes the stream (and compacts)
     stats_c = chaos.finish(timeout_s=240)
     chaos.close()

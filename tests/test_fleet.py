@@ -1,0 +1,675 @@
+"""Pod-scale sharded serving spine (parallel/fleet.py, DESIGN.md §10).
+
+Tier-1 (fast, in-process) coverage of ISSUE 9:
+
+- stable service-hash partitioner: pinned values (cross-process/restart
+  determinism), key→partition coverage of the fixture service set at
+  every N ≤ 8, routing by service vs server key;
+- partition-id header round-trip on ALL three transports (memory, AMQP
+  via fake_pika, durable spool);
+- the driver row-handoff primitives (export / remove / import) and their
+  bit-equality through the resume install path;
+- the quiesced rebalance protocol in-process: release → adopt under the
+  memory broker, merged fleet state bit-identical to a no-rebalance
+  golden run, ownership persistence, partition-header mismatch defense;
+- per-shard observability: apm_shard_id labels, dedup-window occupancy,
+  epoch-stall healthz 503, manager /fleet degrade + shard expansion;
+- fleet trace conformance: handoff events accepted clean, broken
+  orderings rejected.
+
+The multi-process kill−9 / live-traffic rebalance scenarios live in
+tests/test_fleet_chaos.py (slow tier, ``run_tests.sh --fleet``).
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.parallel.fleet import (
+    FleetPartitioner,
+    parse_partition,
+    partition_queue,
+    read_handoff,
+    service_partition,
+    tx_partition_key,
+    write_handoff,
+)
+from apmbackend_tpu.transport.base import QueueManager
+from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+FIXTURE_SERVICES = [f"svc{i:03d}" for i in range(12)]  # make_stream's set
+
+
+def _tx(t, i, *, svc=None, srv=None, base=170_000_000, e=None):
+    e = 100 + (i * 7 + t) % 50 if e is None else e
+    svc = svc or f"svc{i % 10:03d}"
+    srv = srv or f"jvm{i % 3}"
+    return (
+        f"tx|{srv}|{svc}|x{t}-{i}|1|{(base + t) * 10000 - e}|"
+        f"{(base + t) * 10000 + i}|{e}|Y"
+    )
+
+
+# -- partitioner --------------------------------------------------------------
+
+
+def test_service_partition_pinned_values():
+    """The routing hash is part of the persistence contract: these values
+    may NEVER drift (a re-hash re-routes the fleet and orphans every
+    dedup window / chain). Pinned against FNV-1a/32."""
+    assert [service_partition(s, 8) for s in FIXTURE_SERVICES] == [
+        7, 4, 5, 2, 3, 0, 1, 6, 7, 4, 6, 1]
+    assert service_partition("getOffers", 4) == 0
+    assert service_partition("svc00042", 4) == 1
+
+
+def test_service_partition_stable_across_processes():
+    """PYTHONHASHSEED must not matter (it would if this were hash())."""
+    code = (
+        "from apmbackend_tpu.parallel.fleet import service_partition;"
+        "print([service_partition(f'svc{i:03d}', 8) for i in range(12)])"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE, check=True,
+        env={"PYTHONHASHSEED": "12345", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+    ).stdout.decode()
+    assert eval(out.strip()) == [7, 4, 5, 2, 3, 0, 1, 6, 7, 4, 6, 1]
+
+
+@pytest.mark.parametrize("n", range(2, 9))
+def test_partition_coverage_no_empty_shard(n):
+    """The fixture service set reaches every partition for N <= 8 — a
+    fleet sized from these fixtures never boots a shard with zero
+    traffic."""
+    got = {service_partition(s, n) for s in FIXTURE_SERVICES}
+    assert got == set(range(n))
+
+
+def test_partition_queue_roundtrip():
+    assert partition_queue("transactions", 3) == "transactions.p3"
+    assert parse_partition("transactions.p3", "transactions") == 3
+    assert parse_partition("transactions", "transactions") is None
+    assert parse_partition("transactions.px", "transactions") is None
+    assert parse_partition("other.p1", "transactions") is None
+
+
+def test_tx_partition_key_modes():
+    line = _tx(0, 1, svc="getOffers", srv="jvmA")
+    assert tx_partition_key(line, "service") == "getOffers"
+    assert tx_partition_key(line, "server") == "jvmA"
+    assert tx_partition_key("jmx|host|x", "service") is None
+    assert tx_partition_key("garbage", "service") is None
+
+
+def test_partitioner_routes_and_stamps():
+    broker = MemoryBroker()
+    qm = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    part = FleetPartitioner(qm, "transactions", 4)
+    seen = {}
+
+    def consume_for(p):
+        def cb(line, headers=None, token=None):
+            seen.setdefault(p, []).append((line, headers))
+        return cb
+
+    qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    for p in range(4):
+        qm_c.get_queue(partition_queue("transactions", p), "c",
+                       consume_for(p)).start_consume()
+    lines = [_tx(0, i, svc=s) for i, s in enumerate(FIXTURE_SERVICES)]
+    routed = [part.write_line(ln) for ln in lines]
+    broker.pump()
+    for ln, p in zip(lines, routed):
+        assert p == service_partition(tx_partition_key(ln, "service"), 4)
+        got = [h for (l2, h) in seen[p] if l2 == ln]
+        assert got and got[0]["partition"] == p  # stamped header
+        assert "msg_id" in got[0] and "ingest_ts" in got[0]
+    # non-tx lines route deterministically to partition 0
+    assert part.write_line("jmx|host|blob") == 0
+
+
+# -- partition header round-trip on all transports ----------------------------
+
+
+def _roundtrip_partition_header(make_channel, pump):
+    qm_p = QueueManager(lambda d: make_channel("p"), 3600)
+    q = qm_p.get_queue("transactions.p2", "p")
+    q.partition = 2
+    got = []
+    qm_c = QueueManager(lambda d: make_channel("c"), 3600)
+    qm_c.get_queue(
+        "transactions.p2", "c",
+        lambda line, headers=None, token=None: got.append(headers),
+        manual_ack=True,
+    ).start_consume()
+    q.write_line(_tx(0, 5))
+    pump()
+    assert len(got) == 1
+    assert got[0]["partition"] == 2
+    assert "msg_id" in got[0]
+
+
+def test_partition_header_roundtrip_memory():
+    broker = MemoryBroker()
+    _roundtrip_partition_header(lambda d: MemoryChannel(broker), broker.pump)
+
+
+def test_partition_header_roundtrip_spool(tmp_path):
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    chans = []
+
+    def make(d):
+        ch = SpoolChannel(str(tmp_path / "spool"))
+        chans.append(ch)
+        return ch
+
+    _roundtrip_partition_header(make, lambda: [c.deliver() for c in chans])
+
+
+def test_partition_header_roundtrip_amqp():
+    import time as _time
+
+    from fake_pika import FakeBroker, make_fake_pika
+
+    from apmbackend_tpu.transport.amqp import AmqpChannel
+
+    broker = FakeBroker()
+    mod = make_fake_pika(broker)
+    chans = []
+
+    def make(d):
+        ch = AmqpChannel("amqp://fake", direction=d, pika_module=mod,
+                         poll_interval_s=0.005)
+        chans.append(ch)
+        return ch
+
+    try:
+        _roundtrip_partition_header(make, lambda: _time.sleep(0.3))
+    finally:
+        for c in chans:
+            c.close()
+
+
+# -- driver row handoff primitives --------------------------------------------
+
+
+def _driver(capacity=64):
+    from apmbackend_tpu.pipeline import PipelineDriver
+
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = capacity
+    cfg["tpuEngine"]["samplesPerBucket"] = 32
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
+    ]
+    return PipelineDriver(cfg, capacity=capacity)
+
+
+def test_export_remove_import_roundtrip():
+    """Rows exported from one engine and imported into another must carry
+    bit-identical per-row state through the resume install path."""
+    a = _driver()
+    lines = [_tx(t, i) for t in range(4) for i in range(30)]
+    a.feed_csv_batch(lines)
+    a.flush()
+    pred = lambda srv, svc: service_partition(svc, 2) == 1  # noqa: E731
+    keys_a = a.registry.rows()
+    moved_keys = [k for k in keys_a if pred(*k)]
+    data = a.export_service_rows(pred)
+    assert data["registry"].shape[0] == len(moved_keys)
+    before = {
+        k: np.asarray(a.state.stats.counts)[i].copy()
+        for i, k in enumerate(keys_a)
+    }
+    removed = a.remove_service_rows(pred)
+    assert removed == len(moved_keys)
+    assert all(not pred(*k) for k in a.registry.rows())
+
+    b = _driver()
+    rest = [ln for ln in lines if not pred(ln.split("|")[1], ln.split("|")[2])]
+    assert b.import_service_rows(data) == len(moved_keys)
+    del rest
+    keys_b = b.registry.rows()
+    counts_b = np.asarray(b.state.stats.counts)
+    for i, k in enumerate(keys_b):
+        assert k in before
+        assert np.array_equal(counts_b[i], before[k]), k
+    # re-import of the same keys is a routing violation
+    with pytest.raises(ValueError):
+        b.import_service_rows(data)
+
+
+def test_import_rotates_ring_to_cursor(tmp_path):
+    """An importer whose shared ring cursor differs from the exporter's
+    must land each incoming column on the slot of the SAME label."""
+    a, b = _driver(), _driver()
+    # a sees labels 0..3 for svcA; b independently ticks 0..3 on svcB
+    a.feed_csv_batch([_tx(t, 0, svc="svcA") for t in range(4)])
+    a.flush()
+    b.feed_csv_batch([_tx(t, 0, svc="svcB") for t in range(4)])
+    b.flush()
+    z_a = np.asarray(a.state.zscores[0].values)[0].copy()  # svcA's row
+    data = a.export_service_rows(lambda srv, svc: svc == "svcA")
+    b.import_service_rows(data)
+    row = b.registry.rows().index(("jvm0", "svcA"))
+    z_b = np.asarray(b.state.zscores[0].values)[row]
+    assert np.array_equal(z_a, z_b, equal_nan=True)
+
+
+def test_handoff_file_roundtrip(tmp_path):
+    a = _driver()
+    a.feed_csv_batch([_tx(t, i) for t in range(2) for i in range(20)])
+    a.flush()
+    data = a.export_service_rows(lambda srv, svc: True)
+    meta = {"partition": 1, "queue": "transactions.p1",
+            "base": "transactions", "window": ["m1", "m2"], "epoch": 3}
+    path = str(tmp_path / "h.npz")
+    write_handoff(path, data, meta)
+    data2, meta2 = read_handoff(path)
+    assert meta2 == meta
+    assert set(data2) == set(data)
+    for k in data:
+        a1, a2 = np.asarray(data[k]), np.asarray(data2[k])
+        eq = (np.array_equal(a1, a2, equal_nan=True)
+              if a1.dtype.kind == "f" else np.array_equal(a1, a2))
+        assert eq, k
+
+
+# -- in-process fleet: rebalance golden equivalence ---------------------------
+
+
+def _mk_fleet_worker(broker, k, shards, tmp_path=None, **eng_overrides):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+
+    cfg = default_config()
+    cfg["tpuEngine"].update(dict(
+        serviceCapacity=64, samplesPerBucket=32, deliveryMode="atLeastOnce",
+        metricsPort=None, resumeFileFullPath=None,
+        deliveryFeedMaxDelaySeconds=0.05,
+    ))
+    cfg["tpuEngine"].update(eng_overrides)
+    cfg["fleet"] = {"shards": shards, "partitionKey": "service",
+                    "shardId": k, "epochStallSeconds": 300.0}
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
+    ]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = 3600
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False,
+                       console_log=False)
+    rt.qm = QueueManager(lambda d: MemoryChannel(broker), 3600,
+                         logger=rt.logger)
+    return WorkerApp(rt), rt
+
+
+def _fleet_run(tmp_path, rebalance):
+    broker = MemoryBroker()
+    workers, rts = [], []
+    for k in range(2):
+        w, rt = _mk_fleet_worker(broker, k, 2)
+        workers.append(w)
+        rts.append(rt)
+    try:
+        qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        part = FleetPartitioner(qm_p, "transactions", 2)
+        for t in range(4):
+            for i in range(40):
+                part.write_line(_tx(t, i))
+        broker.pump()
+        for w in workers:
+            w.drain_delivery_pending()
+            w.save_state()
+        if rebalance:
+            hf = str(tmp_path / "handoff.npz")
+            meta = workers[1].release_partition(1, hf)
+            assert meta["rows"] > 0 and len(meta["window"]) > 0
+            res = workers[0].adopt_partition(1, hf)
+            assert res["rows"] == meta["rows"]
+            assert workers[0].owned_partitions() == [0, 1]
+            assert workers[1].owned_partitions() == []
+            # re-adopt is a no-op (controller retry safety)
+            again = workers[0].adopt_partition(1, hf)
+            assert again.get("already_owned")
+        # live traffic continues: partition-1 lines reach the new owner
+        for t in range(4, 8):
+            for i in range(40):
+                part.write_line(_tx(t, i))
+        broker.pump()
+        for w in workers:
+            w.drain_delivery_pending()
+            w.save_state()
+        assert broker.unacked_count() == 0
+        merged = {}
+        for w in workers:
+            counts = np.asarray(w.driver.state.stats.counts)
+            sums = np.asarray(w.driver.state.stats.sums)
+            for row, key in enumerate(w.driver.registry.rows()):
+                assert key not in merged, f"{key} lives on two shards"
+                merged[key] = (counts[row].copy(), sums[row].copy())
+        deduped = sum(w._deduped_total for w in workers)
+        return merged, deduped
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_inprocess_rebalance_bit_identical_to_golden(tmp_path):
+    """The quiesced handoff under continuing traffic: merged fleet stats
+    equal a crash-free no-rebalance golden run key for key — zero loss,
+    zero double-effect, owner-locality (no key on two shards)."""
+    golden, _ = _fleet_run(tmp_path / "golden", rebalance=False)
+    moved, _ = _fleet_run(tmp_path / "moved", rebalance=True)
+    assert set(golden) == set(moved)
+    for key in golden:
+        gc, gs = golden[key]
+        mc, ms = moved[key]
+        assert np.array_equal(gc, mc), key
+        assert np.array_equal(gs, ms, equal_nan=True), key
+
+
+def test_partition_mismatch_rejected(tmp_path):
+    """A delivery whose stamped partition contradicts its queue is counted
+    and rejected, never absorbed (the shardmodel mismatch mutant's
+    double-effect/stranding cannot happen)."""
+    broker = MemoryBroker()
+    w, rt = _mk_fleet_worker(broker, 0, 2)
+    try:
+        # craft a partition-1-stamped message onto partition 0's queue
+        broker.send(
+            "transactions.p0", _tx(0, 0, svc="svc005").encode(),
+            {"msg_id": "bad-1", "partition": 1},
+        )
+        broker.pump()
+        w.drain_delivery_pending()
+        w.save_state()
+        assert w._partition_mismatch_total == 1
+        assert w.driver.registry.count == 0  # never absorbed
+        assert broker.unacked_count() == 0  # but acked: cannot loop
+        # correctly-stamped delivery on the same queue absorbs normally
+        broker.send(
+            "transactions.p0", _tx(0, 1, svc="svc005").encode(),
+            {"msg_id": "good-1", "partition": 0},
+        )
+        broker.pump()
+        w.drain_delivery_pending()
+        assert w.driver.registry.count == 1
+    finally:
+        rt.stop_timers()
+
+
+def test_ownership_persists_across_restart(tmp_path):
+    """A shard that adopted (or released) partitions must re-own exactly
+    the committed set after a restart — ownership rides the delivery
+    tree in the checkpoint."""
+    broker = MemoryBroker()
+    res = str(tmp_path / "s0.resume.npz")
+    w, rt = _mk_fleet_worker(broker, 0, 2, resumeFileFullPath=res)
+    w2 = rt2 = None
+    try:
+        qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        part = FleetPartitioner(qm_p, "transactions", 2)
+        for i in range(20):
+            part.write_line(_tx(0, i))
+        broker.pump()
+        w.drain_delivery_pending()
+        w.save_state()
+        # release our ONLY partition, then "crash" (no shutdown)
+        hf = str(tmp_path / "handoff.npz")
+        w.release_partition(0, hf)
+        assert w.owned_partitions() == []
+        rt.stop_timers()
+        broker2 = MemoryBroker()
+        w2, rt2 = _mk_fleet_worker(broker2, 0, 2, resumeFileFullPath=res)
+        assert w2.owned_partitions() == []  # the release COMMIT held
+        assert w2.driver.registry.count == 0
+    finally:
+        rt.stop_timers()
+        if rt2 is not None:
+            rt2.stop_timers()
+
+
+def test_shard_path_templating(tmp_path):
+    broker = MemoryBroker()
+    chain_t = str(tmp_path / "chain-shard{shard}")
+    w, rt = _mk_fleet_worker(
+        broker, 1, 2, checkpointMode="delta", checkpointChainDir=chain_t,
+    )
+    try:
+        assert w._ckpt_chain.directory == str(tmp_path / "chain-shard1")
+        import os
+
+        assert os.path.isdir(str(tmp_path / "chain-shard1"))
+    finally:
+        rt.stop_timers()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_shard_labels_and_window_occupancy(tmp_path):
+    broker = MemoryBroker()
+    w, rt = _mk_fleet_worker(broker, 1, 2)
+    try:
+        qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        part = FleetPartitioner(qm_p, "transactions", 2)
+        for i in range(40):
+            part.write_line(_tx(0, i))
+        broker.pump()
+        w.drain_delivery_pending()
+        samples = list(w._collect_metrics())
+        by_name = {}
+        for s in samples:
+            by_name.setdefault(s.name, []).append((s.labels, s.value))
+        for name in ("apm_delivery_epoch", "apm_delivery_unacked",
+                     "apm_redelivered_deduped_total",
+                     "apm_delivery_epoch_age_seconds",
+                     "apm_fleet_partition_mismatch_total",
+                     "apm_shard_rebalances_total",
+                     "apm_shard_owned_partitions"):
+            labels, _v = by_name[name][0]
+            assert labels.get("apm_shard_id") == "1", name
+        win = by_name["apm_delivery_dedup_window"]
+        assert win[0][0]["queue"] == "transactions.p1"
+        assert win[0][1] > 0  # occupancy reflects absorbed ids
+        assert by_name["apm_shard_owned_partitions"][0][1] == 1.0
+    finally:
+        rt.stop_timers()
+
+
+def test_epoch_stall_degrades_healthz(tmp_path):
+    import time as _time
+
+    broker = MemoryBroker()
+    w, rt = _mk_fleet_worker(broker, 0, 2)
+    try:
+        h = w._health()
+        assert "epoch_stalled" not in h["delivery"]
+        # wedge simulation: unacked deliveries + an old last-commit stamp
+        with w._driver_lock:
+            w._epoch_tokens.append(("transactions.p0", 1))
+            w._epoch_stall_s = 0.01
+            w._last_epoch_commit = _time.monotonic() - 1.0
+        h = w._health()
+        assert h["ok"] is False
+        assert h["delivery"]["epoch_stalled"] is True
+        assert h["delivery"]["shard"] == 0
+    finally:
+        rt.stop_timers()
+
+
+def test_expand_module_settings_shards():
+    from apmbackend_tpu.manager.manager import expand_module_settings
+
+    plain = {"module": "apmbackend_tpu.ingest.parser_main"}
+    sharded = {"module": "apmbackend_tpu.runtime.worker", "shards": 3,
+               "metricsPort": 9300}
+    out = expand_module_settings([plain, sharded])
+    assert out[0] == (plain, {}, True)
+    names = [ms["name"] for ms, _env, _sweep in out[1:]]
+    assert names == ["worker0", "worker1", "worker2"]
+    envs = [env for _ms, env, _sweep in out[1:]]
+    assert [e["APM_SHARD_ID"] for e in envs] == ["0", "1", "2"]
+    assert [e["APM_METRICS_PORT"] for e in envs] == ["9300", "9301", "9302"]
+    ports = [ms["metricsPort"] for ms, _env, _sweep in out[1:]]
+    assert ports == [9300, 9301, 9302]
+    sweeps = [sweep for _ms, _env, sweep in out[1:]]
+    assert sweeps == [True, False, False]  # only shard 0 sweeps stale pids
+
+
+def test_manager_healthz_degrades_on_degraded_shard(tmp_path):
+    """A shard answering /healthz degraded (e.g. epoch stall) must turn
+    the manager's own /healthz into a 503 — the /fleet plane's contract."""
+    from apmbackend_tpu.manager.manager import ManagerApp
+    from apmbackend_tpu.obs.exporter import TelemetryServer
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    child = TelemetryServer(port=0, module="worker0")
+    child.add_health("engine", lambda: {"ok": False, "epoch_stalled": True})
+    child.start()
+    cfg = default_config()
+    cfg["logDir"] = str(tmp_path)
+    cfg["applicationManager"]["moduleSettings"] = [
+        {"module": "apmbackend_tpu.runtime.worker", "name": "worker0",
+         "metricsPort": child.port},
+    ]
+    cfg["applicationManager"]["metricsPort"] = 0
+    runtime = ModuleRuntime("applicationManager", config=cfg,
+                            install_signals=False, console_log=False)
+    app = ManagerApp(runtime, spawn_children=False)
+    try:
+        import os
+        import types
+
+        # make the child look alive so the probe path runs (no real fork)
+        app.modules[0].proc = types.SimpleNamespace(
+            pid=os.getpid(), poll=lambda: None, returncode=None
+        )
+        health = app._fleet_health()
+        app.modules[0].proc = None
+        assert health["ok"] is False
+        assert health["children"]["worker0"]["healthz"] == "degraded"
+    finally:
+        app.alerts.stop()
+        app.shutdown()
+        runtime.stop_timers()
+        child.stop()
+
+
+# -- fleet trace conformance --------------------------------------------------
+
+
+def _ev(ev, shard, **kw):
+    kw.update(ev=ev, shard=shard)
+    return kw
+
+
+def test_fleet_conformance_accepts_clean_handoff():
+    from apmbackend_tpu.analysis.protocol import check_fleet_trace
+
+    events = [
+        _ev("deliver", 1, queue="transactions.p1", msg="m1", dedup=False, tx=True),
+        _ev("checkpoint", 1, ok=True, epoch=1),
+        _ev("handoff_export", 1, partition=1, ids=["m1"], unacked=0),
+        _ev("checkpoint", 1, ok=True, epoch=2, handoff=True),
+        _ev("handoff_import", 0, partition=1, ids=["m1"]),
+        _ev("checkpoint", 0, ok=True, epoch=1, handoff=True),
+        _ev("deliver", 0, queue="transactions.p1", msg="m2", dedup=False, tx=True),
+        _ev("deliver", 0, queue="transactions.p1", msg="m1", dedup=True, tx=True),
+        _ev("checkpoint", 0, ok=True, epoch=2),
+    ]
+    assert check_fleet_trace(events) == []
+
+
+def test_fleet_conformance_rejects_violations():
+    from apmbackend_tpu.analysis.protocol import check_fleet_trace
+
+    # export while unacked
+    v = check_fleet_trace([
+        _ev("handoff_export", 1, partition=1, ids=[], unacked=3),
+    ])
+    assert any("unacked" in x for x in v)
+    # import without export
+    v = check_fleet_trace([
+        _ev("handoff_import", 0, partition=1, ids=["m1"]),
+    ])
+    assert any("without a pending export" in x for x in v)
+    # window dropped in transit
+    v = check_fleet_trace([
+        _ev("handoff_export", 1, partition=1, ids=["m1"], unacked=0),
+        _ev("handoff_import", 0, partition=1, ids=[]),
+    ])
+    assert any("window" in x for x in v)
+    # fleet double effect: two shards commit the same message
+    v = check_fleet_trace([
+        _ev("deliver", 0, queue="transactions.p0", msg="m1", dedup=False, tx=True),
+        _ev("checkpoint", 0, ok=True, epoch=1),
+        _ev("deliver", 1, queue="transactions.p1", msg="m1", dedup=False, tx=True),
+        _ev("checkpoint", 1, ok=True, epoch=1),
+    ])
+    assert any("exactly-once" in x for x in v)
+    # consuming a queue owned by another shard
+    v = check_fleet_trace([
+        _ev("deliver", 0, queue="transactions.p1", msg="m1", dedup=False, tx=True),
+    ])
+    assert any("owned by s1" in x for x in v)
+    # delivery inside the handoff window (released, not yet adopted)
+    v = check_fleet_trace([
+        _ev("handoff_export", 1, partition=1, ids=[], unacked=0),
+        _ev("deliver", 1, queue="transactions.p1", msg="m1", dedup=False, tx=True),
+    ])
+    assert any("handoff window" in x for x in v)
+    # a crash discards provisional absorbs: NOT a double effect
+    v = check_fleet_trace([
+        _ev("deliver", 0, queue="transactions.p0", msg="m1", dedup=False, tx=True),
+        _ev("crash", 0),
+        _ev("recover", 0, epoch=0),
+        _ev("deliver", 0, queue="transactions.p0", msg="m1", dedup=False, tx=True),
+        _ev("checkpoint", 0, ok=True, epoch=1),
+    ])
+    assert v == []
+
+
+def test_shard_conformance_handoff_mirror():
+    """The per-shard mirror follows window ids through export/import and
+    treats a handoff commit's unchanged chain epoch as legal."""
+    from apmbackend_tpu.analysis.protocol import check_protocol_trace
+
+    exporter = [
+        {"ev": "recover", "epoch": 0, "chain_epoch": 0},
+        {"ev": "deliver", "msg": "m1", "dedup": False, "tx": True,
+         "queue": "transactions.p1"},
+        {"ev": "feed", "n": 1},
+        {"ev": "checkpoint", "ok": True, "epoch": 1, "chain_epoch": 1},
+        {"ev": "ack", "n": 1, "epoch": 1},
+        {"ev": "handoff_export", "partition": 1, "ids": ["m1"], "unacked": 0},
+        {"ev": "checkpoint", "ok": True, "epoch": 2, "chain_epoch": 1,
+         "handoff": True},
+    ]
+    assert check_protocol_trace(exporter) == []
+    importer = [
+        {"ev": "recover", "epoch": 0, "chain_epoch": 0},
+        {"ev": "handoff_import", "partition": 1, "ids": ["m1"]},
+        {"ev": "checkpoint", "ok": True, "epoch": 1, "chain_epoch": 0,
+         "handoff": True},
+        # redelivery of the moved id must dedup against the imported window
+        {"ev": "deliver", "msg": "m1", "dedup": True,
+         "queue": "transactions.p1"},
+        # a mismatch delivery absorbs nothing
+        {"ev": "deliver", "msg": "m9", "dedup": False, "tx": False,
+         "mismatch": True, "queue": "transactions.p1"},
+        {"ev": "checkpoint", "ok": True, "epoch": 2, "chain_epoch": 1},
+    ]
+    assert check_protocol_trace(importer) == []
+    # an export with a non-empty ledger is a quiesce violation
+    broken = exporter[:5] + [
+        {"ev": "handoff_export", "partition": 1, "ids": ["m1"], "unacked": 2},
+    ]
+    assert any("quiesce" in v for v in check_protocol_trace(broken))

@@ -1,0 +1,179 @@
+"""Fleet chaos tier: kill −9 one shard of a LIVE fleet, and rebalance a
+partition under live traffic — multi-process, real worker shards over the
+durable spool (``run_tests.sh --fleet``; everything here is ``slow``).
+
+Scenarios (ISSUE 9 chaos satellite):
+
+- **kill −9 one shard mid-stream**: the victim's partition replays from
+  its own chain + spool cursor; sibling shards never notice. The fleet
+  result is BIT-IDENTICAL to a crash-free golden fleet run, shard for
+  shard, array for array — the single-worker crash-equivalence claim
+  (PR 3/PR 7) lifted to fleet scope.
+- **live-traffic quiesced rebalance**: a partition moves owners while the
+  producer keeps streaming into its queue; zero loss / zero double-effect
+  by exact accounting, and the merged protocol event logs replay clean
+  through BOTH the per-shard conformance mirror and the fleet-level
+  checker (owner-locality, quiesce, window transit).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.analysis.protocol.conformance import (
+    check_fleet_trace,
+    check_protocol_trace,
+)
+from apmbackend_tpu.parallel.fleet import FleetHarness, service_partition
+
+from test_chaos_harness import assert_snapshots_equal
+
+pytestmark = pytest.mark.slow
+
+BASE = 170_000_000
+
+
+def _send_labels(h, t0, t1, per_label=40, services=12, seed=0):
+    rng = np.random.RandomState(seed + t0)
+    for t in range(t0, t1):
+        for seq in range(per_label):
+            i = int(rng.randint(0, services))
+            e = int(rng.randint(50, 900))
+            h.send_line(
+                f"tx|jvm{i % 3}|svc{i % services:03d}|c{t}-{seq}|1|"
+                f"{(BASE + t) * 10000 - e}|{(BASE + t) * 10000 + seq}|{e}|Y"
+            )
+
+
+def _fleet(workdir, **kw):
+    kw.setdefault("shards", 2)
+    kw.setdefault("capacity", 64)
+    kw.setdefault("save_every_s", 0.3)
+    kw.setdefault("lags", "6")
+    kw.setdefault("checkpoint_mode", "delta")
+    kw.setdefault("event_log", True)
+    return FleetHarness(str(workdir), **kw)
+
+
+def test_kill9_one_shard_fleet_bit_identical_to_golden(tmp_path):
+    """SIGKILL one shard of a live 2-shard fleet twice; only its partition
+    replays. Every shard's final engine snapshot must equal the crash-free
+    golden fleet's, bit for bit."""
+
+    def drive(workdir, kills):
+        h = _fleet(workdir)
+        try:
+            h.start_all()
+            _send_labels(h, 0, 3)
+            # kill points chosen by the victim's committed cursor so both
+            # runs stream identical spools (determinism of the comparison)
+            if kills:
+                h.wait_acked(1, 10, timeout_s=120)
+                h.kill9(1)
+                h.start(1)
+            _send_labels(h, 3, 6)
+            if kills:
+                h.wait_acked(1, 40, timeout_s=120)
+                h.kill9(1)
+                h.start(1)
+            _send_labels(h, 6, 9)
+            return h, h.finish(timeout_s=300)
+        except BaseException:
+            h.close()
+            raise
+
+    hg, golden = drive(tmp_path / "golden", kills=False)
+    hc, chaos = drive(tmp_path / "chaos", kills=True)
+    try:
+        # identical spool streams per partition: same producer sequence
+        assert hg.sent_per_queue == hc.sent_per_queue
+        for k in (0, 1):
+            assert_snapshots_equal(
+                hg.procs[k].resume_path, hc.procs[k].resume_path
+            )
+        # the sibling shard never restarted and never deduped anything
+        assert chaos[0]["deduped_total"] == golden[0]["deduped_total"] == 0
+        # conformance: the victim's log replays clean across its crashes
+        for k in (0, 1):
+            assert check_protocol_trace(hc.shard_events(k)) == []
+        assert check_fleet_trace(hc.merged_events()) == []
+    finally:
+        hg.close()
+        hc.close()
+
+
+def test_live_traffic_rebalance_zero_loss_and_conformant(tmp_path):
+    """Move a partition between shards while the producer keeps writing
+    into its queue: nothing lost, nothing double-absorbed, ownership
+    lands on the adopter, and the protocol event logs replay clean
+    through the shardmodel-derived checkers."""
+    h = _fleet(tmp_path, shards=2)
+    try:
+        h.start_all()
+        _send_labels(h, 0, 3)
+        h.wait_acked(1, 10, timeout_s=120)
+        # live traffic DURING the handoff: these lines land on p1's queue
+        # while ownership is moving — nobody may consume them until the
+        # adopter owns the partition
+        _send_labels(h, 3, 4)
+        res = h.rebalance(1, 1, 0)
+        assert res["released"]["rows"] > 0
+        assert len(res["released"]["window"]) > 0
+        _send_labels(h, 4, 7)
+        stats = h.finish(timeout_s=300)
+
+        # ownership moved; the adopter serves both partitions
+        assert stats[0]["owned_partitions"] == [0, 1]
+        assert stats[1]["owned_partitions"] == []
+        assert stats[1]["services"] == 0
+        # zero loss: every produced record acked on its partition queue
+        for p in (0, 1):
+            q = f"transactions.p{p}"
+            assert h.acked(p) == h.sent_per_queue[q], q
+        # zero double-effect: every absorb unique fleet-wide
+        events = h.merged_events()
+        absorbed = [
+            e["msg"] for e in events
+            if e.get("ev") == "deliver" and not e.get("dedup")
+            and not e.get("mismatch") and e.get("tx")
+        ]
+        assert len(absorbed) == len(set(absorbed))
+        assert len(set(absorbed)) == sum(h.sent_per_queue.values())
+        # and the logs ARE model paths
+        for k in (0, 1):
+            assert check_protocol_trace(h.shard_events(k)) == []
+        assert check_fleet_trace(events) == []
+        # the moved services' rows live exactly once, on the adopter
+        with np.load(h.procs[0].resume_path, allow_pickle=True) as z:
+            keys0 = [tuple(k.split("\x00", 1)) for k in z["registry"].tolist()]
+        moved = [k for k in keys0 if service_partition(k[1], 2) == 1]
+        assert moved, "no partition-1 services landed on the adopter"
+    finally:
+        h.close()
+
+
+def test_rebalance_then_kill9_adopter_recovers_ownership(tmp_path):
+    """Crash the adopter AFTER the handoff: on restart it must re-own
+    BOTH partitions (ownership rides the import commit) and drain the
+    backlog with zero loss."""
+    h = _fleet(tmp_path, shards=2)
+    try:
+        h.start_all()
+        _send_labels(h, 0, 3)
+        h.wait_acked(0, 10, timeout_s=120)
+        h.rebalance(1, 1, 0)
+        _send_labels(h, 3, 5)
+        time.sleep(0.4)
+        h.kill9(0)
+        h.start(0)
+        _send_labels(h, 5, 7)
+        stats = h.finish(timeout_s=300)
+        assert stats[0]["owned_partitions"] == [0, 1]
+        for p in (0, 1):
+            assert h.acked(p) == h.sent_per_queue[f"transactions.p{p}"]
+        for k in (0, 1):
+            assert check_protocol_trace(h.shard_events(k)) == []
+        assert check_fleet_trace(h.merged_events()) == []
+    finally:
+        h.close()
