@@ -267,6 +267,40 @@ _DEFAULT_CONFIG: dict = {
         "flightDir": None,
         "flightJournalSeconds": 5.0,
         "flightMaxBundles": 16,
+        # Durable telemetry spine (obs/store + obs/recorder, DESIGN.md §8.4).
+        # recorderDir enables the MANAGER-side fleet recorder: every child's
+        # /metrics, /trace, and /decisions scraped each recorderIntervalSeconds
+        # and persisted shard-labeled into an append-only segmented store, so
+        # a kill−9'd shard's last telemetry stays queryable via /query.
+        "recorderDir": None,
+        "recorderIntervalSeconds": 2.0,
+        "recorderRetentionSeconds": 3600.0,
+        "recorderDownsampleAfterSeconds": 900.0,
+        "recorderDownsampleStepSeconds": 60.0,
+        # Per-module store behind each exporter's /query: registry snapshots
+        # every selfSampleSeconds (0 disables /query + the local store);
+        # storeDir=None keeps it in-memory (volatile, still queryable).
+        "storeDir": None,
+        "selfSampleSeconds": 2.0,
+        "storeRetentionSeconds": 900.0,
+    },
+    # SLO burn-rate engine (obs/slo, DESIGN.md §8.4): Google-SRE multi-window
+    # burn rates evaluated over the telemetry store. A "fast" burn (both
+    # windows >= fastBurnThreshold) pages through the alert/decision path and
+    # degrades /healthz to 503; "slow" burns ticket at slowBurnThreshold.
+    # objectives=None uses the built-in four (detection latency p95, alert
+    # latency, per-queue wait/lag, epoch age — obs.slo.DEFAULT_OBJECTIVES);
+    # override with a list of {name, kind: latency|gauge, series,
+    # thresholdSeconds|threshold, target, per}.
+    "slo": {
+        "enabled": True,
+        "evaluationIntervalSeconds": 10.0,
+        "shortWindowSeconds": 300.0,
+        "longWindowSeconds": 3600.0,
+        "fastBurnThreshold": 14.4,
+        "slowBurnThreshold": 6.0,
+        "alertCooldownSeconds": 300.0,
+        "objectives": None,
     },
     "statistics": [
         {"type": "average"},

@@ -433,6 +433,71 @@ class ManagerApp:
             runtime.telemetry.add_route("/trace", self._trace_route)
             runtime.telemetry.add_health("fleet", self._fleet_health)
 
+        # -- durable telemetry spine (obs/store + recorder + SLO, §8.4) ------
+        # observability.recorderDir turns on the fleet recorder: every
+        # child's /metrics, /trace, /decisions persisted shard-labeled each
+        # recorderIntervalSeconds, so a kill−9'd child's telemetry survives
+        # into triage; the SLO engine burns error budgets over that store,
+        # pages through ManagerAlerts, and degrades /healthz on fast burn.
+        self.recorder = None
+        self.recorder_store = None
+        self.slo = None
+        obs_cfg = config.get("observability", {})
+        recorder_dir = obs_cfg.get("recorderDir")
+        if recorder_dir:
+            from ..obs.recorder import FleetRecorder
+            from ..obs.slo import SLOEngine
+            from ..obs.store import TimeSeriesStore, make_query_route
+
+            self.recorder_store = TimeSeriesStore(
+                str(recorder_dir),
+                retention_s=float(obs_cfg.get("recorderRetentionSeconds", 3600.0)),
+                downsample_after_s=obs_cfg.get("recorderDownsampleAfterSeconds", 900.0),
+                downsample_step_s=float(obs_cfg.get("recorderDownsampleStepSeconds", 60.0)),
+                registry=reg,
+                logger=logger,
+            )
+            self.recorder = FleetRecorder(
+                self.recorder_store,
+                self._child_metrics_targets,
+                interval_s=float(obs_cfg.get("recorderIntervalSeconds", 2.0)),
+                self_registry=reg,
+                registry=reg,
+                logger=logger,
+            )
+            runtime.every(
+                max(0.05, self.recorder.interval_s),
+                self.recorder.scrape_once,
+                name="recorder",
+            )
+            slo_cfg = config.get("slo", {})
+            if bool(slo_cfg.get("enabled", True)):
+                self.slo = SLOEngine.from_config(
+                    self.recorder_store,
+                    config,
+                    on_alert=lambda msg, _rec: self.alerts.add(msg),
+                    registry=reg,
+                    logger=logger,
+                )
+                runtime.every(
+                    max(0.05, float(slo_cfg.get("evaluationIntervalSeconds", 10.0))),
+                    self.slo.evaluate,
+                    name="slo-eval",
+                )
+            if getattr(runtime, "telemetry", None) is not None:
+                # overrides the per-module /query: range queries here answer
+                # over EVERY child's persisted telemetry, dead shards included
+                runtime.telemetry.add_route(
+                    "/query", make_query_route(lambda: self.recorder_store))
+                if self.slo is not None:
+                    runtime.telemetry.add_health("slo", self.slo.health)
+            if getattr(runtime, "flight", None) is not None:
+                runtime.flight.add_source("recorder", self.recorder.status)
+                runtime.flight.add_source(
+                    "recorder_tail", lambda: self.recorder_store.tail(32))
+                if self.slo is not None:
+                    runtime.flight.add_source("slo", lambda: self.slo.status())
+
         if spawn_children:
             self.annotate("Restarting all modules")
             for mod in self.modules:
@@ -843,6 +908,11 @@ class ManagerApp:
     # -- lifecycle ------------------------------------------------------------
     def shutdown(self, *, stop_children: Optional[bool] = None) -> None:
         self.alerts.stop()
+        if self.recorder_store is not None:
+            try:  # runtime timers are already stopping: seal the store
+                self.recorder_store.close()
+            except Exception:
+                pass
         if stop_children is None:
             # Reference parity: controller.sh stop kills only the manager and
             # the next start reaps stale module PIDs (apm_manager.js:624).

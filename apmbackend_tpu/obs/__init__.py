@@ -25,6 +25,16 @@ optimization (PAPERS.md: arxiv 1712.08285 per-stage timing, arxiv
   healthz degradation / SIGTERM / watchdog restart, plus a journal +
   sentinel shadow that survives kill−9 and is promoted to a crash bundle
   on the next boot.
+- :mod:`.store` — the durable telemetry spine (DESIGN.md §8.4): an
+  append-only segmented on-disk time-series store with CRC'd records,
+  retention + downsample-on-compact, and ``rate()``/
+  ``histogram_quantile`` range queries behind ``/query``.
+- :mod:`.recorder` — the manager-side fleet recorder persisting every
+  child's ``/metrics``, ``/trace``, and ``/decisions`` shard-labeled into
+  the store, so a kill−9'd shard's telemetry survives into triage.
+- :mod:`.slo` — Google-SRE multi-window burn-rate evaluation over the
+  store (detection latency, per-queue lag/wait, epoch age), paging
+  through the decision ring and degrading ``/healthz`` on fast burn.
 
 Everything here is stdlib-only and import-light: no jax at import time
 (the /profile route imports it lazily), no hard dependency from any hot
@@ -43,22 +53,30 @@ from .registry import (
     relabel_metrics,
     set_registry,
 )
+from .recorder import FleetRecorder
+from .slo import SLOEngine
+from .store import TimeSeriesStore, eval_range, make_query_route
 from .trace import SpanRing, Tracer, get_tracer
 from .tracing import TickTracer
 
 __all__ = [
     "DecisionRing",
+    "FleetRecorder",
     "FlightRecorder",
     "MetricsRegistry",
+    "SLOEngine",
     "Sample",
     "SpanRing",
     "TelemetryServer",
     "TickTracer",
+    "TimeSeriesStore",
     "Tracer",
+    "eval_range",
     "get_decisions",
     "get_registry",
     "get_tracer",
     "histogram_quantile",
+    "make_query_route",
     "parse_prom_text",
     "relabel_metrics",
     "set_registry",

@@ -1,0 +1,234 @@
+"""Manager-side fleet recorder: scrape every child's ``/metrics``,
+``/trace``, and ``/decisions`` on a cadence and persist them shard-labeled
+into a :class:`~.store.TimeSeriesStore` (DESIGN.md §8.4).
+
+This closes the PR-5 durable-sink follow-up: a kill−9'd shard's last
+scraped series, spans, and alert decisions survive in the store and stay
+queryable through ``/query`` after the process (and its rings) are gone.
+
+Failure discipline: a scrape error is counted and skipped — the loop
+never raises, never blocks past the per-target timeout, and a full disk
+degrades inside the store (drop-and-count), so the recorder can never
+take down the manager's monitor cadence or a child's hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .registry import MetricsRegistry, Sample, parse_prom_text
+from .store import TimeSeriesStore
+
+Targets = Callable[[], List[Tuple[str, str]]]
+
+
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+class FleetRecorder:
+    """Scrapes ``targets()`` -> ``[(module_name, base_url)]`` into a store.
+
+    Drive it either with :meth:`start`/:meth:`stop` (own daemon thread —
+    tests, benches, standalone) or by calling :meth:`scrape_once` from an
+    existing timer (the manager wires ``runtime.every``).
+    """
+
+    def __init__(
+        self,
+        store: TimeSeriesStore,
+        targets: Targets,
+        *,
+        interval_s: float = 2.0,
+        timeout_s: float = 2.0,
+        trace_n: int = 256,
+        decision_n: int = 256,
+        self_registry: Optional[MetricsRegistry] = None,
+        self_module: str = "manager",
+        registry: Optional[MetricsRegistry] = None,
+        logger=None,
+    ):
+        self.store = store
+        self.targets = targets
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.trace_n = int(trace_n)
+        self.decision_n = int(decision_n)
+        self.self_registry = self_registry
+        self.self_module = self_module
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._seen: Dict[str, Tuple[set, deque]] = {}  # guarded-by: _lock
+        self._counts = {  # guarded-by: _lock
+            "scrapes_total": 0,
+            "scrape_errors_total": 0,
+            "rows_total": 0,
+            "span_rows_total": 0,
+            "decision_rows_total": 0,
+        }
+        self._errors_by_module: Dict[str, int] = {}  # guarded-by: _lock
+        self._last = {"ts": 0.0, "targets": 0, "ok": 0}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if registry is not None:
+            registry.add_collector(self._collect)
+
+    # -- metrics about the recorder itself ---------------------------------
+
+    def _collect(self):
+        with self._lock:
+            counts = dict(self._counts)
+            errs = dict(self._errors_by_module)
+            last = dict(self._last)
+        yield Sample("apm_recorder_scrapes_total", {}, counts["scrapes_total"],
+                     "counter", "Fleet recorder scrape passes completed")
+        for mod, n in sorted(errs.items()):
+            yield Sample("apm_recorder_scrape_errors_total", {"module": mod}, n,
+                         "counter",
+                         "Failed child endpoint fetches (skipped, drop-and-count)")
+        yield Sample("apm_recorder_rows_total", {"kind": "sample"},
+                     counts["rows_total"], "counter",
+                     "Metric sample rows persisted by the fleet recorder")
+        yield Sample("apm_recorder_rows_total", {"kind": "span"},
+                     counts["span_rows_total"], "counter",
+                     "Trace span rows persisted by the fleet recorder")
+        yield Sample("apm_recorder_rows_total", {"kind": "decision"},
+                     counts["decision_rows_total"], "counter",
+                     "Alert decision rows persisted by the fleet recorder")
+        yield Sample("apm_recorder_last_scrape_unixtime", {}, last["ts"],
+                     "gauge", "Wall time of the last completed scrape pass")
+        yield Sample("apm_recorder_targets", {}, last["targets"], "gauge",
+                     "Targets seen on the last scrape pass")
+
+    # -- dedup bookkeeping --------------------------------------------------
+
+    def _fresh(self, target: str, kind: str, keys: List[tuple],
+               rows: List[dict]) -> List[dict]:
+        """Rows whose (kind, key) was not persisted for this target yet —
+        /trace and /decisions return rings, so every pass re-sends history;
+        bounded memory (the ring sizes bound what can ever come back)."""
+        out = []
+        with self._lock:
+            seen, order = self._seen.setdefault(target, (set(), deque()))
+            for key, row in zip(keys, rows):
+                k = (kind,) + key
+                if k in seen:
+                    continue
+                seen.add(k)
+                order.append(k)
+                while len(order) > 8192:
+                    seen.discard(order.popleft())
+                out.append(row)
+        return out
+
+    # -- one pass ------------------------------------------------------------
+
+    def _scrape_target(self, name: str, base: str, now: float) -> None:
+        extra = {"module": name}
+        text = _fetch(f"{base}/metrics", self.timeout_s).decode("utf-8", "replace")
+        n = self.store.append_samples(parse_prom_text(text), ts=now,
+                                      extra_labels=extra)
+        with self._lock:
+            self._counts["rows_total"] += n
+        try:
+            doc = json.loads(_fetch(f"{base}/trace?n={self.trace_n}",
+                                    self.timeout_s))
+            spans = [s for s in doc.get("spans", []) if isinstance(s, dict)]
+            keys = [(s.get("trace_id"), s.get("name"), s.get("start"))
+                    for s in spans]
+            fresh = self._fresh(name, "t", keys, spans)
+            if fresh:
+                n = self.store.append_spans(fresh, extra=extra)
+                with self._lock:
+                    self._counts["span_rows_total"] += n
+        except Exception:
+            self._note_error(name)
+        try:
+            doc = json.loads(_fetch(f"{base}/decisions?n={self.decision_n}",
+                                    self.timeout_s))
+            decs = [d for d in doc.get("decisions", []) if isinstance(d, dict)]
+            keys = [(d.get("trace_id"), d.get("ts"), d.get("service"),
+                     d.get("channel")) for d in decs]
+            fresh = self._fresh(name, "d", keys, decs)
+            if fresh:
+                n = self.store.append_decisions(fresh, extra=extra)
+                with self._lock:
+                    self._counts["decision_rows_total"] += n
+        except Exception:
+            self._note_error(name)
+
+    def _note_error(self, module: str) -> None:
+        with self._lock:
+            self._counts["scrape_errors_total"] += 1
+            self._errors_by_module[module] = \
+                self._errors_by_module.get(module, 0) + 1
+
+    def scrape_once(self, now: Optional[float] = None) -> dict:
+        """One pass over every target; never raises. Returns a summary."""
+        now = time.time() if now is None else float(now)
+        try:
+            targets = list(self.targets() or [])
+        except Exception:
+            targets = []
+        ok = 0
+        for name, base in targets:
+            try:
+                self._scrape_target(name, base.rstrip("/"), now)
+                ok += 1
+            except Exception as e:
+                self._note_error(name)
+                if self._logger:
+                    self._logger.debug("recorder: scrape %s failed: %s", name, e)
+        if self.self_registry is not None:
+            try:
+                n = self.store.ingest_registry(
+                    self.self_registry, ts=now,
+                    extra_labels={"module": self.self_module})
+                with self._lock:
+                    self._counts["rows_total"] += n
+            except Exception:
+                self._note_error(self.self_module)
+        try:
+            self.store.compact(now)
+        except Exception:
+            pass
+        with self._lock:
+            self._counts["scrapes_total"] += 1
+            self._last = {"ts": now, "targets": len(targets), "ok": ok}
+            return {"ts": now, "targets": len(targets), "ok": ok,
+                    "errors_total": self._counts["scrape_errors_total"]}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.scrape_once()
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, name="apm-recorder",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.timeout_s + self.interval_s + 1.0)
+
+    def status(self) -> dict:
+        with self._lock:
+            out = {"last": dict(self._last), "counts": dict(self._counts),
+                   "errors_by_module": dict(self._errors_by_module)}
+        out["store"] = self.store.stats()
+        return out

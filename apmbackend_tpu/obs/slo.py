@@ -1,0 +1,352 @@
+"""Config-schema'd SLO engine: Google-SRE multi-window burn rates over the
+time-series store (DESIGN.md §8.4).
+
+An *objective* declares a target fraction of good events for one series:
+
+- ``kind: "latency"`` — histogram objective; an event is bad when it lands
+  above ``thresholdSeconds``. Bad fraction over a window is computed from
+  the increase of the cumulative ``<series>_bucket`` counters across the
+  window (the bucket with the smallest bound >= threshold vs ``+Inf``),
+  so it works from recorder scrapes alone, no per-event stream needed.
+- ``kind: "gauge"`` — point objective; a sampled point is bad when its
+  value exceeds ``threshold`` (per-queue lag, epoch age). Bad fraction =
+  bad points / points.
+
+``per: "<label>"`` fans one objective out over every observed value of a
+label (the ROADMAP's per-queue lag SLOs: one burn rate per queue).
+
+Burn rate = bad_fraction / (1 - target): burning the whole error budget
+over the window is exactly 1.0. Multi-window alerting (SRE workbook ch.5):
+page ("fast") when BOTH the short and long window burn >= fastBurnThreshold
+(14.4 ~ 2% of a 30-day budget in one hour); ticket ("slow") at
+slowBurnThreshold (6.0). Fast burn degrades ``/healthz`` to 503 through
+the engine's :meth:`health` provider.
+
+Every alert is recorded with full provenance — the windows, bad
+fractions, thresholds, and point counts that produced it — into the
+process decision ring (the same ring ``_dispatch_alert`` records into, so
+``/decisions`` resolves SLO pages exactly like anomaly pages) and handed
+to the ``on_alert`` sink (the manager wires ``ManagerAlerts``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .registry import MetricsRegistry, Sample
+from .store import TimeSeriesStore
+
+# Default objectives: the four budgets the ISSUE names. Series fed by the
+# worker histograms, the transport lag gauge, and the fleet epoch gauge.
+DEFAULT_OBJECTIVES = [
+    {
+        "name": "detection_latency_p95",
+        "kind": "latency",
+        "series": "apm_e2e_ingest_to_emit_seconds",
+        "thresholdSeconds": 0.1,
+        "target": 0.95,
+    },
+    {
+        "name": "alert_latency",
+        "kind": "latency",
+        "series": "apm_e2e_ingest_to_alert_seconds",
+        "thresholdSeconds": 0.25,
+        "target": 0.99,
+    },
+    {
+        "name": "queue_wait",
+        "kind": "latency",
+        "series": "apm_queue_wait_seconds",
+        "thresholdSeconds": 0.5,
+        "target": 0.99,
+        "per": "queue",
+    },
+    {
+        "name": "queue_lag",
+        "kind": "gauge",
+        "series": "apm_queue_lag",
+        "threshold": 10000.0,
+        "target": 0.99,
+        "per": "queue",
+    },
+    {
+        "name": "epoch_age",
+        "kind": "gauge",
+        "series": "apm_delivery_epoch_age_seconds",
+        "threshold": 60.0,
+        "target": 0.99,
+    },
+]
+
+
+def _delta(points: List[Tuple[float, float]]) -> float:
+    """Reset-aware counter increase over a point list (first..last)."""
+    if len(points) < 2:
+        return 0.0
+    inc = 0.0
+    for (_, a), (_, b) in zip(points, points[1:]):
+        inc += (b - a) if b >= a else b
+    return max(0.0, inc)
+
+
+class SLOEngine:
+    """Evaluates objectives over a store; thread-safe."""
+
+    def __init__(
+        self,
+        store: TimeSeriesStore,
+        objectives: Optional[List[dict]] = None,
+        *,
+        short_window_s: float = 300.0,
+        long_window_s: float = 3600.0,
+        fast_burn: float = 14.4,
+        slow_burn: float = 6.0,
+        cooldown_s: float = 300.0,
+        on_alert: Optional[Callable[[str, dict], None]] = None,
+        decisions=None,
+        registry: Optional[MetricsRegistry] = None,
+        logger=None,
+    ):
+        self.store = store
+        self.objectives = list(DEFAULT_OBJECTIVES if objectives is None
+                               else objectives)
+        self.short_window_s = float(short_window_s)
+        self.long_window_s = float(long_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.cooldown_s = float(cooldown_s)
+        self.on_alert = on_alert
+        self._decisions = decisions
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._last_alert: Dict[tuple, float] = {}  # guarded-by: _lock
+        self._last_eval: List[dict] = []  # guarded-by: _lock
+        self._last_eval_ts = 0.0  # guarded-by: _lock
+        self._alerts_total: Dict[tuple, int] = {}  # guarded-by: _lock
+        self._evals_total = 0  # guarded-by: _lock
+        if registry is not None:
+            registry.add_collector(self._collect)
+
+    @classmethod
+    def from_config(cls, store: TimeSeriesStore, config: dict, **kw) -> "SLOEngine":
+        """Build from the ``slo.*`` config section (config.py schema)."""
+        slo_cfg = (config or {}).get("slo") or {}
+        return cls(
+            store,
+            slo_cfg.get("objectives"),
+            short_window_s=slo_cfg.get("shortWindowSeconds", 300.0),
+            long_window_s=slo_cfg.get("longWindowSeconds", 3600.0),
+            fast_burn=slo_cfg.get("fastBurnThreshold", 14.4),
+            slow_burn=slo_cfg.get("slowBurnThreshold", 6.0),
+            cooldown_s=slo_cfg.get("alertCooldownSeconds", 300.0),
+            **kw,
+        )
+
+    # -- window math ---------------------------------------------------------
+
+    def _bad_fraction_latency(self, obj: dict, start: float, end: float,
+                              key_label: Optional[str]) -> Dict[str, dict]:
+        threshold = float(obj.get("thresholdSeconds", 0.1))
+        groups = self.store.series_points(
+            str(obj["series"]) + "_bucket", start, end, obj.get("labels"))
+        by_key: Dict[str, Dict[float, List[Tuple[float, float]]]] = {}
+        for lblkey, pts in groups.items():
+            lbl = dict(lblkey)
+            le_raw = lbl.pop("le", None)
+            if le_raw is None:
+                continue
+            le = math.inf if le_raw in ("+Inf", "inf", "Inf") else float(le_raw)
+            key = str(lbl.get(key_label, "")) if key_label else ""
+            by_key.setdefault(key, {}).setdefault(le, []).extend(pts)
+        out: Dict[str, dict] = {}
+        for key, by_le in by_key.items():
+            total = _delta(sorted(by_le.get(math.inf, []), key=lambda p: p[0]))
+            finite = sorted(b for b in by_le if not math.isinf(b))
+            good_le = next((b for b in finite if b >= threshold), None)
+            good = _delta(sorted(by_le[good_le], key=lambda p: p[0])) \
+                if good_le is not None else 0.0
+            bad = max(0.0, total - good)
+            out[key] = {
+                "bad_fraction": (bad / total) if total > 0 else 0.0,
+                "events": total,
+                "bad_events": bad,
+                "bucket_le": good_le,
+            }
+        return out
+
+    def _bad_fraction_gauge(self, obj: dict, start: float, end: float,
+                            key_label: Optional[str]) -> Dict[str, dict]:
+        threshold = float(obj.get("threshold", 0.0))
+        groups = self.store.series_points(
+            str(obj["series"]), start, end, obj.get("labels"))
+        by_key: Dict[str, List[float]] = {}
+        for lblkey, pts in groups.items():
+            lbl = dict(lblkey)
+            key = str(lbl.get(key_label, "")) if key_label else ""
+            by_key.setdefault(key, []).extend(v for _, v in pts)
+        out: Dict[str, dict] = {}
+        for key, values in by_key.items():
+            bad = sum(1 for v in values if v > threshold)
+            out[key] = {
+                "bad_fraction": (bad / len(values)) if values else 0.0,
+                "events": len(values),
+                "bad_events": bad,
+                "bucket_le": None,
+            }
+        return out
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Evaluate every objective over both windows; dispatch alerts for
+        fast/slow burns (cooldown-limited); never raises."""
+        now = time.time() if now is None else float(now)
+        results: List[dict] = []
+        for obj in self.objectives:
+            try:
+                results.extend(self._evaluate_objective(obj, now))
+            except Exception as e:
+                if self._logger:
+                    self._logger.warning("slo: objective %s failed: %s",
+                                         obj.get("name"), e)
+        with self._lock:
+            self._last_eval = results
+            self._last_eval_ts = now
+            self._evals_total += 1
+        return results
+
+    def _evaluate_objective(self, obj: dict, now: float) -> List[dict]:
+        kind = obj.get("kind", "gauge")
+        key_label = obj.get("per")
+        target = float(obj.get("target", 0.99))
+        budget = max(1e-9, 1.0 - target)
+        frac = self._bad_fraction_latency if kind == "latency" \
+            else self._bad_fraction_gauge
+        windows = {"short": self.short_window_s, "long": self.long_window_s}
+        per_window = {
+            w: frac(obj, now - seconds, now, key_label)
+            for w, seconds in windows.items()
+        }
+        keys = set()
+        for d in per_window.values():
+            keys.update(d)
+        out = []
+        for key in sorted(keys):
+            win = {
+                w: per_window[w].get(
+                    key, {"bad_fraction": 0.0, "events": 0, "bad_events": 0,
+                          "bucket_le": None})
+                for w in windows
+            }
+            burn_short = win["short"]["bad_fraction"] / budget
+            burn_long = win["long"]["bad_fraction"] / budget
+            if burn_short >= self.fast_burn and burn_long >= self.fast_burn:
+                severity = "fast"
+            elif burn_short >= self.slow_burn and burn_long >= self.slow_burn:
+                severity = "slow"
+            else:
+                severity = None
+            res = {
+                "objective": obj.get("name", obj.get("series")),
+                "kind": kind,
+                "series": obj.get("series"),
+                "key": key,
+                "per": key_label,
+                "target": target,
+                "threshold": obj.get("thresholdSeconds", obj.get("threshold")),
+                "burn_short": burn_short,
+                "burn_long": burn_long,
+                "severity": severity,
+                "windows": {
+                    w: dict(win[w], window_s=windows[w]) for w in windows
+                },
+                "ts": now,
+            }
+            out.append(res)
+            if severity is not None:
+                self._maybe_alert(res, now)
+        return out
+
+    def _maybe_alert(self, res: dict, now: float) -> None:
+        akey = (res["objective"], res["key"])
+        with self._lock:
+            last = self._last_alert.get(akey, 0.0)
+            if now - last < self.cooldown_s:
+                return
+            self._last_alert[akey] = now
+            ck = (res["objective"], res["severity"])
+            self._alerts_total[ck] = self._alerts_total.get(ck, 0) + 1
+        record = dict(res, decision="slo_burn_rate")
+        ring = self._decisions
+        if ring is None:
+            from .decisions import get_decisions
+            ring = get_decisions()
+        try:
+            ring.record(record)
+        except Exception:
+            pass
+        key_part = f" [{res['per']}={res['key']}]" if res["key"] else ""
+        msg = (
+            f"SLO {res['severity']}-burn: {res['objective']}{key_part} "
+            f"burn_short={res['burn_short']:.1f} burn_long={res['burn_long']:.1f} "
+            f"(target={res['target']}, threshold={res['threshold']})"
+        )
+        if self.on_alert is not None:
+            try:
+                self.on_alert(msg, record)
+            except Exception:
+                pass
+        if self._logger:
+            self._logger.warning("%s", msg)
+
+    # -- providers -----------------------------------------------------------
+
+    def health(self) -> dict:
+        """``add_health`` provider: fast burn degrades /healthz to 503."""
+        with self._lock:
+            results = list(self._last_eval)
+            ts = self._last_eval_ts
+        fast = [f"{r['objective']}:{r['key']}" if r["key"] else r["objective"]
+                for r in results if r["severity"] == "fast"]
+        slow = [f"{r['objective']}:{r['key']}" if r["key"] else r["objective"]
+                for r in results if r["severity"] == "slow"]
+        return {"ok": not fast, "fast_burning": fast, "slow_burning": slow,
+                "objectives": len(self.objectives), "last_eval": ts}
+
+    def status(self) -> dict:
+        """Flight-bundle / qstat view: the full last evaluation."""
+        with self._lock:
+            return {"last_eval_ts": self._last_eval_ts,
+                    "results": list(self._last_eval),
+                    "windows": {"short_s": self.short_window_s,
+                                "long_s": self.long_window_s},
+                    "thresholds": {"fast": self.fast_burn,
+                                   "slow": self.slow_burn}}
+
+    def _collect(self):
+        with self._lock:
+            results = list(self._last_eval)
+            alerts = dict(self._alerts_total)
+            evals = self._evals_total
+        yield Sample("apm_slo_evaluations_total", {}, evals, "counter",
+                     "SLO engine evaluation passes")
+        for (objective, severity), n in sorted(alerts.items()):
+            yield Sample("apm_slo_alerts_total",
+                         {"objective": objective, "severity": severity}, n,
+                         "counter", "Burn-rate alerts dispatched (post-cooldown)")
+        for r in results:
+            lbl = {"objective": r["objective"]}
+            if r["key"]:
+                lbl["key"] = r["key"]
+            yield Sample("apm_slo_burn_rate", dict(lbl, window="short"),
+                         r["burn_short"], "gauge",
+                         "Error-budget burn rate over the short window")
+            yield Sample("apm_slo_burn_rate", dict(lbl, window="long"),
+                         r["burn_long"], "gauge",
+                         "Error-budget burn rate over the long window")
+            yield Sample("apm_slo_fast_burn_active", lbl,
+                         1.0 if r["severity"] == "fast" else 0.0, "gauge",
+                         "1 while the objective is fast-burning (healthz 503)")

@@ -1,0 +1,871 @@
+"""Append-only segmented on-disk time-series store (the durable telemetry
+spine, DESIGN.md §8.4).
+
+Three record kinds share one journal: metric ``samples`` (registry
+snapshots, shard-labeled by the fleet recorder), trace ``spans``, and
+alert ``decisions`` — so a kill−9'd shard's last telemetry survives into
+triage instead of dying with its process rings.
+
+Durability discipline (two sanctioned idioms, analysis/durability.py):
+
+- The ACTIVE segment is an append-mode journal: ``magic | per-record
+  (u32 len | u32 crc32 | JSON batch)``. Append + flush is the commit; a
+  torn tail is detected by the READER (length bounds + CRC) and recovery
+  stops at the last valid record. No rename dance on the hot path.
+- Compaction rewrites (downsample) and nothing else go through the
+  tmp + ``os.replace`` atomic writer.
+
+Hostile storage reuses the ``APM_CHAOS_FS`` seam from deltachain
+(``StorageFaultPlan.on_segment_write`` — torn prefix then OSError).
+Failed disk writes DEGRADE, never raise: the rows stay queryable from
+the in-memory index, the drop is counted, and the writer backs off and
+retries on a fresh segment. A full disk must not take down the scrape
+loop or the hot path.
+
+Retention is time-based (whole aged-out segments are unlinked);
+segments older than ``downsample_after_s`` are compacted in place to
+one sample per ``downsample_step_s`` bucket per series (LAST value per
+bucket — cumulative counters stay correct for ``rate()``).
+
+``directory=None`` gives a volatile in-memory store with the identical
+query surface (the per-module ``/query`` default).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import struct
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .registry import MetricsRegistry, Sample, histogram_quantile, parse_prom_text
+
+
+def _faults():
+    """The deltachain ``APM_CHAOS_FS`` fault plan (shared seam) — imported
+    lazily so the obs package stays stdlib-only at import time (deltachain
+    pulls numpy)."""
+    from ..deltachain import _faults as dc_faults
+
+    return dc_faults()
+
+_MAGIC = b"APMTSDB1"
+_REC = struct.Struct("<II")  # payload_len, payload_crc32
+_MAX_RECORD = 16 << 20  # bounds check against bit-rotted length fields
+
+SEGMENT_GLOB_RE = re.compile(r"^tseries-(\d{8})\.seg$")
+
+
+def _seg_name(seq: int) -> str:
+    return f"tseries-{seq:08d}.seg"
+
+
+def _labelkey(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _write_segment_atomic(path: str, blob: bytes) -> None:
+    """Sanctioned atomic writer for compaction outputs: pid-suffixed tmp,
+    flush+fsync, then ``os.replace`` — the rename IS the commit."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as fh:
+            plan = _faults()
+            if plan is not None:
+                plan.on_segment_write(fh, blob)
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class _Segment:
+    """One on-disk (or in-memory) segment: decoded record batches plus the
+    bookkeeping compaction and retention need. Mutated only under the
+    owning store's lock."""
+
+    __slots__ = ("seq", "path", "records", "min_ts", "max_ts", "bytes",
+                 "downsampled", "created")
+
+    def __init__(self, seq: int, path: Optional[str], created: float):
+        self.seq = seq
+        self.path = path
+        self.records: List[dict] = []
+        self.min_ts = math.inf
+        self.max_ts = -math.inf
+        self.bytes = 0
+        self.downsampled = 0.0  # step already applied; 0 = raw
+        self.created = created
+
+    def note(self, record: dict, nbytes: int) -> None:
+        self.records.append(record)
+        self.bytes += nbytes
+        ts = float(record.get("t", 0.0))
+        self.min_ts = min(self.min_ts, ts)
+        self.max_ts = max(self.max_ts, ts)
+
+
+def _encode_record(record: dict) -> bytes:
+    payload = json.dumps(record, separators=(",", ":")).encode("utf-8")
+    return _REC.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _encode_segment_blob(header: dict, records: Iterable[dict]) -> bytes:
+    parts = [_MAGIC, _encode_record({"k": "h", "t": header.get("created", 0.0),
+                                     "h": header})]
+    for rec in records:
+        parts.append(_encode_record(rec))
+    return b"".join(parts)
+
+
+def _decode_records(blob: bytes) -> Tuple[List[dict], bool, int]:
+    """Decode framed records; returns (records, clean, good_off). ``clean``
+    is False when the walk stopped early — torn tail, bit-rot, or bounds —
+    in which case everything before the first invalid frame is kept and
+    ``good_off`` is the byte offset of the first invalid frame (the length
+    a repair pass may truncate the file to)."""
+    out: List[dict] = []
+    if not blob.startswith(_MAGIC):
+        return out, False, 0
+    off = len(_MAGIC)
+    n = len(blob)
+    while off < n:
+        if off + _REC.size > n:
+            return out, False, off  # torn tail inside a frame header
+        length, crc = _REC.unpack_from(blob, off)
+        if length > _MAX_RECORD or off + _REC.size + length > n:
+            return out, False, off  # bit-rotted length or truncated payload
+        payload = blob[off + _REC.size:off + _REC.size + length]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return out, False, off  # bit-rot
+        try:
+            rec = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return out, False, off
+        out.append(rec)
+        off += _REC.size + length
+    return out, True, off
+
+
+class TimeSeriesStore:
+    """Append-only segmented time-series store with range queries.
+
+    Thread-safe; every public method may be called from scrape threads,
+    HTTP handler threads, and timer threads concurrently.
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        *,
+        retention_s: float = 3600.0,
+        segment_max_bytes: int = 4 << 20,
+        segment_max_age_s: float = 300.0,
+        downsample_after_s: Optional[float] = 900.0,
+        downsample_step_s: float = 60.0,
+        reopen_backoff_s: float = 5.0,
+        registry: Optional[MetricsRegistry] = None,
+        logger=None,
+    ):
+        self.directory = directory
+        self.retention_s = float(retention_s)
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.segment_max_age_s = float(segment_max_age_s)
+        self.downsample_after_s = (
+            None if downsample_after_s in (None, 0) else float(downsample_after_s)
+        )
+        self.downsample_step_s = max(1.0, float(downsample_step_s))
+        self.reopen_backoff_s = float(reopen_backoff_s)
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._segments: List[_Segment] = []  # guarded-by: _lock
+        self._active: Optional[_Segment] = None  # guarded-by: _lock
+        self._fh = None  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self._reopen_at = 0.0  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        self._counts = {  # guarded-by: _lock
+            "rows_total": 0,
+            "dropped_rows_total": 0,
+            "write_errors_total": 0,
+            "recovered_rows": 0,
+            "corrupt_segments_total": 0,
+            "compactions_total": 0,
+            "retention_drops_total": 0,
+        }
+        if directory is not None:
+            os.makedirs(directory, exist_ok=True)
+            with self._lock:
+                self._recover_locked()
+        if registry is not None:
+            registry.add_collector(self._collect)
+
+    # -- telemetry about the telemetry store -------------------------------
+
+    def _collect(self):
+        st = self.stats()
+        yield Sample("apm_tsdb_rows_total", {}, st["rows_total"], "counter",
+                     "Rows (samples/spans/decisions) appended to the time-series store")
+        yield Sample("apm_tsdb_dropped_rows_total", {}, st["dropped_rows_total"],
+                     "counter",
+                     "Rows whose durable write failed (kept in memory, drop-and-count)")
+        yield Sample("apm_tsdb_write_errors_total", {}, st["write_errors_total"],
+                     "counter", "Segment write failures (ENOSPC/EIO degradation)")
+        yield Sample("apm_tsdb_corrupt_segments_total", {},
+                     st["corrupt_segments_total"], "counter",
+                     "Segments whose recovery walk stopped early (torn tail / bit-rot)")
+        yield Sample("apm_tsdb_compactions_total", {}, st["compactions_total"],
+                     "counter", "Downsample-on-compact rewrites")
+        yield Sample("apm_tsdb_segments", {}, st["segments"], "gauge",
+                     "Live segments in the time-series store")
+        yield Sample("apm_tsdb_bytes", {}, st["bytes"], "gauge",
+                     "Total bytes across live store segments")
+
+    # -- recovery ----------------------------------------------------------
+
+    # apm: holds(_lock): called from __init__ under the lock
+    def _recover_locked(self) -> None:
+        names = []
+        for fn in os.listdir(self.directory):
+            m = SEGMENT_GLOB_RE.match(fn)
+            if m:
+                names.append((int(m.group(1)), fn))
+        names.sort()
+        stop = False
+        for seq, fn in names:
+            self._seq = max(self._seq, seq)
+            path = os.path.join(self.directory, fn)
+            if stop:
+                # past the last valid segment: quarantine (rename aside,
+                # content preserved for forensics) so the NEXT recovery sees
+                # fresh appends — which land on higher seqs — as a clean
+                # readable prefix instead of an unreachable tail
+                self._quarantine(path)
+                continue
+            try:
+                with open(path, "rb") as fh:
+                    blob = fh.read()
+            except OSError:
+                self._counts["corrupt_segments_total"] += 1
+                stop = True
+                self._quarantine(path)
+                continue
+            records, clean, good_off = _decode_records(blob)
+            if not records:
+                # nothing valid (bad magic / empty / rotted header):
+                # recovery stops at the last valid segment before this one
+                self._counts["corrupt_segments_total"] += 1
+                stop = True
+                self._quarantine(path)
+                continue
+            if records[0].get("k") == "h":
+                header, body = records[0].get("h", {}), records[1:]
+            else:
+                header, body = {}, records
+            seg = _Segment(seq, path, float(header.get("created", 0.0)))
+            seg.downsampled = float(header.get("ds", 0.0))
+            for rec in body:
+                seg.note(rec, 0)
+                self._counts["recovered_rows"] += len(rec.get("rows", ()))
+            seg.bytes = len(blob)
+            self._segments.append(seg)
+            if not clean:
+                self._counts["corrupt_segments_total"] += 1
+                stop = True  # torn/rotted mid-file: later segments stay unread
+                # repair in place: drop the rotted suffix so the segment
+                # reads clean next time and doesn't re-poison recovery
+                try:
+                    with open(path, "r+b") as fh:
+                        fh.truncate(good_off)
+                    seg.bytes = good_off
+                except OSError:
+                    self._counts["write_errors_total"] += 1
+
+    def _quarantine(self, path: str) -> None:
+        try:
+            os.replace(path, path + ".quarantine")
+        except OSError:
+            if self._logger:
+                self._logger.warning("tsdb: quarantine failed for %s", path)
+
+    # -- segment lifecycle -------------------------------------------------
+
+    # apm: holds(_lock): callers append under the lock
+    def _open_segment_locked(self, now: float) -> bool:
+        if self.directory is None:
+            self._seq += 1
+            self._active = _Segment(self._seq, None, now)
+            self._segments.append(self._active)
+            return True
+        if now < self._reopen_at:
+            return False
+        self._seq += 1
+        path = os.path.join(self.directory, _seg_name(self._seq))
+        header_blob = _MAGIC + _encode_record(
+            {"k": "h", "t": now, "h": {"created": now, "ds": 0.0}})
+        try:
+            fh = open(path, "ab")
+            plan = _faults()
+            if plan is not None:
+                plan.on_segment_write(fh, header_blob)
+            fh.write(header_blob)
+            fh.flush()
+        except OSError as e:
+            self._counts["write_errors_total"] += 1
+            self._reopen_at = now + self.reopen_backoff_s
+            if self._logger:
+                self._logger.warning("tsdb: segment open failed (degraded): %s", e)
+            try:
+                fh.close()  # type: ignore[possibly-undefined]
+            except Exception:
+                pass
+            try:
+                # a torn header would stop the next recovery dead at this
+                # seq; an empty/absent file never becomes a segment
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        self._fh = fh
+        self._active = _Segment(self._seq, path, now)
+        self._active.bytes = len(header_blob)
+        self._segments.append(self._active)
+        return True
+
+    # apm: holds(_lock): rotation happens under the append lock
+    def _seal_active_locked(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+        self._active = None
+
+    # apm: holds(_lock): the one writer path, always under the lock
+    def _append_locked(self, record: dict, now: float) -> None:
+        nrows = len(record.get("rows", ()))
+        self._counts["rows_total"] += nrows
+        blob = _encode_record(record)
+        # rotate on size/age before the write so segments stay bounded
+        if self._active is not None and (
+            self._active.bytes + len(blob) > self.segment_max_bytes
+            or now - self._active.created > self.segment_max_age_s
+        ):
+            self._seal_active_locked()
+        if self._active is None:
+            if not self._open_segment_locked(now):
+                # disk unavailable: keep the row queryable in memory only
+                seg = self._segments[-1] if self._segments and \
+                    self._segments[-1].path is None else None
+                if seg is None:
+                    seg = _Segment(self._seq, None, now)
+                    self._segments.append(seg)
+                seg.note(record, 0)
+                self._counts["dropped_rows_total"] += nrows
+                return
+        seg = self._active
+        assert seg is not None
+        if seg.path is None:  # in-memory store
+            seg.note(record, len(blob))
+            return
+        try:
+            plan = _faults()
+            if plan is not None:
+                plan.on_segment_write(self._fh, blob)
+            self._fh.write(blob)
+            self._fh.flush()
+        except OSError as e:
+            # drop-and-count: memory keeps serving, disk backs off
+            self._counts["write_errors_total"] += 1
+            self._counts["dropped_rows_total"] += nrows
+            self._reopen_at = now + self.reopen_backoff_s
+            if self._logger:
+                self._logger.warning("tsdb: append failed (degraded): %s", e)
+            # the failed write may have left a torn tail (real ENOSPC tears
+            # mid-record): truncate back to the last clean frame so this
+            # segment — and everything sealed after it — recovers readable
+            try:
+                self._fh.truncate(seg.bytes)
+            except OSError:
+                pass
+            self._seal_active_locked()
+            seg.note(record, 0)
+            return
+        seg.note(record, len(blob))
+
+    # -- public append API -------------------------------------------------
+
+    def append_samples(
+        self,
+        rows: Iterable[Tuple[str, Dict[str, str], float]],
+        ts: Optional[float] = None,
+        extra_labels: Optional[Dict[str, str]] = None,
+    ) -> int:
+        """Append (name, labels, value) metric rows stamped at ``ts``."""
+        now = time.time()
+        t = now if ts is None else float(ts)
+        packed = []
+        for name, labels, value in rows:
+            lbl = dict(labels)
+            if extra_labels:
+                lbl.update(extra_labels)
+            if not (isinstance(value, (int, float)) and math.isfinite(value)):
+                continue
+            packed.append([name, lbl, value])
+        if not packed:
+            return 0
+        with self._lock:
+            if self._closed:
+                return 0
+            self._append_locked({"k": "s", "t": t, "rows": packed}, now)
+        return len(packed)
+
+    def ingest_registry(
+        self,
+        registry: MetricsRegistry,
+        ts: Optional[float] = None,
+        extra_labels: Optional[Dict[str, str]] = None,
+    ) -> int:
+        """Snapshot a live registry (scrape-equivalent) into the store."""
+        return self.append_samples(
+            parse_prom_text(registry.render()), ts=ts, extra_labels=extra_labels)
+
+    def ingest_prom_text(
+        self,
+        text: str,
+        ts: Optional[float] = None,
+        extra_labels: Optional[Dict[str, str]] = None,
+    ) -> int:
+        return self.append_samples(parse_prom_text(text), ts=ts,
+                                   extra_labels=extra_labels)
+
+    def append_spans(self, spans: Iterable[dict],
+                     extra: Optional[Dict[str, str]] = None) -> int:
+        now = time.time()
+        rows = []
+        for sp in spans:
+            d = dict(sp)
+            if extra:
+                d.update(extra)
+            rows.append(d)
+        if not rows:
+            return 0
+        t = max((float(r.get("start", now)) for r in rows), default=now)
+        with self._lock:
+            if self._closed:
+                return 0
+            self._append_locked({"k": "t", "t": t, "rows": rows}, now)
+        return len(rows)
+
+    def append_decisions(self, decisions: Iterable[dict],
+                         extra: Optional[Dict[str, str]] = None) -> int:
+        now = time.time()
+        rows = []
+        for dec in decisions:
+            d = dict(dec)
+            if extra:
+                d.update(extra)
+            rows.append(d)
+        if not rows:
+            return 0
+        t = max((float(r.get("ts", now)) for r in rows), default=now)
+        with self._lock:
+            if self._closed:
+                return 0
+            self._append_locked({"k": "d", "t": t, "rows": rows}, now)
+        return len(rows)
+
+    # -- compaction / retention --------------------------------------------
+
+    def compact(self, now: Optional[float] = None) -> dict:
+        """Time-based retention + downsample-on-compact. Safe on a timer;
+        failures degrade (the raw segment stays) rather than raise."""
+        now = time.time() if now is None else float(now)
+        dropped = rewritten = 0
+        with self._lock:
+            if self._closed:
+                return {"dropped": 0, "downsampled": 0}
+            keep: List[_Segment] = []
+            for seg in self._segments:
+                aged = (seg.max_ts < now - self.retention_s) if seg.records \
+                    else (seg.created < now - self.retention_s)
+                if seg is not self._active and aged:
+                    if seg.path is not None:
+                        try:
+                            os.unlink(seg.path)
+                        except OSError:
+                            pass
+                    self._counts["retention_drops_total"] += 1
+                    dropped += 1
+                    continue
+                keep.append(seg)
+            self._segments = keep
+            if self.downsample_after_s is not None:
+                for seg in self._segments:
+                    if seg is self._active or seg.downsampled or seg.path is None:
+                        continue
+                    if seg.max_ts >= now - self.downsample_after_s:
+                        continue
+                    if self._downsample_locked(seg):
+                        rewritten += 1
+        return {"dropped": dropped, "downsampled": rewritten}
+
+    # apm: holds(_lock): compact() holds the lock across the rewrite
+    def _downsample_locked(self, seg: _Segment) -> bool:
+        step = self.downsample_step_s
+        last: Dict[tuple, Tuple[float, list]] = {}
+        others: List[dict] = []
+        order: List[tuple] = []
+        for rec in seg.records:
+            if rec.get("k") != "s":
+                others.append(rec)  # spans/decisions are sparse: keep raw
+                continue
+            t = float(rec.get("t", 0.0))
+            bucket = math.floor(t / step) * step
+            for row in rec.get("rows", ()):
+                key = (bucket, row[0], _labelkey(row[1]))
+                if key not in last:
+                    order.append(key)
+                last[key] = (t, row)  # LAST value per bucket wins
+        sample_recs: Dict[float, dict] = {}
+        for key in order:
+            t, row = last[key]
+            bucket = key[0]
+            rec = sample_recs.setdefault(
+                bucket, {"k": "s", "t": bucket, "rows": []})
+            rec["rows"].append(row)
+        new_records = sorted(sample_recs.values(), key=lambda r: r["t"]) + others
+        header = {"created": seg.created, "ds": step}
+        blob = _encode_segment_blob(header, new_records)
+        try:
+            _write_segment_atomic(seg.path, blob)
+        except OSError as e:
+            self._counts["write_errors_total"] += 1
+            if self._logger:
+                self._logger.warning("tsdb: downsample failed (raw kept): %s", e)
+            return False
+        seg.records = new_records
+        seg.bytes = len(blob)
+        seg.downsampled = step
+        self._counts["compactions_total"] += 1
+        return True
+
+    # -- queries -----------------------------------------------------------
+
+    def series_points(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Dict[tuple, List[Tuple[float, float]]]:
+        """Raw points per labelset for one series name within [start, end]."""
+        out: Dict[tuple, List[Tuple[float, float]]] = {}
+        with self._lock:
+            segs = list(self._segments)
+        for seg in segs:
+            if seg.max_ts < start or seg.min_ts > end:
+                continue
+            for rec in seg.records:
+                if rec.get("k") != "s":
+                    continue
+                t = float(rec.get("t", 0.0))
+                if t < start or t > end:
+                    continue
+                for row in rec.get("rows", ()):
+                    if row[0] != name:
+                        continue
+                    lbl = row[1]
+                    if labels and any(str(lbl.get(k)) != str(v)
+                                      for k, v in labels.items()):
+                        continue
+                    out.setdefault(_labelkey(lbl), []).append((t, float(row[2])))
+        for pts in out.values():
+            pts.sort(key=lambda p: p[0])
+        return out
+
+    def series_names(self, prefix: str = "") -> List[str]:
+        names = set()
+        with self._lock:
+            segs = list(self._segments)
+        for seg in segs:
+            for rec in seg.records:
+                if rec.get("k") != "s":
+                    continue
+                for row in rec.get("rows", ()):
+                    if row[0].startswith(prefix):
+                        names.add(row[0])
+        return sorted(names)
+
+    def _rows_of_kind(self, kind: str, start: float, end: float,
+                      match: Optional[Dict[str, str]], tskey: str,
+                      limit: int) -> List[dict]:
+        out: List[dict] = []
+        with self._lock:
+            segs = list(self._segments)
+        for seg in segs:
+            if seg.max_ts < start or seg.min_ts > end:
+                continue
+            for rec in seg.records:
+                if rec.get("k") != kind:
+                    continue
+                for row in rec.get("rows", ()):
+                    t = float(row.get(tskey, rec.get("t", 0.0)) or rec.get("t", 0.0))
+                    if t < start or t > end:
+                        continue
+                    if match and any(str(row.get(k)) != str(v)
+                                     for k, v in match.items()):
+                        continue
+                    out.append(row)
+        out.sort(key=lambda r: float(r.get(tskey, 0.0) or 0.0))
+        return out[-limit:] if limit else out
+
+    def spans(self, start: float = 0.0, end: float = math.inf,
+              match: Optional[Dict[str, str]] = None,
+              limit: int = 0) -> List[dict]:
+        return self._rows_of_kind("t", start, end, match, "start", limit)
+
+    def decisions(self, start: float = 0.0, end: float = math.inf,
+                  match: Optional[Dict[str, str]] = None,
+                  limit: int = 0) -> List[dict]:
+        return self._rows_of_kind("d", start, end, match, "ts", limit)
+
+    def tail(self, n: int = 64) -> List[dict]:
+        """Last ``n`` record batches (newest last) — the flight-bundle
+        'trajectory into the crash' source."""
+        with self._lock:
+            recs: List[dict] = []
+            for seg in self._segments:
+                recs.extend(seg.records)
+            return [dict(r) for r in recs[-n:]]
+
+    def stats(self) -> dict:
+        with self._lock:
+            st = dict(self._counts)
+            st["segments"] = len(self._segments)
+            st["bytes"] = sum(s.bytes for s in self._segments)
+            st["degraded"] = self._reopen_at > time.time()
+            min_ts = min((s.min_ts for s in self._segments if s.records),
+                         default=math.inf)
+            max_ts = max((s.max_ts for s in self._segments if s.records),
+                         default=-math.inf)
+        st["oldest_ts"] = None if math.isinf(min_ts) else min_ts
+        st["newest_ts"] = None if math.isinf(max_ts) else max_ts
+        return st
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._seal_active_locked()
+
+
+# ---------------------------------------------------------------------------
+# Range-query expression evaluation (the /query endpoint and qstat --range)
+# ---------------------------------------------------------------------------
+
+_EXPR_RE = re.compile(
+    r"^\s*(?:(?P<fn>rate|histogram_quantile)\s*\(\s*"
+    r"(?:(?P<q>[0-9.]+)\s*,\s*)?)?"
+    r"(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<sel>[^}]*)\})?"
+    r"(?:\[(?P<win>[0-9.]+)s\])?"
+    r"\s*\)?\s*$"
+)
+
+
+def parse_selector(sel: Optional[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if not sel:
+        return out
+    for part in sel.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def _instant(points: List[Tuple[float, float]], t: float,
+             lookback: float) -> Optional[float]:
+    """Last value at or before ``t`` within ``lookback`` (prometheus
+    instant-vector semantics, bounded staleness)."""
+    best = None
+    for ts, v in points:
+        if ts > t:
+            break
+        if ts >= t - lookback:
+            best = v
+    return best
+
+
+def _rate(points: List[Tuple[float, float]], t: float,
+          window: float) -> Optional[float]:
+    """Counter rate over (t-window, t]: sum of positive increments (reset
+    aware) divided by the observed span."""
+    win = [(ts, v) for ts, v in points if t - window < ts <= t]
+    if len(win) < 2:
+        return None
+    span = win[-1][0] - win[0][0]
+    if span <= 0:
+        return None
+    inc = 0.0
+    for (_, a), (_, b) in zip(win, win[1:]):
+        if b >= a:
+            inc += b - a
+        else:
+            inc += b  # counter reset: the new value is the increment
+    return inc / span
+
+
+_MAX_EVAL_STEPS = 11000  # prometheus caps range resolution the same way
+
+
+def eval_range(
+    store: TimeSeriesStore,
+    expr: str,
+    start: float,
+    end: float,
+    step: float,
+) -> dict:
+    """Evaluate a range query over the store.
+
+    Supported expressions (the qstat subset):
+
+    - ``name`` / ``name{label="v"}`` — instant vector per step
+    - ``rate(name[Ns])`` — reset-aware counter rate (window defaults to
+      4×step when ``[Ns]`` is omitted)
+    - ``histogram_quantile(q, name)`` — prometheus quantile over the
+      ``name_bucket`` cumulative series, grouped by labels minus ``le``
+    """
+    m = _EXPR_RE.match(expr or "")
+    if not m:
+        raise ValueError(f"unsupported query expression: {expr!r}")
+    step = max(0.001, float(step))
+    start, end = float(start), float(end)
+    if end < start:
+        raise ValueError("end < start")
+    # a huge range at a tiny step would spin the serving thread for minutes
+    # (start=0 over epoch seconds is 10^8 steps); cap like prometheus does
+    if (end - start) / step > _MAX_EVAL_STEPS:
+        raise ValueError(
+            f"range/step yields more than {_MAX_EVAL_STEPS} steps; "
+            f"widen the step or narrow the range")
+    fn = m.group("fn")
+    name = m.group("name")
+    sel = parse_selector(m.group("sel"))
+    window = float(m.group("win")) if m.group("win") else 4.0 * step
+    lookback = max(step, 15.0)
+    steps = []
+    t = start
+    while t <= end + 1e-9:
+        steps.append(t)
+        t += step
+    series_out = []
+
+    if fn == "histogram_quantile":
+        if m.group("q") is None:
+            raise ValueError("histogram_quantile needs a quantile argument")
+        q = float(m.group("q"))
+        base = name[:-len("_bucket")] if name.endswith("_bucket") else name
+        groups = store.series_points(base + "_bucket", start - lookback, end, sel)
+        merged: Dict[tuple, Dict[float, List[Tuple[float, float]]]] = {}
+        for key, pts in groups.items():
+            le = None
+            rest = []
+            for k, v in key:
+                if k == "le":
+                    le = math.inf if v in ("+Inf", "inf") else float(v)
+                else:
+                    rest.append((k, v))
+            if le is None:
+                continue
+            merged.setdefault(tuple(rest), {}).setdefault(le, []).extend(pts)
+        for key, by_le in sorted(merged.items()):
+            pts_out = []
+            for t in steps:
+                buckets = []
+                for le, pts in by_le.items():
+                    v = _instant(sorted(pts), t, lookback)
+                    if v is not None:
+                        buckets.append((le, v))
+                val = histogram_quantile(buckets, q) if buckets else None
+                pts_out.append([t, None if val is None or not math.isfinite(val)
+                                else val])
+            series_out.append({"labels": dict(key), "points": pts_out})
+        return {"expr": expr, "start": start, "end": end, "step": step,
+                "series": series_out}
+
+    lb = window if fn == "rate" else lookback
+    groups = store.series_points(name, start - lb, end, sel)
+    for key, pts in sorted(groups.items()):
+        pts_out = []
+        for t in steps:
+            if fn == "rate":
+                v = _rate(pts, t, window)
+            else:
+                v = _instant(pts, t, lookback)
+            pts_out.append([t, None if v is None or not math.isfinite(v) else v])
+        series_out.append({"labels": dict(key), "points": pts_out})
+    return {"expr": expr, "start": start, "end": end, "step": step,
+            "series": series_out}
+
+
+def make_query_route(store_fn: Callable[[], Optional[TimeSeriesStore]]):
+    """Build a TelemetryServer ``/query`` route over a store accessor.
+
+    ``GET /query?series=<expr>&start=&end=&step=`` evaluates a range
+    expression; ``GET /query?kind=spans|decisions|names|stats`` reads the
+    other record kinds (the dead-shard triage path). Label filters ride
+    as plain query params (e.g. ``&module=shard0``).
+    """
+    _reserved = {"series", "kind", "start", "end", "step", "limit", "q"}
+
+    def route(query):
+        # the exporter hands parse_qs dicts (list values) and expects a
+        # str body; plain-dict queries (unit tests) work too
+        q = {k: (v[0] if isinstance(v, list) else v) for k, v in query.items()}
+        store = store_fn()
+        if store is None:
+            return 404, "text/plain; charset=utf-8", "no time-series store configured\n"
+        now = time.time()
+        try:
+            start = float(q.get("start", now - 300.0))
+            end = float(q.get("end", now))
+            step = float(q.get("step", 10.0))
+            limit = int(q.get("limit", 256))
+        except ValueError:
+            return 400, "text/plain; charset=utf-8", "bad start/end/step/limit\n"
+        match = {k: v for k, v in q.items() if k not in _reserved}
+        kind = q.get("kind")
+        try:
+            if kind in ("spans", "decisions"):
+                rows = (store.spans if kind == "spans" else store.decisions)(
+                    start, end, match or None, limit)
+                body = {"kind": kind, "start": start, "end": end, "rows": rows}
+            elif kind == "names":
+                body = {"kind": "names", "names": store.series_names()}
+            elif kind == "stats":
+                body = {"kind": "stats", "stats": store.stats()}
+            elif q.get("series"):
+                body = eval_range(store, q["series"], start, end, step)
+            else:
+                return 400, "text/plain; charset=utf-8", \
+                    "need ?series=<expr> or ?kind=spans|decisions|names|stats\n"
+        except ValueError as e:
+            return 400, "text/plain; charset=utf-8", f"{e}\n"
+        return 200, "application/json", json.dumps(body, default=repr)
+
+    return route

@@ -183,6 +183,10 @@ class FleetShardProc:
         self.ctl_done_path = self.ctl_path + ".done"
         self.log_path = os.path.join(harness.workdir, f"shard{shard_id}.log")
         self.stats_path = os.path.join(harness.workdir, f"shard{shard_id}.stats.json")
+        # exporter-port discovery (metrics=True): the shard asks for an
+        # ephemeral port and writes the bound one here (ModuleRuntime's
+        # APM_METRICS_PORT_FILE seam) so the harness/recorder can scrape it
+        self.port_path = os.path.join(harness.workdir, f"shard{shard_id}.port")
         self.resume_path = os.path.join(
             harness.workdir, f"shard{shard_id}.engine.npz"
         )
@@ -201,6 +205,12 @@ class FleetShardProc:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    APM_SHARD_ID=str(self.shard_id))
         env.pop("PYTHONPATH", None)  # no TPU-relay sitecustomize in children
+        if h.metrics:
+            env["APM_METRICS_PORT_FILE"] = self.port_path
+            try:  # a stale port file must not alias a dead incarnation
+                os.unlink(self.port_path)
+            except OSError:
+                pass
         argv = [
             sys.executable, "-m", "apmbackend_tpu.parallel.fleet", "--shard",
             "--workdir", h.workdir,
@@ -220,6 +230,8 @@ class FleetShardProc:
             argv.append("--event-log")
         if h.metrics:
             argv.append("--metrics")
+        if h.fast_alerts:
+            argv.append("--fast-alerts")
         log_fh = open(self.log_path, "ab")
         self.proc = subprocess.Popen(
             argv, stdout=log_fh, stderr=log_fh, stdin=subprocess.DEVNULL,
@@ -283,7 +295,8 @@ class FleetHarness:
                  feed_delay_s: float = 0.05, checkpoint_mode: str = "delta",
                  compact_every: int = 0, partition_key: str = "service",
                  lags: str = "6", base_queue: str = "transactions",
-                 event_log: bool = False, metrics: bool = False):
+                 event_log: bool = False, metrics: bool = False,
+                 fast_alerts: bool = False):
         from ..transport.base import QueueManager
         from ..transport.spool import SpoolChannel
 
@@ -302,6 +315,7 @@ class FleetHarness:
         self.base_queue = base_queue
         self.event_log = event_log
         self.metrics = metrics
+        self.fast_alerts = fast_alerts
         self.done_path = os.path.join(self.workdir, "DONE.json")
         self._producer_channel = SpoolChannel(self.spool_dir)
         self._qm = QueueManager(lambda _d: self._producer_channel, 3600)
@@ -330,6 +344,33 @@ class FleetHarness:
 
     def kill9(self, k: int) -> None:
         self.procs[k].kill9()
+
+    # -- telemetry plumbing (metrics=True) -----------------------------------
+    def metrics_port(self, k: int, timeout_s: float = 15.0) -> int:
+        """Bound exporter port of shard ``k`` (ephemeral ports: the shard
+        writes it via the APM_METRICS_PORT_FILE seam once the exporter is
+        up). Raises TimeoutError if the shard never publishes one."""
+        path = self.procs[k].port_path
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    return int(fh.read().strip())
+            except (OSError, ValueError):
+                time.sleep(0.05)
+        raise TimeoutError(f"shard {k} never published its metrics port ({path})")
+
+    def metrics_url(self, k: int, timeout_s: float = 15.0) -> str:
+        return f"http://127.0.0.1:{self.metrics_port(k, timeout_s)}"
+
+    def metrics_targets(self, timeout_s: float = 15.0):
+        """``[(name, base_url)]`` for every shard — the FleetRecorder's
+        targets feed (dead shards keep their last known port; the recorder
+        counts the failed scrape and moves on)."""
+        return [
+            (f"shard{k}", self.metrics_url(k, timeout_s))
+            for k in sorted(self.procs)
+        ]
 
     # -- rebalance (the two-phase controller, shardmodel semantics) ----------
     def rebalance(self, p: int, frm: int, to: int,
@@ -468,6 +509,7 @@ def _shard_main(argv=None) -> int:
     ap.add_argument("--queue", default="transactions")
     ap.add_argument("--event-log", action="store_true")
     ap.add_argument("--metrics", action="store_true")
+    ap.add_argument("--fast-alerts", action="store_true")
     args = ap.parse_args(argv)
 
     from ..config import default_config
@@ -512,6 +554,17 @@ def _shard_main(argv=None) -> int:
     cfg["streamCalcStats"]["inQueue"] = args.queue
     cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = args.save_every_s
     cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    if args.fast_alerts:
+        # chaos/e2e harness mode: page within a couple of bad intervals
+        # instead of the production 45-of-60 gating, so a test can force a
+        # deterministic alert (and its decision record) with a short spike
+        al = cfg["streamProcessAlerts"]
+        al["rollingAlertWindowSizeInIntervals"] = 3
+        al["requiredNumberBadIntervalsInAlertWindowToTrigger"] = 2
+        al["alertOnBothOnly"] = False
+        al["perServiceAlertCooldownInMinutes"] = 0
+        al["hardMinMsAlertThreshold"] = 1
+        al["hardMinTpmAlertThreshold"] = 0
     cfg["logDir"] = None
 
     runtime = ModuleRuntime(

@@ -23,6 +23,7 @@ import signal
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, List, Optional
 
 from ..config import ConfigWatcher, default_config, load_config
@@ -121,6 +122,11 @@ class ModuleRuntime:
         # from a per-module exporter thread.
         self.telemetry = None
         self.flight = None
+        self.store = None
+        self.slo = None
+        self._span_seen: set = set()
+        self._span_order: deque = deque()
+        self._decision_seen_total = 0
         obs_cfg = self.config.get("observability", {})
         if bool(obs_cfg.get("enabled", True)):
             from ..obs.views import register_queue_stats
@@ -144,6 +150,15 @@ class ModuleRuntime:
                 )
                 self.telemetry.add_health("process", self._process_health)
                 self.telemetry.start()
+                # ephemeral-port discovery seam: a supervisor that asked for
+                # port 0 (fleet shards) learns the bound port from this file
+                port_file = os.environ.get("APM_METRICS_PORT_FILE")
+                if port_file:
+                    try:
+                        with open(port_file, "w") as fh:
+                            fh.write(f"{self.telemetry.port}\n")
+                    except OSError as e:
+                        self.logger.warning(f"metrics port file write failed: {e}")
             # distributed trace plane (obs/trace): configure the process
             # tracer in place — transport objects cache the reference, so
             # this is wiring-order independent. In single-process topologies
@@ -187,6 +202,74 @@ class ModuleRuntime:
                 )
                 if self.telemetry is not None:
                     self.telemetry.flight = self.flight
+            # durable telemetry spine (obs/store, DESIGN.md §8.4): a
+            # per-module store behind GET /query, fed by registry snapshots
+            # every selfSampleSeconds (plus new spans/decisions); the SLO
+            # engine evaluates burn rates over it and degrades /healthz
+            # to 503 while any objective fast-burns.
+            if self.telemetry is not None:
+                sample_s = float(obs_cfg.get("selfSampleSeconds", 2.0) or 0.0)
+                if sample_s > 0:
+                    from ..obs.store import TimeSeriesStore, make_query_route
+
+                    store_dir = obs_cfg.get("storeDir")
+                    self.store = TimeSeriesStore(
+                        str(store_dir) if store_dir else None,
+                        retention_s=float(obs_cfg.get("storeRetentionSeconds", 900.0)),
+                        logger=self.logger,
+                    )
+                    self.telemetry.add_route("/query", make_query_route(lambda: self.store))
+                    self.every(sample_s, self._self_sample, name="self-sample")
+                slo_cfg = self.config.get("slo", {})
+                if self.store is not None and bool(slo_cfg.get("enabled", True)):
+                    from ..obs.slo import SLOEngine
+
+                    self.slo = SLOEngine.from_config(
+                        self.store, self.config, logger=self.logger
+                    )
+                    self.telemetry.add_health("slo", self.slo.health)
+                    self.every(
+                        max(0.05, float(slo_cfg.get("evaluationIntervalSeconds", 10.0))),
+                        self.slo.evaluate,
+                        name="slo-eval",
+                    )
+                if self.flight is not None and self.store is not None:
+                    self.flight.add_source("store_tail", lambda: self.store.tail(32))
+                    if self.slo is not None:
+                        self.flight.add_source("slo", lambda: self.slo.status())
+
+    def _self_sample(self) -> None:
+        """Snapshot the process registry — plus spans/decisions not yet
+        persisted — into the per-module store (the /query data feed). Runs
+        on its own timer thread; dedup state is only touched here."""
+        from ..obs import get_registry
+        from ..obs.decisions import get_decisions
+        from ..obs.trace import get_tracer
+
+        store = self.store
+        if store is None:
+            return
+        now = time.time()
+        store.ingest_registry(get_registry(), ts=now)
+        fresh = []
+        for sp in get_tracer().ring.spans(n=256):
+            key = (sp.get("trace_id"), sp.get("name"), sp.get("start"))
+            if key in self._span_seen:
+                continue
+            self._span_seen.add(key)
+            self._span_order.append(key)
+            while len(self._span_order) > 4096:
+                self._span_seen.discard(self._span_order.popleft())
+            fresh.append(sp)
+        if fresh:
+            store.append_spans(fresh)
+        ring = get_decisions()
+        total = ring.total
+        new = total - self._decision_seen_total
+        if new > 0:
+            store.append_decisions(ring.recent(min(new, 512)))
+            self._decision_seen_total = total
+        store.compact(now)
 
     def _process_health(self) -> dict:
         """Baseline liveness every module reports: the process is serving,
@@ -300,6 +383,11 @@ class ModuleRuntime:
         for t in self._timers:
             if t is not me and t.is_alive():
                 t.join(timeout=5.0)
+        if self.store is not None:
+            try:  # timers are joined: no more appends race the close
+                self.store.close()
+            except Exception:
+                pass
         if self.flight is not None:
             # an orderly teardown is not a crash: consume the alive sentinel
             # so the next boot does not promote this run's journal

@@ -767,6 +767,19 @@ class WorkerApp:
                     delivery["epoch_stalled"] = True
                     out["ok"] = False
                 out["delivery"] = delivery
+        # per-queue lag (backlog the consumer still owes) for every intake
+        # queue whose transport can count it — the same numbers the
+        # apm_queue_lag gauge scrapes and the lag SLO burns against
+        lag = {}
+        for qname, cq in self.in_queues.items():
+            ch_lag = getattr(cq.channel, "queue_lag", None)
+            if ch_lag is not None:
+                try:
+                    lag[qname] = int(ch_lag(qname))
+                except Exception:
+                    pass
+        if lag:
+            out["queue_lag"] = lag
         if tracer is not None:
             out.update(tracer.summary())
         try:

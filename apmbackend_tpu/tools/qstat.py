@@ -9,6 +9,17 @@ Three sources:
 - AMQP backend: passively declare each configured queue to read its message
   count (needs broker reachability).
 - in-process memory broker: direct depth reads (standalone pipeline, tests).
+
+Two history modes over the durable telemetry spine (DESIGN.md §8.4), both
+broker-credential-free:
+
+- ``--range EXPR`` — evaluate a range query (``name``, ``rate(name[Ns])``,
+  ``histogram_quantile(q, name)``) against a live ``/query`` endpoint
+  (``--metrics-url``) or directly against a recorder store directory
+  (``--store``) — the latter works on a crashed fleet's leftover store.
+- ``--slo`` — evaluate the configured SLO objectives' multi-window burn
+  rates over a recorder store directory (``--store``), or show the live
+  engine's health section from ``/healthz`` (``--metrics-url``).
 """
 
 from __future__ import annotations
@@ -136,8 +147,124 @@ def format_metrics_rows(rows: List[Tuple[str, int, float, float, float, float, f
     return "\n".join(lines)
 
 
+def _query_base(url: str) -> str:
+    base = url.rstrip("/")
+    for suffix in ("/metrics", "/query", "/healthz"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    return base
+
+
+def range_query_url(url: str, expr: str, start: float, end: float,
+                    step: float, timeout_s: float = 5.0) -> dict:
+    """Evaluate ``expr`` against a live ``/query`` endpoint."""
+    import json
+    import urllib.parse
+    import urllib.request
+
+    qs = urllib.parse.urlencode(
+        {"series": expr, "start": f"{start:.3f}", "end": f"{end:.3f}",
+         "step": f"{step:g}"})
+    with urllib.request.urlopen(f"{_query_base(url)}/query?{qs}",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def range_query_store(store_dir: str, expr: str, start: float, end: float,
+                      step: float) -> dict:
+    """Evaluate ``expr`` directly over a recorder store directory — the
+    post-mortem path (works on a crashed fleet's leftover segments)."""
+    from ..obs.store import TimeSeriesStore, eval_range
+
+    store = TimeSeriesStore(store_dir)
+    try:
+        return eval_range(store, expr, start, end, step)
+    finally:
+        store.close()
+
+
+def format_range_result(doc: dict) -> str:
+    lines = [f"# {doc.get('expr')}  [{doc.get('start'):.0f} .. "
+             f"{doc.get('end'):.0f}] step {doc.get('step'):g}s"]
+    series = doc.get("series", [])
+    if not series:
+        lines.append("(no matching series)")
+    for s in series:
+        labels = ",".join(f'{k}="{v}"' for k, v in sorted((s.get("labels") or {}).items()))
+        pts = [(t, v) for t, v in (s.get("points") or []) if v is not None]
+        vals = [v for _t, v in pts]
+        head = f"{{{labels}}}" if labels else "{}"
+        if not vals:
+            lines.append(f"{head}  (no data in range)")
+            continue
+        lines.append(
+            f"{head}  points={len(vals)} last={vals[-1]:.6g} "
+            f"min={min(vals):.6g} max={max(vals):.6g}"
+        )
+        for t, v in pts:
+            lines.append(f"  {t:.3f}  {v:.6g}")
+    return "\n".join(lines)
+
+
+def slo_store_eval(store_dir: str, config: dict, at=None) -> List[dict]:
+    """Run the configured SLO objectives' burn-rate evaluation over a
+    recorder store directory. Defaults ``at`` to the newest sample in the
+    store so a crashed fleet's historic windows evaluate, not empty
+    wall-clock-now ones."""
+    from ..obs.slo import SLOEngine
+    from ..obs.store import TimeSeriesStore
+
+    store = TimeSeriesStore(store_dir)
+    try:
+        engine = SLOEngine.from_config(store, config, on_alert=lambda _m, _r: None)
+        if at is None:
+            at = store.stats().get("newest_ts")
+        if at is None:
+            return []
+        return engine.evaluate(float(at))
+    finally:
+        store.close()
+
+
+def format_slo_rows(results: List[dict]) -> str:
+    if not results:
+        return "(no SLO input series in store — is this a recorder directory?)"
+    lines = [
+        f"{'objective':<26} {'key':<16} {'burn short':>11} {'burn long':>11} "
+        f"{'bad% short':>11} {'bad% long':>11} {'severity':>9}"
+    ]
+    for r in results:
+        win = r.get("windows", {})
+        bf_s = (win.get("short") or {}).get("bad_fraction")
+        bf_l = (win.get("long") or {}).get("bad_fraction")
+        lines.append(
+            f"{r.get('objective', '?'):<26} {str(r.get('key') or '-'):<16} "
+            f"{r.get('burn_short', 0.0):>11.2f} {r.get('burn_long', 0.0):>11.2f} "
+            f"{(bf_s or 0.0) * 100.0:>10.2f}% {(bf_l or 0.0) * 100.0:>10.2f}% "
+            f"{r.get('severity') or '-':>9}"
+        )
+    return "\n".join(lines)
+
+
+def slo_health_url(url: str, timeout_s: float = 5.0) -> dict:
+    """Fetch a live module's ``/healthz`` and return its ``slo`` section
+    (the engine's health view; a 503 still carries the body)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(f"{_query_base(url)}/healthz",
+                                    timeout=timeout_s) as resp:
+            body = json.loads(resp.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as e:  # 503 = fast-burn; body is the answer
+        body = json.loads(e.read().decode("utf-8", "replace"))
+    return {"status": body.get("status"), "slo": body.get("slo")}
+
+
 def main(argv=None) -> int:
     import os
+    import time
 
     from ..config import default_config, load_config
     from ..runtime.module_base import CONFIG_ENV_VAR
@@ -149,7 +276,75 @@ def main(argv=None) -> int:
         help="scrape a telemetry exporter (http://host:port[/metrics]) instead "
         "of talking to a broker — no credentials needed",
     )
+    ap.add_argument(
+        "--store",
+        help="recorder store directory (observability.recorderDir) — offline "
+        "source for --range/--slo; works on a crashed fleet's leftovers",
+    )
+    ap.add_argument(
+        "--range", dest="range_expr", metavar="EXPR",
+        help="evaluate a range query (name, rate(name[Ns]), "
+        "histogram_quantile(q, name)) via --metrics-url /query or --store",
+    )
+    ap.add_argument("--start", type=float,
+                    help="range start unix ts (default: end - 900)")
+    ap.add_argument("--end", type=float,
+                    help="range end unix ts (default: now, or the newest "
+                    "stored sample with --store)")
+    ap.add_argument("--step", type=float, default=15.0,
+                    help="range step seconds (default 15)")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate SLO burn rates over --store, or show a "
+                    "live engine's /healthz slo section via --metrics-url")
+    ap.add_argument("--at", type=float,
+                    help="--slo evaluation instant (default: newest stored "
+                    "sample)")
     args = ap.parse_args(argv)
+    config = load_config(args.config) if args.config else default_config()
+    if args.range_expr:
+        try:
+            if args.store:
+                end = args.end
+                if end is None:
+                    from ..obs.store import TimeSeriesStore
+
+                    probe = TimeSeriesStore(args.store)
+                    try:
+                        end = probe.stats().get("newest_ts") or time.time()
+                    finally:
+                        probe.close()
+                start = args.start if args.start is not None else end - 900.0
+                doc = range_query_store(args.store, args.range_expr, start,
+                                        end, args.step)
+            elif args.metrics_url:
+                end = args.end if args.end is not None else time.time()
+                start = args.start if args.start is not None else end - 900.0
+                doc = range_query_url(args.metrics_url, args.range_expr,
+                                      start, end, args.step)
+            else:
+                print("--range needs --metrics-url or --store", file=sys.stderr)
+                return 2
+        except (OSError, ValueError) as e:
+            print(f"range query failed: {e}", file=sys.stderr)
+            return 1
+        print(format_range_result(doc))
+        return 0
+    if args.slo:
+        try:
+            if args.store:
+                print(format_slo_rows(slo_store_eval(args.store, config,
+                                                     at=args.at)))
+            elif args.metrics_url:
+                import json
+
+                print(json.dumps(slo_health_url(args.metrics_url), indent=1))
+            else:
+                print("--slo needs --store or --metrics-url", file=sys.stderr)
+                return 2
+        except OSError as e:
+            print(f"slo evaluation failed: {e}", file=sys.stderr)
+            return 1
+        return 0
     if args.metrics_url:
         try:
             print(format_metrics_rows(metrics_url_stats(args.metrics_url)))
@@ -157,7 +352,6 @@ def main(argv=None) -> int:
             print(f"could not scrape {args.metrics_url}: {e}", file=sys.stderr)
             return 1
         return 0
-    config = load_config(args.config) if args.config else default_config()
     if config.get("brokerBackend") == "amqp":
         rows = amqp_stats(config.get("amqpConnectionString", "amqp://localhost:5672"),
                           known_queue_names(config))

@@ -275,6 +275,18 @@ class ConsumerQueue(EventEmitter):
             "Transport latency: producer ingest stamp -> consumer delivery",
             labels={"queue": queue_name},
         )
+        # per-queue lag accounting (the SLO engine's queue_lag objective):
+        # backends that can count undelivered+unacked work expose queue_lag()
+        # and the gauge samples it at scrape time — uniform across the memory
+        # broker and the durable spool (which has no depth gauge otherwise)
+        ch_lag = getattr(channel, "queue_lag", None)
+        if ch_lag is not None:
+            get_registry().gauge(
+                "apm_queue_lag",
+                "Messages accepted but not yet acked for this queue "
+                "(backlog the consumer still owes)",
+                labels={"queue": queue_name},
+            ).set_fn(lambda: float(ch_lag(queue_name)))
         channel.assert_queue(queue_name)
 
     def _observe_delivery(self, headers: dict) -> None:

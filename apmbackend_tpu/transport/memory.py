@@ -235,6 +235,12 @@ class MemoryChannel(Channel):
     def on_drain(self, callback) -> None:
         self.broker.on_drain(callback)
 
+    def queue_lag(self, name: str) -> int:
+        """Waiting depth plus unacked in-flight deliveries — the backlog the
+        consumer still owes. Scrape-time view for the ``apm_queue_lag``
+        gauge (the per-queue lag SLO input), uniform with the spool's."""
+        return self.broker.queue_depth(name) + self.broker.unacked_count(name)
+
     def close(self) -> None:
         # redelivery-on-close: a closing consumer channel abandons its
         # unacked deliveries back to the queues (RabbitMQ connection-death

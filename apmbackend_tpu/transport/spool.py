@@ -267,6 +267,22 @@ class SpoolChannel(Channel):
             q = self._queues.get(name)
             return q.next_deliver if q else 0
 
+    def queue_lag(self, name: str) -> int:
+        """Records persisted to the spool but not yet acked by this consumer
+        — the backlog it still owes. Scrape-time view for the
+        ``apm_queue_lag`` gauge (the per-queue lag SLO input); polls so a
+        producer-only burst shows up without waiting for a delivery."""
+        with self._lock:
+            q = self._queues.get(name)
+            if q is None:
+                # observer path: a fresh channel over an existing spool dir
+                # (the manager probing a dead consumer's backlog) gets the
+                # same disk-backed view — cursor and records read from disk
+                q = self._queues[name] = _SpoolQueue(
+                    self.directory, name, fsync=self.fsync)
+            q.poll()
+            return max(0, len(q.records) - q.acked_upto)
+
     def start_pump_thread(self, poll_s: float = 0.005) -> None:
         if self._pump_thread is not None:
             return

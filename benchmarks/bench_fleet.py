@@ -97,8 +97,22 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
                 )
             time.sleep(0.02)
 
+    recorder = None
+    rec_store = None
     try:
         h.start_all()
+        # -- fleet recorder: persist every shard's /metrics + /trace +
+        # /decisions into the on-disk store for the SLO compliance row
+        # (ISSUE 12) — same spine the manager runs in production
+        import os as _os
+
+        from apmbackend_tpu.config import default_config as _default_config
+        from apmbackend_tpu.obs import FleetRecorder, SLOEngine, TimeSeriesStore
+
+        rec_store = TimeSeriesStore(_os.path.join(workdir, "recorder"))
+        recorder = FleetRecorder(rec_store, h.metrics_targets,
+                                 interval_s=0.5, self_module="bench")
+        recorder.start()
         # -- warmup: register the whole service population, rotate every
         # rebuild chunk program, drain (compiles land OUTSIDE the window)
         for i in range(services):
@@ -125,6 +139,33 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
         for t in range(drill_t0 + 1, drill_t0 + drill_labels):
             send_label(t, per_label)
         wait_drained(0)
+        # final scrape while every shard is still alive, then the SLO
+        # burn-rate evaluation over what the recorder persisted
+        recorder.scrape_once()
+        recorder.stop()
+        rec_counts = recorder.status().get("counts", {})
+        slo_engine = SLOEngine.from_config(rec_store, _default_config(),
+                                           on_alert=lambda _m, _r: None)
+        newest = rec_store.stats().get("newest_ts") or time.time()
+        slo_results = slo_engine.evaluate(float(newest))
+        fast = sorted({f"{r['objective']}:{r['key']}" if r.get("key")
+                       else r["objective"]
+                       for r in slo_results if r.get("severity") == "fast"})
+        slow = sorted({f"{r['objective']}:{r['key']}" if r.get("key")
+                       else r["objective"]
+                       for r in slo_results if r.get("severity") == "slow"})
+        slo_cert = {
+            "objectives_evaluated": len(slo_results),
+            "fast_burning": fast,
+            "slow_burning": slow,
+            "compliant": not fast,
+            "recorder_scrapes": rec_counts.get("scrapes_total", 0),
+            "recorder_rows": rec_counts.get("rows_total", 0),
+            "recorder_scrape_errors": rec_counts.get("scrape_errors_total", 0),
+            "store": {k: rec_store.stats().get(k)
+                      for k in ("segments", "bytes", "dropped_rows_total",
+                                "write_errors_total")},
+        }
         stats = h.finish()
 
         # -- accounting ----------------------------------------------------
@@ -237,9 +278,17 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
                 "measured_wall_s": round(wall, 3),
                 "per_shard": per_shard,
                 "rebalance": rebalance_cert,
+                # ISSUE 12: multi-window burn-rate compliance over what the
+                # fleet recorder persisted DURING the bench (every shard's
+                # /metrics + /trace + /decisions, shard-labeled)
+                "slo": slo_cert,
             },
         )
     finally:
+        if recorder is not None:
+            recorder.stop()
+        if rec_store is not None:
+            rec_store.close()
         h.close()
         if owned:
             shutil.rmtree(workdir, ignore_errors=True)

@@ -481,6 +481,117 @@ def _measure_tracing(quick: bool) -> dict:
     }
 
 
+def _measure_recorder(quick: bool) -> dict:
+    """ISSUE 12 acceptance: fleet recorder ON vs OFF.
+
+    The same transport->driver loop twice — recorder OFF (no exporter, the
+    bare wire) vs ON with a live exporter and a FleetRecorder persisting
+    /metrics + /trace + /decisions into an on-disk TimeSeriesStore at 2 Hz
+    throughout. The recorder runs out-of-band (scrape thread + append-mode
+    journal), so the hot path should not feel it: the delta must stay
+    under 2%."""
+    import shutil
+    import tempfile
+
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.entries import EntryFactory
+    from apmbackend_tpu.obs import FleetRecorder, TelemetryServer, TimeSeriesStore
+    from apmbackend_tpu.pipeline import PipelineDriver
+    from apmbackend_tpu.transport.base import QueueManager
+    from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+    ticks = 8 if quick else 48
+    per_tick = 128
+    cfg = default_config()
+    cfg["tpuEngine"]["serviceCapacity"] = 128
+    cfg["tpuEngine"]["samplesPerBucket"] = 64
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 360, "THRESHOLD": 20.0, "INFLUENCE": 0.1}
+    ]
+    base = 170_300_000
+    rng = np.random.RandomState(7)
+    stream = []
+    for t in range(ticks + 2):
+        for i in range(per_tick):
+            e = int(rng.randint(50, 900))
+            stream.append(
+                f"tx|jvm{i % 4}|svc{i % 100:03d}|t{t}-{i}|1|{(base + t) * 10000 - e}|"
+                f"{(base + t) * 10000 + i}|{e}|Y"
+            )
+
+    def one(record: bool) -> tuple:
+        server = None
+        recorder = None
+        store = None
+        store_dir = None
+        rows = 0
+        scrapes = 0
+        try:
+            drv = PipelineDriver(cfg, capacity=128)
+            fac = EntryFactory()
+            broker = MemoryBroker()
+            prod = QueueManager(lambda d: MemoryChannel(broker), 3600).get_queue(
+                "transactions", "p"
+            )
+            qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+
+            def cb(line):
+                drv.feed(fac.from_csv(line))
+
+            qm_c.get_queue("transactions", "c", cb).start_consume()
+
+            if record:
+                server = TelemetryServer(port=0, module="bench_recorder")
+                server.start()
+                store_dir = tempfile.mkdtemp(prefix="bench_recorder_")
+                store = TimeSeriesStore(store_dir)
+                recorder = FleetRecorder(
+                    store,
+                    lambda: [("bench", server.url)],
+                    interval_s=0.5,
+                    self_module="bench",
+                )
+                recorder.start()
+
+            for line in stream[: 2 * per_tick]:
+                prod.write_line(line)
+            broker.pump()
+            t0 = time.perf_counter()
+            for t in range(ticks):
+                lo = (t + 2) * per_tick
+                for line in stream[lo : lo + per_tick]:
+                    prod.write_line(line)
+                broker.pump()
+            drv.flush()
+            wall = time.perf_counter() - t0
+            if recorder is not None:
+                counts = recorder.status().get("counts", {})
+                rows = counts.get("rows_total", 0)
+                scrapes = counts.get("scrapes_total", 0)
+            return ticks * per_tick / wall, rows, scrapes
+        finally:
+            if recorder is not None:
+                recorder.stop()
+            if store is not None:
+                store.close()
+            if server is not None:
+                server.stop()
+            if store_dir is not None:
+                shutil.rmtree(store_dir, ignore_errors=True)
+
+    off, _, _ = one(False)
+    on, n_rows, n_scrapes = one(True)
+    return {
+        "lines_per_s_off": round(off, 1),
+        "lines_per_s_on": round(on, 1),
+        "overhead_pct": round((off - on) / off * 100.0, 2),
+        "rows_persisted_during_run": n_rows,
+        "scrapes_during_run": n_scrapes,
+        "ticks": ticks,
+        "tx_per_tick": per_tick,
+    }
+
+
 def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tick: int = 4096) -> dict:
     import jax
 
@@ -493,6 +604,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
     overhead_pct = (bare["throughput"] - teleme["throughput"]) / bare["throughput"] * 100.0
     delivery = _measure_delivery(quick)
     tracing = _measure_tracing(quick)
+    recorder = _measure_recorder(quick)
 
     tick, sched, lat, rebuilds = bare["tick"], bare["sched"], bare["lat"], bare["rebuilds"]
     return result(
@@ -531,5 +643,8 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
             # ISSUE 5 acceptance: distributed trace plane at default 1/64
             # head sampling (+ live /trace scraper) vs sampling OFF
             "tracing": tracing,
+            # ISSUE 12 acceptance: fleet recorder persisting /metrics +
+            # /trace + /decisions to the on-disk store at 2 Hz vs bare loop
+            "recorder": recorder,
         },
     )

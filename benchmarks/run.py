@@ -81,6 +81,12 @@ def main(argv=None) -> int:
         with open(out, "w", encoding="utf-8") as fh:
             fh.write(line + "\n")
         d = res.get("details", {})
+        slo = d.get("slo", {})
+        print(f"slo: compliant={slo.get('compliant')} "
+              f"fast={slo.get('fast_burning')} slow={slo.get('slow_burning')} "
+              f"({slo.get('recorder_rows')} rows recorded over "
+              f"{slo.get('recorder_scrapes')} scrapes)",
+              file=sys.stderr, flush=True)
         ok = bool(d.get("meets_1m_aggregate")) and bool(d.get("meets_100ms_budget")) \
             and bool(d.get("rebalance", {}).get("zero_loss")) \
             and bool(d.get("rebalance", {}).get("conformance_clean"))

@@ -177,3 +177,193 @@ def test_rebalance_then_kill9_adopter_recovers_ownership(tmp_path):
         assert check_fleet_trace(h.merged_events()) == []
     finally:
         h.close()
+
+
+# -- ISSUE 12: the durable telemetry spine under shard loss --------------------
+
+
+def _fetch(url, timeout=10):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, _json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read().decode("utf-8"))
+
+
+def test_recorder_survives_shard_kill9_and_slo_burn_alert(tmp_path):
+    """ISSUE 12 acceptance, end to end: fleet recorder on, kill -9 one
+    shard mid-stream. (a) the DEAD shard's pre-crash metric series, trace
+    spans, and alert decisions are still queryable through the manager-side
+    ``/query`` endpoint (its telemetry outlives the process); (b) the
+    sustained queue-lag breach the dead consumer leaves behind raises a
+    multi-window fast-burn SLO alert whose decision record resolves the
+    inputs; (c) the manager ``/healthz`` degrades to 503; (d) the recorder
+    degrades on the dead target (counts errors, keeps scraping the rest)."""
+    import json
+
+    from apmbackend_tpu.config import default_config
+    from apmbackend_tpu.obs import (
+        FleetRecorder,
+        MetricsRegistry,
+        SLOEngine,
+        TelemetryServer,
+        TimeSeriesStore,
+        make_query_route,
+    )
+    from apmbackend_tpu.obs.decisions import DecisionRing
+    from apmbackend_tpu.obs.trace import Tracer, set_tracer
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    # head-sample EVERY produced line: trace ids are stamped producer-side,
+    # and the producer queue resolves the process tracer at creation — so
+    # install it BEFORE the harness builds its partitioner
+    old_tracer = set_tracer(Tracer(module="producer", sample_rate=1))
+    h = _fleet(tmp_path, metrics=True, fast_alerts=True)
+    store = TimeSeriesStore(str(tmp_path / "recorder-store"))
+    ring = DecisionRing()
+    paged = []
+
+    # services pinned to the victim shard's partition (p1)
+    victims = [f"svc{i:03d}" for i in range(64)
+               if service_partition(f"svc{i:03d}", 2) == 1][:3]
+    assert len(victims) == 3
+    sent_p1 = 0
+
+    def send_victims(t, elapsed):
+        nonlocal sent_p1
+        for seq, svc in enumerate(victims):
+            # jittered baseline: a zero-variance window never emits a z
+            # signal, so give the detector a real (small) std to band around
+            e = elapsed + (t * 7 + seq * 13) % 30
+            h.send_line(
+                f"tx|jvm1|{svc}|e{t}-{seq}|1|{(BASE + t) * 10000 - e}|"
+                f"{(BASE + t) * 10000 + seq}|{e}|Y"
+            )
+            sent_p1 += 1
+
+    # dead-consumer lag probe: a FRESH spool view per scrape reads the
+    # victim partition's backlog (records minus acked cursor) off disk —
+    # it keeps reporting after the consumer is SIGKILLed
+    def p1_lag():
+        ch = SpoolChannel(str(h.spool_dir))
+        try:
+            return float(ch.queue_lag("transactions.p1"))
+        finally:
+            ch.close()
+
+    probe_reg = MetricsRegistry()
+    probe_reg.gauge(
+        "apm_queue_lag", "victim partition backlog (observer view)",
+        labels={"queue": "transactions.p1"},
+    ).set_fn(p1_lag)
+    probe = TelemetryServer(probe_reg, port=0, module="lagprobe")
+    probe.start()
+
+    # tight SLO windows so the breach certifies in seconds, not hours
+    cfg = default_config()
+    cfg["slo"]["shortWindowSeconds"] = 3.0
+    cfg["slo"]["longWindowSeconds"] = 10.0
+    cfg["slo"]["alertCooldownSeconds"] = 0.0
+    cfg["slo"]["objectives"] = [
+        {"name": "queue_lag", "kind": "gauge", "series": "apm_queue_lag",
+         "threshold": 10.0, "target": 0.99, "per": "queue"},
+    ]
+    eng = SLOEngine.from_config(store, cfg, decisions=ring,
+                                on_alert=lambda m, r: paged.append(m))
+    qsrv = TelemetryServer(MetricsRegistry(), port=0, module="mgr")
+    qsrv.add_route("/query", make_query_route(lambda: store))
+    qsrv.add_health("slo", eng.health)
+    qsrv.start()
+
+    rec = None
+    try:
+        h.start_all()
+        rec = FleetRecorder(
+            store,
+            lambda: h.metrics_targets(timeout_s=30.0)
+            + [("lagprobe", probe.url)],
+            interval_s=0.25, self_module="mgr",
+        )
+        rec.start()
+
+        # baseline ticks, then a sustained spike: with --fast-alerts the
+        # victim shard pages on the 2nd bad interval and records the alert
+        # decisions the recorder must preserve past the crash
+        for t in range(12):
+            send_victims(t, 100)
+        send_victims(12, 30000)
+        send_victims(13, 30000)
+        # the stats stream holds bufferSizeInIntervals=6 buckets open behind
+        # the watermark: trailing labels flush the spike buckets into ticks
+        for t in range(14, 22):
+            send_victims(t, 100)
+        h.wait_acked(1, sent_p1, timeout_s=120)
+        time.sleep(0.6)  # at least one full scrape cadence post-drain
+        rec.scrape_once()  # deterministic pre-crash snapshot
+        errors_before = rec.status()["counts"]["scrape_errors_total"]
+
+        # -- kill -9 the victim mid-stream; its backlog starts growing ----
+        h.kill9(1)
+        for t in range(14, 34):
+            send_victims(t, 100)  # 60 lines nobody will ack
+        time.sleep(4.0)  # breach spans the whole short window + scrapes
+
+        # (d) recorder degrades drop-and-count on the dead target
+        counts = rec.status()["counts"]
+        assert counts["scrape_errors_total"] > errors_before
+        assert counts["scrapes_total"] > 0
+
+        # (a) the dead shard's pre-crash telemetry is queryable via /query
+        now = time.time()
+        status, doc = _fetch(
+            f"{qsrv.url}/query?series=apm_engine_tx_ingested_total"
+            f"&start={now - 600:.0f}&end={now:.0f}&step=10&module=shard1")
+        assert status == 200
+        assert doc["series"], "dead shard's metric series must survive"
+        assert any(v is not None and v > 0
+                   for s in doc["series"] for _, v in s["points"])
+        status, doc = _fetch(
+            f"{qsrv.url}/query?kind=spans&start=0&module=shard1&limit=64")
+        assert status == 200 and len(doc["rows"]) >= 1
+        status, doc = _fetch(
+            f"{qsrv.url}/query?kind=decisions&start=0&module=shard1")
+        assert status == 200
+        assert len(doc["rows"]) >= 1, "pre-crash alert decision must survive"
+        assert any(d.get("service") in victims for d in doc["rows"])
+
+        # (b) sustained queue-lag breach -> multi-window fast burn + page
+        res = eng.evaluate(time.time())
+        lag = [r for r in res if r["objective"] == "queue_lag"
+               and r.get("key") == "transactions.p1"]
+        assert lag, f"queue_lag objective missing from {res!r}"
+        assert lag[0]["severity"] == "fast"
+        assert lag[0]["burn_short"] >= 14.4 and lag[0]["burn_long"] >= 14.4
+        assert paged, "fast burn must dispatch an alert"
+        stored = [d for d in ring.recent()
+                  if d.get("decision") == "slo_burn_rate"]
+        assert stored
+        d = stored[-1]
+        assert d["series"] == "apm_queue_lag"
+        assert d["key"] == "transactions.p1"
+        assert d["threshold"] == 10.0 and d["target"] == 0.99
+        for w in ("short", "long"):
+            assert d["windows"][w]["events"] > 0
+            assert d["windows"][w]["bad_fraction"] >= 0.144
+
+        # (c) the manager healthz degrades to 503 while fast-burning
+        status, doc = _fetch(f"{qsrv.url}/healthz")
+        assert status == 503
+        assert "queue_lag:transactions.p1" in doc["slo"]["fast_burning"]
+        assert json.loads(json.dumps(doc))  # body is real JSON end to end
+    finally:
+        if rec is not None:
+            rec.stop()
+        probe.stop()
+        qsrv.stop()
+        store.close()
+        h.close()
+        set_tracer(old_tracer)

@@ -1,0 +1,572 @@
+"""Durable telemetry spine (DESIGN.md §8.4): the on-disk time-series
+store's framing/recovery/degradation, range-query evaluation, the fleet
+recorder against a live exporter, the SLO burn-rate engine (provenance +
+healthz 503), the transport queue-lag gauge, and the qstat --range/--slo
+modes. Hostile storage reuses the deltachain ``APM_CHAOS_FS`` seam."""
+
+import json
+import math
+import os
+import time
+import urllib.error
+import urllib.request
+from urllib.parse import urlencode
+
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.deltachain import StorageFaultPlan, install_fault_plan
+from apmbackend_tpu.obs import (
+    FleetRecorder,
+    MetricsRegistry,
+    SLOEngine,
+    TelemetryServer,
+    TimeSeriesStore,
+    eval_range,
+    make_query_route,
+    set_registry,
+)
+from apmbackend_tpu.obs.decisions import DecisionRing
+from apmbackend_tpu.obs.store import SEGMENT_GLOB_RE
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(MetricsRegistry())
+    yield
+    set_registry(old)
+
+
+@pytest.fixture(autouse=True)
+def no_fault_plan():
+    install_fault_plan(None)
+    yield
+    install_fault_plan(None)
+
+
+def _fill(store, n=10, t0=1000.0, dt=10.0, name="apm_x_total", q="db"):
+    for i in range(n):
+        store.append_samples(
+            [[name, {"queue": q}, float(i)]], ts=t0 + i * dt
+        )
+
+
+def _segs(d):
+    return sorted(f for f in os.listdir(d) if SEGMENT_GLOB_RE.match(f))
+
+
+# -- store: framing, recovery, degradation -----------------------------------
+
+def test_store_round_trip_and_recovery(tmp_path):
+    d = str(tmp_path)
+    st = TimeSeriesStore(d)
+    _fill(st, n=12)
+    st.append_spans([{"trace_id": "t-1", "name": "tick", "start": 1050.0,
+                      "end": 1050.1}], extra={"module": "w0"})
+    st.append_decisions([{"trace_id": "t-1", "ts": 1051.0, "service": "s",
+                          "channel": 6}], extra={"module": "w0"})
+    st.close()
+
+    st2 = TimeSeriesStore(d)
+    pts = st2.series_points("apm_x_total", 0, 2000)
+    assert len(pts) == 1
+    (_key, series), = pts.items()
+    assert [v for _, v in series] == [float(i) for i in range(12)]
+    spans = st2.spans(0, math.inf)
+    assert spans and spans[0]["trace_id"] == "t-1"
+    assert spans[0]["module"] == "w0"
+    decs = st2.decisions(0, math.inf, match={"module": "w0"})
+    assert decs and decs[0]["channel"] == 6
+    assert st2.stats()["recovered_rows"] > 0
+    st2.close()
+
+
+def test_store_torn_tail_truncates_not_fails(tmp_path):
+    d = str(tmp_path)
+    st = TimeSeriesStore(d)
+    _fill(st, n=8)
+    st.close()
+    seg = os.path.join(d, _segs(d)[-1])
+    sz = os.path.getsize(seg)
+    with open(seg, "r+b") as fh:  # torn final record: chop mid-frame
+        fh.truncate(sz - 7)
+    st2 = TimeSeriesStore(d)
+    (_k, series), = st2.series_points("apm_x_total", 0, 2000).items()
+    assert 0 < len(series) < 8  # prefix survives, tail gone
+    assert st2.stats()["corrupt_segments_total"] == 1
+    # the store stays writable after recovering a torn segment
+    st2.append_samples([["apm_x_total", {"queue": "db"}, 99.0]], ts=2000.0)
+    st2.close()
+
+
+def test_store_bit_rot_stops_at_last_valid_segment(tmp_path):
+    d = str(tmp_path)
+    st = TimeSeriesStore(d, segment_max_bytes=256)  # force several segments
+    _fill(st, n=30)
+    st.close()
+    segs = _segs(d)
+    assert len(segs) >= 3
+    # flip a payload byte in a MIDDLE segment: CRC must catch it and
+    # recovery must stop there — later segments stay unread (prefix
+    # semantics, same discipline as the delta chain)
+    victim = os.path.join(d, segs[len(segs) // 2])
+    with open(victim, "r+b") as fh:
+        fh.seek(os.path.getsize(victim) - 3)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_CUR)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    st2 = TimeSeriesStore(d)
+    (_k, series), = st2.series_points("apm_x_total", 0, 5000).items()
+    full = TimeSeriesStore(str(tmp_path / "nothing"))
+    assert len(series) < 30
+    assert st2.stats()["corrupt_segments_total"] >= 1
+    # new appends land on a FRESH sequence number (no collision with the
+    # unread tail)
+    st2.append_samples([["apm_x_total", {"queue": "db"}, 123.0]], ts=9000.0)
+    st2.close()
+    full.close()
+    st3 = TimeSeriesStore(d)
+    (_k, series3), = st3.series_points("apm_x_total", 8000, 10000).items()
+    assert [v for _, v in series3] == [123.0]
+    st3.close()
+
+
+def test_store_enospc_degrades_drop_and_count(tmp_path):
+    st = TimeSeriesStore(str(tmp_path), reopen_backoff_s=0.0)
+    st.append_samples([["apm_x_total", {}, 1.0]], ts=100.0)
+    # after=0,count=1: the NEXT segment write tears (partial bytes hit the
+    # file, then ENOSPC) — the deltachain chaos seam, byte-identical plan
+    install_fault_plan(StorageFaultPlan("enospc:after=0,count=1"))
+    st.append_samples([["apm_x_total", {}, 2.0]], ts=110.0)  # torn + ENOSPC
+    install_fault_plan(None)
+    stats = st.stats()
+    assert stats["write_errors_total"] == 1
+    assert stats["dropped_rows_total"] == 1
+    # degrade, don't lose the live view: BOTH rows stay queryable
+    (_k, series), = st.series_points("apm_x_total", 0, 200).items()
+    assert [v for _, v in series] == [1.0, 2.0]
+    # and the writer recovers onto a fresh segment afterwards
+    st.append_samples([["apm_x_total", {}, 3.0]], ts=120.0)
+    assert st.stats()["write_errors_total"] == 1
+    st.close()
+    st2 = TimeSeriesStore(str(tmp_path))
+    (_k, series2), = st2.series_points("apm_x_total", 0, 200).items()
+    assert 3.0 in [v for _, v in series2]
+    st2.close()
+
+
+def test_store_retention_and_downsample(tmp_path):
+    now = 100000.0
+    d = str(tmp_path)
+
+    def _open():
+        return TimeSeriesStore(d, retention_s=500.0,
+                               downsample_after_s=100.0,
+                               downsample_step_s=60.0)
+
+    # segment boundaries via close/reopen (recovered segments are sealed):
+    # retention and downsample both operate on whole sealed segments
+    st = _open()
+    # aged beyond retention: whole segment unlinked
+    st.append_samples([["apm_old", {}, 1.0]], ts=now - 1000.0)
+    st.close()
+    st = _open()
+    # old enough to downsample, young enough to keep: 6 points in one
+    # 60 s bucket collapse to the LAST value
+    for i in range(6):
+        st.append_samples([["apm_mid", {}, float(i)]], ts=now - 300.0 + i)
+    st.append_spans([{"trace_id": "t", "name": "n", "start": now - 290.0,
+                      "end": now - 289.0}])
+    st.close()
+    st = _open()
+    st.append_samples([["apm_new", {}, 7.0]], ts=now - 5.0)
+    st.compact(now)
+    assert st.series_points("apm_old", 0, now) == {}
+    (_k, mid), = st.series_points("apm_mid", 0, now).items()
+    assert [v for _, v in mid] == [5.0]  # last value per bucket
+    assert st.spans(0, now)  # spans ride through compaction raw
+    (_k, new), = st.series_points("apm_new", 0, now).items()
+    assert [v for _, v in new] == [7.0]
+    stats = st.stats()
+    assert stats["retention_drops_total"] >= 1
+    assert stats["compactions_total"] >= 1
+    st.close()
+    # the rewrite is durable: reopen sees the downsampled shape
+    st2 = TimeSeriesStore(str(tmp_path))
+    (_k, mid2), = st2.series_points("apm_mid", 0, now).items()
+    assert [v for _, v in mid2] == [5.0]
+    st2.close()
+
+
+# -- range-query evaluation ---------------------------------------------------
+
+def test_eval_range_instant_rate_and_quantile(tmp_path):
+    st = TimeSeriesStore(None)  # volatile store, identical query surface
+    for i in range(20):
+        t = 1000.0 + i * 5.0
+        st.append_samples([["apm_c_total", {"m": "a"}, float(i * 10)]], ts=t)
+        # synthetic cumulative histogram: 90% under 0.1s, all under 0.25s
+        st.append_samples(
+            [["apm_lat_seconds_bucket", {"le": "0.1"}, float(i * 9)],
+             ["apm_lat_seconds_bucket", {"le": "0.25"}, float(i * 10)],
+             ["apm_lat_seconds_bucket", {"le": "+Inf"}, float(i * 10)]], ts=t)
+    doc = eval_range(st, "apm_c_total", 1000.0, 1095.0, 5.0)
+    (s,) = doc["series"]
+    assert s["labels"] == {"m": "a"}
+    assert s["points"][-1][1] == 190.0
+    doc = eval_range(st, "rate(apm_c_total[20s])", 1050.0, 1095.0, 5.0)
+    vals = {v for _, v in doc["series"][0]["points"] if v is not None}
+    assert vals == {2.0}  # +10 every 5s
+    doc = eval_range(st, "histogram_quantile(0.95, apm_lat_seconds)",
+                     1050.0, 1095.0, 5.0)
+    (s,) = doc["series"]
+    qv = [v for _, v in s["points"] if v is not None]
+    # rank 9.5i lands in the (0.1, 0.25] bucket; prometheus-style linear
+    # interpolation puts p95 halfway through it
+    assert qv and all(v == pytest.approx(0.175) for v in qv)
+    with pytest.raises(ValueError):
+        eval_range(st, "not a query(", 0, 1, 1)
+    with pytest.raises(ValueError):
+        # step-count cap: epoch-wide range at 1 s step must refuse, not spin
+        eval_range(st, "apm_c_total", 0, 2_000_000_000, 1.0)
+    st.close()
+
+
+def test_query_route_contract(tmp_path):
+    """The route handler honours the exporter contract: parse_qs list
+    values in, str body out; kind= readers filter on labels."""
+    st = TimeSeriesStore(None)
+    _fill(st, n=4)
+    st.append_spans([{"trace_id": "t-9", "name": "tick", "start": 1000.0,
+                      "end": 1000.5}], extra={"module": "shard1"})
+    handler = make_query_route(lambda: st)
+    code, ctype, body = handler({"series": ["apm_x_total"], "start": ["900"],
+                                 "end": ["1200"], "step": ["10"]})
+    assert code == 200 and ctype == "application/json"
+    assert isinstance(body, str)
+    doc = json.loads(body)
+    assert doc["series"][0]["labels"] == {"queue": "db"}
+    code, _, body = handler({"kind": ["spans"], "start": ["0"],
+                             "module": ["shard1"]})
+    assert code == 200
+    assert json.loads(body)["rows"][0]["trace_id"] == "t-9"
+    code, _, body = handler({"kind": ["spans"], "start": ["0"],
+                             "module": ["other"]})
+    assert json.loads(body)["rows"] == []
+    code, _, body = handler({"kind": ["names"]})
+    assert "apm_x_total" in json.loads(body)["names"]
+    code, _, _body = handler({"series": ["broken("]})
+    assert code == 400
+    st.close()
+
+
+# -- fleet recorder -----------------------------------------------------------
+
+def test_recorder_scrapes_live_exporter_and_degrades_on_dead_target():
+    from apmbackend_tpu.obs import get_registry
+    from apmbackend_tpu.obs.decisions import set_decisions
+    from apmbackend_tpu.obs.trace import Tracer, set_tracer
+
+    reg = get_registry()
+    reg.gauge("apm_engine_services", "rows").set(42.0)
+    old_tracer = set_tracer(Tracer(module="child", sample_rate=1))
+    old_ring = set_decisions(DecisionRing())
+    from apmbackend_tpu.obs.decisions import get_decisions
+    from apmbackend_tpu.obs.trace import get_tracer
+
+    get_tracer().span("t-r1", "tick", 10.0, 10.2)
+    get_decisions().record({"trace_id": "t-r1", "ts": 11.0, "service": "s",
+                            "channel": 6})
+    server = TelemetryServer(reg, port=0, module="child")
+    server.start()
+    st = TimeSeriesStore(None)
+    rec = FleetRecorder(
+        st,
+        lambda: [("shard0", server.url), ("dead", "http://127.0.0.1:9/")],
+        timeout_s=2.0,
+    )
+    try:
+        summary = rec.scrape_once(now=5000.0)
+        assert summary["ok"] == 1  # the dead target was skipped, not fatal
+        pts = st.series_points("apm_engine_services", 0, 6000,
+                               labels={"module": "shard0"})
+        (_k, series), = pts.items()
+        assert series == [(5000.0, 42.0)]
+        assert st.spans(0, math.inf, match={"module": "shard0"})
+        assert st.decisions(0, math.inf, match={"module": "shard0"})
+        counts = rec.status()["counts"]
+        assert counts["scrape_errors_total"] >= 1
+        assert counts["span_rows_total"] == 1
+        # second pass: ring contents are deduped, counters don't re-count
+        rec.scrape_once(now=5001.0)
+        assert rec.status()["counts"]["span_rows_total"] == 1
+        assert rec.status()["counts"]["decision_rows_total"] == 1
+    finally:
+        server.stop()
+        st.close()
+        set_tracer(old_tracer)
+        set_decisions(old_ring)
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+def _lag_breach_store(now, *, breach_from=None):
+    """apm_queue_lag for one queue: healthy zeros, then a sustained breach
+    (> default 10k threshold) from ``breach_from`` to ``now``."""
+    st = TimeSeriesStore(None)
+    breach_from = now - 240.0 if breach_from is None else breach_from
+    t = now - 3600.0
+    while t <= now:
+        v = 50000.0 if t >= breach_from else 0.0
+        st.append_samples([["apm_queue_lag", {"queue": "db_insert"}, v]], ts=t)
+        t += 15.0
+    return st
+
+
+def test_slo_gauge_fast_burn_alert_with_provenance():
+    now = 500000.0
+    st = _lag_breach_store(now)
+    ring = DecisionRing()
+    alerts = []
+    eng = SLOEngine(st, short_window_s=300.0, long_window_s=3600.0,
+                    decisions=ring, on_alert=lambda m, r: alerts.append((m, r)))
+    results = eng.evaluate(now)
+    lag = [r for r in results if r["objective"] == "queue_lag"]
+    assert lag and lag[0]["key"] == "db_insert"
+    # short window: 240/300 bad -> burn 80; long: 240/3600 -> burn 6.7;
+    # only the SHORT clears 14.4, so severity must NOT be fast...
+    assert lag[0]["burn_short"] > 14.4
+    # widen the breach to cover the long window too -> fast
+    st2 = _lag_breach_store(now, breach_from=now - 3600.0)
+    eng2 = SLOEngine(st2, decisions=ring,
+                     on_alert=lambda m, r: alerts.append((m, r)))
+    res2 = eng2.evaluate(now)
+    lag2 = [r for r in res2 if r["objective"] == "queue_lag"][0]
+    assert lag2["severity"] == "fast"
+    assert alerts, "fast burn must dispatch an alert"
+    msg, record = alerts[-1]
+    assert "queue_lag" in msg
+    # decision provenance: the record resolves every SLO input
+    stored = [d for d in ring.recent() if d.get("decision") == "slo_burn_rate"]
+    assert stored
+    d = stored[-1]
+    assert d["series"] == "apm_queue_lag" and d["key"] == "db_insert"
+    for w in ("short", "long"):
+        win = d["windows"][w]
+        assert win["bad_fraction"] == 1.0
+        assert win["events"] > 0 and "window_s" in win
+    assert d["burn_short"] == pytest.approx(1.0 / 0.01)
+    assert d["target"] == 0.99 and d["threshold"] == 10000.0
+    # cooldown: immediate re-evaluation must not re-page
+    n = len(stored)
+    eng2.evaluate(now + 1.0)
+    stored2 = [x for x in ring.recent()
+               if x.get("decision") == "slo_burn_rate"]
+    assert len(stored2) == n
+    st.close()
+    st2.close()
+
+
+def test_slo_latency_objective_from_histogram_buckets():
+    now = 200000.0
+    st = TimeSeriesStore(None)
+    # cumulative buckets: of each 100 new events, 90 land <= 0.1s
+    for i in range(0, 3600 // 15):
+        t = now - 3600.0 + i * 15.0
+        st.append_samples(
+            [["apm_e2e_ingest_to_emit_seconds_bucket", {"le": "0.1"},
+              90.0 * i],
+             ["apm_e2e_ingest_to_emit_seconds_bucket", {"le": "+Inf"},
+              100.0 * i]], ts=t)
+    eng = SLOEngine(st)
+    res = eng.evaluate(now)
+    det = [r for r in res if r["objective"] == "detection_latency_p95"][0]
+    # 10% bad vs 5% budget -> burn 2.0 on both windows; threshold bucket
+    # resolved to the smallest le >= 0.1
+    assert det["burn_short"] == pytest.approx(2.0, rel=1e-3)
+    assert det["burn_long"] == pytest.approx(2.0, rel=1e-3)
+    assert det["severity"] is None
+    assert det["windows"]["short"]["bucket_le"] == 0.1
+    st.close()
+
+
+def test_slo_health_degrades_healthz_to_503():
+    now = 300000.0
+    st = _lag_breach_store(now, breach_from=now - 3600.0)
+    eng = SLOEngine(st)
+    server = TelemetryServer(MetricsRegistry(), port=0, module="mgr")
+    server.add_health("slo", eng.health)
+    server.start()
+    try:
+        status, body = _fetch_any(f"{server.url}/healthz")
+        assert status == 200  # no evaluation yet -> no verdict
+        eng.evaluate(now)
+        assert eng.health()["ok"] is False
+        status, body = _fetch_any(f"{server.url}/healthz")
+        assert status == 503
+        doc = json.loads(body)
+        assert doc["slo"]["fast_burning"] == ["queue_lag:db_insert"]
+    finally:
+        server.stop()
+        st.close()
+
+
+def _fetch_any(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def test_slo_from_config_schema():
+    cfg = default_config()
+    assert cfg["slo"]["enabled"] is True
+    cfg["slo"]["shortWindowSeconds"] = 60.0
+    cfg["slo"]["fastBurnThreshold"] = 2.0
+    cfg["slo"]["objectives"] = [
+        {"name": "only", "kind": "gauge", "series": "apm_queue_lag",
+         "threshold": 1.0, "target": 0.5, "per": "queue"}]
+    st = TimeSeriesStore(None)
+    eng = SLOEngine.from_config(st, cfg)
+    assert eng.short_window_s == 60.0
+    assert eng.fast_burn == 2.0
+    assert [o["name"] for o in eng.objectives] == ["only"]
+    st.close()
+
+
+# -- transport lag gauge ------------------------------------------------------
+
+def test_queue_lag_gauge_memory_and_spool(tmp_path):
+    from apmbackend_tpu.obs import get_registry, parse_prom_text
+    from apmbackend_tpu.transport.base import QueueManager
+    from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    broker = MemoryBroker()
+    # producer and consumer live in separate processes in production: two
+    # managers over the shared broker (one manager caches by queue name)
+    qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    # manual-ack consumer that never acks: both deliveries stay owed
+    qm_c.get_queue("q1", "c", lambda line, headers, token: None,
+                   manual_ack=True)  # registers the gauge
+    prod = qm_p.get_queue("q1", "p")
+    prod.write_line("a|b")
+    prod.write_line("c|d")
+    rendered = {(n, labels.get("queue")): v for n, labels, v in
+                parse_prom_text(get_registry().render())
+                if n == "apm_queue_lag"}
+    assert rendered[("apm_queue_lag", "q1")] == 2.0  # sent, not acked
+
+    ch = SpoolChannel(str(tmp_path))
+    ch.send("qs", b"x", None)
+    ch.send("qs", b"y", None)
+    assert ch.queue_lag("qs") == 2
+    # a FRESH channel over the same directory sees the same backlog — the
+    # dead-consumer observer path (manager-side lag probe)
+    ch2 = SpoolChannel(str(tmp_path))
+    assert ch2.queue_lag("qs") == 2
+    ch.close()
+    ch2.close()
+
+
+# -- qstat modes --------------------------------------------------------------
+
+def test_qstat_range_and_slo_store_modes(tmp_path, capsys):
+    from apmbackend_tpu.tools import qstat
+
+    d = str(tmp_path)
+    st = TimeSeriesStore(d)
+    now = time.time()
+    for i in range(40):
+        t = now - 600.0 + i * 15.0
+        st.append_samples([["apm_queue_lag", {"queue": "db_insert"},
+                            50000.0]], ts=t)
+        st.append_samples([["apm_in_total", {}, float(i * 30)]], ts=t)
+    st.close()
+
+    assert qstat.main(["--range", "apm_queue_lag", "--store", d]) == 0
+    out = capsys.readouterr().out
+    assert 'queue="db_insert"' in out and "last=50000" in out
+
+    assert qstat.main(["--range", "rate(apm_in_total[60s])", "--store", d,
+                       "--step", "60"]) == 0
+    out = capsys.readouterr().out
+    assert "last=2" in out  # +30 every 15s
+
+    assert qstat.main(["--slo", "--store", d]) == 0
+    out = capsys.readouterr().out
+    assert "queue_lag" in out and "fast" in out
+
+    assert qstat.main(["--range", "apm_queue_lag"]) == 2  # no source
+    assert qstat.main(["--slo"]) == 2
+
+
+def test_qstat_range_via_live_query_endpoint():
+    from apmbackend_tpu.tools import qstat
+
+    st = TimeSeriesStore(None)
+    now = time.time()
+    for i in range(10):
+        st.append_samples([["apm_live_g", {}, float(i)]], ts=now - 100 + i * 10)
+    server = TelemetryServer(MetricsRegistry(), port=0, module="m")
+    server.add_route("/query", make_query_route(lambda: st))
+    server.start()
+    try:
+        doc = qstat.range_query_url(server.url, "apm_live_g",
+                                    now - 120, now, 10.0)
+        assert doc["series"][0]["points"]
+        assert qstat.main(["--range", "apm_live_g",
+                           "--metrics-url", server.url,
+                           "--start", str(now - 120), "--end", str(now)]) == 0
+    finally:
+        server.stop()
+        st.close()
+
+
+def test_qstat_slo_health_via_url():
+    from apmbackend_tpu.tools import qstat
+
+    now = time.time()
+    st = _lag_breach_store(now, breach_from=now - 3600.0)
+    eng = SLOEngine(st)
+    eng.evaluate(now)
+    server = TelemetryServer(MetricsRegistry(), port=0, module="mgr")
+    server.add_health("slo", eng.health)
+    server.start()
+    try:
+        doc = qstat.slo_health_url(server.url)
+        assert doc["status"] == "degraded"
+        assert doc["slo"]["fast_burning"] == ["queue_lag:db_insert"]
+    finally:
+        server.stop()
+        st.close()
+
+
+# -- /query wired into the module runtime ------------------------------------
+
+def test_module_runtime_serves_query_over_self_samples(tmp_path):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    cfg = default_config()
+    cfg["logDir"] = None
+    cfg["tpuEngine"]["metricsPort"] = 0
+    cfg["observability"]["selfSampleSeconds"] = 0.1
+    cfg["observability"]["storeDir"] = str(tmp_path / "selfstore")
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False,
+                       console_log=False)
+    try:
+        assert rt.store is not None
+        assert rt.slo is not None
+        rt._self_sample()
+        url = f"http://127.0.0.1:{rt.telemetry.port}"
+        qs = urlencode({"kind": "stats"})
+        status, body = _fetch_any(f"{url}/query?{qs}")
+        assert status == 200
+        assert json.loads(body)["stats"]["rows_total"] > 0
+        status, body = _fetch_any(f"{url}/healthz")
+        doc = json.loads(body)
+        assert "slo" in doc  # engine health provider mounted
+    finally:
+        rt.stop_timers()
