@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 
 class DecisionRing:
@@ -37,6 +37,17 @@ class DecisionRing:
         with self._lock:
             self._ring.append(decision)
             self.total += 1
+
+    def snapshot(self, n: Optional[int] = None) -> Tuple[int, List[dict]]:
+        """Atomic ``(total, last n items)`` under one lock hold — a caller
+        tracking a seen-counter against ``total`` cannot race a concurrent
+        record() landing between the counter read and the item read."""
+        with self._lock:
+            total = self.total
+            items = list(self._ring)
+        if n is not None and 0 < n < len(items):
+            items = items[-n:]
+        return total, items
 
     def recent(self, n: Optional[int] = None, trace_id: Optional[str] = None) -> List[dict]:
         with self._lock:

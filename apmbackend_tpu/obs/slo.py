@@ -152,7 +152,11 @@ class SLOEngine:
         threshold = float(obj.get("thresholdSeconds", 0.1))
         groups = self.store.series_points(
             str(obj["series"]) + "_bucket", start, end, obj.get("labels"))
-        by_key: Dict[str, Dict[float, List[Tuple[float, float]]]] = {}
+        # one point list PER ORIGINAL LABELSET under each (key, le): the
+        # reset-aware delta must run per counter series — interleaving two
+        # shards' counters (shard0=100, shard1=5, ...) would read every
+        # cross-shard transition as a reset and inflate the increase
+        by_key: Dict[str, Dict[float, List[List[Tuple[float, float]]]]] = {}
         for lblkey, pts in groups.items():
             lbl = dict(lblkey)
             le_raw = lbl.pop("le", None)
@@ -160,13 +164,13 @@ class SLOEngine:
                 continue
             le = math.inf if le_raw in ("+Inf", "inf", "Inf") else float(le_raw)
             key = str(lbl.get(key_label, "")) if key_label else ""
-            by_key.setdefault(key, {}).setdefault(le, []).extend(pts)
+            by_key.setdefault(key, {}).setdefault(le, []).append(pts)
         out: Dict[str, dict] = {}
         for key, by_le in by_key.items():
-            total = _delta(sorted(by_le.get(math.inf, []), key=lambda p: p[0]))
+            total = sum(_delta(s) for s in by_le.get(math.inf, []))
             finite = sorted(b for b in by_le if not math.isinf(b))
             good_le = next((b for b in finite if b >= threshold), None)
-            good = _delta(sorted(by_le[good_le], key=lambda p: p[0])) \
+            good = sum(_delta(s) for s in by_le[good_le]) \
                 if good_le is not None else 0.0
             bad = max(0.0, total - good)
             out[key] = {

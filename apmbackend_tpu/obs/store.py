@@ -175,10 +175,17 @@ class TimeSeriesStore:
         downsample_after_s: Optional[float] = 900.0,
         downsample_step_s: float = 60.0,
         reopen_backoff_s: float = 5.0,
+        read_only: bool = False,
         registry: Optional[MetricsRegistry] = None,
         logger=None,
     ):
         self.directory = directory
+        # read_only: post-mortem reader mode (qstat --store). Recovery
+        # reads the valid prefix but never repairs in place — no truncate,
+        # no quarantine rename — so pointing a CLI at a LIVE recorder
+        # directory cannot mutate segments out from under the running
+        # writer's open file handle. Appends and compaction are refused.
+        self.read_only = bool(read_only)
         self.retention_s = float(retention_s)
         self.segment_max_bytes = int(segment_max_bytes)
         self.segment_max_age_s = float(segment_max_age_s)
@@ -251,7 +258,8 @@ class TimeSeriesStore:
                 # content preserved for forensics) so the NEXT recovery sees
                 # fresh appends — which land on higher seqs — as a clean
                 # readable prefix instead of an unreachable tail
-                self._quarantine(path)
+                if not self.read_only:
+                    self._quarantine(path)
                 continue
             try:
                 with open(path, "rb") as fh:
@@ -259,7 +267,8 @@ class TimeSeriesStore:
             except OSError:
                 self._counts["corrupt_segments_total"] += 1
                 stop = True
-                self._quarantine(path)
+                if not self.read_only:
+                    self._quarantine(path)
                 continue
             records, clean, good_off = _decode_records(blob)
             if not records:
@@ -267,7 +276,8 @@ class TimeSeriesStore:
                 # recovery stops at the last valid segment before this one
                 self._counts["corrupt_segments_total"] += 1
                 stop = True
-                self._quarantine(path)
+                if not self.read_only:
+                    self._quarantine(path)
                 continue
             if records[0].get("k") == "h":
                 header, body = records[0].get("h", {}), records[1:]
@@ -283,6 +293,8 @@ class TimeSeriesStore:
             if not clean:
                 self._counts["corrupt_segments_total"] += 1
                 stop = True  # torn/rotted mid-file: later segments stay unread
+                if self.read_only:
+                    continue
                 # repair in place: drop the rotted suffix so the segment
                 # reads clean next time and doesn't re-poison recovery
                 try:
@@ -424,7 +436,7 @@ class TimeSeriesStore:
             if not (isinstance(value, (int, float)) and math.isfinite(value)):
                 continue
             packed.append([name, lbl, value])
-        if not packed:
+        if not packed or self.read_only:
             return 0
         with self._lock:
             if self._closed:
@@ -460,7 +472,7 @@ class TimeSeriesStore:
             if extra:
                 d.update(extra)
             rows.append(d)
-        if not rows:
+        if not rows or self.read_only:
             return 0
         t = max((float(r.get("start", now)) for r in rows), default=now)
         with self._lock:
@@ -478,7 +490,7 @@ class TimeSeriesStore:
             if extra:
                 d.update(extra)
             rows.append(d)
-        if not rows:
+        if not rows or self.read_only:
             return 0
         t = max((float(r.get("ts", now)) for r in rows), default=now)
         with self._lock:
@@ -495,7 +507,7 @@ class TimeSeriesStore:
         now = time.time() if now is None else float(now)
         dropped = rewritten = 0
         with self._lock:
-            if self._closed:
+            if self._closed or self.read_only:
                 return {"dropped": 0, "downsampled": 0}
             keep: List[_Segment] = []
             for seg in self._segments:
@@ -711,15 +723,12 @@ def _instant(points: List[Tuple[float, float]], t: float,
     return best
 
 
-def _rate(points: List[Tuple[float, float]], t: float,
-          window: float) -> Optional[float]:
-    """Counter rate over (t-window, t]: sum of positive increments (reset
-    aware) divided by the observed span."""
+def _increase(points: List[Tuple[float, float]], t: float,
+              window: float) -> Optional[Tuple[float, float]]:
+    """Reset-aware counter increase over (t-window, t] -> (increase,
+    observed span); None with fewer than two in-window points."""
     win = [(ts, v) for ts, v in points if t - window < ts <= t]
     if len(win) < 2:
-        return None
-    span = win[-1][0] - win[0][0]
-    if span <= 0:
         return None
     inc = 0.0
     for (_, a), (_, b) in zip(win, win[1:]):
@@ -727,7 +736,18 @@ def _rate(points: List[Tuple[float, float]], t: float,
             inc += b - a
         else:
             inc += b  # counter reset: the new value is the increment
-    return inc / span
+    return max(0.0, inc), win[-1][0] - win[0][0]
+
+
+def _rate(points: List[Tuple[float, float]], t: float,
+          window: float) -> Optional[float]:
+    """Counter rate over (t-window, t]: the reset-aware increase divided
+    by the observed span."""
+    got = _increase(points, t, window)
+    if got is None:
+        return None
+    inc, span = got
+    return inc / span if span > 0 else None
 
 
 _MAX_EVAL_STEPS = 11000  # prometheus caps range resolution the same way
@@ -747,8 +767,12 @@ def eval_range(
     - ``name`` / ``name{label="v"}`` — instant vector per step
     - ``rate(name[Ns])`` — reset-aware counter rate (window defaults to
       4×step when ``[Ns]`` is omitted)
-    - ``histogram_quantile(q, name)`` — prometheus quantile over the
-      ``name_bucket`` cumulative series, grouped by labels minus ``le``
+    - ``histogram_quantile(q, name[Ns])`` — prometheus quantile over the
+      ``name_bucket`` series, grouped by labels minus ``le``. Buckets are
+      WINDOWED first (reset-aware increase over ``[Ns]``, defaulting to
+      4×step — the ``histogram_quantile(q, rate(...))`` idiom), so the
+      quantile reflects the queried range, not the cumulative
+      since-process-start distribution.
     """
     m = _EXPR_RE.match(expr or "")
     if not m:
@@ -780,7 +804,7 @@ def eval_range(
             raise ValueError("histogram_quantile needs a quantile argument")
         q = float(m.group("q"))
         base = name[:-len("_bucket")] if name.endswith("_bucket") else name
-        groups = store.series_points(base + "_bucket", start - lookback, end, sel)
+        groups = store.series_points(base + "_bucket", start - window, end, sel)
         merged: Dict[tuple, Dict[float, List[Tuple[float, float]]]] = {}
         for key, pts in groups.items():
             le = None
@@ -792,15 +816,17 @@ def eval_range(
                     rest.append((k, v))
             if le is None:
                 continue
+            # (rest, le) == the full original labelset: each list stays one
+            # counter series, already time-sorted by series_points
             merged.setdefault(tuple(rest), {}).setdefault(le, []).extend(pts)
         for key, by_le in sorted(merged.items()):
             pts_out = []
             for t in steps:
                 buckets = []
                 for le, pts in by_le.items():
-                    v = _instant(sorted(pts), t, lookback)
-                    if v is not None:
-                        buckets.append((le, v))
+                    got = _increase(pts, t, window)
+                    if got is not None:
+                        buckets.append((le, got[0]))
                 val = histogram_quantile(buckets, q) if buckets else None
                 pts_out.append([t, None if val is None or not math.isfinite(val)
                                 else val])

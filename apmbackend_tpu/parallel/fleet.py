@@ -325,6 +325,14 @@ class FleetHarness:
         self.procs: Dict[int, FleetShardProc] = {
             k: FleetShardProc(self, k) for k in range(shards)
         }
+        # last port each shard ever published: lets the recorder targets
+        # feed keep scraping (and counting failures against) a dead or
+        # restarting shard whose port file is currently absent
+        self._last_ports: Dict[int, int] = {}
+        # shards the targets feed already paid its one startup wait for —
+        # afterwards the feed only polls, so a shard that never publishes
+        # cannot stall every scrape pass
+        self._port_waited: set = set()
         self.sent_per_queue: Dict[str, int] = {
             partition_queue(base_queue, p): 0 for p in range(shards)
         }
@@ -349,28 +357,49 @@ class FleetHarness:
     def metrics_port(self, k: int, timeout_s: float = 15.0) -> int:
         """Bound exporter port of shard ``k`` (ephemeral ports: the shard
         writes it via the APM_METRICS_PORT_FILE seam once the exporter is
-        up). Raises TimeoutError if the shard never publishes one."""
+        up). Always tries at least one read (``timeout_s=0`` = poll once);
+        raises TimeoutError if the shard never publishes one in time."""
         path = self.procs[k].port_path
         deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        while True:
             try:
                 with open(path, "r", encoding="utf-8") as fh:
-                    return int(fh.read().strip())
+                    port = int(fh.read().strip())
+                self._last_ports[k] = port
+                return port
             except (OSError, ValueError):
+                if time.time() >= deadline:
+                    raise TimeoutError(
+                        f"shard {k} never published its metrics port ({path})")
                 time.sleep(0.05)
-        raise TimeoutError(f"shard {k} never published its metrics port ({path})")
 
     def metrics_url(self, k: int, timeout_s: float = 15.0) -> str:
         return f"http://127.0.0.1:{self.metrics_port(k, timeout_s)}"
 
     def metrics_targets(self, timeout_s: float = 15.0):
         """``[(name, base_url)]`` for every shard — the FleetRecorder's
-        targets feed (dead shards keep their last known port; the recorder
-        counts the failed scrape and moves on)."""
-        return [
-            (f"shard{k}", self.metrics_url(k, timeout_s))
-            for k in sorted(self.procs)
-        ]
+        targets feed. Never raises and never stalls steady-state scrape
+        passes: ``timeout_s`` bounds ONE startup wait per shard that has
+        not published a port yet; afterwards the feed only polls. A shard
+        whose port file is absent (kill −9, or mid-restart after start()
+        unlinked it) reuses its last known port — the recorder counts the
+        failed scrape and moves on — and a shard with no known port yet
+        is skipped for this pass instead of failing the whole feed."""
+        out = []
+        for k in sorted(self.procs):
+            if k in self._last_ports or k in self._port_waited:
+                wait = 0.0
+            else:
+                wait = timeout_s
+                self._port_waited.add(k)
+            try:
+                port = self.metrics_port(k, wait)
+            except TimeoutError:
+                port = self._last_ports.get(k)
+                if port is None:
+                    continue
+            out.append((f"shard{k}", f"http://127.0.0.1:{port}"))
+        return out
 
     # -- rebalance (the two-phase controller, shardmodel semantics) ----------
     def rebalance(self, p: int, frm: int, to: int,

@@ -263,11 +263,16 @@ class ModuleRuntime:
             fresh.append(sp)
         if fresh:
             store.append_spans(fresh)
+        # one atomic (total, items) snapshot: a decision recorded after it
+        # is counted next pass, never double-persisted against a stale
+        # total. If more than the ring size arrived since the last pass the
+        # overflow is already gone from the ring either way — persist what
+        # survives and advance the seen-counter past the loss.
         ring = get_decisions()
-        total = ring.total
+        total, items = ring.snapshot(512)
         new = total - self._decision_seen_total
         if new > 0:
-            store.append_decisions(ring.recent(min(new, 512)))
+            store.append_decisions(items[-new:] if new < len(items) else items)
             self._decision_seen_total = total
         store.compact(now)
 

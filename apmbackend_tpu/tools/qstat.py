@@ -173,10 +173,12 @@ def range_query_url(url: str, expr: str, start: float, end: float,
 def range_query_store(store_dir: str, expr: str, start: float, end: float,
                       step: float) -> dict:
     """Evaluate ``expr`` directly over a recorder store directory — the
-    post-mortem path (works on a crashed fleet's leftover segments)."""
+    post-mortem path (works on a crashed fleet's leftover segments).
+    Read-only recovery: the directory may belong to a LIVE recorder, so
+    the CLI must never truncate or quarantine segments under the writer."""
     from ..obs.store import TimeSeriesStore, eval_range
 
-    store = TimeSeriesStore(store_dir)
+    store = TimeSeriesStore(store_dir, read_only=True)
     try:
         return eval_range(store, expr, start, end, step)
     finally:
@@ -214,7 +216,7 @@ def slo_store_eval(store_dir: str, config: dict, at=None) -> List[dict]:
     from ..obs.slo import SLOEngine
     from ..obs.store import TimeSeriesStore
 
-    store = TimeSeriesStore(store_dir)
+    store = TimeSeriesStore(store_dir, read_only=True)
     try:
         engine = SLOEngine.from_config(store, config, on_alert=lambda _m, _r: None)
         if at is None:
@@ -308,7 +310,7 @@ def main(argv=None) -> int:
                 if end is None:
                     from ..obs.store import TimeSeriesStore
 
-                    probe = TimeSeriesStore(args.store)
+                    probe = TimeSeriesStore(args.store, read_only=True)
                     try:
                         end = probe.stats().get("newest_ts") or time.time()
                     finally:

@@ -484,6 +484,48 @@ def test_shard_labels_and_window_occupancy(tmp_path):
         rt.stop_timers()
 
 
+def test_metrics_targets_feed_never_stalls_or_raises(tmp_path):
+    """The FleetRecorder targets feed runs every couple of seconds: a
+    shard that never published a port is skipped for the pass (not a
+    TimeoutError that drops EVERY target), and a shard whose port file is
+    gone (kill −9 / mid-restart unlink) keeps its last known port so the
+    recorder can count the failed scrape instead of blocking 15 s."""
+    import os as _os
+    import time as _time
+
+    from apmbackend_tpu.parallel.fleet import FleetHarness
+
+    h = FleetHarness(str(tmp_path), shards=2, metrics=True)
+    try:
+        # nobody published yet: empty feed, no exception, no 15 s stall
+        t0 = _time.monotonic()
+        assert h.metrics_targets(timeout_s=0.0) == []
+        assert _time.monotonic() - t0 < 1.0
+        with open(h.procs[0].port_path, "w", encoding="utf-8") as fh:
+            fh.write("12345\n")
+        assert h.metrics_targets(timeout_s=0.0) == [
+            ("shard0", "http://127.0.0.1:12345")]
+        # port file unlinked (what start() does before the shard rebinds):
+        # the last known port survives, the unpublished shard stays skipped
+        _os.unlink(h.procs[0].port_path)
+        t0 = _time.monotonic()
+        assert h.metrics_targets(timeout_s=5.0) == [
+            ("shard0", "http://127.0.0.1:12345")]
+        assert _time.monotonic() - t0 < 1.0  # cached: no per-shard re-wait
+        # a republished (new ephemeral) port replaces the cached one
+        with open(h.procs[1].port_path, "w", encoding="utf-8") as fh:
+            fh.write("23456\n")
+        assert h.metrics_targets(timeout_s=0.0) == [
+            ("shard0", "http://127.0.0.1:12345"),
+            ("shard1", "http://127.0.0.1:23456")]
+        # the blocking single-shard accessor still raises for callers that
+        # want the hard wait (startup assertions)
+        with pytest.raises(TimeoutError):
+            h.metrics_port(0, timeout_s=0.0)
+    finally:
+        h.close()
+
+
 def test_epoch_stall_degrades_healthz(tmp_path):
     import time as _time
 

@@ -131,6 +131,46 @@ def test_store_bit_rot_stops_at_last_valid_segment(tmp_path):
     st3.close()
 
 
+def test_store_read_only_recovery_is_nondestructive(tmp_path):
+    """qstat --store may point at a LIVE recorder directory: a read-only
+    open must read the valid prefix without truncating segments in place
+    or renaming the tail to *.quarantine under the writer's open handle."""
+    d = str(tmp_path)
+    st = TimeSeriesStore(d, segment_max_bytes=256)
+    _fill(st, n=30)
+    st.close()
+    segs = _segs(d)
+    assert len(segs) >= 3
+    victim = os.path.join(d, segs[len(segs) // 2])
+    sz = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:  # torn mid-frame in a MIDDLE segment
+        fh.truncate(sz - 5)
+
+    def _listing():
+        return {f: os.path.getsize(os.path.join(d, f)) for f in os.listdir(d)}
+
+    before = _listing()
+    ro = TimeSeriesStore(d, read_only=True)
+    (_k, series), = ro.series_points("apm_x_total", 0, 5000).items()
+    assert 0 < len(series) < 30  # valid prefix only, same stop semantics
+    assert ro.stats()["corrupt_segments_total"] >= 1
+    # writes are refused wholesale: appends, spans, decisions, compaction
+    assert ro.append_samples([["apm_x_total", {}, 1.0]], ts=1.0) == 0
+    assert ro.append_spans([{"trace_id": "t", "start": 1.0}]) == 0
+    assert ro.append_decisions([{"ts": 1.0}]) == 0
+    assert ro.compact(10_000_000.0) == {"dropped": 0, "downsampled": 0}
+    ro.close()
+    assert _listing() == before
+    # the qstat post-mortem paths ride the same read-only recovery
+    from apmbackend_tpu.tools import qstat
+    assert qstat.main(["--range", "apm_x_total", "--store", d]) == 0
+    assert qstat.main(["--slo", "--store", d]) == 0
+    assert _listing() == before
+    # a subsequent WRITER open still repairs (truncate and/or quarantine)
+    TimeSeriesStore(d).close()
+    assert _listing() != before
+
+
 def test_store_enospc_degrades_drop_and_count(tmp_path):
     st = TimeSeriesStore(str(tmp_path), reopen_backoff_s=0.0)
     st.append_samples([["apm_x_total", {}, 1.0]], ts=100.0)
@@ -229,6 +269,34 @@ def test_eval_range_instant_rate_and_quantile(tmp_path):
     with pytest.raises(ValueError):
         # step-count cap: epoch-wide range at 1 s step must refuse, not spin
         eval_range(st, "apm_c_total", 0, 2_000_000_000, 1.0)
+    st.close()
+
+
+def test_eval_range_histogram_quantile_is_windowed_not_alltime():
+    """The quantile at each step must come from the bucket INCREASE over
+    the window (histogram_quantile(q, rate(...)) idiom), not the
+    cumulative since-process-start counts — after a latency regime change
+    the all-time distribution barely moves, the windowed one tracks it."""
+    st = TimeSeriesStore(None)
+    # phase 1 (t<=1050): every event slow, lands in (0.1, 1.0];
+    # phase 2 (t>1050): every NEW event fast, lands in [0, 0.1]
+    for i in range(21):
+        t = 1000.0 + i * 5.0
+        fast = 10.0 * max(0, i - 10)
+        total = 10.0 * i
+        st.append_samples(
+            [["apm_l_seconds_bucket", {"le": "0.1"}, fast],
+             ["apm_l_seconds_bucket", {"le": "1.0"}, total],
+             ["apm_l_seconds_bucket", {"le": "+Inf"}, total]], ts=t)
+    doc = eval_range(st, "histogram_quantile(0.95, apm_l_seconds[20s])",
+                     1050.0, 1100.0, 5.0)
+    (s,) = doc["series"]
+    vals = {t: v for t, v in s["points"]}
+    # window fully inside the slow phase: p95 interpolates in (0.1, 1.0]
+    assert vals[1050.0] == pytest.approx(0.955)
+    # window fully inside the fast phase: p95 lands in the first bucket —
+    # the all-time cumulative mix would still report ~0.91 here
+    assert vals[1100.0] == pytest.approx(0.095)
     st.close()
 
 
@@ -390,6 +458,38 @@ def test_slo_latency_objective_from_histogram_buckets():
     st.close()
 
 
+def test_slo_latency_bad_fraction_per_labelset_not_interleaved():
+    """A manager recorder store holds every shard's cumulative buckets
+    under per-shard ``module`` labels. The burn-rate math must delta each
+    counter series separately and sum the increases — merging the series
+    into one point list reads every shard0→shard1 value transition as a
+    counter reset and inflates the event counts by orders of magnitude."""
+    now = 400000.0
+    st = TimeSeriesStore(None)
+    for i in range(0, 3600 // 15):
+        t = now - 3600.0 + i * 15.0
+        rows = []
+        for mod, scale in (("shard0", 100.0), ("shard1", 10.0)):
+            rows += [
+                ["apm_e2e_ingest_to_emit_seconds_bucket",
+                 {"le": "0.1", "module": mod}, 0.9 * scale * i],
+                ["apm_e2e_ingest_to_emit_seconds_bucket",
+                 {"le": "+Inf", "module": mod}, scale * i],
+            ]
+        st.append_samples(rows, ts=t)
+    eng = SLOEngine(st)
+    det = [r for r in eng.evaluate(now)
+           if r["objective"] == "detection_latency_p95"][0]
+    # both shards run 10% bad against the 5% budget -> burn exactly 2.0
+    assert det["burn_short"] == pytest.approx(2.0, rel=1e-3)
+    assert det["burn_long"] == pytest.approx(2.0, rel=1e-3)
+    assert det["severity"] is None
+    # events = the true summed increase across both shards' +Inf counters
+    n = 3600 // 15 - 1
+    assert det["windows"]["long"]["events"] == pytest.approx(110.0 * n, rel=0.05)
+    st.close()
+
+
 def test_slo_health_degrades_healthz_to_503():
     now = 300000.0
     st = _lag_breach_store(now, breach_from=now - 3600.0)
@@ -545,6 +645,55 @@ def test_qstat_slo_health_via_url():
 
 
 # -- /query wired into the module runtime ------------------------------------
+
+def test_decision_ring_snapshot_atomic_and_bounded():
+    ring = DecisionRing(maxlen=4)
+    for i in range(3):
+        ring.record({"i": i})
+    total, items = ring.snapshot()
+    assert total == 3 and [d["i"] for d in items] == [0, 1, 2]
+    for i in range(3, 10):  # overflow the ring
+        ring.record({"i": i})
+    total, items = ring.snapshot()
+    assert total == 10 and [d["i"] for d in items] == [6, 7, 8, 9]
+    total, items = ring.snapshot(2)
+    assert total == 10 and [d["i"] for d in items] == [8, 9]
+
+
+def test_self_sample_decisions_no_dupes_no_silent_skip():
+    """The self-sample pass snapshots (total, items) atomically: repeated
+    passes never re-persist a decision, and a between-pass ring overflow
+    persists the survivors exactly once while advancing the seen-counter
+    past the (already gone) overflow."""
+    from apmbackend_tpu.obs.decisions import get_decisions, set_decisions
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    old_ring = set_decisions(DecisionRing(maxlen=8))
+    cfg = default_config()
+    cfg["logDir"] = None
+    cfg["tpuEngine"]["metricsPort"] = 0
+    cfg["observability"]["selfSampleSeconds"] = 3600.0  # manual passes only
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False,
+                       console_log=False)
+    try:
+        ring = get_decisions()
+        for i in range(5):
+            ring.record({"ts": 100.0 + i, "service": f"s{i}", "channel": 1})
+        rt._self_sample()
+        rt._self_sample()  # nothing new -> nothing re-appended
+        assert len(rt.store.decisions(0.0, 150.0)) == 5
+        # 20 > ring size 8: the 12 oldest are gone from the ring either
+        # way; the 8 survivors persist once, then the counter is caught up
+        for i in range(20):
+            ring.record({"ts": 200.0 + i, "service": f"t{i}", "channel": 2})
+        rt._self_sample()
+        rt._self_sample()
+        decs = rt.store.decisions(150.0, math.inf)
+        assert [d["service"] for d in decs] == [f"t{i}" for i in range(12, 20)]
+    finally:
+        rt.stop_timers()
+        set_decisions(old_ring)
+
 
 def test_module_runtime_serves_query_over_self_samples(tmp_path):
     from apmbackend_tpu.runtime.module_base import ModuleRuntime
