@@ -23,7 +23,11 @@ boundary is exposed so a crash can land between them), crash (volatile
 state lost, durable checkpoint restored, broker redelivers), bounce
 (broker restart only: worker memory survives, ledger requeues), and
 chaos duplicate delivery (same payload+msg_id+token replayed — the
-ChaosChannel ``dup_p`` seam).
+ChaosChannel ``dup_p`` seam), and the broker outage cycle (ISSUE 15):
+``broker_down`` refuses every broker-touching action — the producer
+buffers upstream, acks park for retry — and ``reconnect`` requeues all
+unacked deliveries (the XAUTOCLAIM / AMQP connection-death path) before
+traffic resumes.
 
 Invariants (checked at EVERY reachable state):
 
@@ -44,7 +48,8 @@ can push a redelivered id out of the window before it is re-seen.
 Mutations (seeded protocol bugs — see mutations.py for the catalogue):
 ``ack_before_persist``, ``dup_ack_early`` (the real PR 3 bug),
 ``evict_on_persist``, ``skip_drain``, ``ack_on_failed_write``,
-``window_not_restored``, ``requeue_back``.
+``window_not_restored``, ``requeue_back``, ``reconnect_drops_unacked``
+(a reconnect that forgets the unacked ledger instead of redelivering it).
 """
 
 from __future__ import annotations
@@ -67,15 +72,20 @@ from typing import Iterator, Optional, Tuple
 # tokens:   current epoch's unacked tokens, sorted
 # to_ack:   tokens persisted-but-not-yet-acked (the commit→ack window)
 # crashes/bounces/dups/wfails: remaining fault budgets
+# downs:    remaining broker-outage budget (ISSUE 15 chaos tier)
+# down:     1 while the broker is dead: publish/deliver/dup/ack are all
+#           refused (the producer buffers upstream); reconnect requeues
+#           every unacked delivery exactly like a bounce
 S = namedtuple(
     "S",
     "sent queue ledger gen cursor ndeliv abeyond window pwindow pending "
-    "vol dur tokens to_ack crashes bounces dups wfails",
+    "vol dur tokens to_ack crashes bounces dups wfails downs down",
 )
 
 _MUTATIONS = frozenset({
     "ack_before_persist", "dup_ack_early", "evict_on_persist", "skip_drain",
     "ack_on_failed_write", "window_not_restored", "requeue_back",
+    "reconnect_drops_unacked",
 })
 
 
@@ -83,7 +93,8 @@ class AloModel:
     def __init__(self, *, kind: str = "memory", n_msgs: int = 3,
                  window: int = 2, prefetch: Optional[int] = None,
                  crashes: int = 1, bounces: int = 1, dups: int = 1,
-                 wfails: int = 0, mutations: Tuple[str, ...] = ()):
+                 wfails: int = 0, downs: int = 1,
+                 mutations: Tuple[str, ...] = ()):
         if kind not in ("memory", "amqp", "spool"):
             raise ValueError(f"unknown broker kind {kind!r}")
         bad = set(mutations) - _MUTATIONS
@@ -97,19 +108,23 @@ class AloModel:
         self.bounces = 0 if kind == "spool" else bounces
         self.dups = dups
         self.wfails = wfails if "ack_on_failed_write" in mutations else 0
+        # broker outage: the spool has no broker process to kill — the file
+        # IS the broker, and killing the consumer is already `crash`
+        self.downs = 0 if kind == "spool" else downs
         self.mut = frozenset(mutations)
         self.name = f"alo-{kind}" + (f"[{'+'.join(sorted(self.mut))}]" if self.mut else "")
         self.scope = {
             "broker": kind, "msgs": n_msgs, "window": window,
             "prefetch": self.prefetch, "crashes": crashes,
-            "bounces": self.bounces, "dups": dups,
+            "bounces": self.bounces, "dups": dups, "downs": self.downs,
         }
 
     # -- state helpers -------------------------------------------------------
     def initial(self) -> S:
         z = (0,) * self.n
         return S(0, (), (), 0, 0, 0, frozenset(), (), (), (), z, z, (), (),
-                 self.crashes, self.bounces, self.dups, self.wfails)
+                 self.crashes, self.bounces, self.dups, self.wfails,
+                 self.downs, 0)
 
     @staticmethod
     def _bump(vec: tuple, m: int) -> tuple:
@@ -171,8 +186,25 @@ class AloModel:
     # -- transition relation -------------------------------------------------
     def actions(self, s: S) -> Iterator[Tuple[str, S]]:
         out = []
+        # broker outage (ISSUE 15): while down, every broker-touching action
+        # (publish/deliver/dup/ack/bounce) is refused — send returns False
+        # and the producer buffers upstream, acks park for retry. The worker
+        # side (drain/commit/crash) keeps running.
+        if s.downs > 0 and not s.down:
+            out.append(("broker_down", s._replace(down=1, downs=s.downs - 1)))
+        if s.down:
+            # reconnect: the broker is back; everything unacked is
+            # redelivered (PEL idle-claim / AMQP requeue-on-connection-death
+            # — the same front-requeue a bounce performs). The seeded
+            # reconnect_drops_unacked mutant forgets the ledger instead:
+            # delivered-but-unacked messages silently settle (loss).
+            if "reconnect_drops_unacked" in self.mut:
+                ns = s._replace(ledger=(), gen=s.gen + 1, down=0)
+            else:
+                ns = self._requeue(s)._replace(down=0)
+            out.append(("reconnect", ns))
         # publish: producer stamps the next msg_id and sends
-        if s.sent < self.n:
+        if s.sent < self.n and not s.down:
             m = s.sent
             ns = s._replace(sent=s.sent + 1)
             if self.kind != "spool":
@@ -186,14 +218,14 @@ class AloModel:
                 m = s.ndeliv
                 ns = s._replace(ndeliv=s.ndeliv + 1)
                 out.append((f"deliver(m{m})", self._receive(ns, m, m)))
-        elif s.queue and len(s.ledger) < self.prefetch:
+        elif s.queue and len(s.ledger) < self.prefetch and not s.down:
             m, rest = s.queue[0], s.queue[1:]
             token = (s.gen, m)
             ns = s._replace(queue=rest, ledger=s.ledger + (token,))
             out.append((f"deliver(m{m})", self._receive(ns, m, token)))
 
         # chaos duplicate: replay an in-flight delivery (same msg_id+token)
-        if s.dups > 0:
+        if s.dups > 0 and not s.down:
             if self.kind == "spool":
                 inflight = [(i, i) for i in range(s.cursor, s.ndeliv)
                             if i not in s.abeyond]
@@ -236,8 +268,9 @@ class AloModel:
             ns = self._settle(ns, ns.tokens)._replace(tokens=())
             out.append(("commit[write-failed,ack]", ns))
 
-        # ack: commit the epoch's tokens on the broker
-        if s.to_ack:
+        # ack: commit the epoch's tokens on the broker (parked while down —
+        # the channel's pending-ack retry path)
+        if s.to_ack and not s.down:
             if self.kind == "amqp":
                 # marshalled basic_ack: one token per step (a crash can
                 # interleave a half-acked epoch)
@@ -258,10 +291,13 @@ class AloModel:
                 window=() if "window_not_restored" in self.mut else s.pwindow,
                 pending=(), tokens=(), to_ack=(),
             )
-            out.append(("crash+recover", self._requeue(ns)))
+            # crash during an outage: the broker can't requeue yet — the
+            # ledger survives on the (dead) broker and redelivery happens
+            # at reconnect instead
+            out.append(("crash+recover", ns if s.down else self._requeue(ns)))
 
         # bounce: broker restart, worker survives (stale tokens appear)
-        if s.bounces > 0:
+        if s.bounces > 0 and not s.down:
             out.append(("bounce", self._requeue(s._replace(bounces=s.bounces - 1))))
         return out
 
@@ -302,5 +338,6 @@ class AloModel:
         dur = "".join(str(c) for c in s.dur)
         tok = ",".join(self._tok(t) for t in s.tokens)
         ack = ",".join(self._tok(t) for t in s.to_ack)
-        return (f"sent={s.sent} {broker} win=[{win}] pwin=[{pwin}] "
+        down = " DOWN" if s.down else ""
+        return (f"sent={s.sent} {broker}{down} win=[{win}] pwin=[{pwin}] "
                 f"pend=[{pend}] vol={vol} dur={dur} tok=[{tok}] toack=[{ack}]")

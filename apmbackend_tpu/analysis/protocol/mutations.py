@@ -78,6 +78,16 @@ MUTANTS: Dict[str, Tuple[str, Callable[[], object]]] = {
         "re-seen (why transport/memory.py front-requeues)",
         lambda: AloModel(mutations=("requeue_back",)),
     ),
+    "alo-reconnect-drops-unacked": (
+        "the broker-outage reconnect forgets the unacked ledger instead of "
+        "redelivering it (a Redis group whose PEL is never XAUTOCLAIMed, "
+        "or an AMQP reconnect that drops the old connection's deliveries "
+        "on the floor) — a delivered-but-unacked message silently settles "
+        "with no durable effect: loss (why transport/redis_streams.py "
+        "claims idle pending on every pump, and transport/amqp.py requeues "
+        "on connection death)",
+        lambda: AloModel(mutations=("reconnect_drops_unacked",)),
+    ),
     "dc-compaction-gc-live-base": (
         "compaction GC deletes the previous base generation and its "
         "deltas immediately — a new base that later proves unreadable "

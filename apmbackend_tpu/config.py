@@ -236,11 +236,44 @@ def default_config() -> dict:
 _DEFAULT_CONFIG: dict = {
     "appDirectory": ".",
     "amqpConnectionString": "amqp://localhost:5672",
-    "brokerBackend": "memory",  # "memory" | "amqp"
+    "brokerBackend": "memory",  # "memory" | "amqp" | "redis" | "spool"
     # consumer prefetch for at-least-once (manual-ack) AMQP consumers: the
     # broker bound on in-flight unacked deliveries per connection — also the
     # worst-case redelivery span a dedup window must cover
     "amqpPrefetchCount": 1000,
+    # End-to-end flow control (transport/base.py, DESIGN.md §7.1): the
+    # producer pause buffer — what write_line holds while the broker refuses
+    # — is capped; past the cap the oldest lines are evicted under
+    # producerOverflowPolicy ("drop-oldest": counted loss, the at-least-once
+    # layer's dedup absorbs any overlap; "spill-spool": evictions land in a
+    # durable spool under spillDirectory for offline replay) and the episode
+    # degrades loudly (flight bundle + decision record + counter).
+    "transport": {
+        # broker selection override; None defers to top-level brokerBackend
+        # (kept for config compatibility with pre-ISSUE-15 deployments)
+        "broker": None,
+        "producerBufferMaxLines": 100000,  # 0 = legacy unbounded
+        "producerOverflowPolicy": "drop-oldest",  # | "spill-spool"
+        "spillDirectory": "spool/overflow",
+        # brokerBackend "spool": directory of the shared durable spool fabric
+        "spoolDirectory": "spool/broker",
+        # /healthz flow-control provider degrades when any producer buffer
+        # reaches this fraction of the cap (pages BEFORE eviction starts)
+        "producerBufferDegradedRatio": 0.8,
+    },
+    # Redis Streams backend (transport/redis_streams.py): consumer groups
+    # give manual-ack/redelivery via the PEL + XAUTOCLAIM; send refuses while
+    # the group backlog is at streamMaxlen (retention trims at 2x, so only
+    # the acked prefix is ever dropped). claimIdleMs is how long a delivery
+    # may sit unacked before another consumer may steal it — the redis
+    # analog of the AMQP redelivery-on-connection-death span.
+    "redis": {
+        "connectionString": "redis://localhost:6379/0",
+        "streamMaxlen": 100000,
+        "group": "apm",
+        "claimIdleMs": 5000,
+        "prefetchCount": 1000,
+    },
     "logDir": "logs",
     "statLogIntervalInSeconds": 60,
     "dbInsertQueue": "db_insert",

@@ -34,16 +34,26 @@ from ..transport.memory import MemoryBroker, MemoryChannel
 CONFIG_ENV_VAR = "APM_CONFIG"
 
 
-def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBroker] = None) -> QueueManager:
-    """QueueManager with the backend named by config ``brokerBackend``.
+def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBroker] = None,
+                       redis_module=None) -> QueueManager:
+    """QueueManager with the backend named by ``transport.broker`` (falling
+    back to the top-level ``brokerBackend`` for pre-ISSUE-15 configs).
 
     ``memory``: channels share one in-process :class:`MemoryBroker` (passed in
     for single-process pipelines, else created + pump-started here).
     ``amqp``: one pika connection per channel against ``amqpConnectionString``,
     mirroring the reference's one-connection-per-direction design
     (queue.js:73-78).
+    ``redis``: one Redis Streams channel per direction (consumer groups =
+    manual ack, XAUTOCLAIM = redelivery), pump-started; ``redis_module``
+    injects the in-process fake for serverless tests.
+    ``spool``: channels share one durable file-backed SpoolChannel fabric
+    under ``transport.spoolDirectory``, pump-started.
     """
-    backend = config.get("brokerBackend", "memory")
+    from ..transport import effective_broker_backend
+
+    backend = effective_broker_backend(config)
+    transport_cfg = config.get("transport", {}) or {}
     if backend == "memory":
         shared = broker or MemoryBroker()
         if broker is None:
@@ -62,9 +72,34 @@ def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBrok
         factory = lambda qtype: AmqpChannel(  # noqa: E731
             conn_str, direction=qtype, logger=logger, prefetch_count=prefetch
         )
+    elif backend == "redis":
+        from ..transport.redis_streams import RedisStreamsChannel
+
+        redis_cfg = config.get("redis", {}) or {}
+
+        def factory(_qtype):
+            ch = RedisStreamsChannel(
+                redis_cfg.get("connectionString", "redis://localhost:6379/0"),
+                redis_module=redis_module, logger=logger,
+                group=redis_cfg.get("group", "apm"),
+                stream_maxlen=redis_cfg.get("streamMaxlen", 100000),
+                claim_idle_ms=redis_cfg.get("claimIdleMs", 5000),
+                prefetch=redis_cfg.get("prefetchCount", 1000),
+            )
+            # the pump owns delivery, reconnect backoff, ack retry, AND
+            # producer-side drain detection (drain is polled, not pushed)
+            ch.start_pump_thread()
+            return ch
+    elif backend == "spool":
+        from ..transport.spool import SpoolChannel
+
+        shared_spool = SpoolChannel(transport_cfg.get("spoolDirectory", "spool/broker"))
+        shared_spool.start_pump_thread()
+        factory = lambda _qtype: shared_spool  # noqa: E731
     else:
         raise ValueError(f"Unknown brokerBackend: {backend!r}")
-    qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger)
+    qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger,
+                      transport_config=transport_cfg)
     return qm
 
 
@@ -94,6 +129,10 @@ class ModuleRuntime:
         log_dir = self.config.get("logDir")
         self.logger = get_logger(log_dir, prefix, console=console_log)
         self.qm = make_queue_manager(self.config, self.logger, broker=broker)
+        # producer-buffer overflow → flight bundle (rate-limited in the
+        # handler); registered before flight exists, gated inside
+        self._last_overflow_dump = 0.0
+        self.qm.on("overflow", self._on_producer_overflow)
         self._exit_handlers: List[Callable[[], None]] = []
         self._reload_handlers: List[Callable[[dict], None]] = []
         self._exiting = False
@@ -127,6 +166,10 @@ class ModuleRuntime:
         self._span_seen: set = set()
         self._span_order: deque = deque()
         self._decision_seen_total = 0
+        # serializes sample passes: the timer's immediate first fire can
+        # overlap a manual _self_sample() (tests, /query warmup) and the
+        # span/decision dedup state is read-modify-write
+        self._sample_lock = threading.Lock()
         obs_cfg = self.config.get("observability", {})
         if bool(obs_cfg.get("enabled", True)):
             from ..obs.views import register_queue_stats
@@ -149,6 +192,7 @@ class ModuleRuntime:
                     logger=self.logger,
                 )
                 self.telemetry.add_health("process", self._process_health)
+                self.telemetry.add_health("flow_control", self._flow_control_health)
                 self.telemetry.start()
                 # ephemeral-port discovery seam: a supervisor that asked for
                 # port 0 (fleet shards) learns the bound port from this file
@@ -241,7 +285,9 @@ class ModuleRuntime:
     def _self_sample(self) -> None:
         """Snapshot the process registry — plus spans/decisions not yet
         persisted — into the per-module store (the /query data feed). Runs
-        on its own timer thread; dedup state is only touched here."""
+        on its own timer thread; passes are serialized under _sample_lock
+        so a manual invocation racing the timer's immediate first fire
+        can never double-persist against a stale seen-counter."""
         from ..obs import get_registry
         from ..obs.decisions import get_decisions
         from ..obs.trace import get_tracer
@@ -249,32 +295,34 @@ class ModuleRuntime:
         store = self.store
         if store is None:
             return
-        now = time.time()
-        store.ingest_registry(get_registry(), ts=now)
-        fresh = []
-        for sp in get_tracer().ring.spans(n=256):
-            key = (sp.get("trace_id"), sp.get("name"), sp.get("start"))
-            if key in self._span_seen:
-                continue
-            self._span_seen.add(key)
-            self._span_order.append(key)
-            while len(self._span_order) > 4096:
-                self._span_seen.discard(self._span_order.popleft())
-            fresh.append(sp)
-        if fresh:
-            store.append_spans(fresh)
-        # one atomic (total, items) snapshot: a decision recorded after it
-        # is counted next pass, never double-persisted against a stale
-        # total. If more than the ring size arrived since the last pass the
-        # overflow is already gone from the ring either way — persist what
-        # survives and advance the seen-counter past the loss.
-        ring = get_decisions()
-        total, items = ring.snapshot(512)
-        new = total - self._decision_seen_total
-        if new > 0:
-            store.append_decisions(items[-new:] if new < len(items) else items)
-            self._decision_seen_total = total
-        store.compact(now)
+        with self._sample_lock:
+            now = time.time()
+            store.ingest_registry(get_registry(), ts=now)
+            fresh = []
+            for sp in get_tracer().ring.spans(n=256):
+                key = (sp.get("trace_id"), sp.get("name"), sp.get("start"))
+                if key in self._span_seen:
+                    continue
+                self._span_seen.add(key)
+                self._span_order.append(key)
+                while len(self._span_order) > 4096:
+                    self._span_seen.discard(self._span_order.popleft())
+                fresh.append(sp)
+            if fresh:
+                store.append_spans(fresh)
+            # one atomic (total, items) snapshot: a decision recorded after
+            # it is counted next pass, never double-persisted against a
+            # stale total. If more than the ring size arrived since the
+            # last pass the overflow is already gone from the ring either
+            # way — persist what survives and advance the seen-counter past
+            # the loss.
+            ring = get_decisions()
+            total, items = ring.snapshot(512)
+            new = total - self._decision_seen_total
+            if new > 0:
+                store.append_decisions(items[-new:] if new < len(items) else items)
+                self._decision_seen_total = total
+            store.compact(now)
 
     def _process_health(self) -> dict:
         """Baseline liveness every module reports: the process is serving,
@@ -293,6 +341,36 @@ class ModuleRuntime:
                 out["devices_error"] = repr(e)
                 out["ok"] = False
         return out
+
+    def _flow_control_health(self) -> dict:
+        """Producer pause-buffer pressure: /healthz degrades (503) once any
+        producer buffer reaches ``producerBufferDegradedRatio`` of the cap —
+        the page fires BEFORE eviction starts, while the operator can still
+        add consumers or raise the cap."""
+        transport_cfg = self.config.get("transport", {}) or {}
+        cap = int(transport_cfg.get("producerBufferMaxLines", 0) or 0)
+        ratio = float(transport_cfg.get("producerBufferDegradedRatio", 0.8) or 0.8)
+        buffers = self.qm.producer_buffer_counts()
+        worst = max(buffers.values(), default=0)
+        degraded = cap > 0 and worst >= cap * ratio
+        return {
+            "ok": not degraded,
+            "producer_buffer_lines": buffers,
+            "cap": cap,
+            "degraded_at": int(cap * ratio) if cap > 0 else None,
+        }
+
+    def _on_producer_overflow(self, queue_name: str, evicted: int) -> None:
+        """A producer buffer blew past its cap: capture a flight bundle
+        (rate-limited — a sustained overflow episode is one incident, not a
+        bundle per write_line)."""
+        if self.flight is None:
+            return
+        now = time.monotonic()
+        if now - self._last_overflow_dump < 30.0:
+            return
+        self._last_overflow_dump = now
+        self.flight.dump(f"producer-overflow-{queue_name}", force=True)
 
     # -- config hot reload (§5.6) --------------------------------------------
     def on_reload(self, handler: Callable[[dict], None]) -> None:

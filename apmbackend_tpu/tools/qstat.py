@@ -10,6 +10,14 @@ Three sources:
   count (needs broker reachability).
 - in-process memory broker: direct depth reads (standalone pipeline, tests).
 
+``--lag`` is the transport-generic view: ONE code path
+(``Channel.queue_lag`` per configured queue) instead of the per-backend
+special cases above — spool reads the durable directory's backlog, redis
+the consumer-group pending+undelivered count, AMQP a passive-declare
+message count on a dedicated observer connection; the process-local memory
+broker prints a pointer at ``--metrics-url`` instead of fake zeros
+presented as truth.
+
 Two history modes over the durable telemetry spine (DESIGN.md §8.4), both
 broker-credential-free:
 
@@ -62,6 +70,69 @@ def amqp_stats(connection_string: str, names: List[str]) -> List[Tuple[str, int,
             rows.append((name, -1, float("nan")))
     conn.close()
     return rows
+
+
+def make_lag_observer(config: dict, *, redis_module=None, pika_module=None):
+    """Build the read-only observer channel behind ``qstat --lag``: one
+    per-backend constructor here, then ONE shared read path — every backend
+    answers through ``Channel.queue_lag`` (``lag_rows``), instead of the
+    per-backend special cases the depth view grew. Returns
+    ``(channel, warning)``; a ``None`` channel means the backend has no
+    out-of-process lag view (memory) and the warning says what to do."""
+    from ..transport import effective_broker_backend
+
+    backend = effective_broker_backend(config)
+    transport_cfg = config.get("transport", {}) or {}
+    if backend == "memory":
+        return None, (
+            "memory broker is process-local: a fresh observer sees an empty "
+            "broker; use --metrics-url against the pipeline's telemetry "
+            "exporter (apm_queue_lag) for live lag"
+        )
+    if backend == "spool":
+        from ..transport.spool import SpoolChannel
+
+        return SpoolChannel(transport_cfg.get("spoolDirectory", "spool/broker")), None
+    if backend == "redis":
+        from ..transport.redis_streams import RedisStreamsChannel
+
+        redis_cfg = config.get("redis", {}) or {}
+        return (
+            RedisStreamsChannel(
+                redis_cfg.get("connectionString", "redis://localhost:6379/0"),
+                redis_module=redis_module,
+                group=redis_cfg.get("group", "apm"),
+            ),
+            None,
+        )
+    if backend == "amqp":
+        from ..transport.amqp import AmqpChannel
+
+        return (
+            AmqpChannel(
+                config.get("amqpConnectionString", "amqp://localhost:5672"),
+                direction="p",
+                pika_module=pika_module,
+            ),
+            None,
+        )
+    raise ValueError(f"Unknown brokerBackend: {backend}")
+
+
+def lag_rows(channel, names: List[str]) -> List[Tuple[str, int]]:
+    """The transport-generic lag read: depth + unacked backlog the consumer
+    side still owes, per queue, through the uniform ``queue_lag`` contract.
+    Disconnected backends read 0 by contract rather than raising — a CLI
+    probe against a dead broker reports zeros plus whatever the backend
+    logs, not a stack trace."""
+    return [(name, int(channel.queue_lag(name))) for name in names]
+
+
+def format_lag_rows(rows: List[Tuple[str, int]]) -> str:
+    lines = [f"{'queue':<20} {'lag':>10}"]
+    for name, lag in rows:
+        lines.append(f"{name:<20} {lag:>10}")
+    return "\n".join(lines)
 
 
 def format_rows(rows: List[Tuple[str, int, float]]) -> str:
@@ -301,6 +372,12 @@ def main(argv=None) -> int:
     ap.add_argument("--at", type=float,
                     help="--slo evaluation instant (default: newest stored "
                     "sample)")
+    ap.add_argument("--lag", action="store_true",
+                    help="per-queue lag (depth + unacked backlog) through the "
+                    "transport-generic queue_lag contract — spool reads the "
+                    "durable directory, redis the consumer-group backlog, "
+                    "amqp a passive declare; memory is process-local "
+                    "(use --metrics-url)")
     args = ap.parse_args(argv)
     config = load_config(args.config) if args.config else default_config()
     if args.range_expr:
@@ -346,6 +423,23 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"slo evaluation failed: {e}", file=sys.stderr)
             return 1
+        return 0
+    if args.lag:
+        try:
+            channel, warning = make_lag_observer(config)
+        except (RuntimeError, ValueError) as e:
+            print(f"lag observer failed: {e}", file=sys.stderr)
+            return 1
+        if channel is None:
+            print(warning, file=sys.stderr)
+            print(format_lag_rows([(n, 0) for n in known_queue_names(config)]))
+            return 0
+        try:
+            print(format_lag_rows(lag_rows(channel, known_queue_names(config))))
+        finally:
+            close = getattr(channel, "close", None)
+            if close is not None:
+                close()
         return 0
     if args.metrics_url:
         try:

@@ -2,24 +2,38 @@ from .base import Channel, ConsumerQueue, EventEmitter, ProducerQueue, QueueMana
 from .memory import MemoryBroker, MemoryChannel  # noqa: F401
 from .amqp import AmqpChannel, HAVE_PIKA  # noqa: F401
 from .spool import SpoolChannel, read_spool_cursor  # noqa: F401
+from .redis_streams import HAVE_REDIS, RedisStreamsChannel  # noqa: F401
 
 
-def make_queue_manager(config: dict, *, broker=None, logger=None) -> QueueManager:
+def effective_broker_backend(config: dict) -> str:
+    """Broker selection: ``transport.broker`` wins when set, else the
+    top-level ``brokerBackend`` (kept for pre-ISSUE-15 configs)."""
+    transport_cfg = config.get("transport", {}) or {}
+    return transport_cfg.get("broker") or config.get("brokerBackend", "memory")
+
+
+def make_queue_manager(config: dict, *, broker=None, logger=None,
+                       redis_module=None) -> QueueManager:
     """Build a QueueManager for the configured backend.
 
     ``brokerBackend: "memory"`` shares the passed (or a fresh) MemoryBroker
     between the producer and consumer channels; ``"amqp"`` connects to
-    ``amqpConnectionString`` per channel like the reference (queue.js:120-137).
+    ``amqpConnectionString`` per channel like the reference
+    (queue.js:120-137); ``"redis"`` builds one RedisStreamsChannel per
+    direction from the ``redis`` section (``redis_module`` injects the
+    in-process fake); ``"spool"`` shares one durable SpoolChannel fabric
+    under ``transport.spoolDirectory``.
     """
-    backend = config.get("brokerBackend", "memory")
+    backend = effective_broker_backend(config)
     interval = config.get("statLogIntervalInSeconds", 60)
+    transport_cfg = config.get("transport", {}) or {}
     if backend == "memory":
         shared = broker if broker is not None else MemoryBroker()
 
         def factory(_kind: str):
             return MemoryChannel(shared)
 
-        qm = QueueManager(factory, interval, logger=logger)
+        qm = QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
         qm.broker = shared
         return qm
     if backend == "amqp":
@@ -28,5 +42,29 @@ def make_queue_manager(config: dict, *, broker=None, logger=None) -> QueueManage
         def factory(kind: str):
             return AmqpChannel(conn, direction=kind, logger=logger)
 
-        return QueueManager(factory, interval, logger=logger)
+        return QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
+    if backend == "redis":
+        redis_cfg = config.get("redis", {}) or {}
+
+        def factory(_kind: str):
+            ch = RedisStreamsChannel(
+                redis_cfg.get("connectionString", "redis://localhost:6379/0"),
+                redis_module=redis_module, logger=logger,
+                group=redis_cfg.get("group", "apm"),
+                stream_maxlen=redis_cfg.get("streamMaxlen", 100000),
+                claim_idle_ms=redis_cfg.get("claimIdleMs", 5000),
+                prefetch=redis_cfg.get("prefetchCount", 1000),
+            )
+            return ch
+
+        return QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
+    if backend == "spool":
+        shared_spool = SpoolChannel(transport_cfg.get("spoolDirectory", "spool/broker"))
+
+        def factory(_kind: str):
+            return shared_spool
+
+        qm = QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
+        qm.spool = shared_spool
+        return qm
     raise ValueError(f"Unknown brokerBackend: {backend}")

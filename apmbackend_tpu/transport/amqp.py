@@ -123,6 +123,14 @@ class AmqpChannel(Channel):
         self._consumers: Dict[str, Tuple[str, Callable[[bytes], None], bool]] = {}  # guarded-by: _lock
         self._conn_gen = 0  # guarded-by: _lock
 
+        # lag observer: a dedicated short-lived connection for passive
+        # declares (queue_lag), so scrape-time reads never touch the
+        # publisher/consumer threads' links
+        self._lag_lock = threading.Lock()
+        self._lag_conn = None  # guarded-by: _lag_lock
+        self._lag_ch = None  # guarded-by: _lag_lock
+        self._lag_cache: Dict[str, Tuple[float, int]] = {}  # guarded-by: _lag_lock
+
         target = self._publisher_loop if direction == "p" else self._consumer_loop
         self._thread = threading.Thread(
             target=target, name=f"amqp-{direction}", daemon=True
@@ -202,6 +210,8 @@ class AmqpChannel(Channel):
                 )
         self._stop.set()
         self._thread.join(timeout=5.0)
+        with self._lag_lock:
+            self._drop_lag_observer_locked()
 
     # -- introspection (qstat / tests) ---------------------------------------
     @property
@@ -211,6 +221,45 @@ class AmqpChannel(Channel):
     @property
     def outbound_depth(self) -> int:
         return self._out.qsize()
+
+    _LAG_TTL_S = 5.0
+
+    def queue_lag(self, name: str) -> int:
+        """Ready-message depth via a passive declare on a dedicated observer
+        connection — the transport-generic lag read behind ``qstat --lag``
+        and the scrape-time ``apm_queue_lag`` gauge. Cached for ``_LAG_TTL_S``
+        so a tight scrape loop costs one broker round-trip per queue per
+        window. Never raises: while the broker is unreachable (or the queue
+        does not exist yet) lag is unknowable and reads 0, matching the
+        redis backend's disconnected contract. A passive declare cannot see
+        unacked in-flight deliveries, so AMQP lag under-counts that window
+        — the depth the broker still HOLDS, not the depth the consumer owes."""
+        now = time.monotonic()
+        with self._lag_lock:
+            hit = self._lag_cache.get(name)
+            if hit is not None and now - hit[0] < self._LAG_TTL_S:
+                return hit[1]
+            try:
+                if self._lag_ch is None or not getattr(self._lag_ch, "is_open", True):
+                    self._drop_lag_observer_locked()
+                    self._lag_conn, self._lag_ch = self._connect()
+                ok = self._lag_ch.queue_declare(queue=name, durable=True, passive=True)
+                lag = int(ok.method.message_count)
+            except Exception:
+                # a passive declare on a missing queue closes the channel and a
+                # dead broker raises — either way drop the observer link (it is
+                # rebuilt on the next expired read) and report 0
+                self._drop_lag_observer_locked()
+                lag = 0
+            self._lag_cache[name] = (now, lag)
+            return lag
+
+    # apm: holds(_lag_lock): tears down the observer connection pair
+    def _drop_lag_observer_locked(self) -> None:
+        if self._lag_conn is not None:
+            self._close_quietly(self._lag_conn)
+        self._lag_conn = None
+        self._lag_ch = None
 
     # -- publisher thread ----------------------------------------------------
     def _on_blocked(self, *_args) -> None:

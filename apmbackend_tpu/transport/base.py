@@ -113,7 +113,8 @@ class Channel:
 
 
 class ProducerQueue(EventEmitter):
-    def __init__(self, queue_name: str, channel: Channel, queue_stats: QueueStats, logger=None):
+    def __init__(self, queue_name: str, channel: Channel, queue_stats: QueueStats, logger=None,
+                 transport_cfg: Optional[dict] = None):
         super().__init__()
         self.queue_name = queue_name
         self.channel = channel
@@ -125,6 +126,19 @@ class ProducerQueue(EventEmitter):
         self.paused = False  # guarded-by: _lock
         self.type = "p"
         self._lock = threading.Lock()
+        # flow-control cap on the pause buffer: an unbounded buffer turns a
+        # stalled consumer into a producer OOM. 0 keeps the legacy unbounded
+        # behavior; past the cap the OLDEST buffered lines are evicted under
+        # the configured policy — counted drop (the stream self-heals via
+        # redelivery/dedup upstream) or spill to a durable spool — and the
+        # episode degrades loudly (error log + decision record + "overflow"
+        # event the runtime turns into a flight bundle), never silently.
+        transport_cfg = transport_cfg or {}
+        self.buffer_max_lines = int(transport_cfg.get("producerBufferMaxLines", 0) or 0)
+        self.overflow_policy = str(transport_cfg.get("producerOverflowPolicy", "drop-oldest"))
+        self._spill_dir = transport_cfg.get("spillDirectory") or "spool/overflow"
+        self._spill: Optional[Channel] = None  # guarded-by: _lock
+        self._overflow_note = 0  # guarded-by: _lock (evictions not yet reported)
         # message-id stamp for at-least-once consumers: unique across
         # producers and producer restarts (redelivered messages carry the
         # ORIGINAL id — the broker retains headers — so consumers dedup on
@@ -147,6 +161,22 @@ class ProducerQueue(EventEmitter):
         from ..obs.trace import get_tracer
 
         self._tracer = get_tracer()
+        from ..obs import get_registry
+
+        # buffer depth is the flow-control health signal: the runtime's
+        # /healthz degrades when it nears the cap, and the SLO engine can
+        # burn against it like any other gauge series
+        get_registry().gauge(
+            "apm_producer_buffer_lines",
+            "Lines held in the producer pause buffer (waiting for drain)",
+            labels={"queue": queue_name},
+        ).set_fn(lambda: float(self.buffer_count()))
+        self._overflow_counter = get_registry().counter(
+            "apm_producer_buffer_overflow_total",
+            "Buffered lines evicted past producerBufferMaxLines "
+            "(dropped or spilled per producerOverflowPolicy)",
+            labels={"queue": queue_name},
+        )
         self.queue_stats.add_counter(queue_name, "p")
         channel.assert_queue(queue_name)
 
@@ -170,6 +200,7 @@ class ProducerQueue(EventEmitter):
                 self.buffer.insert(0, (line, headers))
             else:
                 self.buffer.append((line, headers))
+            self._enforce_cap_locked()
             return False
         ok = self.channel.send(self.queue_name, line.encode("utf-8"), headers)
         if not ok:
@@ -177,12 +208,55 @@ class ProducerQueue(EventEmitter):
                 self.buffer.insert(0, (line, headers))
             else:
                 self.buffer.append((line, headers))
+            self._enforce_cap_locked()
             self.paused = True
             return True
         if verbose and self.logger:
             self.logger.info(f"QUEUE: {self.queue_name} ::: {line}")
         self.queue_stats.incr(self.queue_name)
         return False
+
+    # apm: holds(_lock): called from _send_locked right after a buffer append
+    def _enforce_cap_locked(self) -> None:
+        """Evict past ``producerBufferMaxLines`` — oldest first, so the
+        buffer keeps the most recent window of the stream (the same choice
+        every bounded telemetry ring in the repo makes). Reporting is
+        deferred to ``_note_overflow`` outside the lock."""
+        if self.buffer_max_lines <= 0:
+            return
+        while len(self.buffer) > self.buffer_max_lines:
+            old_line, old_headers = self.buffer.pop(0)
+            self._overflow_counter.inc()
+            if self.overflow_policy == "spill-spool":
+                if self._spill is None:
+                    from .spool import SpoolChannel
+
+                    self._spill = SpoolChannel(self._spill_dir)
+                    self._spill.assert_queue(self.queue_name)
+                self._spill.send(self.queue_name, old_line.encode("utf-8"), old_headers)
+            self._overflow_note += 1
+
+    def _note_overflow(self, evicted: int) -> None:
+        """Loud degradation, outside the lock: error log + decision record
+        (replayable provenance for the page) + an ``overflow`` event the
+        QueueManager forwards so the runtime can dump a flight bundle."""
+        action = "spilled" if self.overflow_policy == "spill-spool" else "dropped"
+        if self.logger:
+            self.logger.error(
+                f"--- PRODUCER BUFFER OVERFLOW (Q={self.queue_name}) --- "
+                f"{action} {evicted} oldest buffered lines (cap={self.buffer_max_lines})"
+            )
+        from ..obs.decisions import get_decisions
+
+        get_decisions().record({
+            "kind": "producer_buffer_overflow",
+            "queue": self.queue_name,
+            "policy": self.overflow_policy,
+            "evicted": evicted,
+            "cap": self.buffer_max_lines,
+            "ts": time.time(),
+        })
+        self.emit("overflow", self.queue_name, evicted)
 
     def write_line(self, line: str, verbose: bool = False) -> None:
         # the transport-entry stamp: every message carries when it entered
@@ -213,6 +287,9 @@ class ProducerQueue(EventEmitter):
                     queue=self.queue_name,
                 )
             entered_pause = self._send_locked(line, headers, verbose)
+            overflowed, self._overflow_note = self._overflow_note, 0
+        if overflowed:
+            self._note_overflow(overflowed)
         if entered_pause:
             if self.logger:
                 self.logger.info(
@@ -356,17 +433,29 @@ class QueueManager(EventEmitter):
 
     pause/resume propagation (queue.js:67-189)."""
 
-    def __init__(self, backend_factory: Callable[[str], Channel], stat_log_interval_s: int = 60, logger=None):
+    def __init__(self, backend_factory: Callable[[str], Channel], stat_log_interval_s: int = 60, logger=None,
+                 transport_config: Optional[dict] = None):
         super().__init__()
         self._backend_factory = backend_factory
         self.queue_stats = QueueStats(stat_log_interval_s, logger=logger)
         self.logger = logger
+        # the `transport` config section (producer buffer cap + overflow
+        # policy), handed to every ProducerQueue this manager creates
+        self.transport_cfg = transport_config or {}
         self.producer_channel: Optional[Channel] = None
         self.consumer_channel: Optional[Channel] = None
         self.queue_map: Dict[str, object] = {}
 
     def set_interval(self, interval_s: int) -> None:
         self.queue_stats.set_interval(interval_s)
+
+    def producer_buffer_counts(self) -> Dict[str, int]:
+        """{queue: buffered line count} across producers — the /healthz
+        flow-control provider's input."""
+        return {
+            name: q.buffer_count()
+            for name, q in self.queue_map.items() if q.type == "p"
+        }
 
     def retry_all_queue_buffers(self) -> None:
         for queue in self.queue_map.values():
@@ -388,8 +477,10 @@ class QueueManager(EventEmitter):
             if self.producer_channel is None:
                 self.producer_channel = self._backend_factory("p")
                 self.producer_channel.on_drain(self._on_drain)
-            queue = ProducerQueue(queue_name, self.producer_channel, self.queue_stats, self.logger)
+            queue = ProducerQueue(queue_name, self.producer_channel, self.queue_stats, self.logger,
+                                  transport_cfg=self.transport_cfg)
             queue.on("pause", lambda: self.emit("pause"))
+            queue.on("overflow", lambda *a: self.emit("overflow", *a))
         else:
             if self.consumer_channel is None:
                 self.consumer_channel = self._backend_factory("c")

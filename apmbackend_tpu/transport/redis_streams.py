@@ -1,0 +1,452 @@
+"""Redis Streams transport backend — consumer-group at-least-once fabric.
+
+The DAQ swap the roadmap names (PAPERS.md, arxiv 2511.14894): Redis
+Streams' consumer groups map 1:1 onto the manual-ack Channel contract —
+XREADGROUP ``">"`` delivers and records each entry in the group's pending
+entries list (PEL), XACK commits, and XAUTOCLAIM is the redelivery path:
+entries a dead or stalled consumer left pending are re-claimed once idle
+longer than ``claim_idle_ms`` and re-delivered with
+``headers["redelivered"]`` set, exactly like a broker bounce on the
+memory backend or AMQP connection death.
+
+Flow control is send-side and explicit: Redis itself never refuses an
+XADD — ``MAXLEN`` trimming silently deletes the OLDEST entries instead,
+which under a stalled consumer is data loss, not backpressure. So
+``send`` refuses (returns False → ProducerQueue buffers + pause) while
+the group backlog (PEL pending + undelivered lag) is at
+``stream_maxlen``, and the retention trim rides far behind at
+``2 * stream_maxlen`` (approximate) so it only ever eats the acked
+prefix. Drain fires when the backlog falls to half the cap.
+
+Durability class: bounded-loss durable — entries survive broker restart
+(RDB/AOF) and consumer crashes (PEL + XAUTOCLAIM), but retention trimming
+caps history at ``2 * stream_maxlen`` per stream; XAUTOCLAIM surfaces any
+entry trimmed out from under the PEL in its *deleted* list and the
+channel counts those loudly rather than hiding them.
+
+Connection loss is absorbed the same way fullness is: ``send`` returns
+False (the producer buffers upstream under its own cap), acks park in a
+retry list (XACK is idempotent, so retrying after reconnect is safe), and
+the pump thread reconnects with decorrelated-jitter backoff.
+
+The redis-py client is optional exactly like pika: ``redis_module``
+injects an in-process fake (tests/fake_redis.py) so tier-1 never needs a
+server; real-server tests auto-skip when nothing listens.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .base import Channel, accepts_headers
+
+try:  # pragma: no cover - exercised only where redis-py is installed
+    import redis as _redis  # type: ignore
+
+    HAVE_REDIS = True
+except Exception:  # pragma: no cover
+    _redis = None
+    HAVE_REDIS = False
+
+
+def _s(x) -> str:
+    """redis-py (decode_responses=False) hands back bytes; fakes hand str."""
+    return x.decode("utf-8") if isinstance(x, (bytes, bytearray)) else str(x)
+
+
+def _field(fields: dict, key: str):
+    """Field lookup tolerant of bytes keys (real client) and str (fake)."""
+    if key in fields:
+        return fields[key]
+    return fields.get(key.encode("utf-8"))
+
+
+class RedisStreamsChannel(Channel):
+    """Channel over Redis Streams consumer groups (DESIGN.md §7.1).
+
+    One channel serves either direction: producers only ``send``, consumers
+    only ``consume``/``deliver``. Delivery is pumped (``deliver()`` /
+    ``start_pump_thread``) like the memory broker and the spool; the pump
+    thread also owns reconnect, ack retry, and drain detection, so a
+    producer-side channel needs it too (drain is observed by polling the
+    group backlog, not pushed by the broker).
+    """
+
+    def __init__(
+        self,
+        connection_string: str = "redis://localhost:6379/0",
+        *,
+        redis_module=None,
+        logger=None,
+        group: str = "apm",
+        consumer_name: Optional[str] = None,
+        stream_maxlen: int = 100000,
+        claim_idle_ms: int = 5000,
+        prefetch: int = 1000,
+        reconnect_base_backoff_s: float = 0.05,
+        reconnect_max_backoff_s: float = 2.0,
+        jitter_rng=None,
+    ):
+        mod = redis_module if redis_module is not None else _redis
+        if mod is None:
+            raise RuntimeError(
+                "redis-py is not installed and no redis_module fake was "
+                "injected — RedisStreamsChannel needs one or the other")
+        self._mod = mod
+        self._conn_errors = (mod.exceptions.ConnectionError, OSError)
+        self._resp_error = mod.exceptions.ResponseError
+        self.connection_string = connection_string
+        self.logger = logger
+        self.group = group
+        self.consumer_name = consumer_name or f"c-{id(self):x}"
+        self.stream_maxlen = int(stream_maxlen)
+        self.claim_idle_ms = int(claim_idle_ms)
+        self.prefetch = int(prefetch)
+        self._lock = threading.RLock()
+        self._cli = None  # guarded-by: _lock
+        self._queues: Set[str] = set()  # guarded-by: _lock
+        # queue -> (tag, callback, manual) — one consumer per queue, like spool
+        self._consumers: Dict[str, Tuple[str, Callable, bool]] = {}  # guarded-by: _lock
+        self._groups_ready: Set[str] = set()  # guarded-by: _lock
+        self._unacked: Set[Tuple[str, str]] = set()  # guarded-by: _lock
+        self._pending_acks: List[Tuple[str, str]] = []  # guarded-by: _lock
+        self._pressure = False  # guarded-by: _lock
+        self._pressured: Set[str] = set()  # guarded-by: _lock
+        self._next_connect_at = 0.0  # guarded-by: _lock
+        self._backoff_s = reconnect_base_backoff_s  # guarded-by: _lock
+        self._base_backoff_s = reconnect_base_backoff_s
+        self._max_backoff_s = reconnect_max_backoff_s
+        if jitter_rng is None:
+            import random
+
+            jitter_rng = random.Random()
+        self._rng = jitter_rng
+        self.deleted_count = 0  # guarded-by: _lock (PEL entries lost to trim)
+        self._drain_cbs: List[Callable[[], None]] = []
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- connection ----------------------------------------------------------
+    # apm: holds(_lock): every caller acquires it (send, deliver, ack, pump)
+    def _ensure_client_locked(self):
+        """Caller holds self._lock. Returns a live client or raises one of
+        ``self._conn_errors`` (respecting the reconnect backoff window)."""
+        if self._cli is not None:
+            return self._cli
+        now = time.monotonic()
+        if now < self._next_connect_at:
+            raise self._conn_errors[0]("reconnect backoff in effect")
+        try:
+            cli = self._mod.Redis.from_url(self.connection_string)
+            cli.ping()
+        except self._conn_errors:
+            # decorrelated jitter (same policy as AmqpChannel._next_backoff):
+            # spreads a fleet's reconnect herd after a broker restart
+            self._backoff_s = min(
+                self._max_backoff_s,
+                self._rng.uniform(self._base_backoff_s,
+                                  max(self._backoff_s * 3, self._base_backoff_s)))
+            self._next_connect_at = now + self._backoff_s
+            raise
+        self._cli = cli
+        self._backoff_s = self._base_backoff_s
+        self._next_connect_at = 0.0
+        return cli
+
+    # apm: holds(_lock): callers are the op paths that just caught a conn error
+    def _drop_client_locked(self, err: Exception) -> None:
+        if self._cli is not None and self.logger:
+            self.logger.error(f"redis connection lost: {err}")
+        self._cli = None
+        # a restarted server without persistence may have lost the groups;
+        # re-creating is one idempotent XGROUP CREATE per queue (BUSYGROUP
+        # swallowed), so re-learn them after every reconnect
+        self._groups_ready.clear()
+
+    # apm: holds(_lock): group bookkeeping is shared consumer state
+    def _ensure_group_locked(self, cli, name: str) -> None:
+        if name in self._groups_ready:
+            return
+        try:
+            # id="0": a group created after the producer already streamed
+            # entries must still see them ("$" would skip the backlog)
+            cli.xgroup_create(name, self.group, id="0", mkstream=True)
+        except self._resp_error as e:
+            if "BUSYGROUP" not in str(e):
+                raise
+        self._groups_ready.add(name)
+
+    # apm: holds(_lock): reads shared group bookkeeping
+    def _backlog_locked(self, cli, name: str) -> int:
+        """Messages this channel's group still owes: PEL pending + entries
+        never delivered (lag). Before any group exists (no consumer started
+        anywhere yet) the whole stream is backlog."""
+        infos = cli.xinfo_groups(name)
+        for info in infos:
+            if _s(info.get("name")) == self.group:
+                return int(info.get("pending", 0)) + int(info.get("lag", 0) or 0)
+        return int(cli.xlen(name))
+
+    # -- Channel contract ----------------------------------------------------
+    def assert_queue(self, name: str) -> None:
+        with self._lock:
+            self._queues.add(name)
+
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        fields = {"p": payload, "h": json.dumps(headers or {})}
+        with self._lock:
+            try:
+                cli = self._ensure_client_locked()
+                if self._backlog_locked(cli, name) >= self.stream_maxlen:
+                    # Redis never refuses an XADD — MAXLEN trim would eat the
+                    # oldest entries instead. Refuse HERE so the overload
+                    # surfaces as producer pause, not silent loss.
+                    self._pressure = True
+                    self._pressured.add(name)
+                    return False
+                # retention trim rides at 2x the refusal cap: with sends
+                # refused at stream_maxlen backlog, trimming only ever
+                # removes the acked prefix
+                cli.xadd(name, fields, maxlen=self.stream_maxlen * 2,
+                         approximate=True)
+                return True
+            except self._conn_errors as e:
+                # connection loss looks like fullness to the producer: it
+                # buffers under its own cap and waits for the drain event
+                self._drop_client_locked(e)
+                self._pressure = True
+                self._pressured.add(name)
+                return False
+
+    def consume(self, name: str, callback: Callable, consumer_tag: str,
+                manual_ack: bool = False) -> None:
+        if not manual_ack and not accepts_headers(callback):
+            inner = callback
+            callback = lambda payload, _h=None, _cb=inner: _cb(payload)  # noqa: E731
+        with self._lock:
+            self._queues.add(name)
+            self._consumers[name] = (consumer_tag, callback, manual_ack)
+
+    def cancel(self, consumer_tag: str) -> None:
+        with self._lock:
+            self._consumers = {
+                q: c for q, c in self._consumers.items() if c[0] != consumer_tag
+            }
+
+    def ack(self, tokens) -> None:
+        per_queue: Dict[str, List[str]] = defaultdict(list)
+        for name, entry_id in tokens:
+            per_queue[name].append(entry_id)
+        with self._lock:
+            for name, ids in per_queue.items():
+                self._ack_ids_locked(name, ids)
+            fire = self._drain_ready_locked()
+        if fire:
+            self._fire_drain()
+
+    # apm: holds(_lock): mutates the unacked ledger and the ack-retry list
+    def _ack_ids_locked(self, name: str, ids: List[str]) -> None:
+        try:
+            cli = self._ensure_client_locked()
+            cli.xack(name, self.group, *ids)
+            for entry_id in ids:
+                self._unacked.discard((name, entry_id))
+        except self._conn_errors as e:
+            # XACK is idempotent: park the tokens and retry after reconnect.
+            # They stay on _unacked too, so prefetch keeps gating deliveries
+            # until the broker really confirmed the commit.
+            self._drop_client_locked(e)
+            self._pending_acks.extend((name, entry_id) for entry_id in ids)
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        self._drain_cbs.append(callback)
+
+    def close(self) -> None:
+        self.stop()
+        with self._lock:
+            self._retry_pending_acks_locked()
+            if self._cli is not None:
+                try:
+                    self._cli.close()
+                except Exception:
+                    pass
+                self._cli = None
+
+    # -- delivery ------------------------------------------------------------
+    def deliver(self, max_messages: Optional[int] = None) -> int:
+        """One delivery pass: XAUTOCLAIM idle pending (redelivery), then
+        XREADGROUP new entries; invokes callbacks outside the lock."""
+        delivered = 0
+        while max_messages is None or delivered < max_messages:
+            batch = self._collect_batch(
+                None if max_messages is None else max_messages - delivered)
+            if not batch:
+                break
+            to_ack: Dict[str, List[str]] = defaultdict(list)
+            for cb, payload, headers, manual, token in batch:
+                if manual:
+                    cb(payload, headers, token)
+                else:
+                    cb(payload, headers)
+                    to_ack[token[0]].append(token[1])
+                delivered += 1
+            if to_ack:
+                with self._lock:
+                    for name, ids in to_ack.items():
+                        self._ack_ids_locked(name, ids)
+        with self._lock:
+            fire = self._drain_ready_locked()
+        if fire:
+            self._fire_drain()
+        return delivered
+
+    def _collect_batch(self, limit: Optional[int]):
+        out = []
+        with self._lock:
+            try:
+                cli = self._ensure_client_locked()
+            except self._conn_errors:
+                return out
+            for name, (tag, cb, manual) in list(self._consumers.items()):
+                budget = self.prefetch - len(self._unacked) if manual else 256
+                if limit is not None:
+                    budget = min(budget, limit - len(out))
+                if budget <= 0:
+                    continue
+                try:
+                    self._ensure_group_locked(cli, name)
+                    entries = self._claim_locked(cli, name, budget)
+                    got = len(entries)
+                    if got < budget:
+                        resp = cli.xreadgroup(
+                            self.group, self.consumer_name, {name: ">"},
+                            count=budget - got)
+                        for _stream, fresh in resp or []:
+                            entries.extend((eid, fields, False)
+                                           for eid, fields in fresh)
+                except self._conn_errors as e:
+                    self._drop_client_locked(e)
+                    return out
+                for entry_id, fields, reclaimed in entries:
+                    payload = _field(fields, "p") or b""
+                    if not isinstance(payload, (bytes, bytearray)):
+                        payload = str(payload).encode("utf-8")
+                    try:
+                        headers = json.loads(_s(_field(fields, "h") or "{}"))
+                    except Exception:
+                        headers = {}
+                    if reclaimed:
+                        # the crash-redelivery hop, same flag as a memory
+                        # bounce, an AMQP connection death, or a spool boot
+                        headers["redelivered"] = True
+                    token = (name, _s(entry_id))
+                    if manual:
+                        self._unacked.add(token)
+                    out.append((cb, bytes(payload), headers, manual, token))
+        return out
+
+    # apm: holds(_lock): walks the shared unacked ledger
+    def _claim_locked(self, cli, name: str, budget: int):
+        """Idle-PEL redelivery. Entries trimmed out from under the PEL come
+        back in XAUTOCLAIM's deleted list — count them loudly (the loss a
+        too-small stream_maxlen buys) instead of silently shrinking."""
+        _next, claimed, deleted = cli.xautoclaim(
+            name, self.group, self.consumer_name, self.claim_idle_ms,
+            start_id="0-0", count=budget)
+        if deleted:
+            self.deleted_count += len(deleted)
+            for entry_id in deleted:
+                self._unacked.discard((name, _s(entry_id)))
+            if self.logger:
+                self.logger.error(
+                    f"redis trimmed {len(deleted)} unacked entries on "
+                    f"'{name}' — stream_maxlen is too small for this backlog")
+        return [(eid, fields, True) for eid, fields in claimed]
+
+    # apm: holds(_lock): drains the shared ack-retry list
+    def _retry_pending_acks_locked(self) -> None:
+        if not self._pending_acks:
+            return
+        pending, self._pending_acks = self._pending_acks, []
+        per_queue: Dict[str, List[str]] = defaultdict(list)
+        for name, entry_id in pending:
+            per_queue[name].append(entry_id)
+        for name, ids in per_queue.items():
+            self._ack_ids_locked(name, ids)
+
+    # apm: holds(_lock): reads/clears the shared pressure flags
+    def _drain_ready_locked(self) -> bool:
+        """True when pressure just cleared. The caller fires the drain
+        callbacks AFTER releasing ``_lock`` — a drain callback re-enters
+        ``ProducerQueue._lock``, and write_line takes those two locks in the
+        opposite order, so firing under ``_lock`` would deadlock (the memory
+        broker's ``_maybe_drain`` makes the same split)."""
+        if not self._pressure or self._cli is None:
+            return False
+        low_water = max(1, self.stream_maxlen // 2)
+        try:
+            for name in self._pressured:
+                if self._backlog_locked(self._cli, name) > low_water:
+                    return False
+        except self._conn_errors as e:
+            self._drop_client_locked(e)
+            return False
+        self._pressure = False
+        self._pressured.clear()
+        return True
+
+    def _fire_drain(self) -> None:
+        for cb in list(self._drain_cbs):
+            cb()
+
+    def queue_lag(self, name: str) -> int:
+        """Group backlog (pending + undelivered) for the scrape-time
+        ``apm_queue_lag`` gauge. Never raises: while disconnected the lag is
+        unknowable and reads 0 — the SLO that matters then is availability."""
+        with self._lock:
+            try:
+                cli = self._ensure_client_locked()
+                return self._backlog_locked(cli, name)
+            except Exception:
+                return 0
+
+    def pump_once(self) -> int:
+        """One maintenance cycle: reconnect (backoff permitting), retry
+        parked acks, deliver, re-check drain. Producer-side channels need
+        this too — drain is polled, not pushed."""
+        with self._lock:
+            try:
+                self._ensure_client_locked()
+            except self._conn_errors:
+                return 0
+            self._retry_pending_acks_locked()
+        n = self.deliver()
+        return n
+
+    def start_pump_thread(self, poll_s: float = 0.01) -> None:
+        if self._pump_thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    if self.pump_once() == 0:
+                        self._stop.wait(poll_s)
+                except Exception as e:  # keep the pump alive across surprises
+                    if self.logger:
+                        self.logger.error(f"redis pump error: {e}")
+                    self._stop.wait(poll_s)
+
+        self._pump_thread = threading.Thread(
+            target=_loop, name="redis-pump", daemon=True)
+        self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+            self._pump_thread = None

@@ -592,6 +592,200 @@ def _measure_recorder(quick: bool) -> dict:
     }
 
 
+def _measure_transports(quick: bool) -> dict:
+    """ISSUE 15 acceptance: the broker is a swappable, measured component.
+
+    Two drills over the same produce->consume loop:
+
+    - throughput per fabric — memory, durable spool, in-process fake-redis
+      (wire-faithful Streams semantics), and a real redis server when one
+      answers at ``APM_TEST_REDIS_URL`` (skipped otherwise, recorded as
+      such — a silent skip would read as coverage);
+    - outage recovery — for the fabrics with a broker to kill (fake-redis
+      restart, AMQP connection churn via fake_pika): kill mid-stream, keep
+      producing into the bounded pause buffer, restart, and report seconds
+      from restart to full drain with the unique-delivery count proving
+      zero loss through the msg_id dedup window.
+    """
+    import os
+    import shutil
+    import sys
+    import tempfile
+
+    from apmbackend_tpu.transport.amqp import AmqpChannel
+    from apmbackend_tpu.transport.base import QueueManager
+    from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+    from apmbackend_tpu.transport.redis_streams import HAVE_REDIS, RedisStreamsChannel
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    tests_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    from fake_pika import FakeBroker, make_fake_pika
+    from fake_redis import FakeRedisServer, make_fake_redis
+
+    n = 2000 if quick else 20000
+    lines = [
+        f"tx|jvm{i % 4}|svc{i % 100:03d}|m{i}|1|{1700000000000 + i}|"
+        f"{1700000001000 + i}|{i % 900}|Y"
+        for i in range(n)
+    ]
+    deadline_s = 120.0
+
+    def throughput(prod_ch, cons_ch, pump) -> float:
+        """lines/s through one fabric: producer write_line -> consumer cb."""
+        got = 0
+
+        def cb(_line):
+            nonlocal got
+            got += 1
+
+        prod = QueueManager(lambda d: prod_ch, 3600).get_queue("bench", "p")
+        qm_c = QueueManager(lambda d: cons_ch, 3600)
+        cons = qm_c.get_queue("bench", "c", cb)
+        cons.start_consume()
+        t0 = time.perf_counter()
+        for line in lines:
+            prod.write_line(line)
+        while got < n and time.perf_counter() - t0 < deadline_s:
+            if pump() == 0 and prod.buffer_count():
+                prod.retry_buffer()
+        wall = time.perf_counter() - t0
+        return round(n / wall, 1) if got == n else float("nan")
+
+    out: dict = {"lines": n}
+
+    broker = MemoryBroker()
+    out["memory_lines_per_s"] = throughput(
+        MemoryChannel(broker), MemoryChannel(broker), broker.pump)
+
+    spool_dir = tempfile.mkdtemp(prefix="bench_spool_")
+    try:
+        spool = SpoolChannel(spool_dir)
+        out["spool_lines_per_s"] = throughput(spool, spool, spool.deliver)
+        spool.close()
+    finally:
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
+    def redis_pair(mod):
+        kw = dict(redis_module=mod, stream_maxlen=max(n, 1000),
+                  reconnect_base_backoff_s=0.0, reconnect_max_backoff_s=0.01)
+        return (RedisStreamsChannel("redis://bench", **kw),
+                RedisStreamsChannel("redis://bench", **kw))
+
+    server = FakeRedisServer()
+    mod = make_fake_redis(server)
+    prod_ch, cons_ch = redis_pair(mod)
+    out["fake_redis_lines_per_s"] = throughput(
+        prod_ch, cons_ch, lambda: prod_ch.pump_once() + cons_ch.pump_once())
+
+    real_url = os.environ.get("APM_TEST_REDIS_URL", "redis://localhost:6379/15")
+    if HAVE_REDIS:
+        try:
+            import redis as _r
+
+            _r.from_url(real_url, socket_connect_timeout=0.5).ping()
+            kw = dict(stream_maxlen=max(n, 1000), group=f"bench-{os.getpid()}")
+            p, c = (RedisStreamsChannel(real_url, **kw),
+                    RedisStreamsChannel(real_url, **kw))
+            out["real_redis_lines_per_s"] = throughput(
+                p, c, lambda: p.pump_once() + c.pump_once())
+            p.close()
+            c.close()
+        except Exception as e:
+            out["real_redis_skipped"] = f"no server at {real_url}: {e}"
+    else:
+        out["real_redis_skipped"] = "redis-py not installed"
+
+    def outage_redis() -> dict:
+        server = FakeRedisServer()
+        mod = make_fake_redis(server)
+        prod_ch, cons_ch = redis_pair(mod)
+        qm_p = QueueManager(lambda d: prod_ch, 3600,
+                            transport_config={"producerBufferMaxLines": n})
+        prod = qm_p.get_queue("bench", "p")
+        qm_c = QueueManager(lambda d: cons_ch, 3600)
+        seen = set()
+
+        def cb(_line, h, tok):
+            seen.add((h or {}).get("msg_id"))
+            cons_ch.ack([tok])
+
+        cons = qm_c.get_queue("bench", "c", cb, manual_ack=True)
+        cons.start_consume()
+        half = n // 2
+        for line in lines[:half]:
+            prod.write_line(line)
+        t0 = time.perf_counter()
+        while len(seen) < half and time.perf_counter() - t0 < deadline_s:
+            cons_ch.pump_once()
+        server.kill()
+        for line in lines[half:]:
+            prod.write_line(line)  # refused sends buffer under the cap
+        server.restart()
+        t1 = time.perf_counter()
+        while len(seen) < n and time.perf_counter() - t1 < deadline_s:
+            prod_ch.pump_once()
+            if prod.buffer_count():
+                prod.retry_buffer()
+            cons_ch.pump_once()
+        return {
+            "recovery_s": round(time.perf_counter() - t1, 3),
+            "unique_delivered": len(seen),
+            "lost": n - len(seen),
+        }
+
+    out["fake_redis_outage"] = outage_redis()
+
+    def outage_amqp() -> dict:
+        broker = FakeBroker(block_at=10 ** 9)
+        mod = make_fake_pika(broker)
+        kw = dict(pika_module=mod, poll_interval_s=0.002,
+                  reconnect_base_backoff_s=0.005, reconnect_max_backoff_s=0.02)
+        prod_ch = AmqpChannel("amqp://bench", direction="p", **kw)
+        cons_ch = AmqpChannel("amqp://bench", direction="c", **kw)
+        qm_p = QueueManager(lambda d: prod_ch, 3600,
+                            transport_config={"producerBufferMaxLines": n})
+        prod = qm_p.get_queue("bench", "p")
+        qm_c = QueueManager(lambda d: cons_ch, 3600)
+        seen = set()
+
+        def cb(_line, h, tok):
+            seen.add((h or {}).get("msg_id"))
+            cons_ch.ack([tok])
+
+        cons = qm_c.get_queue("bench", "c", cb, manual_ack=True)
+        cons.start_consume()
+        half = n // 2
+        for line in lines[:half]:
+            prod.write_line(line)
+        t0 = time.perf_counter()
+        while len(seen) < half and time.perf_counter() - t0 < deadline_s:
+            time.sleep(0.002)
+        broker.kill_connections()
+        t1 = time.perf_counter()
+        for line in lines[half:]:
+            prod.write_line(line)
+            if prod.buffer_count():
+                prod.retry_buffer()
+        while len(seen) < n and time.perf_counter() - t1 < deadline_s:
+            if prod.buffer_count():
+                prod.retry_buffer()
+            time.sleep(0.002)
+        rec = {
+            "recovery_s": round(time.perf_counter() - t1, 3),
+            "unique_delivered": len(seen),
+            "lost": n - len(seen),
+        }
+        prod_ch.close()
+        cons_ch.close()
+        return rec
+
+    out["amqp_churn_outage"] = outage_amqp()
+    return out
+
+
 def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tick: int = 4096) -> dict:
     import jax
 
@@ -605,6 +799,7 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
     delivery = _measure_delivery(quick)
     tracing = _measure_tracing(quick)
     recorder = _measure_recorder(quick)
+    transports = _measure_transports(quick)
 
     tick, sched, lat, rebuilds = bare["tick"], bare["sched"], bare["lat"], bare["rebuilds"]
     return result(
@@ -646,5 +841,9 @@ def run(quick: bool = False, *, services: int = 100, ticks: int = 64, tx_per_tic
             # ISSUE 12 acceptance: fleet recorder persisting /metrics +
             # /trace + /decisions to the on-disk store at 2 Hz vs bare loop
             "recorder": recorder,
+            # ISSUE 15 acceptance: per-broker throughput (memory vs spool vs
+            # fake-redis vs real redis when present) and broker-outage
+            # recovery time with zero-loss proof
+            "transports": transports,
         },
     )

@@ -126,6 +126,25 @@ if [ "$1" = "--chaos" ]; then
         -m "slow or not slow" "$@"
 fi
 
+# --broker: the broker-outage tier — kill/restart the broker mid-stream
+# under at-least-once delivery (fake-redis process death, AMQP
+# connection-generation churn, durable spool as the no-broker control)
+# and prove bit-identical recovery vs a crash-free golden plus bounded
+# producer memory throughout, plus the full redis transport suite (the
+# real-server tests auto-skip when nothing answers APM_TEST_REDIS_URL)
+# and the flow-control spine. Run before touching transport/ send/ack
+# paths, the producer pause buffer, or the reconnect/redeliver cycle:
+# ./run_tests.sh --broker [pytest args...].
+if [ "$1" = "--broker" ]; then
+    shift
+    exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_broker_outage.py \
+        tests/test_redis_transport.py tests/test_flow_control.py \
+        tests/test_transport.py tests/test_amqp.py \
+        -m "slow or not slow" "$@"
+fi
+
 exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python -m pytest tests/ -m "soak or not soak" "$@"

@@ -122,11 +122,20 @@ class FakeChannel:
         if self._conn._killed or not self.is_open:
             raise _FakeConnectionError("channel/connection closed")
 
-    def queue_declare(self, queue: str, durable: bool = False):
+    def queue_declare(self, queue: str, durable: bool = False, passive: bool = False):
         self._check()
-        with self._conn._broker.lock:
-            self._conn._broker.declared.add(queue)
-        return SimpleNamespace(method=SimpleNamespace(queue=queue))
+        broker = self._conn._broker
+        with broker.lock:
+            if passive:
+                # real-broker semantics: a passive declare on a missing queue
+                # closes the channel (qstat's lag observer relies on this)
+                if queue not in broker.declared:
+                    self.is_open = False
+                    raise _FakeConnectionError(f"passive declare: no queue '{queue}'")
+            else:
+                broker.declared.add(queue)
+            count = len(broker.queues.get(queue, ()))
+        return SimpleNamespace(method=SimpleNamespace(queue=queue, message_count=count))
 
     def confirm_delivery(self) -> None:
         self._check()

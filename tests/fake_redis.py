@@ -1,0 +1,294 @@
+"""A faithful in-process fake of the redis-py surface RedisStreamsChannel uses.
+
+Models the Redis Streams behaviors the at-least-once stack depends on:
+
+- streams as append-only entry lists with monotonic ``"<seq>-0"`` ids;
+  XADD MAXLEN trimming removes the OLDEST entries (the silent-loss hazard
+  the channel's send-side refusal exists to stay ahead of);
+- consumer groups with a ``last-delivered-id`` read cursor and a real PEL
+  (pending entries list): XREADGROUP ``">"`` delivers only entries past the
+  cursor and records each in the PEL; XACK removes PEL entries (idempotent
+  — re-acking returns 0, never raises);
+- XAUTOCLAIM as the redelivery path: PEL entries idle longer than
+  ``min_idle_time`` are re-claimed (delivery counter bumped) and handed to
+  the caller; PEL entries whose underlying stream entry was trimmed away
+  come back in the *deleted* list, exactly like Redis >= 6.2;
+- XINFO GROUPS exposing ``pending`` + ``lag`` (the backlog a group still
+  owes), the channel's refusal and queue-lag input;
+- a kill/restart seam: ``kill()`` severs every live connection (clients
+  raise ConnectionError until a NEW client is built after ``restart()``),
+  while streams, groups, and the PEL survive — AOF-persistence semantics,
+  so recovery is a reconnect + XAUTOCLAIM cycle, never a data reload.
+
+Idle time is virtual: ``advance_ms`` ages the PEL without sleeping, so
+redelivery tests run in microseconds.
+
+Usage: ``server = FakeRedisServer(); mod = make_fake_redis(server)`` and
+pass ``redis_module=mod`` to RedisStreamsChannel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Tuple
+
+
+class _FakeRedisError(Exception):
+    pass
+
+
+class _FakeConnectionError(_FakeRedisError):
+    pass
+
+
+class _FakeResponseError(_FakeRedisError):
+    pass
+
+
+class _Group:
+    """One consumer group on one stream: read cursor + pending entries list."""
+
+    def __init__(self, last_seq: int):
+        self.last_seq = last_seq  # seq of the last entry delivered via ">"
+        # entry id -> [consumer, last_delivery_ms, delivery_count]
+        self.pel: Dict[str, list] = {}
+
+
+class FakeRedisServer:
+    def __init__(self):
+        self.lock = threading.RLock()
+        # stream name -> ordered [(id, fields)] — trimming pops the front
+        self.streams: Dict[str, List[Tuple[str, dict]]] = {}
+        self._seq: Dict[str, int] = {}
+        self.groups: Dict[Tuple[str, str], _Group] = {}
+        self.down = False
+        # bumped by kill(): clients carry the epoch they were built under and
+        # a stale client keeps raising after restart() — a severed TCP
+        # connection never comes back; the channel must build a new client
+        self.epoch = 0
+        self._skew_ms = 0.0
+        self.add_count = 0
+        self.ack_count = 0
+        self.claim_count = 0
+        self.trimmed_count = 0
+        self.kill_count = 0
+
+    # -- virtual clock -------------------------------------------------------
+    def now_ms(self) -> float:
+        with self.lock:
+            return time.monotonic() * 1000.0 + self._skew_ms
+
+    def advance_ms(self, ms: float) -> None:
+        """Age every PEL entry by ``ms`` without sleeping."""
+        with self.lock:
+            self._skew_ms += ms
+
+    # -- chaos seam ----------------------------------------------------------
+    def kill(self) -> None:
+        """Broker process death: every live client starts raising and stays
+        dead even after restart (its connection is gone); stream + group
+        state persists (AOF semantics)."""
+        with self.lock:
+            self.down = True
+            self.epoch += 1
+            self.kill_count += 1
+
+    def restart(self) -> None:
+        with self.lock:
+            self.down = False
+
+    # -- introspection for tests --------------------------------------------
+    def stream_len(self, name: str) -> int:
+        with self.lock:
+            return len(self.streams.get(name, ()))
+
+    def pending_count(self, name: str, group: str = "apm") -> int:
+        with self.lock:
+            g = self.groups.get((name, group))
+            return len(g.pel) if g else 0
+
+    # -- ops (called by FakeRedisClient under self.lock) ---------------------
+    def _check_up(self, client_epoch: int) -> None:
+        if self.down:
+            raise _FakeConnectionError("fake redis is down")
+        if client_epoch != self.epoch:
+            raise _FakeConnectionError("connection severed by broker restart")
+
+    def _entry_seq(self, entry_id: str) -> int:
+        return int(str(entry_id).split("-")[0])
+
+    def xadd(self, name: str, fields: dict, maxlen: Optional[int]) -> str:
+        seq = self._seq.get(name, 0) + 1
+        self._seq[name] = seq
+        entry_id = f"{seq}-0"
+        self.streams.setdefault(name, []).append((entry_id, dict(fields)))
+        self.add_count += 1
+        if maxlen is not None:
+            stream = self.streams[name]
+            while len(stream) > maxlen:
+                stream.pop(0)
+                self.trimmed_count += 1
+        return entry_id
+
+    def xgroup_create(self, name: str, group: str, id: str, mkstream: bool) -> bool:
+        if (name, group) in self.groups:
+            raise _FakeResponseError(
+                "BUSYGROUP Consumer Group name already exists")
+        if name not in self.streams:
+            if not mkstream:
+                raise _FakeResponseError(
+                    "NOGROUP no such key; use MKSTREAM to create it")
+            self.streams[name] = []
+            self._seq.setdefault(name, 0)
+        last = self._seq.get(name, 0) if id in ("$",) else 0
+        self.groups[(name, group)] = _Group(last)
+        return True
+
+    def xreadgroup(self, group: str, consumer: str, name: str,
+                   count: Optional[int]) -> List[Tuple[str, dict]]:
+        g = self.groups.get((name, group))
+        if g is None:
+            raise _FakeResponseError("NOGROUP no such consumer group")
+        out: List[Tuple[str, dict]] = []
+        now = self.now_ms()
+        for entry_id, fields in self.streams.get(name, ()):
+            if self._entry_seq(entry_id) <= g.last_seq:
+                continue
+            out.append((entry_id, dict(fields)))
+            g.last_seq = self._entry_seq(entry_id)
+            g.pel[entry_id] = [consumer, now, 1]
+            if count is not None and len(out) >= count:
+                break
+        return out
+
+    def xack(self, name: str, group: str, ids) -> int:
+        g = self.groups.get((name, group))
+        if g is None:
+            return 0
+        removed = 0
+        for entry_id in ids:
+            if g.pel.pop(str(entry_id), None) is not None:
+                removed += 1
+                self.ack_count += 1
+        return removed
+
+    def xautoclaim(self, name: str, group: str, consumer: str,
+                   min_idle_ms: float, count: int):
+        """(next_start_id, [(id, fields)...] claimed, [deleted ids])."""
+        g = self.groups.get((name, group))
+        if g is None:
+            raise _FakeResponseError("NOGROUP no such consumer group")
+        entries = {eid: f for eid, f in self.streams.get(name, ())}
+        now = self.now_ms()
+        claimed: List[Tuple[str, dict]] = []
+        deleted: List[str] = []
+        for entry_id in sorted(g.pel, key=self._entry_seq):
+            if len(claimed) >= count:
+                break
+            if entry_id not in entries:
+                # trimmed out from under the PEL: Redis drops the PEL entry
+                # and reports the id in the deleted list — visible data loss
+                deleted.append(entry_id)
+                del g.pel[entry_id]
+                continue
+            owner, ts, n = g.pel[entry_id]
+            if now - ts < min_idle_ms:
+                continue
+            g.pel[entry_id] = [consumer, now, n + 1]
+            claimed.append((entry_id, dict(entries[entry_id])))
+            self.claim_count += 1
+        return "0-0", claimed, deleted
+
+    def xinfo_groups(self, name: str) -> List[dict]:
+        out = []
+        for (stream, group), g in self.groups.items():
+            if stream != name:
+                continue
+            lag = sum(
+                1 for eid, _f in self.streams.get(name, ())
+                if self._entry_seq(eid) > g.last_seq)
+            out.append({"name": group, "pending": len(g.pel), "lag": lag})
+        return out
+
+
+class FakeRedisClient:
+    """One connection. Built via ``make_fake_redis(server).Redis.from_url``;
+    carries the server epoch at creation so a broker kill permanently severs
+    it (the channel's reconnect path must build a fresh client)."""
+
+    def __init__(self, server: FakeRedisServer):
+        self._server = server
+        with server.lock:
+            self._epoch = server.epoch
+
+    def _srv(self) -> FakeRedisServer:
+        self._server._check_up(self._epoch)
+        return self._server
+
+    def ping(self) -> bool:
+        with self._server.lock:
+            self._srv()
+            return True
+
+    def xadd(self, name, fields, id="*", maxlen=None, approximate=False):
+        with self._server.lock:
+            return self._srv().xadd(name, fields, maxlen)
+
+    def xlen(self, name) -> int:
+        with self._server.lock:
+            return len(self._srv().streams.get(name, ()))
+
+    def xgroup_create(self, name, groupname, id="$", mkstream=False):
+        with self._server.lock:
+            return self._srv().xgroup_create(name, groupname, id, mkstream)
+
+    def xreadgroup(self, groupname, consumername, streams, count=None, block=None):
+        with self._server.lock:
+            srv = self._srv()
+            out = []
+            for name, cursor in streams.items():
+                if cursor != ">":
+                    continue  # channel only reads new entries
+                entries = srv.xreadgroup(groupname, consumername, name, count)
+                if entries:
+                    out.append([name, entries])
+            return out
+
+    def xack(self, name, groupname, *ids) -> int:
+        with self._server.lock:
+            return self._srv().xack(name, groupname, ids)
+
+    def xautoclaim(self, name, groupname, consumername, min_idle_time,
+                   start_id="0-0", count=100):
+        with self._server.lock:
+            return self._srv().xautoclaim(
+                name, groupname, consumername, min_idle_time, count)
+
+    def xinfo_groups(self, name):
+        with self._server.lock:
+            return self._srv().xinfo_groups(name)
+
+    def close(self) -> None:
+        pass
+
+
+def make_fake_redis(server: FakeRedisServer):
+    """A module-like object exposing the redis-py surface the channel uses."""
+
+    def from_url(url: str, **kw):
+        with server.lock:
+            if server.down:
+                raise _FakeConnectionError("fake redis is down")
+        return FakeRedisClient(server)
+
+    exceptions = SimpleNamespace(
+        RedisError=_FakeRedisError,
+        ConnectionError=_FakeConnectionError,
+        ResponseError=_FakeResponseError,
+    )
+    return SimpleNamespace(
+        Redis=SimpleNamespace(from_url=from_url),
+        exceptions=exceptions,
+    )

@@ -1,0 +1,384 @@
+"""Broker-outage chaos tier: kill the BROKER (not the worker) mid-stream
+under at-least-once delivery and prove bit-identical recovery.
+
+The scenario the backpressure spine exists for: the transport fabric dies
+while a live worker holds absorbed-but-unacked messages and a live producer
+keeps writing. The contract proved here, per backend:
+
+- zero loss: every line the producer wrote reaches the worker exactly once
+  in effect (redeliveries of the delivered-but-unacked window are deduped
+  by msg_id, never double-absorbed);
+- the final windowed state is bit-identical to a crash-free golden run
+  (``assert_snapshots_equal``, the PR 3 chaos-harness comparator);
+- producer memory stays bounded: the pause buffer never exceeds
+  ``transport.producerBufferMaxLines`` at any observable instant, and the
+  ``pause`` event engages synchronously with the first refused write (the
+  parser wires this straight to ``TailManager.pause_reads``,
+  ingest/parser_main.py:111-112 — one drain interval, no polling gap);
+- ``resume`` fires after reconnect+drain and the stream completes.
+
+Backends: fake-redis (server kill/restart severs clients, stream+PEL
+survive — AOF semantics), AMQP connection churn (fake_pika
+``kill_connections``: unacked requeued at the front, stale acks dropped),
+and the durable spool as the control (no broker process exists to die;
+an "outage" is a pump gap and must be a perfect no-op).
+
+Run via ``./run_tests.sh --broker``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.transport.base import QueueManager
+
+from fake_pika import FakeBroker, make_fake_pika
+from fake_redis import FakeRedisServer, make_fake_redis
+from test_chaos_harness import assert_snapshots_equal, make_stream
+
+CLAIM_IDLE_MS = 500
+
+
+def wait_for(predicate, timeout=20.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _worker_over(factory, resume_path, *, transport=None):
+    """A real at-least-once WorkerApp whose QueueManager runs on the given
+    channel factory (the test owns the broker seam)."""
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 64
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["resumeFileFullPath"] = resume_path
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = 3600
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False,
+                       console_log=False)
+    rt.qm = QueueManager(factory, 3600, logger=rt.logger,
+                         transport_config=transport or {})
+    worker = WorkerApp(rt)
+    return worker, rt
+
+
+def _absorbed(worker) -> int:
+    with worker._driver_lock:
+        return int(np.asarray(worker.driver.state.stats.counts).sum())
+
+
+# -- fake redis: broker process death ------------------------------------------
+
+
+def _redis_channel(server, **kw):
+    from apmbackend_tpu.transport.redis_streams import RedisStreamsChannel
+
+    kw.setdefault("redis_module", make_fake_redis(server))
+    kw.setdefault("claim_idle_ms", CLAIM_IDLE_MS)
+    kw.setdefault("reconnect_base_backoff_s", 0.0)
+    kw.setdefault("reconnect_max_backoff_s", 0.0)
+    return RedisStreamsChannel("redis://fake", **kw)
+
+
+def _drain_redis(worker, cons_ch, server, total, timeout=30.0):
+    """Pump delivery + epoch commits until the stream is fully settled:
+    backlog empty, PEL empty, nothing left unacked in the worker."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        n = cons_ch.pump_once()
+        if n:
+            continue
+        worker.save_state()  # commit the open epoch -> acks flow
+        cons_ch.pump_once()  # ...and let the drain/ack retry settle
+        if (cons_ch.queue_lag("transactions") == 0
+                and server.pending_count("transactions") == 0):
+            return
+        server.advance_ms(CLAIM_IDLE_MS + 10)  # age the PEL: claim the rest
+    raise TimeoutError(
+        f"stream never settled: lag={cons_ch.queue_lag('transactions')} "
+        f"pel={server.pending_count('transactions')} absorbed={_absorbed(worker)}")
+
+
+def _golden_redis(tmp_path, lines):
+    server = FakeRedisServer()
+    res = str(tmp_path / "golden.npz")
+    chans = {}
+
+    def factory(kind):
+        chans[kind] = _redis_channel(server)
+        return chans[kind]
+
+    worker, rt = _worker_over(factory, res)
+    prod_qm = QueueManager(lambda d: _redis_channel(server), 3600)
+    prod = prod_qm.get_queue("transactions", "p")
+    for line in lines:
+        prod.write_line(line)
+    _drain_redis(worker, chans["c"], server, len(lines))
+    assert _absorbed(worker) == len(lines)
+    rt.stop_timers()
+    return res
+
+
+@pytest.mark.slow
+def test_redis_broker_killed_midstream_recovery_bit_identical(tmp_path):
+    lines = make_stream(n_labels=4, per_label=50)
+    golden_res = _golden_redis(tmp_path, lines)
+
+    server = FakeRedisServer()
+    chaos_res = str(tmp_path / "chaos.npz")
+    chans = {}
+
+    def factory(kind):
+        chans[kind] = _redis_channel(server)
+        return chans[kind]
+
+    worker, rt = _worker_over(factory, chaos_res)
+    # the cap bounds memory; it must be sized ABOVE the expected outage
+    # write volume for a loss-free episode (overflow past it is the
+    # counted-drop policy, proved in the next test)
+    cap = 128
+    prod_qm = QueueManager(lambda d: _redis_channel(server, stream_maxlen=100000),
+                           3600, transport_config={"producerBufferMaxLines": cap})
+    events = []
+    prod_qm.on("pause", lambda: events.append("pause"))
+    prod_qm.on("resume", lambda: events.append("resume"))
+    prod = prod_qm.get_queue("transactions", "p")
+    cons = chans["c"]
+
+    half = len(lines) // 2
+    for line in lines[:half]:
+        prod.write_line(line)
+    # deliver ~half in bounded batches, commit ONE epoch mid-way, and leave
+    # a delivered-but-unacked window on the PEL for the outage to threaten
+    delivered = 0
+    while delivered < half // 2:
+        delivered += cons.deliver(8)
+    worker.save_state()
+    while delivered < half:
+        delivered += cons.deliver(8)
+    unacked_at_kill = server.pending_count("transactions")
+    assert unacked_at_kill > 0  # the window the outage puts at risk
+
+    server.kill()  # --- BROKER DEATH ---
+
+    # the producer keeps writing into the outage: sends refuse, the pause
+    # engages on the FIRST refused write (no drain-interval lag), and the
+    # buffer stays bounded at every instant
+    buffer_maxima = []
+    for line in lines[half:]:
+        prod.write_line(line)
+        buffer_maxima.append(prod.buffer_count())
+    assert events and events[0] == "pause"
+    assert max(buffer_maxima) <= cap
+    assert cons.pump_once() == 0  # consumer fails soft while down
+
+    server.restart()  # --- RECOVERY ---
+
+    # producer pump reconnects, sees the drained backlog, fires drain ->
+    # retry_buffer -> resume; the buffered tail lands on the stream
+    assert wait_for(lambda: (prod_qm.producer_channel.pump_once(), "resume" in events)[1],
+                    timeout=10)
+    assert prod.buffer_count() == 0
+
+    # age the PEL past claim_idle BEFORE the next epoch commit: the at-risk
+    # window must come back through XAUTOCLAIM and be deduped (the
+    # alo-reconnect-drops-unacked mutant is the protocol that skips this)
+    server.advance_ms(CLAIM_IDLE_MS + 10)
+    while cons.pump_once():
+        pass
+    assert worker._deduped_total >= unacked_at_kill
+
+    _drain_redis(worker, cons, server, len(lines))
+    rt.stop_timers()
+
+    # zero loss, zero double-effect: every distinct line absorbed once...
+    assert _absorbed(worker) == len(lines)
+    # ...the delivered-but-unacked window WAS redelivered (XAUTOCLAIM after
+    # the restart) and every copy deduped by msg_id, not re-absorbed
+    assert worker._deduped_total >= unacked_at_kill
+    # ...and the final windowed state equals the crash-free run exactly
+    assert_snapshots_equal(golden_res, chaos_res)
+
+
+@pytest.mark.slow
+def test_redis_outage_producer_overflow_degrades_loudly(tmp_path):
+    """Outage outlasting the buffer: eviction is counted, never silent."""
+    from apmbackend_tpu.obs.decisions import get_decisions
+
+    server = FakeRedisServer()
+    cap = 8
+    prod_qm = QueueManager(lambda d: _redis_channel(server), 3600,
+                           transport_config={"producerBufferMaxLines": cap})
+    overflows = []
+    prod_qm.on("overflow", lambda q, n: overflows.append(n))
+    prod = prod_qm.get_queue("transactions", "p")
+    server.kill()
+    for i in range(cap * 3):
+        prod.write_line(f"line{i}")
+        assert prod.buffer_count() <= cap
+    assert sum(overflows) == cap * 2
+    assert any(d.get("kind") == "producer_buffer_overflow"
+               for d in get_decisions().recent(64))
+
+
+# -- AMQP: connection churn ----------------------------------------------------
+
+
+def _amqp_factory(mod, channels, **kw):
+    from apmbackend_tpu.transport.amqp import AmqpChannel
+
+    def factory(kind):
+        ch = AmqpChannel("amqp://fake", direction=kind, pika_module=mod,
+                         poll_interval_s=0.005, **kw)
+        channels.append(ch)
+        return ch
+
+    return factory
+
+
+def _drain_amqp(worker, broker, total, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        worker.save_state()  # absorb + commit whatever has arrived
+        if (_absorbed(worker) >= total
+                and broker.depth("transactions") == 0
+                and not worker._epoch_tokens):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(
+        f"amqp stream never settled: absorbed={_absorbed(worker)}/{total} "
+        f"depth={broker.depth('transactions')}")
+
+
+def _golden_amqp(tmp_path, lines):
+    broker = FakeBroker(block_at=10**9, unblock_at=10)
+    mod = make_fake_pika(broker)
+    channels = []
+    res = str(tmp_path / "golden-amqp.npz")
+    worker, rt = _worker_over(
+        _amqp_factory(mod, channels, prefetch_count=16), res)
+    prod_qm = QueueManager(_amqp_factory(mod, channels), 3600)
+    prod = prod_qm.get_queue("transactions", "p")
+    for line in lines:
+        prod.write_line(line)
+    _drain_amqp(worker, broker, len(lines))
+    rt.stop_timers()
+    for ch in channels:
+        ch.close()
+    return res
+
+
+@pytest.mark.slow
+def test_amqp_connection_churn_midstream_recovery_bit_identical(tmp_path):
+    lines = make_stream(n_labels=3, per_label=40, seed=5)
+    golden_res = _golden_amqp(tmp_path, lines)
+
+    broker = FakeBroker(block_at=10**9, unblock_at=10)
+    mod = make_fake_pika(broker)
+    channels = []
+    chaos_res = str(tmp_path / "chaos-amqp.npz")
+    # prefetch bounds in-flight unacked at 16: the broker stops delivering
+    # until acks flow, so a delivered-but-unacked window deterministically
+    # exists when the churn hits
+    worker, rt = _worker_over(
+        _amqp_factory(mod, channels, prefetch_count=16), chaos_res)
+    prod_qm = QueueManager(_amqp_factory(mod, channels), 3600,
+                           transport_config={"producerBufferMaxLines": 256})
+    prod = prod_qm.get_queue("transactions", "p")
+
+    half = len(lines) // 2
+    for line in lines[:half]:
+        prod.write_line(line)
+    assert wait_for(lambda: len(worker._epoch_tokens) >= 16)  # prefetch full
+    worker.save_state()  # one committed epoch: acks flow, delivery resumes
+    assert wait_for(lambda: len(worker._epoch_tokens) >= 8)
+    assert worker._epoch_tokens  # delivered-but-unacked window at risk
+
+    broker.kill_connections()  # --- CONNECTION CHURN ---
+    for line in lines[half:]:
+        prod.write_line(line)
+        assert prod.buffer_count() <= 256
+
+    # both directions reconnect; the requeued unacked window is redelivered
+    # (redelivered flag + original msg_id) and deduped, the tail delivers
+    _drain_amqp(worker, broker, len(lines))
+    rt.stop_timers()
+    for ch in channels:
+        ch.close()
+
+    assert _absorbed(worker) == len(lines)
+    assert worker._deduped_total >= 1  # churn redelivered the unacked window
+    assert_snapshots_equal(golden_res, chaos_res)
+
+
+# -- spool: the control (no broker process exists to die) ----------------------
+
+
+@pytest.mark.slow
+def test_spool_control_outage_is_a_noop(tmp_path):
+    """The durable-spool fabric has no broker process: the same drill is a
+    pump gap, and the result must STILL be bit-identical to golden — pinning
+    that the harness itself (feed order, epoch timing) adds no noise."""
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    lines = make_stream(n_labels=3, per_label=40, seed=9)
+
+    def run(spool_dir, res, with_gap):
+        spools = []
+        worker_chans = {}
+
+        def worker_factory(kind):
+            ch = SpoolChannel(spool_dir)
+            spools.append(ch)
+            worker_chans[kind] = ch
+            return ch
+
+        def prod_factory(kind):
+            ch = SpoolChannel(spool_dir)
+            spools.append(ch)
+            return ch
+
+        worker, rt = _worker_over(worker_factory, res)
+        prod_qm = QueueManager(prod_factory, 3600,
+                               transport_config={"producerBufferMaxLines": 256})
+        prod = prod_qm.get_queue("transactions", "p")
+        cons = worker_chans["c"]
+        half = len(lines) // 2
+        for line in lines[:half]:
+            prod.write_line(line)
+        delivered = 0
+        while delivered < half // 2:
+            delivered += cons.deliver(16)
+        worker.save_state()
+        if with_gap:
+            time.sleep(0.05)  # the "outage": nothing to kill, just a stall
+        for line in lines[half:]:
+            prod.write_line(line)
+            assert prod.buffer_count() <= 256
+        while delivered < len(lines):
+            delivered += cons.deliver(64)
+        worker.save_state()
+        rt.stop_timers()
+        for ch in spools:
+            ch.close()
+        assert _absorbed(worker) == len(lines)
+        assert cons.acked_count("transactions") == len(lines)
+
+    gres = str(tmp_path / "golden-spool.npz")
+    cres = str(tmp_path / "gap-spool.npz")
+    run(str(tmp_path / "sp-golden"), gres, with_gap=False)
+    run(str(tmp_path / "sp-gap"), cres, with_gap=True)
+    assert_snapshots_equal(gres, cres)

@@ -196,6 +196,23 @@ def test_partition_header_roundtrip_amqp():
             c.close()
 
 
+def test_partition_header_roundtrip_redis():
+    from fake_redis import FakeRedisServer, make_fake_redis
+
+    from apmbackend_tpu.transport.redis_streams import RedisStreamsChannel
+
+    server = FakeRedisServer()
+    mod = make_fake_redis(server)
+    chans = []
+
+    def make(d):
+        ch = RedisStreamsChannel("redis://fake", redis_module=mod)
+        chans.append(ch)
+        return ch
+
+    _roundtrip_partition_header(make, lambda: [c.pump_once() for c in chans])
+
+
 # -- driver row handoff primitives --------------------------------------------
 
 

@@ -1,0 +1,284 @@
+"""Flow-control spine tests: the bounded producer pause buffer.
+
+An unbounded pause buffer turns a stalled broker into a producer OOM — the
+cap (``transport.producerBufferMaxLines``) bounds it, and these tests pin
+what happens at the boundary: oldest-first eviction under both overflow
+policies (counted drop / spill-to-spool), the loud degradation path
+(decision record + ``overflow`` event + flight bundle + /healthz 503
+*before* eviction starts), the exported depth gauge, and the FIFO /
+front-requeue invariants of ``retry_buffer`` racing concurrent
+``write_line`` — the ordering contract the whole pause/drain cycle rests
+on (queue.js:230-263)."""
+
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.obs import MetricsRegistry, set_registry
+from apmbackend_tpu.obs.decisions import get_decisions
+from apmbackend_tpu.transport import Channel, QueueManager
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    old = set_registry(MetricsRegistry())
+    yield
+    set_registry(old)
+
+
+class RefusingChannel(Channel):
+    """Accepts sends until ``refuse`` is set — the stalled-broker stand-in."""
+
+    def __init__(self):
+        self.sent = []
+        self.refuse = True
+        self._drain_cbs = []
+
+    def assert_queue(self, name):
+        pass
+
+    def send(self, name, payload, headers=None):
+        if self.refuse:
+            return False
+        self.sent.append(payload.decode("utf-8"))
+        return True
+
+    def on_drain(self, cb):
+        self._drain_cbs.append(cb)
+
+    def fire_drain(self):
+        for cb in list(self._drain_cbs):
+            cb()
+
+
+def make_producer(transport_cfg, channel=None):
+    ch = channel or RefusingChannel()
+    qm = QueueManager(lambda d: ch, 3600, transport_config=transport_cfg)
+    return qm, qm.get_queue("q", "p"), ch
+
+
+# -- cap enforcement -----------------------------------------------------------
+
+
+def test_cap_evicts_oldest_and_counts():
+    qm, prod, ch = make_producer({"producerBufferMaxLines": 3})
+    overflows = []
+    qm.on("overflow", lambda name, n: overflows.append((name, n)))
+    for i in range(7):
+        prod.write_line(f"line{i}")
+    # buffer keeps the most RECENT window; the 4 oldest were evicted
+    assert prod.buffer_count() == 3
+    assert [l for l, _h in prod.buffer] == ["line4", "line5", "line6"]
+    assert overflows == [("q", 1)] * 4  # one event per overflowing write
+    # the episode is recorded for post-hoc triage
+    kinds = [d for d in get_decisions().recent(16)
+             if d.get("kind") == "producer_buffer_overflow"]
+    assert kinds and kinds[-1]["queue"] == "q" and kinds[-1]["cap"] == 3
+
+
+def test_zero_cap_keeps_legacy_unbounded_buffer():
+    qm, prod, ch = make_producer({"producerBufferMaxLines": 0})
+    for i in range(500):
+        prod.write_line(f"line{i}")
+    assert prod.buffer_count() == 500
+
+
+def test_drained_buffer_preserves_survivor_order():
+    qm, prod, ch = make_producer({"producerBufferMaxLines": 2})
+    for i in range(5):
+        prod.write_line(f"line{i}")
+    ch.refuse = False
+    prod.retry_buffer()
+    assert ch.sent == ["line3", "line4"]  # survivors, still FIFO
+
+
+def test_spill_spool_policy_preserves_evicted_lines(tmp_path):
+    spill_dir = str(tmp_path / "overflow")
+    qm, prod, ch = make_producer({
+        "producerBufferMaxLines": 2,
+        "producerOverflowPolicy": "spill-spool",
+        "spillDirectory": spill_dir,
+    })
+    for i in range(5):
+        prod.write_line(f"line{i}")
+    assert prod.buffer_count() == 2
+    # the 3 evicted lines are not gone — they landed in the durable spool,
+    # headers intact, replayable after the incident
+    from apmbackend_tpu.transport.spool import SpoolChannel
+
+    reader = SpoolChannel(spill_dir)
+    got = []
+    reader.consume("q", lambda p, h: got.append((p.decode("utf-8"), h)), "t1")
+    reader.deliver()
+    assert [l for l, _h in got] == ["line0", "line1", "line2"]
+    assert all("msg_id" in h for _l, h in got)
+
+
+def test_overflow_counter_and_gauge_exported():
+    from apmbackend_tpu.obs import get_registry
+
+    qm, prod, ch = make_producer({"producerBufferMaxLines": 2})
+    for i in range(5):
+        prod.write_line(f"line{i}")
+    text = get_registry().render()
+    assert 'apm_producer_buffer_lines{queue="q"} 2' in text
+    assert 'apm_producer_buffer_overflow_total{queue="q"} 3' in text
+
+
+# -- runtime integration: healthz degradation + flight bundle ------------------
+
+
+def _fetch(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def test_healthz_degrades_before_eviction_and_overflow_dumps_flight(tmp_path):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    cfg = default_config()
+    cfg["logDir"] = str(tmp_path / "logs")
+    cfg["brokerBackend"] = "memory"
+    cfg["transport"] = {
+        "producerBufferMaxLines": 10,
+        "producerBufferDegradedRatio": 0.8,
+    }
+    cfg["tpuEngine"]["metricsPort"] = 0
+    cfg["observability"] = dict(cfg.get("observability", {}))
+    cfg["observability"]["flightDir"] = str(tmp_path / "flight")
+    rt = ModuleRuntime("tpuEngine", config=cfg, install_signals=False,
+                       console_log=False)
+    try:
+        # stall the broker: every send refuses, the buffer fills
+        rt.qm.producer_channel = RefusingChannel()
+        prod = rt.qm.get_queue("q", "p")
+        for i in range(7):
+            prod.write_line(f"line{i}")
+        status, body = _fetch(f"{rt.telemetry.url}/healthz")
+        assert status == 200  # 7 < degraded_at=8: still healthy
+        for i in range(2):
+            prod.write_line(f"more{i}")
+        status, body = _fetch(f"{rt.telemetry.url}/healthz")
+        health = json.loads(body)
+        assert status == 503  # 9 >= 8: degraded BEFORE any eviction
+        assert health["flow_control"]["ok"] is False
+        assert health["flow_control"]["producer_buffer_lines"]["q"] == 9
+        assert health["flow_control"]["degraded_at"] == 8
+        # push past the cap: eviction starts and a flight bundle lands
+        for i in range(3):
+            prod.write_line(f"past{i}")
+        assert prod.buffer_count() == 10
+        bundles = list((tmp_path / "flight").glob("*producer-overflow-q*"))
+        assert bundles, "overflow must capture a flight bundle"
+    finally:
+        rt.stop_timers()
+        if rt.telemetry is not None:
+            rt.telemetry.stop()
+
+
+# -- ordering under concurrency ------------------------------------------------
+
+
+class FlakyChannel(Channel):
+    """Deterministic-random refusals: the worst-case interleaving generator
+    for the buffer's FIFO contract."""
+
+    def __init__(self, seed=7, refuse_p=0.5):
+        self.sent = []
+        self.rng = random.Random(seed)
+        self.refuse_p = refuse_p
+        self.always_accept = False
+        self._drain_cbs = []
+
+    def assert_queue(self, name):
+        pass
+
+    def send(self, name, payload, headers=None):
+        if not self.always_accept and self.rng.random() < self.refuse_p:
+            return False
+        self.sent.append(payload.decode("utf-8"))
+        return True
+
+    def on_drain(self, cb):
+        self._drain_cbs.append(cb)
+
+
+def test_retry_buffer_vs_concurrent_write_line_keeps_fifo():
+    """A drain-driven retry_buffer racing a writer thread must never reorder
+    the stream: a refused front-of-buffer line goes BACK to the front
+    (requeue_front), and write_line appends behind it — so the channel
+    accepts lines in exactly write order, every interleaving."""
+    ch = FlakyChannel()
+    qm = QueueManager(lambda d: ch, 3600,
+                      transport_config={"producerBufferMaxLines": 0})
+    prod = qm.get_queue("q", "p")
+    n = 400
+    done = threading.Event()
+
+    def writer():
+        for i in range(n):
+            prod.write_line(f"line{i}")
+        done.set()
+
+    def drainer():
+        while not done.is_set() or prod.buffer_count():
+            prod.retry_buffer()
+            if done.is_set() and prod.buffer_count() and ch.always_accept:
+                break
+
+    t_w = threading.Thread(target=writer)
+    t_d = threading.Thread(target=drainer)
+    t_w.start()
+    t_d.start()
+    t_w.join(timeout=10)
+    ch.always_accept = True  # broker recovers: let the tail drain
+    t_d.join(timeout=10)
+    prod.retry_buffer()
+    assert prod.buffer_count() == 0
+    assert ch.sent == [f"line{i}" for i in range(n)]
+
+
+def test_retry_buffer_concurrent_with_cap_never_exceeds_cap():
+    """Same race with the cap active: the bound holds at every instant the
+    writer can observe, and the survivors stay in FIFO order."""
+    ch = FlakyChannel(seed=11, refuse_p=0.9)
+    cap = 16
+    qm = QueueManager(lambda d: ch, 3600,
+                      transport_config={"producerBufferMaxLines": cap})
+    prod = qm.get_queue("q", "p")
+    n = 300
+    maxima = []
+    done = threading.Event()
+
+    def writer():
+        for i in range(n):
+            prod.write_line(f"line{i}")
+            maxima.append(prod.buffer_count())
+        done.set()
+
+    def drainer():
+        while not done.is_set():
+            prod.retry_buffer()
+
+    t_w = threading.Thread(target=writer)
+    t_d = threading.Thread(target=drainer)
+    t_w.start()
+    t_d.start()
+    t_w.join(timeout=10)
+    t_d.join(timeout=10)
+    ch.always_accept = True
+    prod.retry_buffer()
+    assert max(maxima) <= cap
+    assert prod.buffer_count() == 0
+    # dropped lines are allowed (that is the policy) — reordering is not
+    sent_idx = [int(l[4:]) for l in ch.sent]
+    assert sent_idx == sorted(sent_idx)
+    assert len(set(sent_idx)) == len(sent_idx)  # and never duplicated

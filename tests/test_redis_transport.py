@@ -1,0 +1,463 @@
+"""Redis Streams transport: Channel-contract conformance over the in-process
+fake (tests/fake_redis.py), plus the broker-loss behaviors the backpressure
+spine depends on — send-side refusal instead of MAXLEN loss, XAUTOCLAIM
+redelivery with the ``redelivered`` flag, parked-ack retry after reconnect,
+and loud accounting of PEL entries trimmed out from under a consumer.
+
+Real-server tests live at the bottom: ``@pytest.mark.slow`` and skipped
+unless something answers on ``APM_TEST_REDIS_URL`` (default
+``redis://localhost:6379/0``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from fake_redis import FakeRedisServer, make_fake_redis
+
+from apmbackend_tpu.transport import make_queue_manager
+from apmbackend_tpu.transport.redis_streams import HAVE_REDIS, RedisStreamsChannel
+
+
+def make_channel(server, **kw):
+    kw.setdefault("redis_module", make_fake_redis(server))
+    return RedisStreamsChannel("redis://fake", **kw)
+
+
+def make_qm(server, *, maxlen=100000, transport=None):
+    cfg = {
+        "brokerBackend": "redis",
+        "statLogIntervalInSeconds": 3600,
+        "redis": {"streamMaxlen": maxlen, "claimIdleMs": 5000},
+    }
+    if transport is not None:
+        cfg["transport"] = transport
+    return make_queue_manager(cfg, redis_module=make_fake_redis(server))
+
+
+# -- channel contract ----------------------------------------------------------
+
+
+def test_requires_redis_module_or_library():
+    if not HAVE_REDIS:
+        with pytest.raises(RuntimeError):
+            RedisStreamsChannel("redis://nowhere")
+
+
+def test_basic_send_consume_roundtrip():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    got = []
+    ch.assert_queue("q")
+    ch.consume("q", lambda payload, headers: got.append((payload, headers)), "t1")
+    assert ch.send("q", b"hello", {"msg_id": "m1", "ingest_ts": 1.5})
+    assert ch.deliver() == 1
+    assert got == [(b"hello", {"msg_id": "m1", "ingest_ts": 1.5})]
+    # auto-ack mode commits on delivery: nothing left pending
+    assert server.pending_count("q") == 0
+
+
+def test_one_arg_callback_wrapped_like_spool():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    got = []
+    ch.consume("q", got.append, "t1")
+    ch.send("q", b"payload", {})
+    ch.deliver()
+    assert got == [b"payload"]
+
+
+def test_group_created_at_zero_sees_producer_backlog():
+    # a consumer that attaches AFTER the producer streamed entries must
+    # still see them — the group is created at id="0", not "$"
+    server = FakeRedisServer()
+    prod = make_channel(server)
+    for i in range(3):
+        assert prod.send("q", f"m{i}".encode(), {})
+    cons = make_channel(server)
+    got = []
+    cons.consume("q", lambda p, h: got.append(p), "t1")
+    assert cons.deliver() == 3
+    assert got == [b"m0", b"m1", b"m2"]
+
+
+def test_manual_ack_and_idempotent_reack():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    got = []
+    ch.consume("q", lambda p, h, token: got.append(token), "t1", manual_ack=True)
+    ch.send("q", b"one", {})
+    ch.deliver()
+    assert len(got) == 1 and server.pending_count("q") == 1
+    ch.ack(got)
+    assert server.pending_count("q") == 0
+    ch.ack(got)  # stale re-ack: ignored, never raises
+    assert server.ack_count == 1
+
+
+def test_prefetch_gates_unacked_deliveries():
+    server = FakeRedisServer()
+    ch = make_channel(server, prefetch=2)
+    tokens = []
+    ch.consume("q", lambda p, h, t: tokens.append(t), "t1", manual_ack=True)
+    for i in range(5):
+        ch.send("q", f"m{i}".encode(), {})
+    assert ch.deliver() == 2  # prefetch window full
+    assert ch.deliver() == 0
+    ch.ack(tokens[:2])
+    assert ch.deliver() == 2
+    ch.ack(tokens[2:])
+    assert ch.deliver() == 1
+
+
+def test_cancel_stops_delivery():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    got = []
+    ch.consume("q", lambda p, h: got.append(p), "tag-a")
+    ch.cancel("tag-a")
+    ch.send("q", b"m", {})
+    assert ch.deliver() == 0
+    assert got == []
+
+
+def test_autoclaim_redelivers_idle_pending_with_flag():
+    server = FakeRedisServer()
+    ch = make_channel(server, claim_idle_ms=5000)
+    got = []
+    ch.consume("q", lambda p, h, t: got.append((p, h, t)), "t1", manual_ack=True)
+    ch.send("q", b"m", {"msg_id": "orig-1"})
+    ch.deliver()
+    assert len(got) == 1 and not got[0][1].get("redelivered")
+    # not yet idle: nothing to claim
+    assert ch.deliver() == 0
+    server.advance_ms(6000)
+    assert ch.deliver() == 1
+    payload, headers, token = got[1]
+    assert payload == b"m"
+    assert headers["redelivered"] is True
+    assert headers["msg_id"] == "orig-1"  # original identity survives the hop
+    ch.ack([token])
+    server.advance_ms(6000)
+    assert ch.deliver() == 0  # acked: gone from the PEL for good
+
+
+def test_send_refuses_at_stream_maxlen_and_drains_at_half():
+    server = FakeRedisServer()
+    ch = make_channel(server, stream_maxlen=4)
+    drains = []
+    ch.on_drain(lambda: drains.append(1))
+    for i in range(4):
+        assert ch.send("q", f"m{i}".encode(), {})
+    assert not ch.send("q", b"overflow", {})  # backlog at cap: refused
+    assert server.stream_len("q") == 4  # ...and NOT trimmed-in silently
+    got = []
+    cons = make_channel(server, stream_maxlen=4)
+    cons.consume("q", lambda p, h, t: got.append(t), "t1", manual_ack=True)
+    cons.deliver()
+    assert ch.pump_once() == 0 and not drains  # delivered-but-unacked still owed
+    cons.ack(got)
+    ch.pump_once()  # producer pump polls the backlog: 0 <= cap//2 -> drain
+    assert drains == [1]
+    assert ch.send("q", b"next", {})
+
+
+def test_trim_only_eats_acked_prefix():
+    # retention rides at 2x the refusal cap, so with sends refused at
+    # stream_maxlen the trim can only remove already-acked entries
+    server = FakeRedisServer()
+    ch = make_channel(server, stream_maxlen=3)
+    got = []
+    ch.consume("q", lambda p, h, t: got.append(t), "t1", manual_ack=True)
+    for round_no in range(4):
+        for i in range(3):
+            assert ch.send("q", f"r{round_no}m{i}".encode(), {})
+        ch.deliver()
+        ch.ack(got)
+        got.clear()
+    assert server.trimmed_count > 0
+    assert ch.deleted_count == 0  # nothing unacked was ever trimmed
+
+
+def test_trimmed_pel_entries_counted_loudly():
+    class Log:
+        def __init__(self):
+            self.errors = []
+
+        def error(self, msg):
+            self.errors.append(msg)
+
+        def info(self, msg):
+            pass
+
+    server = FakeRedisServer()
+    log = Log()
+    ch = make_channel(server, stream_maxlen=100, logger=log)
+    got = []
+    ch.consume("q", lambda p, h, t: got.append(t), "t1", manual_ack=True)
+    for i in range(3):
+        ch.send("q", f"m{i}".encode(), {})
+    ch.deliver()
+    assert server.pending_count("q") == 3
+    # a second producer with a much smaller retention trims the unacked
+    # entries out from under the PEL (the misconfiguration the deleted-list
+    # accounting exists to surface)
+    rogue = make_channel(server, stream_maxlen=1)
+    for i in range(4):
+        rogue.send("q2", b"x", {})  # separate stream keeps rogue sends flowing
+    with server.lock:
+        server.streams["q"] = server.streams["q"][3:]  # trim below the PEL
+    server.advance_ms(6000)
+    ch.deliver()
+    assert ch.deleted_count == 3
+    assert any("trimmed 3 unacked" in e for e in log.errors)
+
+
+def test_queue_lag_counts_pending_plus_undelivered():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    tokens = []
+    ch.consume("q", lambda p, h, t: tokens.append(t), "t1", manual_ack=True)
+    for i in range(4):
+        ch.send("q", f"m{i}".encode(), {})
+    assert ch.queue_lag("q") == 4  # all undelivered (stream backlog pre-group counts)
+    ch.deliver(max_messages=2)
+    assert ch.queue_lag("q") == 4  # 2 pending + 2 undelivered
+    ch.ack(tokens)
+    assert ch.queue_lag("q") == 2
+    server.kill()
+    assert ch.queue_lag("q") == 0  # unknowable while down: never raises
+
+
+# -- broker loss ---------------------------------------------------------------
+
+
+def test_send_fails_soft_while_down_and_recovers():
+    server = FakeRedisServer()
+    ch = make_channel(server, reconnect_base_backoff_s=0.0,
+                      reconnect_max_backoff_s=0.0)
+    assert ch.send("q", b"before", {})
+    server.kill()
+    assert not ch.send("q", b"during", {})  # refusal, not an exception
+    server.restart()
+    deadline = time.time() + 2.0
+    while not ch.send("q", b"after", {}) and time.time() < deadline:
+        time.sleep(0.005)
+    assert server.stream_len("q") == 2  # "before" + "after"; "during" was refused
+
+
+def test_stale_client_is_severed_until_reconnect():
+    server = FakeRedisServer()
+    ch = make_channel(server, reconnect_base_backoff_s=0.0,
+                      reconnect_max_backoff_s=0.0)
+    ch.send("q", b"m", {})
+    server.kill()
+    server.restart()
+    # the pre-kill client is dead even though the server is back: the first
+    # op drops it and the next reconnect builds a fresh client
+    assert not ch.send("q", b"x", {})
+    assert ch.send("q", b"y", {})
+
+
+def test_acks_park_during_outage_and_retry_after_reconnect():
+    server = FakeRedisServer()
+    ch = make_channel(server, reconnect_base_backoff_s=0.0,
+                      reconnect_max_backoff_s=0.0)
+    tokens = []
+    ch.consume("q", lambda p, h, t: tokens.append(t), "t1", manual_ack=True)
+    ch.send("q", b"m", {})
+    ch.deliver()
+    assert server.pending_count("q") == 1
+    server.kill()
+    ch.ack(tokens)  # parks: connection is gone
+    assert server.pending_count("q") == 1
+    server.restart()
+    deadline = time.time() + 2.0
+    while server.pending_count("q") and time.time() < deadline:
+        ch.pump_once()
+        time.sleep(0.005)
+    assert server.pending_count("q") == 0  # parked ack landed after reconnect
+    server.advance_ms(60000)
+    assert ch.deliver() == 0  # ...so nothing is redelivered
+
+
+def test_state_survives_restart_and_pel_redelivers():
+    server = FakeRedisServer()
+    ch = make_channel(server, reconnect_base_backoff_s=0.0,
+                      reconnect_max_backoff_s=0.0)
+    got = []
+    ch.consume("q", lambda p, h, t: got.append((p, h)), "t1", manual_ack=True)
+    ch.send("q", b"m", {"msg_id": "k1"})
+    ch.deliver()
+    server.kill()
+    server.restart()
+    server.advance_ms(6000)
+    deadline = time.time() + 2.0
+    while len(got) < 2 and time.time() < deadline:
+        ch.pump_once()
+        time.sleep(0.005)
+    assert got[1][0] == b"m"
+    assert got[1][1]["redelivered"] is True
+    assert got[1][1]["msg_id"] == "k1"
+
+
+def test_reconnect_backoff_gates_connection_attempts():
+    server = FakeRedisServer()
+    calls = []
+    mod = make_fake_redis(server)
+    real_from_url = mod.Redis.from_url
+
+    def counting_from_url(url, **kw):
+        calls.append(url)
+        return real_from_url(url, **kw)
+
+    mod.Redis.from_url = counting_from_url
+    ch = make_channel(server, redis_module=mod,
+                      reconnect_base_backoff_s=30.0,
+                      reconnect_max_backoff_s=60.0)
+    server.kill()
+    for _ in range(20):
+        ch.send("q", b"m", {})
+    # one real attempt; the rest were swallowed by the backoff window
+    assert len(calls) == 1
+
+
+# -- QueueManager integration --------------------------------------------------
+
+
+def test_queue_manager_pause_buffer_drain_resume():
+    server = FakeRedisServer()
+    qm_p = make_qm(server, maxlen=3)
+    qm_c = make_qm(server, maxlen=3)
+    events = []
+    qm_p.on("pause", lambda: events.append("pause"))
+    qm_p.on("resume", lambda: events.append("resume"))
+    prod = qm_p.get_queue("q", "p")
+    for i in range(5):
+        prod.write_line(f"line{i}")
+    assert events == ["pause"]
+    assert prod.buffer_count() == 2
+    got = []
+    cons = qm_c.get_queue("q", "c",
+                          lambda line, headers=None, token=None: got.append((line, token)),
+                          manual_ack=True)
+    cons.start_consume()
+    qm_c.consumer_channel.pump_once()
+    cons.ack([t for _l, t in got])
+    qm_p.producer_channel.pump_once()  # drain poll -> retry buffers -> resume
+    assert "resume" in events
+    assert prod.buffer_count() == 0
+    qm_c.consumer_channel.pump_once()
+    cons.ack([t for _l, t in got[3:]])
+    assert [l for l, _t in got] == [f"line{i}" for i in range(5)]  # FIFO through the buffer
+
+
+def test_transport_broker_key_selects_redis():
+    server = FakeRedisServer()
+    qm = make_queue_manager(
+        {"brokerBackend": "memory", "transport": {"broker": "redis"},
+         "redis": {"streamMaxlen": 10}},
+        redis_module=make_fake_redis(server))
+    qm.get_queue("q", "p").write_line("via-redis")
+    assert server.stream_len("q") == 1
+
+
+def test_headers_roundtrip_msg_id_ingest_ts():
+    server = FakeRedisServer()
+    qm_p = make_qm(server)
+    qm_c = make_qm(server)
+    got = []
+    qm_p.get_queue("q", "p").write_line("payload")
+    qm_c.get_queue("q", "c",
+                   lambda line, headers=None: got.append(headers)).start_consume()
+    qm_c.consumer_channel.pump_once()
+    assert len(got) == 1
+    assert "msg_id" in got[0] and "ingest_ts" in got[0]
+
+
+def test_pump_thread_end_to_end():
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    got = []
+    done = threading.Event()
+
+    def cb(payload, headers):
+        got.append(payload)
+        if len(got) == 20:
+            done.set()
+
+    ch.consume("q", cb, "t1")
+    ch.start_pump_thread(poll_s=0.001)
+    try:
+        for i in range(20):
+            ch.send("q", f"m{i}".encode(), {})
+        assert done.wait(2.0)
+    finally:
+        ch.stop()
+    assert got == [f"m{i}".encode() for i in range(20)]
+
+
+# -- real server (auto-skip) ---------------------------------------------------
+
+
+def _real_redis_or_skip():
+    import os
+
+    if not HAVE_REDIS:
+        pytest.skip("redis-py not installed")
+    import redis
+
+    url = os.environ.get("APM_TEST_REDIS_URL", "redis://localhost:6379/0")
+    try:
+        cli = redis.Redis.from_url(url)
+        cli.ping()
+    except Exception:
+        pytest.skip(f"no redis server answering at {url}")
+    return url, cli
+
+
+@pytest.mark.slow
+def test_real_redis_roundtrip_and_redelivery():
+    url, cli = _real_redis_or_skip()
+    stream = f"apm-test-{time.time_ns()}"
+    ch = RedisStreamsChannel(url, claim_idle_ms=100)
+    try:
+        got = []
+        ch.consume(stream, lambda p, h, t: got.append((p, h, t)), "t1",
+                   manual_ack=True)
+        assert ch.send(stream, b"real", {"msg_id": "r1"})
+        deadline = time.time() + 5.0
+        while not got and time.time() < deadline:
+            ch.pump_once()
+            time.sleep(0.01)
+        assert got and got[0][0] == b"real" and got[0][1]["msg_id"] == "r1"
+        time.sleep(0.15)  # exceed claim_idle_ms: unacked -> XAUTOCLAIM
+        while len(got) < 2 and time.time() < deadline:
+            ch.pump_once()
+            time.sleep(0.01)
+        assert len(got) >= 2 and got[1][1]["redelivered"] is True
+        ch.ack([t for _p, _h, t in got])
+    finally:
+        ch.close()
+        try:
+            cli.delete(stream)
+        except Exception:
+            pass
+
+
+@pytest.mark.slow
+def test_real_redis_backlog_refusal():
+    url, cli = _real_redis_or_skip()
+    stream = f"apm-test-{time.time_ns()}"
+    ch = RedisStreamsChannel(url, stream_maxlen=4)
+    try:
+        for i in range(4):
+            assert ch.send(stream, f"m{i}".encode(), {})
+        assert not ch.send(stream, b"overflow", {})
+    finally:
+        ch.close()
+        try:
+            cli.delete(stream)
+        except Exception:
+            pass
