@@ -13,7 +13,7 @@ def effective_broker_backend(config: dict) -> str:
 
 
 def make_queue_manager(config: dict, *, broker=None, logger=None,
-                       redis_module=None) -> QueueManager:
+                       redis_module=None, start_pumps: bool = True) -> QueueManager:
     """Build a QueueManager for the configured backend.
 
     ``brokerBackend: "memory"`` shares the passed (or a fresh) MemoryBroker
@@ -23,12 +23,21 @@ def make_queue_manager(config: dict, *, broker=None, logger=None,
     direction from the ``redis`` section (``redis_module`` injects the
     in-process fake); ``"spool"`` shares one durable SpoolChannel fabric
     under ``transport.spoolDirectory``.
+
+    Pumped backends (a memory broker created here, redis, spool) get their
+    pump thread started: the pump owns delivery, reconnect, ack retry, and
+    — on redis, where drain is polled rather than pushed — the drain
+    detection that resumes a paused producer. ``start_pumps=False`` leaves
+    pumping to the caller (tests that drive ``pump_once()`` themselves);
+    a broker passed in is assumed already pumped by its owner.
     """
     backend = effective_broker_backend(config)
     interval = config.get("statLogIntervalInSeconds", 60)
     transport_cfg = config.get("transport", {}) or {}
     if backend == "memory":
         shared = broker if broker is not None else MemoryBroker()
+        if broker is None and start_pumps:
+            shared.start_pump_thread()
 
         def factory(_kind: str):
             return MemoryChannel(shared)
@@ -55,11 +64,18 @@ def make_queue_manager(config: dict, *, broker=None, logger=None,
                 claim_idle_ms=redis_cfg.get("claimIdleMs", 5000),
                 prefetch=redis_cfg.get("prefetchCount", 1000),
             )
+            if start_pumps:
+                # producer-side channels need the pump too: drain is
+                # polled, not pushed, so a paused producer only resumes
+                # when something re-checks the backlog
+                ch.start_pump_thread()
             return ch
 
         return QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
     if backend == "spool":
         shared_spool = SpoolChannel(transport_cfg.get("spoolDirectory", "spool/broker"))
+        if start_pumps:
+            shared_spool.start_pump_thread()
 
         def factory(_kind: str):
             return shared_spool

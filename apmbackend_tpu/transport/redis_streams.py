@@ -16,7 +16,10 @@ which under a stalled consumer is data loss, not backpressure. So
 the group backlog (PEL pending + undelivered lag) is at
 ``stream_maxlen``, and the retention trim rides far behind at
 ``2 * stream_maxlen`` (approximate) so it only ever eats the acked
-prefix. Drain fires when the backlog falls to half the cap.
+prefix. Drain fires when the backlog falls to half the cap. The backlog
+probe (XINFO GROUPS) is amortized: far below the cap it runs once per
+``backlog_check_every`` sends against a locally-advanced estimate, and
+only near the cap does every send pay the round trip.
 
 Durability class: bounded-loss durable — entries survive broker restart
 (RDB/AOF) and consumer crashes (PEL + XAUTOCLAIM), but retention trimming
@@ -116,6 +119,9 @@ class RedisStreamsChannel(Channel):
         self._pending_acks: List[Tuple[str, str]] = []  # guarded-by: _lock
         self._pressure = False  # guarded-by: _lock
         self._pressured: Set[str] = set()  # guarded-by: _lock
+        self._backlog_est: Dict[str, int] = {}  # guarded-by: _lock
+        self._sends_since_check: Dict[str, int] = {}  # guarded-by: _lock
+        self.backlog_check_every = 64  # sends between XINFO checks while well below cap
         self._next_connect_at = 0.0  # guarded-by: _lock
         self._backoff_s = reconnect_base_backoff_s  # guarded-by: _lock
         self._base_backoff_s = reconnect_base_backoff_s
@@ -166,6 +172,9 @@ class RedisStreamsChannel(Channel):
         # re-creating is one idempotent XGROUP CREATE per queue (BUSYGROUP
         # swallowed), so re-learn them after every reconnect
         self._groups_ready.clear()
+        # backlog estimates are per-server state: re-measure after reconnect
+        self._backlog_est.clear()
+        self._sends_since_check.clear()
 
     # apm: holds(_lock): group bookkeeping is shared consumer state
     def _ensure_group_locked(self, cli, name: str) -> None:
@@ -185,11 +194,42 @@ class RedisStreamsChannel(Channel):
         """Messages this channel's group still owes: PEL pending + entries
         never delivered (lag). Before any group exists (no consumer started
         anywhere yet) the whole stream is backlog."""
-        infos = cli.xinfo_groups(name)
+        try:
+            infos = cli.xinfo_groups(name)
+        except self._resp_error as e:
+            # XINFO GROUPS on a stream no XADD has created yet (first send,
+            # or a non-persistent broker restart wiped it) raises
+            # "ERR no such key" — an empty stream owes nothing
+            if "no such key" in str(e).lower():
+                return 0
+            raise
         for info in infos:
             if _s(info.get("name")) == self.group:
                 return int(info.get("pending", 0)) + int(info.get("lag", 0) or 0)
         return int(cli.xlen(name))
+
+    # apm: holds(_lock): reads/updates the shared backlog estimate
+    def _admit_send_locked(self, cli, name: str) -> bool:
+        """Backlog gate for one XADD, without an XINFO round trip per send.
+
+        Between measurements the backlog can only have grown by this
+        channel's own sends (acks shrink it, other producers can add — the
+        estimate is exact for a single producer, conservative-late by at
+        most ``backlog_check_every`` entries with several). So the broker
+        round trip is paid only every ``backlog_check_every`` sends while
+        the estimate plus that slack stays below ``stream_maxlen``; within
+        one interval of the cap every send re-measures, keeping refusal
+        exact exactly where it matters."""
+        est = self._backlog_est.get(name)
+        since = self._sends_since_check.get(name, 0)
+        if (est is not None
+                and since < self.backlog_check_every
+                and est + since + self.backlog_check_every < self.stream_maxlen):
+            return True
+        backlog = self._backlog_locked(cli, name)
+        self._backlog_est[name] = backlog
+        self._sends_since_check[name] = 0
+        return backlog < self.stream_maxlen
 
     # -- Channel contract ----------------------------------------------------
     def assert_queue(self, name: str) -> None:
@@ -201,7 +241,7 @@ class RedisStreamsChannel(Channel):
         with self._lock:
             try:
                 cli = self._ensure_client_locked()
-                if self._backlog_locked(cli, name) >= self.stream_maxlen:
+                if not self._admit_send_locked(cli, name):
                     # Redis never refuses an XADD — MAXLEN trim would eat the
                     # oldest entries instead. Refuse HERE so the overload
                     # surfaces as producer pause, not silent loss.
@@ -213,6 +253,8 @@ class RedisStreamsChannel(Channel):
                 # removes the acked prefix
                 cli.xadd(name, fields, maxlen=self.stream_maxlen * 2,
                          approximate=True)
+                self._sends_since_check[name] = \
+                    self._sends_since_check.get(name, 0) + 1
                 return True
             except self._conn_errors as e:
                 # connection loss looks like fullness to the producer: it
@@ -354,9 +396,12 @@ class RedisStreamsChannel(Channel):
         """Idle-PEL redelivery. Entries trimmed out from under the PEL come
         back in XAUTOCLAIM's deleted list — count them loudly (the loss a
         too-small stream_maxlen buys) instead of silently shrinking."""
-        _next, claimed, deleted = cli.xautoclaim(
+        resp = cli.xautoclaim(
             name, self.group, self.consumer_name, self.claim_idle_ms,
             start_id="0-0", count=budget)
+        # Redis < 7.0 replies (next, claimed); 7.0+ appends the deleted list
+        claimed = resp[1]
+        deleted = resp[2] if len(resp) > 2 else []
         if deleted:
             self.deleted_count += len(deleted)
             for entry_id in deleted:
@@ -390,7 +435,10 @@ class RedisStreamsChannel(Channel):
         low_water = max(1, self.stream_maxlen // 2)
         try:
             for name in self._pressured:
-                if self._backlog_locked(self._cli, name) > low_water:
+                backlog = self._backlog_locked(self._cli, name)
+                self._backlog_est[name] = backlog
+                self._sends_since_check[name] = 0
+                if backlog > low_water:
                     return False
         except self._conn_errors as e:
             self._drop_client_locked(e)
@@ -410,7 +458,10 @@ class RedisStreamsChannel(Channel):
         with self._lock:
             try:
                 cli = self._ensure_client_locked()
-                return self._backlog_locked(cli, name)
+                backlog = self._backlog_locked(cli, name)
+                self._backlog_est[name] = backlog
+                self._sends_since_check[name] = 0
+                return backlog
             except Exception:
                 return 0
 

@@ -12,9 +12,12 @@ Models the Redis Streams behaviors the at-least-once stack depends on:
 - XAUTOCLAIM as the redelivery path: PEL entries idle longer than
   ``min_idle_time`` are re-claimed (delivery counter bumped) and handed to
   the caller; PEL entries whose underlying stream entry was trimmed away
-  come back in the *deleted* list, exactly like Redis >= 6.2;
+  come back in the *deleted* list, exactly like Redis >= 7.0
+  (``server.redis62 = True`` emulates the 6.2 two-element reply);
 - XINFO GROUPS exposing ``pending`` + ``lag`` (the backlog a group still
-  owes), the channel's refusal and queue-lag input;
+  owes), the channel's refusal and queue-lag input — and raising
+  ``ERR no such key`` for a stream no XADD has created yet, exactly like
+  a real server;
 - a kill/restart seam: ``kill()`` severs every live connection (clients
   raise ConnectionError until a NEW client is built after ``restart()``),
   while streams, groups, and the PEL survive — AOF-persistence semantics,
@@ -68,12 +71,16 @@ class FakeRedisServer:
         # a stale client keeps raising after restart() — a severed TCP
         # connection never comes back; the channel must build a new client
         self.epoch = 0
+        # pre-7.0 mode: XAUTOCLAIM replies (next, claimed) with no third
+        # deleted-entries element, like Redis 6.2
+        self.redis62 = False
         self._skew_ms = 0.0
         self.add_count = 0
         self.ack_count = 0
         self.claim_count = 0
         self.trimmed_count = 0
         self.kill_count = 0
+        self.xinfo_count = 0
 
     # -- virtual clock -------------------------------------------------------
     def now_ms(self) -> float:
@@ -202,6 +209,11 @@ class FakeRedisServer:
         return "0-0", claimed, deleted
 
     def xinfo_groups(self, name: str) -> List[dict]:
+        self.xinfo_count += 1
+        if name not in self.streams:
+            # real Redis errors here rather than answering [] — the channel
+            # must treat a nonexistent stream as zero backlog itself
+            raise _FakeResponseError("ERR no such key")
         out = []
         for (stream, group), g in self.groups.items():
             if stream != name:
@@ -263,8 +275,10 @@ class FakeRedisClient:
     def xautoclaim(self, name, groupname, consumername, min_idle_time,
                    start_id="0-0", count=100):
         with self._server.lock:
-            return self._srv().xautoclaim(
+            resp = self._srv().xautoclaim(
                 name, groupname, consumername, min_idle_time, count)
+            # Redis 6.2 drops trimmed PEL entries without reporting them
+            return resp[:2] if self._server.redis62 else resp
 
     def xinfo_groups(self, name):
         with self._server.lock:

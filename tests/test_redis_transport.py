@@ -25,7 +25,7 @@ def make_channel(server, **kw):
     return RedisStreamsChannel("redis://fake", **kw)
 
 
-def make_qm(server, *, maxlen=100000, transport=None):
+def make_qm(server, *, maxlen=100000, transport=None, start_pumps=False):
     cfg = {
         "brokerBackend": "redis",
         "statLogIntervalInSeconds": 3600,
@@ -33,7 +33,9 @@ def make_qm(server, *, maxlen=100000, transport=None):
     }
     if transport is not None:
         cfg["transport"] = transport
-    return make_queue_manager(cfg, redis_module=make_fake_redis(server))
+    # start_pumps=False: these tests drive pump_once() deterministically
+    return make_queue_manager(cfg, redis_module=make_fake_redis(server),
+                              start_pumps=start_pumps)
 
 
 # -- channel contract ----------------------------------------------------------
@@ -56,6 +58,37 @@ def test_basic_send_consume_roundtrip():
     assert got == [(b"hello", {"msg_id": "m1", "ingest_ts": 1.5})]
     # auto-ack mode commits on delivery: nothing left pending
     assert server.pending_count("q") == 0
+
+
+def test_first_send_on_fresh_stream_succeeds():
+    # XINFO GROUPS on a stream no XADD has created raises "ERR no such key"
+    # on a real server (and now on the fake): the very first send — before
+    # any consumer exists anywhere — must treat that as zero backlog, not
+    # die in the producer's write path
+    server = FakeRedisServer()
+    ch = make_channel(server)
+    assert ch.send("fresh", b"first", {"msg_id": "m1"})
+    assert server.stream_len("fresh") == 1
+    assert ch.queue_lag("never-written") == 0  # same path from the lag gauge
+
+
+def test_fresh_stream_after_wiping_restart():
+    # a non-persistent broker restart loses the stream entirely; the first
+    # send after reconnect recreates it instead of erroring out
+    server = FakeRedisServer()
+    ch = make_channel(server, reconnect_base_backoff_s=0.0,
+                      reconnect_max_backoff_s=0.0)
+    assert ch.send("q", b"before", {})
+    server.kill()
+    with server.lock:
+        server.streams.clear()
+        server.groups.clear()
+        server._seq.clear()
+    server.restart()
+    deadline = time.time() + 2.0
+    while not ch.send("q", b"after", {}) and time.time() < deadline:
+        time.sleep(0.005)
+    assert server.stream_len("q") == 1
 
 
 def test_one_arg_callback_wrapped_like_spool():
@@ -141,6 +174,37 @@ def test_autoclaim_redelivers_idle_pending_with_flag():
     ch.ack([token])
     server.advance_ms(6000)
     assert ch.deliver() == 0  # acked: gone from the PEL for good
+
+
+def test_redis62_two_element_xautoclaim_still_redelivers():
+    # pre-7.0 XAUTOCLAIM replies (next, claimed) with no deleted list —
+    # delivery must tolerate it rather than ValueError on every pump pass
+    server = FakeRedisServer()
+    server.redis62 = True
+    ch = make_channel(server, claim_idle_ms=5000)
+    got = []
+    ch.consume("q", lambda p, h, t: got.append((p, h, t)), "t1", manual_ack=True)
+    ch.send("q", b"m", {"msg_id": "m1"})
+    assert ch.deliver() == 1
+    server.advance_ms(6000)
+    assert ch.deliver() == 1  # redelivery via the 2-element reply
+    assert got[1][1]["redelivered"] is True
+    ch.ack([got[1][2]])
+    assert server.pending_count("q") == 0
+
+
+def test_backlog_check_amortized_far_from_cap():
+    # well below stream_maxlen the XINFO round trip is paid once per
+    # backlog_check_every sends, not per send — the hot producer path is
+    # one XADD, not XINFO (+XLEN) then XADD
+    server = FakeRedisServer()
+    ch = make_channel(server, stream_maxlen=100000)
+    for i in range(200):
+        assert ch.send("q", f"m{i}".encode(), {})
+    checks_per_send = server.xinfo_count / 200
+    assert checks_per_send <= 1 / ch.backlog_check_every + 0.01
+    # ...while refusal at the cap stays exact: near the cap every send
+    # re-measures (test_send_refuses_at_stream_maxlen covers exactness)
 
 
 def test_send_refuses_at_stream_maxlen_and_drains_at_half():
@@ -358,7 +422,7 @@ def test_transport_broker_key_selects_redis():
     qm = make_queue_manager(
         {"brokerBackend": "memory", "transport": {"broker": "redis"},
          "redis": {"streamMaxlen": 10}},
-        redis_module=make_fake_redis(server))
+        redis_module=make_fake_redis(server), start_pumps=False)
     qm.get_queue("q", "p").write_line("via-redis")
     assert server.stream_len("q") == 1
 
@@ -374,6 +438,34 @@ def test_headers_roundtrip_msg_id_ingest_ts():
     qm_c.consumer_channel.pump_once()
     assert len(got) == 1
     assert "msg_id" in got[0] and "ingest_ts" in got[0]
+
+
+def test_default_factory_pumps_itself_producer_resumes():
+    # make_queue_manager's default starts the pump thread on every redis
+    # channel — including the producer side, where drain is polled rather
+    # than pushed — so a paused producer resumes with no manual pump_once()
+    server = FakeRedisServer()
+    qm_p = make_qm(server, maxlen=3, start_pumps=True)
+    qm_c = make_qm(server, maxlen=3, start_pumps=True)
+    resumed = threading.Event()
+    qm_p.on("resume", resumed.set)
+    prod = qm_p.get_queue("q", "p")
+    try:
+        for i in range(6):
+            prod.write_line(f"line{i}")
+        assert prod.buffer_count() > 0  # over the cap: paused, buffering
+        got = []
+        qm_c.get_queue(
+            "q", "c", lambda line, headers=None: got.append(line)).start_consume()
+        assert resumed.wait(5.0)
+        deadline = time.time() + 5.0
+        while (prod.buffer_count() or len(got) < 6) and time.time() < deadline:
+            time.sleep(0.01)
+        assert prod.buffer_count() == 0
+        assert got == [f"line{i}" for i in range(6)]
+    finally:
+        qm_p.producer_channel.stop()
+        qm_c.consumer_channel.stop()
 
 
 def test_pump_thread_end_to_end():
@@ -438,6 +530,25 @@ def test_real_redis_roundtrip_and_redelivery():
             time.sleep(0.01)
         assert len(got) >= 2 and got[1][1]["redelivered"] is True
         ch.ack([t for _p, _h, t in got])
+    finally:
+        ch.close()
+        try:
+            cli.delete(stream)
+        except Exception:
+            pass
+
+
+@pytest.mark.slow
+def test_real_redis_first_send_fresh_stream():
+    # the first XADD ever, before any group or consumer exists: the
+    # backlog probe's XINFO GROUPS raises "ERR no such key" on a real
+    # server and send() must absorb it, not kill the writer
+    url, cli = _real_redis_or_skip()
+    stream = f"apm-test-{time.time_ns()}"
+    ch = RedisStreamsChannel(url)
+    try:
+        assert ch.send(stream, b"first", {"msg_id": "f1"})
+        assert ch.queue_lag(stream) == 1
     finally:
         ch.close()
         try:
