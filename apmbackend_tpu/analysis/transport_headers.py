@@ -47,36 +47,35 @@ def _names_in(node: ast.AST) -> Set[str]:
 
 
 def _stamped(project: Project) -> Dict[str, Tuple[str, int]]:
-    """Header keys stamped at ``ProducerQueue.write_line`` (the single
-    transport-entry point): dict-literal keys of ``headers = {...}`` plus
-    ``headers["k"] = ...`` subscript assigns inside the function."""
+    """Header keys stamped at the transport-entry points — both producer
+    send paths: ``ProducerQueue.write_line`` (object wire) and
+    ``ProducerQueue.write_frames`` (frameMode wire, ISSUE 16). Harvested
+    per function: dict-literal keys of ``headers = {...}`` plus
+    ``headers["k"] = ...`` subscript assigns."""
     def build() -> Dict[str, Tuple[str, int]]:
         out: Dict[str, Tuple[str, int]] = {}
         sf = project.file("transport/base.py")
         if sf is None:
             return out
-        fn = None
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.FunctionDef) and node.name == "write_line":
-                fn = node
-                break
-        if fn is None:
-            return out
-        for node in ast.walk(fn):
-            if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict)
-                    and any(isinstance(t, ast.Name) and t.id in _HEADER_NAMES
-                            for t in node.targets)):
-                for key in node.value.keys:
-                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
-                        out.setdefault(key.value, (sf.rel, node.lineno))
-            if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if (isinstance(t, ast.Subscript)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id in _HEADER_NAMES
-                            and isinstance(t.slice, ast.Constant)
-                            and isinstance(t.slice.value, str)):
-                        out.setdefault(t.slice.value, (sf.rel, node.lineno))
+        fns = [node for node in ast.walk(sf.tree)
+               if isinstance(node, ast.FunctionDef)
+               and node.name in ("write_line", "write_frames")]
+        for fn in fns:
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict)
+                        and any(isinstance(t, ast.Name) and t.id in _HEADER_NAMES
+                                for t in node.targets)):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                            out.setdefault(key.value, (sf.rel, node.lineno))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in _HEADER_NAMES
+                                and isinstance(t.slice, ast.Constant)
+                                and isinstance(t.slice.value, str)):
+                            out.setdefault(t.slice.value, (sf.rel, node.lineno))
         return out
     return project.cached("headers.stamped", build)
 
@@ -87,7 +86,9 @@ def _transport_backends(project: Project) -> List[SourceFile]:
     for sf in project.files:
         rel = sf.rel.replace(os.sep, sep)
         parts = rel.split(sep)
-        if "transport" in parts[:-1] and parts[-1] not in ("base.py", "__init__.py"):
+        # frames.py is the payload codec, not a backend — no send() ledger
+        if "transport" in parts[:-1] and parts[-1] not in (
+                "base.py", "__init__.py", "frames.py"):
             out.append(sf)
     return out
 

@@ -236,7 +236,7 @@ def default_config() -> dict:
 _DEFAULT_CONFIG: dict = {
     "appDirectory": ".",
     "amqpConnectionString": "amqp://localhost:5672",
-    "brokerBackend": "memory",  # "memory" | "amqp" | "redis" | "spool"
+    "brokerBackend": "memory",  # "memory" | "amqp" | "redis" | "spool" | "shmring"
     # consumer prefetch for at-least-once (manual-ack) AMQP consumers: the
     # broker bound on in-flight unacked deliveries per connection — also the
     # worst-case redelivery span a dedup window must cover
@@ -260,6 +260,19 @@ _DEFAULT_CONFIG: dict = {
         # /healthz flow-control provider degrades when any producer buffer
         # reaches this fraction of the cap (pages BEFORE eviction starts)
         "producerBufferDegradedRatio": 0.8,
+        # Zero-object byte spine (transport/frames.py, DESIGN.md §4.1):
+        # frameMode makes the parser emit packed APF1 frame batches — one
+        # write_frames per batch, headers stamped once per batch — instead
+        # of one write_line per record. OFF keeps the wire bit-identical to
+        # the pre-frame backend; APM_NO_FRAMES=1 is the runtime kill
+        # switch, APM_FRAMES_NO_NATIVE=1 forces the Python encoder.
+        "frameMode": False,
+        "frameMaxRecords": 512,  # records per batch before a forced flush
+        # brokerBackend "shmring": mmap'd SPSC shared-memory rings (one
+        # file per queue under shmRingDirectory, shmRingBytes each) for the
+        # parser->worker hop — at-most-once, in-host, zero broker process.
+        "shmRingDirectory": "spool/shmring",
+        "shmRingBytes": 8 * 1024 * 1024,
     },
     # Redis Streams backend (transport/redis_streams.py): consumer groups
     # give manual-ack/redelivery via the PEL + XAUTOCLAIM; send refuses while
@@ -620,6 +633,12 @@ _DEFAULT_CONFIG: dict = {
         "ringBytes": 4194304,
         "intakeOverflowMaxLines": 200000,
         "ringFullMaxBlockSeconds": 2.0,
+        # frame intake (transport.frameMode producers): True decodes APF1
+        # frame batches straight into the columnar ingest path
+        # (PipelineDriver.feed_frames — no per-line Python); False unfolds
+        # each batch back into lines at the feed boundary (compat path,
+        # same records either way)
+        "feedFrames": True,
         # double-buffered emission overlap (catch-up aware; r6)
         "asyncEmission": False,
         # per-module profiling harness keys (honored in EVERY module section,

@@ -52,6 +52,13 @@ def js_parse_int(value) -> float:
         return float(value)
     if value is None or t is bool:
         return NAN
+    if t is str:
+        if value.isascii() and value.isdigit():
+            # whole-string ASCII digit run: the regex would match all of it
+            # and compute this same float(int(...)) — skip the match
+            return float(int(value))
+        m = _NUM_PREFIX_INT.match(value)
+        return float(int(m.group(0))) if m else NAN
     if isinstance(value, (int, float)):  # numpy scalars & friends
         if isinstance(value, float) and (math.isnan(value) or math.isinf(value)):
             return NAN
@@ -158,6 +165,39 @@ class TxEntry:
             "elapsed": None if math.isnan(self.elapsed) else int(self.elapsed),
             "toplevel": self.top_level,
         }
+
+
+_MAX_SAFE_INT = 1 << 53  # beyond this, float(int) rounds and str(v) would drift
+
+
+def _csv_num(v) -> str:
+    """``_num_str(js_parse_int(v))`` with exact-type fast paths for the two
+    shapes the frame emitter actually passes: Python ints inside the float53
+    window render as themselves, and short ASCII digit strings (<= 15 digits
+    stays exact through the float round-trip) render as the zero-stripped
+    run. Anything else — signs, whitespace, huge digit runs, NaN — takes
+    the full coercion so the bytes cannot drift from TxEntry.to_csv."""
+    t = type(v)
+    if t is int:
+        if -_MAX_SAFE_INT <= v <= _MAX_SAFE_INT:
+            return str(v)
+    elif t is str and 0 < len(v) <= 15 and v.isascii() and v.isdigit():
+        return v.lstrip("0") or "0"
+    return _num_str(js_parse_int(v))
+
+
+def format_tx_line(server, service, log_id, acct_num,
+                   start_ts, end_ts, elapsed, top_level) -> str:
+    """``TxEntry(...).to_csv()`` without the TxEntry — the frame-emission
+    fast path of the zero-object byte spine. Byte-identical to the
+    dataclass route (pinned by tests/test_parser_native_diff.py): every
+    numeric field takes the same js_parse_int coercion (or a proven-equal
+    fast path, see _csv_num), then the same bare `${num}` rendering."""
+    return (
+        f"tx|{server}|{service}|{log_id}|{_csv_num(acct_num)}|"
+        f"{_csv_num(start_ts)}|{_csv_num(end_ts)}|"
+        f"{_csv_num(elapsed)}|{top_level}"
+    )
 
 
 @dataclass

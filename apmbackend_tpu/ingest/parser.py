@@ -39,7 +39,7 @@ from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Callable, Dict, List, Optional, Union
 
-from ..entries import TxEntry
+from ..entries import TxEntry, format_tx_line
 from .ttlcache import TTLCache
 
 # Kill switch for the native (C++) ingest fast path — same pattern as
@@ -137,19 +137,51 @@ def convert_log_date_to_ms(date_str: str) -> str:
     if _ISO_TZ_RE.search(date_str):
         out = str(int(datetime.fromisoformat(date_str).timestamp() * 1000))
     else:
-        parts = re.split(r"-|\s+|:|,", date_str.strip())
-        mkey = (parts[0], parts[1], parts[2], parts[3], parts[4])
-        base = _minute_ms_cache.get(mkey)
-        if base is None:
-            dt = datetime(
-                int(parts[0]), int(parts[1]), int(parts[2]),
-                int(parts[3]), int(parts[4]),
-            )
-            base = int(dt.timestamp()) * 1000
-            if len(_minute_ms_cache) >= 4096:
-                _minute_ms_cache.clear()
-            _minute_ms_cache[mkey] = base
-        sec, ms = int(parts[5]), int(parts[6])
+        ds = date_str.strip()
+        sec = -1
+        if (
+            len(ds) == 23 and ds[4] == "-" and ds[7] == "-"
+            and ds[10] == " " and ds[13] == ":" and ds[16] == ":"
+            and ds[19] == ","
+        ):
+            # fixed-layout scan of the canonical WildFly form: re.split was
+            # the single hottest memo-miss cost, and dense streams make
+            # almost every call a miss (unique millis). The minute cache is
+            # keyed by the 16-char prefix, so the hot path is one slice, one
+            # dict hit and two int()s. Any non-digit slice falls through to
+            # the general splitter, so junk input keeps the exact legacy
+            # error behaviour (the prefix of a malformed minute can never be
+            # cached — only successful parses insert).
+            try:
+                sec, ms = int(ds[17:19]), int(ds[20:23])
+                mkey = ds[:16]
+                base = _minute_ms_cache.get(mkey)
+                if base is None:
+                    base = int(datetime(
+                        int(ds[:4]), int(ds[5:7]), int(ds[8:10]),
+                        int(ds[11:13]), int(ds[14:16]),
+                    ).timestamp()) * 1000
+                    if len(_minute_ms_cache) >= 4096:
+                        _minute_ms_cache.clear()
+                    _minute_ms_cache[mkey] = base
+            except ValueError:
+                sec = -1
+        if sec < 0:
+            # general form: whitespace runs, fancy widths — the legacy path
+            # (str prefix vs 5-tuple keys cannot collide in the shared cache)
+            parts = re.split(r"-|\s+|:|,", ds)
+            mkey = (parts[0], parts[1], parts[2], parts[3], parts[4])
+            sec, ms = int(parts[5]), int(parts[6])
+            base = _minute_ms_cache.get(mkey)
+            if base is None:
+                dt = datetime(
+                    int(parts[0]), int(parts[1]), int(parts[2]),
+                    int(parts[3]), int(parts[4]),
+                )
+                base = int(dt.timestamp()) * 1000
+                if len(_minute_ms_cache) >= 4096:
+                    _minute_ms_cache.clear()
+                _minute_ms_cache[mkey] = base
         if not (0 <= sec <= 59 and 0 <= ms <= 999):
             # datetime() would have rejected these; keep the raise
             raise ValueError(f"second/millisecond out of range: {date_str!r}")
@@ -302,9 +334,24 @@ class TransactionParser:
         need_num_ttl_s: float = 30.0,
         acct_ttl_s: float = 120.0,
         use_native: Optional[bool] = None,
+        frame_sink: Optional[Callable[[bytes, int], None]] = None,
+        frame_max_records: int = 512,
     ):
         self.on_record = on_record
         self.logger = logger
+        # frame-emission mode (the zero-object byte spine): queue-bound
+        # records skip TxEntry + on_record entirely — the finished CSV line
+        # goes into a buffer that is packed into APF1 frame batches
+        # (transport/frames.py) and handed to frame_sink(blob, n_records)
+        # at chunk/sweep/drain boundaries or when frame_max_records
+        # accumulate. db-direct records (insert_to_db=True) always keep the
+        # per-record on_record path. APM_NO_FRAMES=1 kills the mode (the
+        # APM_PARSE_NO_NATIVE pattern); frames OFF is the default wire.
+        if os.environ.get("APM_NO_FRAMES", "") in ("1", "true"):
+            frame_sink = None
+        self.frame_sink = frame_sink
+        self._frame_buf: list = []
+        self._frame_max = max(1, int(frame_max_records))
         # stage counters (ROADMAP "replay is parser-bound" quantification;
         # exported by obs.views.register_parser, surfaced by bench_replay):
         # plain dict ints — this is the per-line hot loop, registry
@@ -316,6 +363,8 @@ class TransactionParser:
             "parse_ns": 0,      # wall ns inside _read_line / native chunks
             "native_lines": 0,  # lines that went through the native chunk path
             "prefilter_rejected": 0,  # lines the native pre-filter dropped
+            "frames_emitted": 0,      # APF1 frame batches handed to frame_sink
+            "frame_records_out": 0,   # records emitted via frames (no TxEntry)
         }
         self.server_from_path = server_from_path or (lambda fp: fp.split("/")[2] if len(fp.split("/")) > 2 else fp)
         # per-file dispatch cache: (kind, server, native server id) resolved
@@ -394,11 +443,44 @@ class TransactionParser:
         self.acct_cache.sweep()
         self.record_cache.sweep()
         self.need_num_cache.sweep()
+        if self._frame_buf:
+            self._flush_frames_safe("<sweep>")
 
     def drain(self) -> None:
         """End-of-replay: flush numberless records out, drop partials."""
         self.need_num_cache.flush_all()
         self.record_cache.clear()
+        if self._frame_buf:
+            self._flush_frames_safe("<drain>")
+
+    # -- frame emission ------------------------------------------------------
+    def flush_frames(self) -> None:
+        """Pack buffered frame-mode lines into one APF1 batch and hand it to
+        frame_sink. Called at chunk/sweep/drain boundaries and when the
+        buffer reaches frame_max_records; a sink failure raises
+        ConsumerError (batch dropped loudly, like a failed on_record)."""
+        buf = self._frame_buf
+        if not buf:
+            return
+        self._frame_buf = []
+        from ..transport import frames as _frames
+
+        blob = _frames.encode_lines(buf)
+        self.counters["frames_emitted"] += 1
+        try:
+            self.frame_sink(blob, len(buf))
+        except Exception as e:
+            raise ConsumerError(e) from e
+
+    def _flush_frames_safe(self, where: str) -> None:
+        try:
+            self.flush_frames()
+        except ConsumerError as e:
+            if self.logger:
+                self.logger.error(
+                    f"Frame sink failed (batch dropped) at {where}: "
+                    f"{e.__cause__!r}"
+                )
 
     def cache_stats(self) -> dict:
         return {
@@ -421,6 +503,25 @@ class TransactionParser:
             except (TypeError, ValueError):
                 start_ms = ""
         top = "Y" if service.startswith("S:") else "N"  # == _TOPLEVEL_RE.match
+        c = self.counters
+        if self.frame_sink is not None and not insert_to_db:
+            # frame mode, queue-bound record: format the CSV line directly
+            # (format_tx_line == TxEntry(...).to_csv() byte for byte) and
+            # buffer it for batch packing — no TxEntry, no on_record
+            c["tx_out"] += 1
+            c["frame_records_out"] += 1
+            # start/end go in as OUR canonical str(int(...)) strings: the
+            # _csv_num digit fast path renders them verbatim, which is the
+            # same byte output the int(...) round trip produced ('' still
+            # coerces to NaN; negatives and >15-digit strings take the full
+            # js_parse_int route and agree with int()'s reading exactly)
+            self._frame_buf.append(format_tx_line(
+                server, service, log_id, acct_num, start_ms, end_ms,
+                elapsed, top,
+            ))
+            if len(self._frame_buf) >= self._frame_max:
+                self.flush_frames()
+            return
         # start/end are OUR str(int(...)) strings (or ''): int() parses
         # them identically to js_parse_int, and TxEntry's int fast path
         # skips the per-field regex — '' stays '' and parses to NaN as
@@ -432,7 +533,6 @@ class TransactionParser:
             int(end_ms) if end_ms else "",
             elapsed, top,
         )
-        c = self.counters
         c["tx_out"] += 1
         if insert_to_db:
             c["db_direct_out"] += 1
@@ -788,6 +888,8 @@ class TransactionParser:
                 segs.pop()
             for line in segs:
                 self.read_line(file_path, line)
+            if self._frame_buf:
+                self._flush_frames_safe(file_path)
             return len(segs)
         c = self.counters
         t0 = time.perf_counter_ns()
@@ -795,6 +897,8 @@ class TransactionParser:
             return self._read_lines_native(file_path, data)
         finally:
             c["parse_ns"] += time.perf_counter_ns() - t0
+            if self._frame_buf:
+                self._flush_frames_safe(file_path)
 
     def _read_lines_native(self, file_path: str, data: bytes) -> int:
         info = self._file_info_for(file_path)

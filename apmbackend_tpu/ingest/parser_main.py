@@ -65,8 +65,22 @@ def build(runtime, *, tail: bool = True):
         else:
             out_queue.write_line(tx.to_csv(), verbose)
 
+    # transport.frameMode: queue-bound records leave the parser as packed
+    # APF1 frame batches (one write_frames per batch — the zero-object byte
+    # spine) instead of one write_line per record. db-direct audit records
+    # keep the per-record path either way. APM_NO_FRAMES=1 overrides to OFF
+    # inside TransactionParser (the kill switch); OFF is bit-identical to
+    # the pre-frame wire by construction.
+    tcfg = runtime.config.get("transport", {}) or {}
+    frame_sink = None
+    if tcfg.get("frameMode"):
+        def frame_sink(blob: bytes, n_records: int) -> None:
+            out_queue.write_frames(blob, n_records, verbose)
+
     parser = TransactionParser(
-        on_record, logger=runtime.logger, server_from_path=server_extractor(cfg)
+        on_record, logger=runtime.logger, server_from_path=server_extractor(cfg),
+        frame_sink=frame_sink,
+        frame_max_records=int(tcfg.get("frameMaxRecords", 512) or 512),
     )
     # parser-stage counters as a /metrics view, gated like the worker's
     # collector so throwaway test runtimes do not pile up dead collectors

@@ -38,13 +38,17 @@ class TTLCache:
         self._store[key] = (self.clock() + self.ttl_s, value)
 
     def get(self, key: str) -> Optional[Any]:
-        self.maybe_sweep()
+        # one clock() read serves both the sweep check and the expiry test:
+        # get() runs twice per emitted record on the frame fast path
+        now = self.clock()
+        if now - self._last_sweep >= self.sweep_interval_s:
+            self.sweep()
         item = self._store.get(key)
         if item is None:
             self.misses += 1
             return None
         expires_at, value = item
-        if self.clock() >= expires_at:
+        if now >= expires_at:
             del self._store[key]
             if self.on_expired:
                 self.on_expired(key, value)

@@ -17,6 +17,9 @@ demand with ``make`` (g++, no external deps) and exposes:
   the (logId, service) TTL correlation join, plus the per-file SOAP/audit
   state machines; consumed by ingest.parser.TransactionParser.read_lines
   (APM_PARSE_NO_NATIVE=1 kills it).
+- :func:`frames_pack_native` — the APF1 frame-batch packer (apmfrm_pack in
+  native/parser.cpp): newline-joined tx lines -> one packed frame batch
+  for transport/frames.py (APM_FRAMES_NO_NATIVE=1 kills it).
 
 Everything degrades gracefully: with no compiler available the build
 functions return None and callers fall back to the pure-Python paths.
@@ -26,6 +29,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import threading
 from typing import Optional
@@ -367,6 +371,14 @@ def _load_parser_lib():
     ]
     lib.apmpar_soap_arm.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.apmpar_soap_close.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    try:
+        lib.apmfrm_pack.restype = ctypes.c_int64
+        lib.apmfrm_pack.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ]
+    except AttributeError:
+        # stale pre-frame .so: the codec's Python encoder takes over
+        pass
     _parser_lib = lib
     return lib
 
@@ -374,6 +386,29 @@ def _load_parser_lib():
 def have_native_parser() -> bool:
     """True when libapmparser built/loaded (toolchain present)."""
     return _load_parser_lib() is not None
+
+
+def frames_pack_native(lines_b):
+    """Pack line bytes into one APF1 frame batch via the native scanner
+    (apmfrm_pack). Returns a bytearray whose exotic records still carry
+    NaN numerics — transport/frames.py patches those with the full
+    js_parse_int semantics — or None when the library is unavailable, the
+    symbol is stale, or the native record count disagrees with the input
+    (embedded newline) and the Python encoder must take over."""
+    lib = _load_parser_lib()
+    if lib is None or not hasattr(lib, "apmfrm_pack"):
+        return None
+    blob = b"\n".join(lines_b)
+    cap = 16 + 32 * len(lines_b) + len(blob) + 1
+    out = ctypes.create_string_buffer(cap)
+    ret = lib.apmfrm_pack(blob, len(blob), out, cap)
+    if ret <= 0 or ret > cap:
+        return None
+    raw = bytearray(out.raw[:ret])
+    (nrec,) = struct.unpack_from("<I", raw, 4)
+    if nrec != len(lines_b):
+        return None
+    return raw
 
 
 def _parser_event_dtype():

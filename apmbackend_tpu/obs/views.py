@@ -108,6 +108,12 @@ def register_parser(parser, module: str, registry: Optional[MetricsRegistry] = N
         yield Sample("apm_parser_prefilter_rejected_total", labels,
                      c.get("prefilter_rejected", 0), "counter",
                      "Lines dropped by the native marker pre-filter with zero Python work")
+        yield Sample("apm_frames_emitted_total", labels,
+                     c.get("frames_emitted", 0), "counter",
+                     "APF1 frame batches emitted by the parser's frame mode")
+        yield Sample("apm_frame_records_total", labels,
+                     c.get("frame_records_out", 0), "counter",
+                     "Records emitted via frame batches (no TxEntry, no on_record)")
         for cache, st in parser.cache_stats().items():
             cl = dict(labels, cache=cache)
             yield Sample("apm_parser_cache_hits_total", cl, st["hits"], "counter",

@@ -121,6 +121,43 @@ class FleetPartitioner:
         self.queues[p].write_line(line, verbose)
         return p
 
+    def write_frames(self, blob: bytes, verbose: bool = False) -> Dict[int, int]:
+        """Route one packed APF1 batch (transport/frames.py): split it by
+        each record's stable key hash — read straight off the frame spans,
+        no line decode — and send ONE sub-batch per partition, stamped
+        with that partition's header like write_line routing. Returns
+        {partition: records sent}. The split hash and the per-line
+        write_line hash are the same FNV-1a over the same key bytes, so a
+        frame-mode producer and a line-mode producer route every record
+        identically (asserted by tests/test_frames.py)."""
+        from ..transport import frames as _frames
+
+        parts = _frames.split_by_partition(blob, self.n, key=self.key)
+        out: Dict[int, int] = {}
+        for p, sub in sorted(parts.items()):
+            n = _frames.frame_count(sub)
+            self.queues[p].write_frames(sub, n, verbose)
+            out[p] = n
+        return out
+
+    def write_lines_frames(self, lines, verbose: bool = False) -> Dict[int, int]:
+        """Frame-mode bulk send: group ``lines`` by partition and emit one
+        packed batch per partition — the producer-side fan-out that turns
+        N per-line sends into at most ``n_partitions`` transport messages.
+        Returns {partition: records sent}."""
+        from ..transport import frames as _frames
+
+        groups: Dict[int, List[str]] = {}
+        for line in lines:
+            k = tx_partition_key(line, self.key)
+            p = service_partition(k, self.n) if k is not None else 0
+            groups.setdefault(p, []).append(line)
+        out: Dict[int, int] = {}
+        for p, grp in sorted(groups.items()):
+            self.queues[p].write_frames(_frames.encode_lines(grp), len(grp), verbose)
+            out[p] = len(grp)
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Handoff records
@@ -342,6 +379,19 @@ class FleetHarness:
         p = self.partitioner.write_line(line)
         self.sent_per_queue[partition_queue(self.base_queue, p)] += 1
         return p
+
+    def send_lines(self, lines) -> Dict[int, int]:
+        """Frame-mode bulk send: route ``lines`` as at most one packed
+        APF1 batch per partition. ``sent_per_queue`` counts spool RECORDS
+        (one per batch written), because that is the unit the drain/ack
+        accounting compares against: shard exit waits on per-queue
+        ``delivered_count``/``acked_count`` and ``acked()`` reads the
+        spool cursor, all of which advance once per spool record whether
+        it carries one line or a thousand. Returns {partition: records}."""
+        routed = self.partitioner.write_lines_frames(lines)
+        for p in routed:
+            self.sent_per_queue[partition_queue(self.base_queue, p)] += 1
+        return routed
 
     def start_all(self) -> None:
         for proc in self.procs.values():

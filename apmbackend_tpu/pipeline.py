@@ -1830,6 +1830,22 @@ class PipelineDriver:
         )
         return len(labels)
 
+    def feed_frames(self, blob: bytes) -> int:
+        """Bulk intake of one packed APF1 frame batch (transport.frameMode).
+
+        The frame's lines region already IS the newline-separated
+        ``tx|...`` byte blob the bulk decoder wants, so a frame feed is a
+        header check plus one slice into :meth:`feed_csv_bytes` — zero
+        per-record objects between the parser's emitter and the columnar
+        ingest. Raises ``FrameError`` on a corrupt header (callers treat
+        it like any bad batch: count, log, drop)."""
+        from .transport import frames as _frames
+
+        region = _frames.lines_region(blob)
+        if len(region) == 0:
+            return 0
+        return self.feed_csv_bytes(bytes(region))
+
     def _resolve_decoded_rows(self, seg_ids: np.ndarray) -> np.ndarray:
         """Registry rows for one tick segment of decoder key ids.
 

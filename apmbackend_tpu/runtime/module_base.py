@@ -96,6 +96,19 @@ def make_queue_manager(config: dict, logger=None, *, broker: Optional[MemoryBrok
         shared_spool = SpoolChannel(transport_cfg.get("spoolDirectory", "spool/broker"))
         shared_spool.start_pump_thread()
         factory = lambda _qtype: shared_spool  # noqa: E731
+    elif backend == "shmring":
+        from ..transport.shmring import DEFAULT_RING_BYTES, ShmRingChannel
+
+        def factory(_qtype):
+            ch = ShmRingChannel(
+                transport_cfg.get("shmRingDirectory", "spool/shmring"),
+                ring_bytes=int(transport_cfg.get("shmRingBytes", DEFAULT_RING_BYTES)),
+                logger=logger,
+            )
+            # drain (free space after a refusal) is polled off the mmap by
+            # the pump, not pushed — producer-side channels need it too
+            ch.start_pump_thread()
+            return ch
     else:
         raise ValueError(f"Unknown brokerBackend: {backend!r}")
     qm = QueueManager(factory, int(config.get("statLogIntervalInSeconds", 60)), logger=logger,

@@ -49,6 +49,7 @@ from ..entries import EntryFactory
 from ..integrations import EmailSender, GrafanaClient
 from ..ops.alerts import AlertsManager
 from ..pipeline import PipelineDriver
+from ..transport import frames as _frames
 from ..transport.memory import MemoryBroker
 
 
@@ -149,9 +150,16 @@ class WorkerApp:
         # line's effect is in the snapshot. Dedup-window ids are added at
         # ACCEPT time, which is safe for the same reason (the window is
         # only persisted by save_state, after the drain).
-        self._alo_pending: list = []  # guarded-by: _driver_lock ((line, ingest_ts|None, ctx, msg_id, queue))
+        self._alo_pending: list = []  # guarded-by: _driver_lock ((line|frame blob, ingest_ts|None, ctx, msg_id, queue))
         self._alo_batch = max(1, int(eng_cfg.get("deliveryBatchSize", 256)))
         self._alo_drain_s = float(eng_cfg.get("deliveryFeedMaxDelaySeconds", 0.25))
+        # frame intake (transport.frameMode producers): packed APF1 batches
+        # arrive as raw byte blobs — the consumer never unfolds them — and
+        # go straight down the columnar path (driver.feed_frames). With
+        # tpuEngine.feedFrames=false the worker decodes blobs back to lines
+        # at FEED time instead (never at consume time, which would detach a
+        # manual-ack batch from its single token).
+        self._feed_frames = bool(eng_cfg.get("feedFrames", True))
 
         # protocol event log (analysis/protocol conformance): every
         # deliver/feed/checkpoint/ack/compact/recover step appended as one
@@ -295,6 +303,14 @@ class WorkerApp:
 
         self._overflow: collections.deque = collections.deque()  # guarded-by: _overflow_lock
         self._overflow_lock = threading.Lock()
+        # packed-frame side intake (at-most-once + frameMode): frame blobs
+        # cannot ride the byte ring (their lines region embeds the ring's
+        # record separator), so they queue here — bounded by the same record
+        # cap as the overflow FIFO — and the device loop drains them with
+        # one feed_frames per blob, ahead of newer ring entries.
+        self._frame_pending: collections.deque = collections.deque()  # guarded-by: _frame_lock ((blob, n_records))
+        self._frame_pending_records = 0  # guarded-by: _frame_lock
+        self._frame_lock = threading.Lock()
         # transport ingest stamps (header ingest_ts) of consumed-but-not-yet-
         # fed lines, FIFO like the ring: handed to the driver at FEED time so
         # an emission only ever claims stamps of lines actually in flight to
@@ -431,10 +447,14 @@ class WorkerApp:
             if self._at_least_once:
                 with self._driver_lock:
                     self._windows.setdefault(in_queue_name, _DedupWindow())
-            self.in_queues[in_queue_name] = qm.get_queue(
+            consumer = qm.get_queue(
                 in_queue_name, "c", self._make_consume_cb(in_queue_name),
                 manual_ack=self._at_least_once,
             )
+            # frame batches reach _consume as raw blobs (no transport-side
+            # unfold): the worker owns the bulk decode path
+            consumer.frames_aware = True
+            self.in_queues[in_queue_name] = consumer
         # primary queue handle (ack fan-in + single-queue compatibility)
         self.in_queue = next(iter(self.in_queues.values()), None)
         self._consume_enabled = bool(stats_cfg.get("consumeQueue", True))
@@ -541,6 +561,7 @@ class WorkerApp:
         consumer = self.runtime.qm.get_queue(
             qname, "c", self._make_consume_cb(qname), manual_ack=True
         )
+        consumer.frames_aware = True
         self.in_queues[qname] = consumer
         return consumer
 
@@ -906,9 +927,12 @@ class WorkerApp:
             _seq, ctx = fifo.popleft()
             self._note_trace_now(ctx)
 
-    def _consume(self, line: str, headers=None, token=None, qname=None) -> None:
+    def _consume(self, line, headers=None, token=None, qname=None) -> None:
         if self._at_least_once:
             self._consume_at_least_once(line, headers, token, qname)
+            return
+        if isinstance(line, (bytes, bytearray, memoryview)) and _frames.is_frames(line):
+            self._consume_frames(bytes(line), headers)
             return
         # transport ingest stamp (ProducerQueue header): queue it for the
         # feed-time handoff that anchors the ingest->emit/alert series.
@@ -960,7 +984,90 @@ class WorkerApp:
         with self._driver_lock:
             self.driver.feed(entry)
 
-    def _consume_at_least_once(self, line: str, headers, token, qname=None) -> None:
+    def _frame_trace_context(self, trace_id: str, headers: dict, blob: bytes):
+        """Trace context for a sampled frame batch: the batch's single
+        trace_id anchors on its first parseable tx record (only 1/rate
+        batches ever pay this decode)."""
+        for lb in _frames.iter_lines(blob):
+            ctx = self._trace_context(
+                trace_id, headers, lb.decode("utf-8", "replace")
+            )
+            if ctx is not None:
+                return ctx
+        return None
+
+    def _consume_frames(self, blob: bytes, headers) -> None:
+        """One at-most-once packed-frame delivery: queue the blob for the
+        device loop (bounded side FIFO — frames cannot ride the byte ring)
+        or bulk-feed it directly when no ring is running."""
+        n = _frames.frame_count(blob)
+        if n == 0:
+            return
+        trace_ctx = None
+        if headers and self.driver._tracer is not None:
+            ts = headers.get("ingest_ts")
+            if ts is not None:
+                # one stamp per record keeps _note_intake's n-for-n pop
+                # accounting aligned with the record counts feeds report
+                self._intake_ts_fifo.extend([ts] * n)
+            tid = headers.get("trace_id")
+            if tid is not None and self.driver._trace is not None:
+                trace_ctx = self._frame_trace_context(tid, headers, blob)
+        if (
+            self._feed_frames
+            and self._ring is not None
+            and self._ring_thread.is_alive()
+        ):
+            self._enqueue_frames(blob, n)
+            if trace_ctx is not None:
+                self._trace_fifo.append((self._ring_pushed, trace_ctx))
+            return
+        # ring-less (or feedFrames=false compat) path: the batch is already
+        # amortized, so feed it right here under the driver lock
+        self._note_intake(n)
+        if trace_ctx is not None:
+            self._note_trace_now(trace_ctx)
+        try:
+            with self._driver_lock:
+                if self._feed_frames:
+                    self.driver.feed_frames(blob)
+                else:
+                    self.driver.feed_csv_batch(_frames.decode_lines(blob))
+        except Exception:
+            import traceback
+
+            self.runtime.logger.error(
+                f"Frame batch feed failed; {n} records dropped:\n"
+                + traceback.format_exc()
+            )
+
+    def _enqueue_frames(self, blob: bytes, n: int) -> None:
+        with self._frame_lock:
+            self._frame_pending.append((blob, n))
+            self._frame_pending_records += n
+            while self._frame_pending_records > self._overflow_max:
+                _old, on = self._frame_pending.popleft()
+                self._frame_pending_records -= on
+                self.intake_dropped += on
+                if self.intake_dropped % 10_000 == 1:
+                    self.runtime.logger.error(
+                        f"Frame intake overflow past {self._overflow_max} records "
+                        f"while the device loop is stalled: {self.intake_dropped} "
+                        f"oldest records dropped"
+                    )
+        self._ring_pushed += n
+
+    def _drain_frames_locked_pop(self) -> list:
+        with self._frame_lock:
+            out = list(self._frame_pending)
+            self._frame_pending.clear()
+            self._frame_pending_records = 0
+        return out
+
+    def _feed_frame(self, blob: bytes, n: int) -> None:
+        self._feed_guarded(lambda: self.driver.feed_frames(blob), n)
+
+    def _consume_at_least_once(self, line, headers, token, qname=None) -> None:
         """One manual-ack delivery: dedup against its queue's window,
         absorb, remember the token.
 
@@ -971,6 +1078,12 @@ class WorkerApp:
         (redelivery → skip) AND a crash before checkpoint safe (redelivery →
         reprocess against the pre-epoch state)."""
         msg_id = (headers or {}).get("msg_id")
+        frame = isinstance(line, (bytes, bytearray, memoryview)) and _frames.is_frames(line)
+        if frame:
+            # a frame batch is ONE delivery: one msg_id, one dedup entry,
+            # one token — it is absorbed (or rejected) whole, never unfolded
+            # at consume time
+            line = bytes(line)
         if qname is None:
             qname = self._partition_base
         with self._driver_lock:
@@ -985,8 +1098,19 @@ class WorkerApp:
                 # it cannot loop, and never absorb it.
                 hp = (headers or {}).get("partition")
                 expected = self._queue_partition(qname)
-                if hp is not None and expected is not None \
-                        and int(hp) != expected:
+                mismatch = hp is not None and expected is not None \
+                    and int(hp) != expected
+                if not mismatch and frame and expected is not None:
+                    # frame-level routing defense: the header can be right
+                    # while records INSIDE the batch hash elsewhere (producer
+                    # grouped by a drifted key). Reject the whole batch —
+                    # partial absorption would strand the stray records'
+                    # effects on a non-owner.
+                    mismatch = _frames.count_partition_mismatches(
+                        line, self._fleet_shards, expected,
+                        key=self._partition_key,
+                    ) > 0
+                if mismatch:
                     self._partition_mismatch_total += 1
                     if self._ev_fh is not None:
                         self._emit_event(
@@ -995,18 +1119,22 @@ class WorkerApp:
                             redelivered=bool((headers or {}).get("redelivered")),
                         )
                     self.runtime.logger.error(
-                        f"Partition header mismatch on {qname}: stamped "
-                        f"p{hp}, queue is p{expected} — delivery rejected "
+                        f"Partition mismatch on {qname}: stamped p{hp}"
+                        f"{' (frame records hash elsewhere)' if frame else ''}, "
+                        f"queue is p{expected} — delivery rejected "
                         f"(producer partitioner drift?)"
                     )
                     if token is not None:
                         self._epoch_tokens.append(token)
                     return
+            is_tx = (
+                _frames.tx_count(line) > 0 if frame else line.startswith("tx|")
+            )
             if self._ev_fh is not None:
                 self._emit_event(
                     "deliver", msg=msg_id, queue=qname,
                     dedup=msg_id is not None and msg_id in w.ids,
-                    tx=line.startswith("tx|"),
+                    tx=is_tx,
                     redelivered=bool((headers or {}).get("redelivered")),
                 )
             if msg_id is not None and msg_id in w.ids:
@@ -1034,7 +1162,7 @@ class WorkerApp:
                         w.ids.discard(w.fifo.popleft())
                         if self._ckpt_chain is not None:
                             w.evicted += 1
-                if line.startswith("tx|"):
+                if is_tx:
                     h = headers or {}
                     ts = h.get("ingest_ts")
                     # sampled trace context rides the pending entry so the
@@ -1043,14 +1171,21 @@ class WorkerApp:
                     # requeue like msg_id), so the trace extends across a
                     # crash instead of splitting
                     tid = h.get("trace_id")
-                    ctx = (
-                        self._trace_context(tid, h, line)
-                        if tid is not None and self.driver._trace is not None
-                        else None
-                    )
+                    ctx = None
+                    if tid is not None and self.driver._trace is not None:
+                        ctx = (
+                            self._frame_trace_context(tid, h, line)
+                            if frame
+                            else self._trace_context(tid, h, line)
+                        )
                     self._alo_pending.append((line, ts, ctx, msg_id, qname))
                     if len(self._alo_pending) >= self._alo_batch:
                         self._drain_alo_pending_locked()
+                elif frame:
+                    self.runtime.logger.info(
+                        f"Frame batch with no tx records dropped "
+                        f"({_frames.frame_count(line)} records)"
+                    )
                 else:
                     # non-tx entries are rejected at accept time (same policy
                     # as before; malformed tx| lines are counted and logged
@@ -1076,7 +1211,12 @@ class WorkerApp:
         ids are withdrawn from the window (and from the delta-commit
         incremental record): a crash before the epoch commit then
         redelivers and reprocesses them; without a crash they are dropped
-        loudly, same policy as the at-most-once feed path."""
+        loudly, same policy as the at-most-once feed path. Frame-mode
+        streams interleave packed blobs with plain lines; deliveries are
+        fed in arrival order as maximal same-kind runs, and a mid-run
+        exception only withdraws the ids of deliveries NOT yet fed (the
+        fed prefix's effects are in the engine — withdrawing those ids
+        would let a crash redelivery double-count them)."""
         pending = self._alo_pending
         if not pending:
             return
@@ -1091,15 +1231,36 @@ class WorkerApp:
                 # closes their bucket may fire inside this very batch
                 if ctx is not None:
                     self._note_trace_now(ctx)
+        fed = 0  # deliveries whose effects reached the engine
         try:
-            self.driver.feed_csv_batch([line for line, _ts, _c, _m, _q in pending])
+            n = len(pending)
+            while fed < n:
+                payload = pending[fed][0]
+                if isinstance(payload, bytes):
+                    # one packed frame batch = one delivery, straight down
+                    # the columnar path (or unfolded HERE when
+                    # tpuEngine.feedFrames is off — its token stays whole)
+                    if self._feed_frames:
+                        self.driver.feed_frames(payload)
+                    else:
+                        self.driver.feed_csv_batch(_frames.decode_lines(payload))
+                    fed += 1
+                else:
+                    j = fed
+                    while j < n and not isinstance(pending[j][0], bytes):
+                        j += 1
+                    self.driver.feed_csv_batch(
+                        [line for line, _ts, _c, _m, _q in pending[fed:j]]
+                    )
+                    fed = j
         except Exception:
             import traceback
 
             import collections as _collections
 
+            dropped = pending[fed:]
             by_q: dict = {}
-            for _l, _ts, _c, m, q in pending:
+            for _l, _ts, _c, m, q in dropped:
                 if m is not None:
                     by_q.setdefault(q, set()).add(m)
             for q, ids in by_q.items():
@@ -1112,7 +1273,7 @@ class WorkerApp:
                 if self._ckpt_chain is not None:
                     w.added = [m for m in w.added if m not in ids]
             self.runtime.logger.error(
-                f"ALO bulk feed failed; {len(pending)} lines dropped and "
+                f"ALO bulk feed failed; {len(dropped)} deliveries dropped and "
                 f"their ids withdrawn from the dedup window (crash-"
                 f"redelivery will reprocess them):\n" + traceback.format_exc()
             )
@@ -1122,6 +1283,8 @@ class WorkerApp:
                     flight.dump("worker_feed_exception")
                 except Exception:
                     pass
+            if fed:
+                self._emit_event("feed", n=fed)
             return
         self._emit_event("feed", n=len(pending))
 
@@ -1157,6 +1320,15 @@ class WorkerApp:
         recs: list = []  # raw byte records straight off the ring
         max_batch = 4096
         while not self._ring_stop.is_set():
+            if self._frame_pending:  # apm: allow(lock-guard): consumer-side emptiness probe; the pop helper holds the lock
+                # packed frame blobs (side FIFO — they cannot ride the ring)
+                # drain ahead of newer ring entries, one bulk feed per blob
+                if recs:
+                    self._feed_recs(recs)
+                    recs = []
+                for blob, n in self._drain_frames_locked_pop():
+                    self._feed_frame(blob, n)
+                continue
             rec = self._ring.pop()
             if rec is None:
                 if recs:
@@ -1177,6 +1349,8 @@ class WorkerApp:
             recs.append(rec)
         if recs:
             self._feed_recs(recs)
+        for blob, n in self._drain_frames_locked_pop():
+            self._feed_frame(blob, n)
         tail = self._drain_overflow_locked_pop(self._overflow_max)
         if tail:
             self._feed_lines(tail)

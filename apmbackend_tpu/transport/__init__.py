@@ -83,4 +83,20 @@ def make_queue_manager(config: dict, *, broker=None, logger=None,
         qm = QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
         qm.spool = shared_spool
         return qm
+    if backend == "shmring":
+        from .shmring import DEFAULT_RING_BYTES, ShmRingChannel
+
+        def factory(_kind: str):
+            ch = ShmRingChannel(
+                transport_cfg.get("shmRingDirectory", "spool/shmring"),
+                ring_bytes=int(transport_cfg.get("shmRingBytes", DEFAULT_RING_BYTES)),
+                logger=logger,
+            )
+            if start_pumps:
+                # producer-side channels need the pump too: drain (free
+                # space after a refusal) is polled off the mmap, not pushed
+                ch.start_pump_thread()
+            return ch
+
+        return QueueManager(factory, interval, logger=logger, transport_config=transport_cfg)
     raise ValueError(f"Unknown brokerBackend: {backend}")

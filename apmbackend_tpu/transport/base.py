@@ -121,8 +121,10 @@ class ProducerQueue(EventEmitter):
         self.queue_stats = queue_stats
         self.logger = logger
         # buffered entries keep their original ingest stamp: a pause episode
-        # must show up as queue-wait latency downstream, not vanish from it
-        self.buffer: List[Tuple[str, Optional[dict]]] = []  # guarded-by: _lock
+        # must show up as queue-wait latency downstream, not vanish from it.
+        # Entries are str lines or bytes frame batches — both ride the same
+        # FIFO so a pause episode cannot reorder frames against lines.
+        self.buffer: List[Tuple[object, Optional[dict]]] = []  # guarded-by: _lock
         self.paused = False  # guarded-by: _lock
         self.type = "p"
         self._lock = threading.Lock()
@@ -184,11 +186,15 @@ class ProducerQueue(EventEmitter):
         with self._lock:
             return len(self.buffer)
 
-    # apm: holds(_lock): every caller acquires it (write_line, retry_buffer)
+    # apm: holds(_lock): every caller acquires it (write_line, write_frames, retry_buffer)
     def _send_locked(
-        self, line: str, headers: Optional[dict], verbose: bool, requeue_front: bool = False
+        self, line, headers: Optional[dict], verbose: bool, requeue_front: bool = False
     ) -> bool:
         """Caller holds self._lock. Returns True when a pause was entered.
+
+        ``line`` is a str line or a bytes frame batch (write_frames); both
+        take the same buffer/pause path so pressure episodes preserve FIFO
+        order across the two shapes.
 
         ``requeue_front`` is set by retry_buffer: a line popped from the front
         of the buffer that the channel refuses must go BACK to the front
@@ -202,7 +208,8 @@ class ProducerQueue(EventEmitter):
                 self.buffer.append((line, headers))
             self._enforce_cap_locked()
             return False
-        ok = self.channel.send(self.queue_name, line.encode("utf-8"), headers)
+        payload = line.encode("utf-8") if isinstance(line, str) else line
+        ok = self.channel.send(self.queue_name, payload, headers)
         if not ok:
             if requeue_front:
                 self.buffer.insert(0, (line, headers))
@@ -212,7 +219,9 @@ class ProducerQueue(EventEmitter):
             self.paused = True
             return True
         if verbose and self.logger:
-            self.logger.info(f"QUEUE: {self.queue_name} ::: {line}")
+            self.logger.info(f"QUEUE: {self.queue_name} ::: {line!r}"
+                             if isinstance(line, bytes) else
+                             f"QUEUE: {self.queue_name} ::: {line}")
         self.queue_stats.incr(self.queue_name)
         return False
 
@@ -233,7 +242,11 @@ class ProducerQueue(EventEmitter):
 
                     self._spill = SpoolChannel(self._spill_dir)
                     self._spill.assert_queue(self.queue_name)
-                self._spill.send(self.queue_name, old_line.encode("utf-8"), old_headers)
+                self._spill.send(
+                    self.queue_name,
+                    old_line.encode("utf-8") if isinstance(old_line, str) else old_line,
+                    old_headers,
+                )
             self._overflow_note += 1
 
     def _note_overflow(self, evicted: int) -> None:
@@ -297,6 +310,48 @@ class ProducerQueue(EventEmitter):
                 )
             self.emit("pause")
 
+    def write_frames(self, blob: bytes, n_records: int, verbose: bool = False) -> None:
+        """write_line's frame sibling: send one packed APF1 frame batch
+        (transport/frames.py) as ONE message. The transport-entry headers —
+        ``ingest_ts``, ``msg_id``, ``partition``, sampled ``trace_id`` — are
+        stamped once per BATCH, not per record: at-least-once dedup and the
+        fleet partition-routing check operate at batch granularity (one
+        deliver event, one pending entry, one ack token downstream), which
+        is what keeps the protocol-conformance mirror's accounting exact.
+        ``frames`` carries the record count so consumers and lag accounting
+        can weigh the batch without parsing it."""
+        with self._lock:
+            self._msg_seq += 1
+            seq = self._msg_seq
+            now = time.time()
+            headers = {
+                "ingest_ts": now,
+                "msg_id": self._msg_prefix + str(seq),
+                "frames": int(n_records),
+            }
+            if self.partition is not None:
+                headers["partition"] = self.partition
+            tr = self._tracer
+            if tr.rate > 0 and seq % tr.rate == 0:
+                trace_id = "t-" + headers["msg_id"]
+                headers["trace_id"] = trace_id
+                start = tr.ingest_start
+                tr.span(
+                    trace_id, "ingest",
+                    now if start is None or start > now else start, now,
+                    queue=self.queue_name,
+                )
+            entered_pause = self._send_locked(blob, headers, verbose)
+            overflowed, self._overflow_note = self._overflow_note, 0
+        if overflowed:
+            self._note_overflow(overflowed)
+        if entered_pause:
+            if self.logger:
+                self.logger.info(
+                    f"--- PRODUCER CHANNEL BUFFER FULL (Q={self.queue_name}) --- Pausing until drain event"
+                )
+            self.emit("pause")
+
     def retry_buffer(self) -> None:
         """Re-send buffered lines until empty or the channel refuses again
 
@@ -338,6 +393,16 @@ class ConsumerQueue(EventEmitter):
         # consumer commits them via ack(tokens); consume_cb must then take
         # (line, headers, token)
         self.manual_ack = manual_ack
+        # frame dispatch (transport/frames.py): a payload carrying the APF1
+        # magic is a packed frame batch. A frames-aware consumer (the worker
+        # sets this, like FleetPartitioner sets producer.partition) receives
+        # the raw bytes blob as ONE delivery; an unaware auto-ack consumer
+        # gets the batch unfolded into per-line callbacks (same records,
+        # shared headers); an unaware manual-ack consumer also gets the raw
+        # blob — the ack token is batch-granular and unfolding would orphan
+        # it. Undecodable batches are dropped loudly (counter + log), never
+        # fed downstream as garbage text.
+        self.frames_aware = False
         self.queue_stats.add_counter(queue_name, "c")
         # resolved ONCE (this runs per message): does the consumer want the
         # transport headers, the queue-wait histogram instrument, and the
@@ -350,6 +415,12 @@ class ConsumerQueue(EventEmitter):
         self._wait_hist = get_registry().histogram(
             "apm_queue_wait_seconds",
             "Transport latency: producer ingest stamp -> consumer delivery",
+            labels={"queue": queue_name},
+        )
+        self._frame_decode_errors = get_registry().counter(
+            "apm_frame_decode_errors_total",
+            "APF1 frame batches that failed envelope validation/decode "
+            "(batch dropped loudly, never fed downstream as text)",
             labels={"queue": queue_name},
         )
         # per-queue lag accounting (the SLO engine's queue_lag objective):
@@ -391,6 +462,33 @@ class ConsumerQueue(EventEmitter):
         self.queue_stats.incr(self.queue_name)
         if headers:
             self._observe_delivery(headers)
+        from . import frames as _frames
+
+        if _frames.is_frames(payload):
+            if self.frames_aware:
+                if self._cb_headers:
+                    self.consume_cb(bytes(payload), headers)
+                else:
+                    self.consume_cb(bytes(payload))
+                return
+            # unaware consumer: unfold the batch into per-line deliveries
+            # (shared headers — same ingest stamp, one msg_id for the batch)
+            try:
+                lines = _frames.decode_lines(payload)
+            except Exception as e:
+                self._frame_decode_errors.inc()
+                if self.logger:
+                    self.logger.error(
+                        f"Frame batch decode failed on {self.queue_name} "
+                        f"(batch dropped): {e}"
+                    )
+                return
+            for line in lines:
+                if self._cb_headers:
+                    self.consume_cb(line, headers)
+                else:
+                    self.consume_cb(line)
+            return
         if self._cb_headers:
             self.consume_cb(payload.decode("utf-8"), headers)
         else:
@@ -402,6 +500,13 @@ class ConsumerQueue(EventEmitter):
         self.queue_stats.incr(self.queue_name)
         if headers:
             self._observe_delivery(headers)
+        from . import frames as _frames
+
+        if _frames.is_frames(payload):
+            # batch-granular token: the blob is ONE delivery whether or not
+            # the consumer is frames-aware (unfolding would orphan the ack)
+            self.consume_cb(bytes(payload), headers, token)
+            return
         self.consume_cb(payload.decode("utf-8"), headers, token)
 
     def ack(self, tokens) -> None:

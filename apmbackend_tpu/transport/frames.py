@@ -1,0 +1,398 @@
+"""Packed binary transaction frames — the zero-object byte spine's wire unit.
+
+A *frame batch* carries N transaction lines plus a fixed-layout per-record
+header, so every hop between the parser and the engine can route, count,
+dedup, and partition WITHOUT materializing a Python object per record:
+
+- the producer stamps ``msg_id``/``ingest_ts``/``partition``/``trace_id``
+  once per batch (``ProducerQueue.write_frames``),
+- the fleet partitioner reads each record's service field straight out of
+  the frame (FNV-1a over the span — no ``split('|')``, no TxEntry),
+- the worker feeds the lines region into the native bulk CSV decoder in
+  one call (``PipelineDriver.feed_frames``),
+- every transport fabric carries the batch as one opaque payload — one
+  send / one spool append / one XADD / one publish per batch.
+
+Layout (little-endian; DESIGN.md §4.1)::
+
+    +0   b"APF1"                      magic
+    +4   u32  nrec
+    +8   u64  lines_off               == 16 + 32*nrec
+    +16  nrec x 32-byte record structs
+    +lines_off                        line bytes, each line + b"\\n"
+
+Record struct (32 bytes, all fields naturally aligned)::
+
+    +0   f8   end_ts      js_parse_int(field 6) — NaN when absent/NaN
+    +8   f8   elapsed     js_parse_int(field 7)
+    +16  u32  line_len    line bytes, excluding the separator "\\n"
+    +20  u16  srv_off     field-1 span, relative to the line start
+    +22  u16  srv_len
+    +24  u16  svc_off     field-2 span (the fleet partition key)
+    +26  u16  svc_len
+    +28  u8   flags
+    +29  u8   pad
+    +30  u16  reserved
+
+``line_off`` is not stored: records are packed in order, so offsets are the
+running sum of ``line_len + 1`` (:func:`line_offsets`).
+
+Flags:
+
+- ``FL_EXOTIC`` — a numeric field was not a plain ASCII digit run (or was
+  absent): the header's ``end_ts``/``elapsed`` were derived via the full
+  ``js_parse_int`` semantics and downstream decoders should treat the line
+  text as authoritative (the TxDecoder exotic contract).
+- ``FL_NONTX`` — not a ``tx|…`` line (or too short to carry a server
+  field): never counted as a transaction, partition 0 under either key.
+- ``FL_NOSVC`` — no routing key (fewer than 4 ``|``-fields, the
+  ``tx_partition_key`` None rule): partition 0 for either key kind
+  under the service key, mirroring ``tx_partition_key`` returning None.
+
+Field semantics (split on ``|``, no maxsplit) are EXACTLY the reference
+``EntryFactory.from_csv`` / ``tx_partition_key`` view of a line, so frame
+routing and line routing can never disagree on the same bytes. Oversized
+lines (> 0xFFFF bytes — spans would not fit u16) are carried verbatim but
+flagged ``FL_EXOTIC|FL_NONTX|FL_NOSVC``.
+
+The encoder has a native fast path (``apmfrm_pack`` in native/parser.cpp —
+plain numerics parsed in C++, exotic records flagged and patched here via
+``js_parse_int``) and a pure-Python fallback; ``APM_FRAMES_NO_NATIVE=1``
+forces the fallback, and tests pin the two bit-identical.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..entries import js_parse_int
+
+FRAME_MAGIC = b"APF1"
+HEADER = struct.Struct("<4sIQ")  # magic, nrec, lines_off
+HEADER_SIZE = HEADER.size  # 16
+RECORD_SIZE = 32
+
+FL_EXOTIC = 0x01
+FL_NONTX = 0x02
+FL_NOSVC = 0x04
+
+_SPAN_MAX = 0xFFFF
+_PLAIN_MAX_DIGITS = 18  # fits u64 exactly; longer runs take the exotic path
+
+RECORD_DTYPE = np.dtype(
+    [
+        ("end_ts", "<f8"),
+        ("elapsed", "<f8"),
+        ("line_len", "<u4"),
+        ("srv_off", "<u2"),
+        ("srv_len", "<u2"),
+        ("svc_off", "<u2"),
+        ("svc_len", "<u2"),
+        ("flags", "u1"),
+        ("pad", "u1"),
+        ("reserved", "<u2"),
+    ]
+)
+assert RECORD_DTYPE.itemsize == RECORD_SIZE
+
+
+def is_frames(payload) -> bool:
+    """True when ``payload`` is (the start of) a frame batch. str payloads
+    can never be frames — the magic is checked on raw bytes only."""
+    return (
+        isinstance(payload, (bytes, bytearray, memoryview))
+        and bytes(payload[:4]) == FRAME_MAGIC
+    )
+
+
+def frame_count(blob) -> int:
+    """Record count from the batch header (0 for a torn/short blob)."""
+    if len(blob) < HEADER_SIZE:
+        return 0
+    _magic, nrec, _off = HEADER.unpack_from(bytes(blob[:HEADER_SIZE]), 0)
+    return int(nrec)
+
+
+class FrameError(ValueError):
+    pass
+
+
+def _check(blob) -> Tuple[int, int]:
+    """Validate the batch envelope; returns (nrec, lines_off)."""
+    if len(blob) < HEADER_SIZE:
+        raise FrameError(f"frame batch shorter than its header ({len(blob)}B)")
+    magic, nrec, lines_off = HEADER.unpack_from(bytes(blob[:HEADER_SIZE]), 0)
+    if magic != FRAME_MAGIC:
+        raise FrameError(f"bad frame magic {magic!r}")
+    if lines_off != HEADER_SIZE + RECORD_SIZE * nrec or lines_off > len(blob):
+        raise FrameError(
+            f"frame batch header inconsistent: nrec={nrec} "
+            f"lines_off={lines_off} size={len(blob)}"
+        )
+    rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
+    want = int(lines_off) + int(rec["line_len"].sum()) + int(nrec)
+    if want != len(blob):
+        # a torn lines region must fail loudly, not feed a truncated line
+        raise FrameError(
+            f"frame batch size mismatch: header wants {want}B, got {len(blob)}B"
+        )
+    return int(nrec), int(lines_off)
+
+
+def records(blob) -> np.ndarray:
+    """Zero-copy structured view of the per-record headers."""
+    nrec, _lines_off = _check(blob)
+    return np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
+
+
+def lines_region(blob) -> memoryview:
+    """The newline-joined lines region WITHOUT the trailing separator —
+    directly feedable to the bulk CSV decoder (feed_csv_bytes)."""
+    nrec, lines_off = _check(blob)
+    mv = memoryview(blob)[lines_off:]
+    if nrec and len(mv) and mv[-1] == 0x0A:
+        mv = mv[:-1]
+    return mv
+
+
+def line_offsets(rec: np.ndarray) -> np.ndarray:
+    """Per-record byte offsets into the lines region (running sum of
+    ``line_len + 1``), length nrec+1 (the last entry is the region size)."""
+    offs = np.zeros(len(rec) + 1, dtype=np.int64)
+    np.cumsum(rec["line_len"].astype(np.int64) + 1, out=offs[1:])
+    return offs
+
+
+def iter_lines(blob) -> List[bytes]:
+    """Every line as bytes, verbatim (no trailing separator)."""
+    nrec, lines_off = _check(blob)
+    rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
+    offs = line_offsets(rec)
+    mv = memoryview(blob)
+    out = []
+    for i in range(nrec):
+        base = lines_off + int(offs[i])
+        out.append(bytes(mv[base : base + int(rec["line_len"][i])]))
+    return out
+
+
+def decode_lines(blob) -> List[str]:
+    """Frames → text lines (the compat/unfold path for frame-unaware
+    consumers; ``errors='replace'`` mirrors the tailer's decode posture)."""
+    return [b.decode("utf-8", "replace") for b in iter_lines(blob)]
+
+
+def tx_count(blob) -> int:
+    """Transactions in the batch (records without FL_NONTX)."""
+    rec = records(blob)
+    if not len(rec):
+        return 0
+    return int(np.count_nonzero((rec["flags"] & FL_NONTX) == 0))
+
+
+# ---------------------------------------------------------------- encoding
+
+
+def _as_bytes_lines(lines: Iterable) -> List[bytes]:
+    out = []
+    for line in lines:
+        b = line.encode("utf-8") if isinstance(line, str) else bytes(line)
+        if b"\n" in b:
+            raise FrameError("frame lines must not contain newlines")
+        out.append(b)
+    return out
+
+
+def _exotic_num(fields: Sequence[bytes], idx: int) -> float:
+    if len(fields) <= idx:
+        return float("nan")
+    return js_parse_int(fields[idx].decode("utf-8", "replace"))
+
+
+def _is_plain(field: bytes) -> bool:
+    return 0 < len(field) <= _PLAIN_MAX_DIGITS and field.isdigit()
+
+
+def _classify(lb: bytes, rec_row) -> None:
+    """Fill one record row from one line — the single source of truth the
+    native packer (apmfrm_pack) mirrors byte for byte."""
+    rec_row["line_len"] = len(lb)
+    if len(lb) > _SPAN_MAX:
+        rec_row["flags"] = FL_EXOTIC | FL_NONTX | FL_NOSVC
+        rec_row["end_ts"] = rec_row["elapsed"] = float("nan")
+        return
+    f = lb.split(b"|")
+    if len(f) < 2 or f[0] != b"tx":
+        rec_row["flags"] = FL_NONTX | FL_NOSVC
+        rec_row["end_ts"] = rec_row["elapsed"] = float("nan")
+        return
+    flags = 0
+    srv_off = len(f[0]) + 1
+    rec_row["srv_off"] = srv_off
+    rec_row["srv_len"] = len(f[1])
+    if len(f) >= 3:
+        rec_row["svc_off"] = srv_off + len(f[1]) + 1
+        rec_row["svc_len"] = len(f[2])
+    if len(f) < 4:
+        # tx_partition_key wants 4+ fields before it yields a key: such
+        # degenerate lines route to partition 0 under EITHER key kind
+        flags |= FL_NOSVC
+    if len(f) > 6 and _is_plain(f[6]):
+        rec_row["end_ts"] = float(int(f[6]))
+    else:
+        flags |= FL_EXOTIC
+        rec_row["end_ts"] = _exotic_num(f, 6)
+    if len(f) > 7 and _is_plain(f[7]):
+        rec_row["elapsed"] = float(int(f[7]))
+    else:
+        flags |= FL_EXOTIC
+        rec_row["elapsed"] = _exotic_num(f, 7)
+    rec_row["flags"] = flags
+
+
+def _encode_python(lines_b: List[bytes]) -> bytes:
+    n = len(lines_b)
+    rec = np.zeros(n, dtype=RECORD_DTYPE)
+    for i, lb in enumerate(lines_b):
+        _classify(lb, rec[i])
+    head = HEADER.pack(FRAME_MAGIC, n, HEADER_SIZE + RECORD_SIZE * n)
+    return head + rec.tobytes() + b"".join(lb + b"\n" for lb in lines_b)
+
+
+def _patch_exotics(raw: bytearray, lines_b: List[bytes]) -> bytes:
+    """Native pack leaves exotic records' numerics NaN; re-derive them with
+    the full js_parse_int semantics here (the decoder.cpp exotic contract)."""
+    rec = np.frombuffer(raw, RECORD_DTYPE, count=len(lines_b), offset=HEADER_SIZE)
+    exotic = np.nonzero(rec["flags"] & FL_EXOTIC)[0]
+    for i in exotic:
+        if rec["flags"][i] & FL_NONTX:
+            continue
+        f = lines_b[i].split(b"|")
+        rec["end_ts"][i] = _exotic_num(f, 6)
+        rec["elapsed"][i] = _exotic_num(f, 7)
+    return bytes(raw)
+
+
+def _native_disabled() -> bool:
+    return os.environ.get("APM_FRAMES_NO_NATIVE", "") not in ("", "0")
+
+
+def encode_lines(lines: Iterable) -> bytes:
+    """Pack transaction lines (str or bytes, no embedded newlines) into one
+    frame batch. Native scan when the toolchain built it; pure-Python
+    fallback otherwise (bit-identical, pinned by tests/test_frames.py)."""
+    lines_b = _as_bytes_lines(lines)
+    if not lines_b:
+        return HEADER.pack(FRAME_MAGIC, 0, HEADER_SIZE)
+    if not _native_disabled():
+        try:
+            from ..native import frames_pack_native
+        except Exception:
+            frames_pack_native = None
+        if frames_pack_native is not None:
+            raw = frames_pack_native(lines_b)
+            if raw is not None:
+                return _patch_exotics(raw, lines_b)
+    return _encode_python(lines_b)
+
+
+# ---------------------------------------------------------- partition plane
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+
+
+def _fnv1a32(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def partition_ids(blob, n_partitions: int, key: str = "service") -> List[int]:
+    """Per-record partition ids, FNV-1a over the routing-key span read
+    straight from the frame — the same stable hash ``service_partition``
+    computes from a parsed line, without parsing one. Records without a
+    routing key land on partition 0 (the ``tx_partition_key`` None rule —
+    FL_NOSVC marks those for either key kind)."""
+    nrec, lines_off = _check(blob)
+    rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
+    offs = line_offsets(rec)
+    mv = memoryview(blob)
+    use_service = key != "server"
+    out = []
+    for i in range(nrec):
+        flags = int(rec["flags"][i])
+        if flags & (FL_NONTX | FL_NOSVC):
+            out.append(0)
+            continue
+        base = lines_off + int(offs[i])
+        if use_service:
+            o, ln = int(rec["svc_off"][i]), int(rec["svc_len"][i])
+        else:
+            o, ln = int(rec["srv_off"][i]), int(rec["srv_len"][i])
+        out.append(_fnv1a32(bytes(mv[base + o : base + o + ln])) % n_partitions)
+    return out
+
+
+def split_by_partition(blob, n_partitions: int,
+                       key: str = "service") -> Dict[int, bytes]:
+    """Split one mixed batch into per-partition sub-batches (record order
+    preserved within each partition) — the fleet producer's frame router."""
+    parts = partition_ids(blob, n_partitions, key)
+    if not parts:
+        return {}
+    lines = iter_lines(blob)
+    grouped: Dict[int, List[bytes]] = {}
+    for p, lb in zip(parts, lines):
+        grouped.setdefault(p, []).append(lb)
+    return {p: encode_lines(g) for p, g in grouped.items()}
+
+
+def count_partition_mismatches(blob, n_partitions: int, expected: int,
+                               key: str = "service") -> int:
+    """Transactions in the batch whose routing key does NOT hash to
+    ``expected`` — the worker's frame-path partition-header defense."""
+    rec = records(blob)
+    if not len(rec):
+        return 0
+    parts = partition_ids(blob, n_partitions, key)
+    bad = 0
+    for p, flags in zip(parts, rec["flags"]):
+        if int(flags) & FL_NONTX:
+            continue
+        if p != expected:
+            bad += 1
+    return bad
+
+
+def summarize(blob) -> dict:
+    """Cheap batch stats for logs/benches: record counts + byte split."""
+    nrec, lines_off = _check(blob)
+    rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
+    n_tx = int(np.count_nonzero((rec["flags"] & FL_NONTX) == 0)) if nrec else 0
+    n_exotic = int(np.count_nonzero(rec["flags"] & FL_EXOTIC)) if nrec else 0
+    return {
+        "records": nrec,
+        "tx": n_tx,
+        "exotic": n_exotic,
+        "header_bytes": lines_off,
+        "line_bytes": len(blob) - lines_off,
+    }
+
+
+def batch_end_ts_max(blob) -> Optional[float]:
+    """Max end_ts across tx records (NaN-safe); None when the batch carries
+    no finite stamp — a one-pass header read benches/latency probes use."""
+    rec = records(blob)
+    if not len(rec):
+        return None
+    ts = rec["end_ts"][(rec["flags"] & FL_NONTX) == 0]
+    ts = ts[~np.isnan(ts)]
+    if not len(ts):
+        return None
+    return float(ts.max())

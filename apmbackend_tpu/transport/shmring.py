@@ -1,0 +1,355 @@
+"""Shared-memory mmap ring transport — the zero-copy parser→worker hop.
+
+The memory broker moves every message through Python queues in ONE
+process; the spool pays an fsync'd file append per batch. This backend is
+the third point in that space: one mmap'd SPSC ring file per queue
+(``<shmRingDirectory>/<queue>.ring``, ``transport.shmRingBytes`` data
+bytes) shared between exactly one producer process and one consumer
+process. A send is two bounded memcpys into the ring plus a tail bump; a
+delivery is the mirror image. No broker process, no serialization beyond
+the frame/line payload itself — built for ``transport.frameMode``, where
+a record is a packed APF1 batch the worker feeds straight down the
+columnar path.
+
+Layout (little-endian, offsets in bytes)::
+
+    0   8s  magic     b"APMSHM1\\0"
+    8   Q   capacity  data-region size (fixed at file creation; the FILE
+                      is authoritative — a config change needs a new file)
+    16  Q   tail      bytes produced, monotonic   # guarded-by: SPSC — written only by the single producer process
+    24  Q   head      bytes consumed, monotonic   # guarded-by: SPSC — written only by the single consumer process
+    32  Q   msgs_in   records produced, monotonic # guarded-by: SPSC — producer-only
+    40  Q   msgs_out  records consumed, monotonic # guarded-by: SPSC — consumer-only
+    64      data region (records wrap byte-wise across the end)
+
+    record := u32 rec_len | u32 hdr_len | hdr json | payload
+              (rec_len = 8 + hdr_len + len(payload))
+
+The SPSC discipline IS the synchronization: the producer reads ``head``
+and writes data-then-``tail``; the consumer reads ``tail`` and writes
+``head`` after copying out. Each 8-byte counter has a single writer, so
+torn reads cannot happen on any platform this repo targets; within a
+process a lock still serializes the multiple threads a QueueManager may
+point at one channel.
+
+Contract mapping:
+
+- ``send`` returns False when the record does not fit the free span
+  (capacity − (tail − head)) — the ProducerQueue buffers + pauses, and
+  the producer-side pump polls the ring until free space crosses the
+  half-capacity low-water mark, then fires ``drain`` (the Redis backend's
+  polled-drain shape: nothing pushes events across the mmap).
+- Delivery is at-most-once only: a record is consumed by advancing
+  ``head`` — there is no unacked ledger to redeliver from, so
+  ``consume(manual_ack=True)`` raises instead of silently weakening the
+  at-least-once contract. Use the spool/redis/AMQP fabrics for epoch-ack
+  workers.
+- Durability: none across producer+consumer loss (the file persists but
+  a crashed consumer's in-flight record is gone with its process) —
+  same class as the memory broker, minus the single-process constraint.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .base import Channel
+
+MAGIC = b"APMSHM1\0"
+_HDR = struct.Struct("<8sQQQQQ")  # magic, capacity, tail, head, msgs_in, msgs_out
+_OFF_TAIL = 16
+_OFF_HEAD = 24
+_OFF_MSGS_IN = 32
+_OFF_MSGS_OUT = 40
+DATA_OFF = 64
+_REC = struct.Struct("<II")  # rec_len, hdr_len
+
+DEFAULT_RING_BYTES = 8 * 1024 * 1024
+
+
+class _Ring:
+    """One queue's mmap'd ring. All offsets into ``mm`` are absolute;
+    head/tail are monotonic byte counters (position = counter % capacity)."""
+
+    def __init__(self, path: str, ring_bytes: int):
+        self.path = path
+        self._fd, created = self._open_or_create(path, ring_bytes)
+        self.mm = mmap.mmap(self._fd, 0)
+        if created:
+            # data region first, magic LAST: a peer that maps the file mid-
+            # init sees no magic and keeps waiting instead of reading junk
+            self.mm[8:DATA_OFF] = struct.pack("<QQQQQ", ring_bytes, 0, 0, 0, 0) \
+                + b"\0" * (DATA_OFF - 8 - 40)
+            self.mm[0:8] = MAGIC
+            self.mm.flush(0, DATA_OFF)
+        else:
+            deadline = time.monotonic() + 5.0
+            while self.mm[0:8] != MAGIC:  # peer still initializing
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"shm ring never initialized: {path}")
+                time.sleep(0.005)
+        (self.capacity,) = struct.unpack_from("<Q", self.mm, 8)
+        if self.capacity <= 0 or DATA_OFF + self.capacity > len(self.mm):
+            raise RuntimeError(f"shm ring header corrupt: {path}")
+
+    @staticmethod
+    def _open_or_create(path: str, ring_bytes: int):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        try:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o644)
+        except FileExistsError:
+            return os.open(path, os.O_RDWR), False
+        os.ftruncate(fd, DATA_OFF + ring_bytes)
+        return fd, True
+
+    # -- the six header words (each has exactly one writer: SPSC) ----------
+    def tail(self) -> int:
+        return struct.unpack_from("<Q", self.mm, _OFF_TAIL)[0]
+
+    def head(self) -> int:
+        return struct.unpack_from("<Q", self.mm, _OFF_HEAD)[0]
+
+    def msgs_in(self) -> int:
+        return struct.unpack_from("<Q", self.mm, _OFF_MSGS_IN)[0]
+
+    def msgs_out(self) -> int:
+        return struct.unpack_from("<Q", self.mm, _OFF_MSGS_OUT)[0]
+
+    def used(self) -> int:
+        return self.tail() - self.head()
+
+    def lag(self) -> int:
+        return self.msgs_in() - self.msgs_out()
+
+    def _write_span(self, counter: int, data: bytes) -> None:
+        pos = counter % self.capacity
+        first = min(len(data), self.capacity - pos)
+        self.mm[DATA_OFF + pos: DATA_OFF + pos + first] = data[:first]
+        if first < len(data):  # wrap
+            self.mm[DATA_OFF: DATA_OFF + len(data) - first] = data[first:]
+
+    def _read_span(self, counter: int, n: int) -> bytes:
+        pos = counter % self.capacity
+        first = min(n, self.capacity - pos)
+        out = self.mm[DATA_OFF + pos: DATA_OFF + pos + first]
+        if first < n:  # wrap
+            out += self.mm[DATA_OFF: DATA_OFF + n - first]
+        return out
+
+    def push(self, payload: bytes, headers: Optional[dict]) -> bool:
+        """Producer side: False = full (backpressure, not an error)."""
+        hdr = json.dumps(headers or {}, separators=(",", ":")).encode("utf-8")
+        rec_len = _REC.size + len(hdr) + len(payload)
+        if rec_len > self.capacity:
+            raise ValueError(
+                f"record of {rec_len} bytes can never fit a "
+                f"{self.capacity}-byte shm ring ({self.path}); raise "
+                f"transport.shmRingBytes or lower transport.frameMaxRecords"
+            )
+        tail = self.tail()
+        if rec_len > self.capacity - (tail - self.head()):
+            return False
+        self._write_span(tail, _REC.pack(rec_len, len(hdr)) + hdr + payload)
+        # record bytes land before the tail bump publishes them (the
+        # consumer only ever reads below tail)
+        struct.pack_into("<Q", self.mm, _OFF_TAIL, tail + rec_len)
+        struct.pack_into("<Q", self.mm, _OFF_MSGS_IN, self.msgs_in() + 1)
+        return True
+
+    def pop(self):
+        """Consumer side: (payload, headers) or None when empty."""
+        head = self.head()
+        if self.tail() - head < _REC.size:
+            return None
+        rec_len, hdr_len = _REC.unpack(self._read_span(head, _REC.size))
+        body = self._read_span(head + _REC.size, rec_len - _REC.size)
+        hdr_b, payload = body[:hdr_len], body[hdr_len:]
+        try:
+            headers = json.loads(hdr_b) if hdr_b else {}
+        except ValueError:
+            headers = {}
+        # copy-out complete; the head bump frees the span for the producer
+        struct.pack_into("<Q", self.mm, _OFF_HEAD, head + rec_len)
+        struct.pack_into("<Q", self.mm, _OFF_MSGS_OUT, self.msgs_out() + 1)
+        return payload, headers
+
+    def close(self) -> None:
+        try:
+            self.mm.close()
+        finally:
+            os.close(self._fd)
+
+
+class ShmRingChannel(Channel):
+    """Channel over per-queue mmap SPSC rings (DESIGN.md §7.1 "shmring").
+
+    One channel object serves either direction of one process: producers
+    only ``send``; consumers register callbacks and the pump thread
+    delivers. The producer-side pump exists purely for drain detection —
+    free space is polled, never pushed (the Redis backend's shape)."""
+
+    def __init__(self, directory: str, ring_bytes: int = DEFAULT_RING_BYTES,
+                 logger=None):
+        self.directory = directory
+        self.ring_bytes = int(ring_bytes)
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._rings: Dict[str, _Ring] = {}  # guarded-by: _lock
+        self._consumers: Dict[str, Callable] = {}  # guarded-by: _lock (queue -> wrapped cb)
+        self._tags: Dict[str, str] = {}  # guarded-by: _lock (consumer_tag -> queue)
+        self._pressured: set = set()  # guarded-by: _lock (queues that refused a send)
+        self._drain_cbs: List[Callable[[], None]] = []
+        self._pump_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # apm: holds(_lock): every caller acquires it (assert_queue, send, deliver, queue_lag)
+    def _ring_locked(self, name: str) -> _Ring:
+        ring = self._rings.get(name)
+        if ring is None:
+            ring = self._rings[name] = _Ring(
+                os.path.join(self.directory, f"{name}.ring"), self.ring_bytes
+            )
+            from ..obs import get_registry
+
+            get_registry().gauge(
+                "apm_shmring_occupancy_bytes",
+                "Bytes in flight in the shared-memory ring "
+                "(produced, not yet consumed)",
+                labels={"queue": name},
+            ).set_fn(lambda r=ring: float(r.used()))
+        return ring
+
+    def assert_queue(self, name: str) -> None:
+        with self._lock:
+            self._ring_locked(name)
+
+    def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
+        with self._lock:
+            ring = self._ring_locked(name)
+            ok = ring.push(payload, headers)
+            if not ok:
+                self._pressured.add(name)
+        return ok
+
+    def consume(self, name: str, callback: Callable, consumer_tag: str,
+                manual_ack: bool = False) -> None:
+        if manual_ack:
+            raise NotImplementedError(
+                "shmring delivery is at-most-once (head advance = consume; "
+                "no unacked ledger to redeliver from) — use the spool, "
+                "redis, or amqp backend for atLeastOnce workers"
+            )
+        with self._lock:
+            self._ring_locked(name)
+            self._consumers[name] = callback
+            self._tags[consumer_tag] = name
+
+    def cancel(self, consumer_tag: str) -> None:
+        with self._lock:
+            name = self._tags.pop(consumer_tag, None)
+            if name is not None:
+                self._consumers.pop(name, None)
+
+    def ack(self, tokens) -> None:
+        raise NotImplementedError("shmring has no manual-ack ledger")
+
+    def on_drain(self, callback: Callable[[], None]) -> None:
+        self._drain_cbs.append(callback)
+
+    def queue_lag(self, name: str) -> int:
+        with self._lock:
+            ring = self._rings.get(name)
+            return ring.lag() if ring is not None else 0
+
+    def deliver(self, max_records: int = 1024) -> int:
+        """Pop up to ``max_records`` across the registered consumers and
+        invoke their callbacks outside the lock (a callback that writes a
+        downstream queue on this same channel must not deadlock)."""
+        batch = []
+        with self._lock:
+            for name, cb in list(self._consumers.items()):
+                ring = self._rings.get(name)
+                if ring is None:
+                    continue
+                while len(batch) < max_records:
+                    rec = ring.pop()
+                    if rec is None:
+                        break
+                    headers = rec[1]
+                    # every backend synthesizes the redelivery flag; here it
+                    # is constant — consuming IS the head advance, so a shm
+                    # ring delivery can only ever be the first one
+                    headers["redelivered"] = False
+                    batch.append((cb, rec[0], headers))
+        for cb, payload, headers in batch:
+            try:
+                cb(payload, headers)
+            except Exception as e:  # a bad message must not kill the pump
+                if self.logger:
+                    self.logger.error(f"shmring consumer callback error: {e}")
+        return len(batch)
+
+    # apm: holds(_lock): pump_once acquires it around the pressure probe
+    def _drain_ready_locked(self) -> bool:
+        """True when every pressured ring has fallen below the half-capacity
+        low-water mark. The caller fires the drain callbacks AFTER releasing
+        the lock — a drain callback re-enters send() via retry_buffer."""
+        if not self._pressured:
+            return False
+        for name in list(self._pressured):
+            ring = self._rings.get(name)
+            if ring is not None and ring.used() > ring.capacity // 2:
+                return False
+        self._pressured.clear()
+        return True
+
+    def pump_once(self) -> int:
+        n = self.deliver()
+        with self._lock:
+            fire = self._drain_ready_locked()
+        if fire:
+            for cb in list(self._drain_cbs):
+                cb()
+        return n
+
+    def start_pump_thread(self, poll_s: float = 0.002) -> None:
+        if self._pump_thread is not None:
+            return
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    if self.pump_once() == 0:
+                        self._stop.wait(poll_s)
+                except Exception as e:  # keep the pump alive across surprises
+                    if self.logger:
+                        self.logger.error(f"shmring pump error: {e}")
+                    self._stop.wait(poll_s)
+
+        self._pump_thread = threading.Thread(
+            target=_loop, name="shmring-pump", daemon=True)
+        self._pump_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=2.0)
+            self._pump_thread = None
+
+    def close(self) -> None:
+        self.stop()
+        with self._lock:
+            for ring in self._rings.values():
+                try:
+                    ring.close()
+                except Exception:
+                    pass
+            self._rings.clear()
+            self._consumers.clear()
+            self._tags.clear()

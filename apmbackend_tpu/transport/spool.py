@@ -25,6 +25,7 @@ Durability audit (ISSUE 7 satellite):
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import threading
@@ -87,7 +88,11 @@ class _SpoolQueue:
                 continue
             try:
                 rec = json.loads(line)
-                self.records.append((rec["p"].encode("utf-8"), rec.get("h")))
+                if "f" in rec:
+                    # binary record (frame batch): base64 round trip
+                    self.records.append((base64.b64decode(rec["f"]), rec.get("h")))
+                else:
+                    self.records.append((rec["p"].encode("utf-8"), rec.get("h")))
             except Exception:
                 # a mangled record is a poison message: skip it rather than
                 # wedging the queue forever
@@ -175,7 +180,18 @@ class SpoolChannel(Channel):
             if fh is None:
                 fh = open(os.path.join(self.directory, f"{name}.spool"), "ab")
                 self._send_fhs[name] = fh
-            rec = json.dumps({"p": payload.decode("utf-8"), "h": headers})
+            try:
+                # text record: the pre-frame wire format, byte for byte
+                rec = json.dumps({"p": payload.decode("utf-8"), "h": headers})
+            except UnicodeDecodeError:
+                # binary record (APF1 frame batch): base64 into "f". One
+                # append+flush(+fsync) per BATCH — the whole frame batch is
+                # one spool record, one delivery, one ack/cursor advance:
+                # the amortized group-commit slice for the frame path.
+                rec = json.dumps({
+                    "f": base64.b64encode(payload).decode("ascii"),
+                    "h": headers,
+                })
             fh.write(rec.encode("utf-8") + b"\n")
             fh.flush()
             if self.fsync:

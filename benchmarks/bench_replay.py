@@ -87,6 +87,154 @@ def _measure(n_transactions: int, n_services: int, tx_per_bucket) -> dict:
         driver.flush()
         elapsed = time.perf_counter() - t0
 
+        # frames A/B (ISSUE 16): the SAME fixture through the zero-object
+        # byte spine — parser packs APF1 batches (no TxEntry, no per-record
+        # on_record), the engine decodes them straight into the columnar
+        # ingest path (feed_frames). Object path above stays the headline
+        # comparator; this is the frameMode=true wire.
+        from apmbackend_tpu.transport import frames as _frames
+
+        fr_driver = PipelineDriver(
+            cfg,
+            on_stat=lambda s: None,
+            on_fullstat=lambda f: None,
+            micro_batch_size=4096,
+            async_emission=True,
+        )
+        for lbl, n in ((wbase, 4300), (wbase + 1, 10), (wbase + 2, 10)):
+            for i in range(n):
+                ts = lbl * 10000 + (i % 9000)
+                fr_driver.feed(TxEntry("jvmw", f"S:warm{i % 8}", f"w{i}", "1",
+                                       ts - 100, ts, 100 + i % 50, "Y"))
+        fr_driver.flush()
+        fr_bytes = [0, 0, 0]  # blob bytes, line-region bytes, batches
+
+        def frame_sink(blob, n):
+            fr_bytes[0] += len(blob)
+            fr_bytes[1] += len(blob) - _frames.HEADER_SIZE - _frames.RECORD_SIZE * n
+            fr_bytes[2] += 1
+            fr_driver.feed_frames(blob)
+
+        fr_db = [0]
+        fr_parser = TransactionParser(
+            lambda tx, db: fr_db.__setitem__(0, fr_db[0] + 1),
+            frame_sink=frame_sink, frame_max_records=512,
+        )
+        fr_replay = ReplayDriver(fr_parser)
+        t0 = time.perf_counter()
+        fr_lines = fr_replay.feed_dir(d)
+        fr_replay.finish()
+        fr_driver.flush()
+        fr_elapsed = time.perf_counter() - t0
+        fr_c = fr_parser.counters
+        fr_tx = fr_c["tx_out"] + fr_c["db_direct_out"]
+        frames_ab = {
+            "tx_per_sec": round(fr_tx / fr_elapsed, 1),
+            "lines_per_sec": round(fr_lines / fr_elapsed, 1),
+            "wall_s": round(fr_elapsed, 3),
+            "transactions": fr_tx,
+            "frame_batches": fr_bytes[2],
+            "frame_records": fr_c["frame_records_out"],
+            "db_direct_records": fr_db[0],
+            "bytes_frames": fr_bytes[0],
+            "bytes_lines": fr_bytes[1],
+            "frame_overhead_ratio": round(
+                fr_bytes[0] / max(fr_bytes[1], 1), 4),
+            "speedup_vs_objects": round(
+                (fr_tx / fr_elapsed) / max(tx_count[0] / elapsed, 1e-9), 2),
+        }
+        # parser compute share of the FRAME-MODE e2e wall: bare frame-mode
+        # parser (no-op sink) isolates the scan+pack stage the same way the
+        # object-path share below isolates scan+TxEntry emission
+        bare_fr = TransactionParser(lambda tx, db: None,
+                                    frame_sink=lambda b, n: None,
+                                    frame_max_records=512)
+        bare_fr_replay = ReplayDriver(bare_fr)
+        t0 = time.perf_counter()
+        bare_fr_replay.feed_dir(d)
+        bare_fr_replay.finish()
+        bare_fr_elapsed = time.perf_counter() - t0
+        frames_ab["parse_s"] = round(bare_fr_elapsed, 3)
+        frames_ab["share_of_e2e_wall"] = round(
+            bare_fr_elapsed / max(fr_elapsed, 1e-9), 3)
+
+        # pipelined frames e2e — the tentpole's production shape: the parser
+        # thread packs APF1 batches into the shared-memory ring (send=False
+        # -> spin, the ProducerQueue pause/drain contract collapsed to its
+        # bench skeleton) while a worker thread pops blobs and feeds the
+        # columnar ingest path. Parse overlaps decode + device compute to
+        # the extent the stages release the GIL (file IO, the native chunk
+        # scanner, numpy/XLA dispatch).
+        import shutil as _shutil
+        import threading as _threading
+
+        from apmbackend_tpu.transport.shmring import ShmRingChannel
+
+        pl_driver = PipelineDriver(
+            cfg,
+            on_stat=lambda s: None,
+            on_fullstat=lambda f: None,
+            micro_batch_size=4096,
+            async_emission=True,
+        )
+        for lbl, n in ((wbase, 4300), (wbase + 1, 10), (wbase + 2, 10)):
+            for i in range(n):
+                ts = lbl * 10000 + (i % 9000)
+                pl_driver.feed(TxEntry("jvmw", f"S:warm{i % 8}", f"w{i}", "1",
+                                       ts - 100, ts, 100 + i % 50, "Y"))
+        pl_driver.flush()
+        # the ring file must live OUTSIDE the fixture dir — feed_dir
+        # opens every entry of `d` as a log file
+        ring_dir = tempfile.mkdtemp(prefix="bench_shmring_")
+        ch = ShmRingChannel(ring_dir, ring_bytes=4 * 1024 * 1024)
+        ch.assert_queue("frames")
+        pl_fed = [0]
+        ch.consume("frames",
+                   lambda payload, headers: (
+                       pl_driver.feed_frames(payload),
+                       pl_fed.__setitem__(0, pl_fed[0] + 1)),
+                   "bench-pl")
+        producer_done = _threading.Event()
+
+        def _pump():
+            while True:
+                if ch.deliver() == 0:
+                    if producer_done.is_set() and ch.queue_lag("frames") == 0:
+                        return
+                    time.sleep(0.0002)
+
+        def _ring_sink(blob, n):
+            while not ch.send("frames", bytes(blob)):
+                time.sleep(0.0002)  # ring full: the flow-control pause
+
+        pl_parser = TransactionParser(
+            lambda tx, db: None, frame_sink=_ring_sink, frame_max_records=512)
+        pl_replay = ReplayDriver(pl_parser)
+        worker = _threading.Thread(target=_pump, name="bench-shmring-pump",
+                                   daemon=True)
+        t0 = time.perf_counter()
+        worker.start()
+        try:
+            pl_replay.feed_dir(d)
+            pl_replay.finish()
+        finally:
+            producer_done.set()  # a producer crash must not strand the pump
+        worker.join()
+        pl_driver.flush()
+        pl_elapsed = time.perf_counter() - t0
+        pl_c = pl_parser.counters
+        pl_tx = pl_c["tx_out"] + pl_c["db_direct_out"]
+        frames_ab["pipelined"] = {
+            "tx_per_sec": round(pl_tx / pl_elapsed, 1),
+            "wall_s": round(pl_elapsed, 3),
+            "frame_batches": pl_fed[0],
+            "speedup_vs_serial_frames": round(
+                (pl_tx / pl_elapsed) / max(fr_tx / fr_elapsed, 1e-9), 2),
+            "transport": "shmring",
+        }
+        ch.close()
+        _shutil.rmtree(ring_dir, ignore_errors=True)
+
         # parser-stage-only throughput: the SAME fixture through a bare
         # TransactionParser with a no-op consumer — isolates the correlation
         # parser from the detection engine it feeds. Run as a same-box A/B:
@@ -149,6 +297,7 @@ def _measure(n_transactions: int, n_services: int, tx_per_bucket) -> dict:
 
     return {
         "tx_per_sec": tx_count[0] / elapsed,
+        "frames": frames_ab,
         "lines": lines,
         "lines_per_sec": round(lines / elapsed, 1),
         "transactions": tx_count[0],
@@ -187,7 +336,8 @@ def run(quick: bool = False, *, n_transactions: int = 20000, n_services: int = 2
             "sparse_density": {
                 k: (round(v, 1) if isinstance(v, float) else v)
                 for k, v in sparse.items()
-                if k in ("tx_per_sec", "transactions", "wall_s", "lines_per_sec")
+                if k in ("tx_per_sec", "transactions", "wall_s",
+                         "lines_per_sec", "frames")
             },
             "anchor": "reference prod record rate ~76/s (stream_insert_db.js:3-4)",
         },

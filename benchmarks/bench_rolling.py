@@ -698,6 +698,83 @@ def _measure_transports(quick: bool) -> dict:
     else:
         out["real_redis_skipped"] = "redis-py not installed"
 
+    # frame-mode rows (ISSUE 16): the SAME record stream as packed APF1
+    # batches — one write_frames per 512 records, frames-aware consumer
+    # counting records straight off the blob. The spool rows are the
+    # amortized-commit measurement: line mode pays an append+flush(+fsync)
+    # per record, frame mode pays it once per batch.
+    from apmbackend_tpu.transport import frames as _frames
+    from apmbackend_tpu.transport.shmring import ShmRingChannel
+
+    frame_max = 512
+    blobs = [(_frames.encode_lines(lines[i:i + frame_max]),
+              min(frame_max, n - i)) for i in range(0, n, frame_max)]
+
+    def frame_throughput(prod_ch, cons_ch, pump) -> float:
+        """records/s through one fabric in frameMode (same loop shape as
+        ``throughput`` — the per-message unit is a packed batch)."""
+        got = [0]
+
+        def cb(payload, _headers):
+            got[0] += _frames.frame_count(payload)
+
+        prod = QueueManager(lambda d: prod_ch, 3600).get_queue("benchf", "p")
+        cons = QueueManager(lambda d: cons_ch, 3600).get_queue("benchf", "c", cb)
+        cons.frames_aware = True
+        cons.start_consume()
+        t0 = time.perf_counter()
+        for blob, cnt in blobs:
+            prod.write_frames(blob, cnt)
+        while got[0] < n and time.perf_counter() - t0 < deadline_s:
+            if pump() == 0 and prod.buffer_count():
+                prod.retry_buffer()
+        wall = time.perf_counter() - t0
+        return round(n / wall, 1) if got[0] == n else float("nan")
+
+    fr: dict = {"batch_records": frame_max, "batches": len(blobs)}
+
+    broker = MemoryBroker()
+    fr["memory_lines_per_s"] = frame_throughput(
+        MemoryChannel(broker), MemoryChannel(broker), broker.pump)
+
+    for fsync in (False, True):
+        key = "spool_fsync" if fsync else "spool"
+        spool_dir = tempfile.mkdtemp(prefix=f"bench_{key}_")
+        try:
+            spool = SpoolChannel(spool_dir, fsync=fsync)
+            fr[f"{key}_lines_per_s"] = frame_throughput(
+                spool, spool, spool.deliver)
+            spool.close()
+        finally:
+            shutil.rmtree(spool_dir, ignore_errors=True)
+    # the fsync'd LINE path is the unamortized comparator for the group
+    # commit claim (the plain spool row above flushes without fsync)
+    spool_dir = tempfile.mkdtemp(prefix="bench_spool_fsync_line_")
+    try:
+        spool = SpoolChannel(spool_dir, fsync=True)
+        fr["spool_fsync_line_mode_lines_per_s"] = throughput(
+            spool, spool, spool.deliver)
+        spool.close()
+    finally:
+        shutil.rmtree(spool_dir, ignore_errors=True)
+
+    server_f = FakeRedisServer()
+    pf, cf = redis_pair(make_fake_redis(server_f))
+    fr["fake_redis_lines_per_s"] = frame_throughput(
+        pf, cf, lambda: pf.pump_once() + cf.pump_once())
+
+    shm_dir = tempfile.mkdtemp(prefix="bench_shmring_")
+    try:
+        ch = ShmRingChannel(shm_dir, ring_bytes=8 * 1024 * 1024)
+        fr["shmring_lines_per_s"] = frame_throughput(ch, ch, ch.pump_once)
+        fr["shmring_line_mode_lines_per_s"] = throughput(
+            ch, ch, ch.pump_once)
+        ch.close()
+    finally:
+        shutil.rmtree(shm_dir, ignore_errors=True)
+
+    out["frames"] = fr
+
     def outage_redis() -> dict:
         server = FakeRedisServer()
         mod = make_fake_redis(server)

@@ -47,6 +47,7 @@
 // lazy expiry, maybe_sweep on an interval, set-after-miss with a fresh
 // TTL, hit counted even when the service is absent from a live key's map.
 
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -1224,6 +1225,124 @@ done:
     // line order; the caller re-invokes on the remainder
     counts[5] = static_cast<uint64_t>(line - buf);
     return static_cast<int64_t>(n_ev);
+}
+
+// ------------------------------------------------------------- frame pack
+//
+// apmfrm_pack: scan newline-joined transaction lines (the parser's frame
+// buffer) and emit one APF1 frame batch — 16-byte header, nrec 32-byte
+// records, then every line verbatim + '\n'. Field semantics mirror
+// transport/frames.py::_classify byte for byte (the differential suite
+// pins the two encoders bit-identical): fields are the full '|' split,
+// srv/svc spans come from fields 1/2, end_ts/elapsed from fields 6/7 when
+// they are plain ASCII digit runs (<= 18 digits). Anything else is FLAGGED
+// exotic with NaN numerics and patched in Python with the full
+// js_parse_int semantics — the decoder.cpp exotic contract.
+//
+// ret: total bytes written, or -1 when out_cap is too small.
+
+struct FrmRec {
+    double end_ts;
+    double elapsed;
+    uint32_t line_len;
+    uint16_t srv_off;
+    uint16_t srv_len;
+    uint16_t svc_off;
+    uint16_t svc_len;
+    uint8_t flags;
+    uint8_t pad;
+    uint16_t reserved;
+};
+static_assert(sizeof(FrmRec) == 32, "frame record must be 32 bytes");
+
+int64_t apmfrm_pack(const uint8_t* buf, int64_t nbytes, uint8_t* out,
+                    int64_t out_cap) {
+    const uint8_t kExotic = 0x01, kNonTx = 0x02, kNoSvc = 0x04;
+    int64_t nrec = 0;
+    if (nbytes > 0) {
+        for (int64_t i = 0; i < nbytes; ++i)
+            if (buf[i] == '\n') ++nrec;
+        if (buf[nbytes - 1] != '\n') ++nrec;
+    }
+    const int64_t lines_off = 16 + 32 * nrec;
+    int64_t region = nbytes;
+    if (nrec > 0 && buf[nbytes - 1] != '\n') region += 1;
+    if (lines_off + region > out_cap || nrec > 0xFFFFFFFFLL) return -1;
+
+    out[0] = 'A'; out[1] = 'P'; out[2] = 'F'; out[3] = '1';
+    const uint32_t n32 = static_cast<uint32_t>(nrec);
+    std::memcpy(out + 4, &n32, 4);
+    const uint64_t off64 = static_cast<uint64_t>(lines_off);
+    std::memcpy(out + 8, &off64, 8);
+
+    FrmRec* rec = reinterpret_cast<FrmRec*>(out + 16);
+    uint8_t* dst = out + lines_off;
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + nbytes;
+    const double kNaN = std::nan("");
+    for (int64_t i = 0; i < nrec; ++i) {
+        const uint8_t* nl = static_cast<const uint8_t*>(
+            memchr(p, '\n', static_cast<size_t>(end - p)));
+        const uint8_t* le = (nl != nullptr) ? nl : end;
+        const size_t n = static_cast<size_t>(le - p);
+        FrmRec* r = &rec[i];
+        std::memset(r, 0, sizeof(FrmRec));
+        r->line_len = static_cast<uint32_t>(n);
+        r->end_ts = kNaN;
+        r->elapsed = kNaN;
+        if (n > 0xFFFF) {
+            // spans would not fit u16: carried verbatim, never a tx
+            r->flags = kExotic | kNonTx | kNoSvc;
+        } else {
+            // first 8 separators fully determine fields 0..7; field k is
+            // [sep[k-1]+1, sep[k]) — or [.., n) when k is the last field
+            size_t sep[8];
+            int ns = 0;
+            for (size_t j = 0; j < n && ns < 8; ++j)
+                if (p[j] == '|') sep[ns++] = j;
+            if (ns == 0 || sep[0] != 2 || p[0] != 't' || p[1] != 'x') {
+                r->flags = kNonTx | kNoSvc;
+            } else {
+                uint8_t flags = 0;
+                r->srv_off = static_cast<uint16_t>(sep[0] + 1);
+                const size_t srv_end = (ns >= 2) ? sep[1] : n;
+                r->srv_len = static_cast<uint16_t>(srv_end - (sep[0] + 1));
+                if (ns >= 2) {
+                    r->svc_off = static_cast<uint16_t>(sep[1] + 1);
+                    const size_t svc_end = (ns >= 3) ? sep[2] : n;
+                    r->svc_len = static_cast<uint16_t>(svc_end - (sep[1] + 1));
+                }
+                // tx_partition_key wants 4+ fields (3+ separators) before
+                // it yields a key: fewer routes to partition 0 either way
+                if (ns < 3) flags |= kNoSvc;
+                for (int fi = 0; fi < 2; ++fi) {  // fi 0 -> field 6, 1 -> 7
+                    const int need = 6 + fi;      // separators required
+                    double* slot = (fi == 0) ? &r->end_ts : &r->elapsed;
+                    if (ns < need) {
+                        flags |= kExotic;
+                        continue;
+                    }
+                    const size_t fs = sep[need - 1] + 1;
+                    const size_t fe = (ns > need) ? sep[need] : n;
+                    const size_t fl = fe - fs;
+                    bool plain = fl > 0 && fl <= 18;
+                    uint64_t v = 0;
+                    for (size_t j = fs; plain && j < fe; ++j) {
+                        if (p[j] < '0' || p[j] > '9') plain = false;
+                        else v = v * 10 + static_cast<uint64_t>(p[j] - '0');
+                    }
+                    if (plain) *slot = static_cast<double>(v);
+                    else flags |= kExotic;  // Python patches via js_parse_int
+                }
+                r->flags = flags;
+            }
+        }
+        std::memcpy(dst, p, n);
+        dst += n;
+        *dst++ = '\n';
+        p = (nl != nullptr) ? nl + 1 : end;
+    }
+    return lines_off + region;
 }
 
 }  // extern "C"

@@ -68,7 +68,8 @@ fi
 # --sanitize: rebuild every native component with ASan+UBSan (make
 # sanitize -> build-sanitize/) and drive the differential fuzz suite and
 # the native unit tier against the instrumented parser/percentile/rebuild/
-# ring/decoder/tailer. libasan/libubsan are LD_PRELOADed so the
+# ring/decoder/tailer — plus the frame-spine suite, so the native APF1
+# emitter (apmfrm_pack) packs every codec corpus under instrumentation. libasan/libubsan are LD_PRELOADed so the
 # instrumented .so files resolve their runtime inside the stock Python;
 # leak detection stays off (CPython+jax hold arenas for the process
 # lifetime — interceptor noise, not parser bugs), everything else aborts
@@ -87,6 +88,7 @@ if [ "$1" = "--sanitize" ]; then
         ASAN_OPTIONS=detect_leaks=0:abort_on_error=1:handle_segv=1 \
         UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 \
         python -m pytest tests/test_parser_native_diff.py tests/test_native.py \
+        tests/test_frames.py \
         -q -m "not slow" "$@"
 fi
 

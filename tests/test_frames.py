@@ -1,0 +1,563 @@
+"""APF1 frame spine (ISSUE 16): codec identity, parser frame emission,
+opaque carry across all four broker fabrics, the shared-memory ring, fleet
+frame routing, and worker intake parity.
+
+The invariant under test everywhere: turning frames ON changes the number
+of Python objects and transport messages, never the records the engine
+sees — frames vs per-line must be record-identical through every layer,
+and every kill switch (APM_NO_FRAMES, APM_FRAMES_NO_NATIVE,
+transport.frameMode, tpuEngine.feedFrames) must degrade to the exact
+pre-frame behaviour.
+"""
+
+import os
+import time
+
+import pytest
+
+from apmbackend_tpu.parallel.fleet import (
+    FleetPartitioner,
+    partition_queue,
+    service_partition,
+    tx_partition_key,
+)
+from apmbackend_tpu.transport import MemoryBroker, frames, make_queue_manager
+from apmbackend_tpu.transport.base import QueueManager
+from apmbackend_tpu.transport.memory import MemoryChannel
+from apmbackend_tpu.transport.spool import SpoolChannel
+
+try:
+    from apmbackend_tpu.native import have_native_parser
+
+    HAVE_NATIVE = have_native_parser()
+except Exception:
+    HAVE_NATIVE = False
+
+needs_native = pytest.mark.skipif(
+    not HAVE_NATIVE, reason="no C++ toolchain: native frame packer unavailable"
+)
+
+
+def _mk_qm(broker):
+    return QueueManager(lambda d: MemoryChannel(broker), stat_log_interval_s=3600)
+
+
+CORPUS = (
+    [f"tx|jvm{i % 5}|svc{i % 17:02d}|log{i}|1|{1700000000 + i}|"
+     f"{1700000100 + i}|{50 + i}|{'Y' if i % 3 else 'N'}" for i in range(64)]
+    + [
+        "tx|srv|svç|unïcode|1|1700000000|1700000100|100|Y",      # unicode svc
+        "tx|srv|svc| résumé café |1|1700000000|1700000100|7|N",  # unicode id
+        "tx|srv|svc|exotic|1| 123 |1e3|0x10|Y",                  # exotic f8s
+        "tx|srv|svc|neg|1|-5|+7|1_0|N",                          # signs/junk
+        "tx|short",                                              # tx| but <4 fields
+        "log|not|a|transaction",
+        "",                                                      # empty line
+        "noise with spaces and | pipes | everywhere",
+    ]
+)
+
+
+# -- codec --------------------------------------------------------------------
+
+
+def test_roundtrip_identity_and_counts():
+    blob = frames.encode_lines(CORPUS)
+    assert frames.is_frames(blob)
+    assert frames.frame_count(blob) == len(CORPUS)
+    assert frames.decode_lines(blob) == CORPUS
+    # tx classification matches the worker's is_tx rule (startswith "tx|")
+    assert frames.tx_count(blob) == sum(
+        1 for l in CORPUS if l.startswith("tx|")
+    )
+    s = frames.summarize(blob)
+    assert s["records"] == len(CORPUS) and s["tx"] == frames.tx_count(blob)
+
+
+def test_oversized_line_roundtrips_as_nontx():
+    big = "tx|srv|svc|" + "x" * 70000 + "|1|1|2|3|Y"  # spans overflow u16
+    blob = frames.encode_lines(["tx|a|b|c|1|1|2|3|Y", big])
+    assert frames.decode_lines(blob) == ["tx|a|b|c|1|1|2|3|Y", big]
+    assert frames.tx_count(blob) == 1  # oversized record flagged non-tx
+
+
+def test_corrupt_blobs_rejected():
+    blob = bytearray(frames.encode_lines(CORPUS[:4]))
+    assert not frames.is_frames(b"tx|plain|line")
+    assert not frames.is_frames("APF1 but str payloads are never frames")
+    # is_frames is a cheap magic sniff; the envelope check is what rejects
+    for bad in (bytes(blob[:12]), b"NOPE" + bytes(blob[4:]),
+                bytes(blob[:40])):  # records region torn off
+        with pytest.raises(frames.FrameError):
+            frames.decode_lines(bad)
+
+
+@needs_native
+def test_native_and_python_encoders_bit_identical(monkeypatch):
+    native = frames.encode_lines(CORPUS)
+    monkeypatch.setenv("APM_FRAMES_NO_NATIVE", "1")
+    assert bytes(frames.encode_lines(CORPUS)) == bytes(native)
+
+
+# -- partition routing off the frame spans ------------------------------------
+
+
+@pytest.mark.parametrize("key", ["service", "server"])
+def test_partition_ids_match_per_line_hash(key):
+    blob = frames.encode_lines(CORPUS)
+    want = []
+    for line in CORPUS:
+        k = tx_partition_key(line, key)
+        want.append(service_partition(k, 7) if k is not None else 0)
+    assert frames.partition_ids(blob, 7, key=key) == want
+
+
+def test_split_by_partition_preserves_records():
+    blob = frames.encode_lines(CORPUS)
+    parts = frames.split_by_partition(blob, 5)
+    ids = frames.partition_ids(blob, 5)
+    regrouped = {}
+    for line, p in zip(CORPUS, ids):
+        regrouped.setdefault(p, []).append(line)
+    assert {p: frames.decode_lines(b) for p, b in parts.items()} == regrouped
+    for p, sub in parts.items():
+        assert frames.count_partition_mismatches(sub, 5, p) == 0
+        wrong = (p + 1) % 5
+        if any(tx_partition_key(l) is not None for l in regrouped[p]):
+            assert frames.count_partition_mismatches(sub, 5, wrong) > 0
+
+
+# -- parser frame emission ----------------------------------------------------
+
+
+def _feed_fixture(parser, tmp_path, n=120, seed=9):
+    from apmbackend_tpu.ingest.replay import write_fixture_logs
+
+    paths = write_fixture_logs(str(tmp_path), n_transactions=n, seed=seed)
+    for fp in sorted(paths.values()):
+        parser.read_lines(fp, open(fp, "rb").read())
+    parser.drain()
+
+
+def test_parser_frame_emission_matches_per_record(tmp_path):
+    from apmbackend_tpu.ingest.parser import TransactionParser
+
+    ref_lines, db_ref = [], []
+    ref = TransactionParser(
+        lambda tx, db: (db_ref if db else ref_lines).append(tx.to_csv()),
+        server_from_path=lambda fp: "jvm1",
+    )
+    _feed_fixture(ref, tmp_path / "ref")
+
+    got_frames, db_frames = [], []
+    fp_parser = TransactionParser(
+        lambda tx, db: db_frames.append(tx.to_csv()),
+        server_from_path=lambda fp: "jvm1",
+        frame_sink=lambda blob, n: got_frames.append((bytes(blob), n)),
+        frame_max_records=32,
+    )
+    _feed_fixture(fp_parser, tmp_path / "fr")
+
+    emitted = [l for blob, _n in got_frames for l in frames.decode_lines(blob)]
+    assert emitted == ref_lines  # queue-bound stream identical, order kept
+    assert db_frames == db_ref   # db-direct records still object-path
+    c = fp_parser.counters
+    assert c["frames_emitted"] == len(got_frames) > 1  # max_records flushed
+    assert c["frame_records_out"] == len(emitted)
+    assert all(n == frames.frame_count(b) <= 32 for b, n in got_frames)
+
+
+def test_apm_no_frames_kill_switch(monkeypatch):
+    from apmbackend_tpu.ingest.parser import TransactionParser
+
+    monkeypatch.setenv("APM_NO_FRAMES", "1")
+    p = TransactionParser(lambda tx, db: None, frame_sink=lambda b, n: None)
+    assert p.frame_sink is None  # falls back to the per-record object path
+
+
+# -- opaque carry across the four fabrics -------------------------------------
+
+
+def _assert_carry(send, drive, got):
+    """Producer-agnostic carry contract: bit-identical payload, batch
+    headers stamped once, frames_aware consumer sees the raw blob."""
+    blob = frames.encode_lines(CORPUS)
+    send(blob, len(CORPUS))
+    drive(lambda: len(got) >= 1)
+    assert len(got) == 1
+    payload, headers = got[0]
+    assert isinstance(payload, (bytes, bytearray, memoryview))
+    assert bytes(payload) == bytes(blob)
+    assert headers["frames"] == len(CORPUS)
+    assert "msg_id" in headers and "ingest_ts" in headers
+    return headers
+
+
+def test_memory_fabric_carries_frames():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    got = []
+    cons = _mk_qm(broker).get_queue("q", "c", lambda p, h: got.append((p, h)))
+    cons.frames_aware = True
+    cons.start_consume()
+    _assert_carry(prod.write_frames, lambda done: broker.pump(), got)
+
+
+def test_spool_fabric_carries_frames(tmp_path):
+    ch = SpoolChannel(str(tmp_path))
+    prod = QueueManager(lambda d: ch, stat_log_interval_s=3600).get_queue("q", "p")
+    got = []
+    cons = QueueManager(lambda d: ch, stat_log_interval_s=3600).get_queue(
+        "q", "c", lambda p, h, t: got.append((p, h)), manual_ack=True
+    )
+    cons.frames_aware = True
+    cons.start_consume()
+    _assert_carry(prod.write_frames, lambda done: ch.deliver(), got)
+    # one spool record per batch: the ack cursor advances batch-wise
+    assert ch.delivered_count("q") == 1
+    ch.close()
+
+
+def test_redis_fabric_carries_frames():
+    from fake_redis import FakeRedisServer, make_fake_redis
+
+    from apmbackend_tpu.transport.redis_streams import RedisStreamsChannel
+
+    server = FakeRedisServer()
+
+    def mk():
+        return RedisStreamsChannel(
+            "redis://fake", redis_module=make_fake_redis(server))
+
+    pch, cch = mk(), mk()
+    prod = QueueManager(lambda d: pch, stat_log_interval_s=3600).get_queue("q", "p")
+    got = []
+    cons = QueueManager(lambda d: cch, stat_log_interval_s=3600).get_queue(
+        "q", "c", lambda p, h: got.append((p, h)))
+    cons.frames_aware = True
+    cons.start_consume()
+    _assert_carry(prod.write_frames, lambda done: cch.deliver(), got)
+    pch.close(), cch.close()
+
+
+def test_amqp_fabric_carries_frames():
+    from fake_pika import FakeBroker, make_fake_pika
+
+    from apmbackend_tpu.transport.amqp import AmqpChannel
+
+    mod = make_fake_pika(FakeBroker())
+
+    def mk(kind):
+        return AmqpChannel("amqp://fake", direction=kind, pika_module=mod,
+                           poll_interval_s=0.005)
+
+    pch, cch = mk("p"), mk("c")
+    try:
+        prod = QueueManager(lambda d: pch, stat_log_interval_s=3600).get_queue("q", "p")
+        got = []
+        cons = QueueManager(lambda d: cch, stat_log_interval_s=3600).get_queue(
+            "q", "c", lambda p, h: got.append((p, h)))
+        cons.frames_aware = True
+        cons.start_consume()
+
+        def drive(done):
+            deadline = time.time() + 5.0
+            while not done() and time.time() < deadline:
+                time.sleep(0.01)
+
+        _assert_carry(prod.write_frames, drive, got)
+    finally:
+        pch.close(), cch.close()
+
+
+def test_unaware_consumer_unfolds_frames():
+    broker = MemoryBroker()
+    prod = _mk_qm(broker).get_queue("q", "p")
+    got = []
+    _mk_qm(broker).get_queue("q", "c", got.append).start_consume()
+    prod.write_frames(frames.encode_lines(CORPUS), len(CORPUS))
+    broker.pump()
+    assert got == CORPUS
+
+
+def test_decode_error_drops_and_counts():
+    broker = MemoryBroker()
+    # a raw channel send bypassing write_frames: corrupt blob on the wire
+    pch = MemoryChannel(broker)
+    pch.assert_queue("q")
+    bad = bytes(frames.encode_lines(CORPUS))[:-3]  # truncated lines region
+    got = []
+    _mk_qm(broker).get_queue("q", "c", got.append).start_consume()
+    before = _metric_value("apm_frame_decode_errors_total")
+    pch.send("q", bad, {"frames": len(CORPUS)})
+    broker.pump()
+    assert got == []  # dropped, not delivered as garbage
+    assert _metric_value("apm_frame_decode_errors_total") == before + 1
+
+
+def _metric_value(name):
+    from apmbackend_tpu.obs import get_registry
+
+    total = 0.0
+    for line in get_registry().render().splitlines():
+        if line.startswith(name):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+# -- shared-memory ring -------------------------------------------------------
+
+
+def _shm_pair(tmp_path, ring_bytes=1 << 16):
+    from apmbackend_tpu.transport.shmring import ShmRingChannel
+
+    prod_ch = ShmRingChannel(str(tmp_path), ring_bytes=ring_bytes)
+    cons_ch = ShmRingChannel(str(tmp_path), ring_bytes=ring_bytes)
+    return prod_ch, cons_ch
+
+
+def test_shmring_lines_and_frames_roundtrip(tmp_path):
+    prod_ch, cons_ch = _shm_pair(tmp_path)
+    prod = QueueManager(lambda d: prod_ch, stat_log_interval_s=3600).get_queue("q", "p")
+    got = []
+    cons = QueueManager(lambda d: cons_ch, stat_log_interval_s=3600).get_queue(
+        "q", "c", lambda p, h: got.append((p, h)))
+    cons.frames_aware = True
+    cons.start_consume()
+    prod.write_line("tx|a|b|c|1|2|3|4|Y")
+    blob = frames.encode_lines(CORPUS)
+    prod.write_frames(blob, len(CORPUS))
+    cons_ch.deliver()
+    assert got[0][0] == "tx|a|b|c|1|2|3|4|Y"
+    payload, h = got[1]
+    assert bytes(payload) == bytes(blob) and h["frames"] == len(CORPUS)
+    assert cons_ch.queue_lag("q") == 0
+    assert "apm_shmring_occupancy_bytes" in __import__(
+        "apmbackend_tpu.obs", fromlist=["get_registry"]).get_registry().render()
+    prod_ch.close(), cons_ch.close()
+
+
+def test_shmring_backpressure_pause_and_polled_drain(tmp_path):
+    prod_ch, cons_ch = _shm_pair(tmp_path)
+    qm_p = QueueManager(lambda d: prod_ch, stat_log_interval_s=3600)
+    prod = qm_p.get_queue("q", "p")
+    got = []
+    cons = QueueManager(lambda d: cons_ch, stat_log_interval_s=3600).get_queue(
+        "q", "c", lambda p, h: got.append(p))
+    cons.start_consume()
+    drained = []
+    prod_ch.on_drain(lambda: drained.append(1))
+    big = "x" * 1000
+    sent = 0
+    while not prod.paused:
+        prod.write_line(f"tx|s|s|{sent}|1|1|1|1|{big}")
+        sent += 1
+        assert sent < 200  # ring must fill well before this
+    assert prod.buffer_count() > 0
+    assert cons_ch.queue_lag("q") > 0
+    while cons_ch.deliver():
+        pass
+    prod_ch.pump_once()  # drain is polled off the mmap, not pushed
+    assert drained
+    qm_p.retry_all_queue_buffers()
+    assert prod.buffer_count() == 0
+    while cons_ch.deliver():
+        pass
+    assert len(got) == sent  # nothing lost across pause/flush
+    prod_ch.close(), cons_ch.close()
+
+
+def test_shmring_refuses_manual_ack_and_oversize(tmp_path):
+    prod_ch, cons_ch = _shm_pair(tmp_path)
+    with pytest.raises(NotImplementedError):
+        QueueManager(lambda d: cons_ch, stat_log_interval_s=3600).get_queue(
+            "alo", "c", lambda l, h, t: None, manual_ack=True).start_consume()
+    with pytest.raises(ValueError):
+        prod_ch.send("q", b"y" * (1 << 17), {})
+    prod_ch.close(), cons_ch.close()
+
+
+def test_shmring_wraparound_fifo(tmp_path):
+    prod_ch, cons_ch = _shm_pair(tmp_path)
+    prod = QueueManager(lambda d: prod_ch, stat_log_interval_s=3600).get_queue("q", "p")
+    recv = []
+    cons = QueueManager(lambda d: cons_ch, stat_log_interval_s=3600).get_queue(
+        "q", "c", lambda p, h: recv.append(bytes(p)))
+    cons.frames_aware = True
+    cons.start_consume()
+    sent = []
+    for k in range(80):  # > 2x around a 64 KiB ring
+        blob = bytes(frames.encode_lines(
+            [f"tx|s|svc{k % 7}|c{k}-{j}|1|100|200|5|Y" for j in range(20)]))
+        sent.append(blob)
+        prod.write_frames(blob, 20)
+        if prod.paused:
+            while prod.buffer_count():
+                cons_ch.deliver()
+                prod_ch.pump_once()
+    while cons_ch.deliver():
+        pass
+    assert recv == sent  # FIFO through every wrap
+    prod_ch.close(), cons_ch.close()
+
+
+def test_shmring_backend_selectable():
+    qm = make_queue_manager(
+        {"brokerBackend": "shmring",
+         "transport": {"shmRingDirectory": "spool/shmring-test-sel",
+                       "shmRingBytes": 1 << 16}},
+        start_pumps=False)
+    try:
+        prod = qm.get_queue("q", "p")
+        prod.write_line("tx|a|b|c|1|2|3|4|Y")
+    finally:
+        qm.shutdown()
+        import shutil
+
+        shutil.rmtree("spool/shmring-test-sel", ignore_errors=True)
+
+
+# -- fleet frame routing ------------------------------------------------------
+
+
+def test_fleet_write_frames_routes_like_write_line():
+    broker = MemoryBroker()
+    qm = make_queue_manager({"brokerBackend": "memory"}, broker=broker,
+                            start_pumps=False)
+    qmc = make_queue_manager({"brokerBackend": "memory"}, broker=broker,
+                             start_pumps=False)
+    N = 4
+    truth = [FleetPartitioner(qm, "gt", N).write_line(l) for l in CORPUS]
+    per_part = {}
+    for l, p in zip(CORPUS, truth):
+        per_part.setdefault(p, []).append(l)
+
+    pt = FleetPartitioner(qm, "fr", N)
+    got = {}
+
+    def mk(p):
+        def cb(payload, h):
+            assert h["partition"] == p
+            got.setdefault(p, []).extend(frames.decode_lines(payload))
+        return cb
+
+    for p in range(N):
+        c = qmc.get_queue(partition_queue("fr", p), "c", mk(p))
+        c.frames_aware = True
+        c.start_consume()
+    routed = pt.write_frames(frames.encode_lines(CORPUS))
+    broker.pump()
+    assert got == per_part
+    assert routed == {p: len(ls) for p, ls in sorted(per_part.items())}
+    # the grouping writer lands identically
+    got.clear()
+    pt2 = FleetPartitioner(qm, "gl", N)
+    for p in range(N):
+        c = qmc.get_queue(partition_queue("gl", p), "c", mk(p))
+        c.frames_aware = True
+        c.start_consume()
+    assert pt2.write_lines_frames(CORPUS) == routed
+    broker.pump()
+    assert got == per_part
+
+
+def test_fleet_harness_send_lines_counts_spool_records(tmp_path):
+    from apmbackend_tpu.parallel.fleet import FleetHarness
+
+    h = FleetHarness(str(tmp_path), shards=3, capacity=64, lags="6")
+    try:
+        lines = [f"tx|jvm{i % 4}|svc{i % 11}|x{i}|1|100|200|{i}|Y"
+                 for i in range(90)]
+        routed = h.send_lines(lines)
+        assert sum(routed.values()) == 90
+        # one spool RECORD per (partition, batch): the unit finish()/acked()
+        # compare against the spool cursor
+        assert sum(h.sent_per_queue.values()) == len(routed)
+        for p, n in routed.items():
+            q = partition_queue(h.base_queue, p)
+            assert h.sent_per_queue[q] == 1
+            assert n == len([l for l in lines
+                             if service_partition(tx_partition_key(l), 3) == p])
+    finally:
+        h.close()
+
+
+# -- worker intake parity -----------------------------------------------------
+
+
+def _worker_cfg(tmp, mode, feed_frames):
+    from apmbackend_tpu.config import default_config
+
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 32
+    eng["samplesPerBucket"] = 32
+    eng["deliveryMode"] = mode
+    eng["feedFrames"] = feed_frames
+    eng["resumeFileFullPath"] = os.path.join(tmp, "engine.resume.npz")
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1}]
+    cfg["streamCalcStats"]["resumeFileSaveFrequencyInSeconds"] = 3600
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = os.path.join(
+        tmp, "alerts.resume")
+    cfg["logDir"] = None
+    return cfg
+
+
+def _worker_run(tmp, mode, use_frames, feed_frames=True, bounce=False):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+
+    broker = MemoryBroker()
+    rt = ModuleRuntime("tpuEngine", config=_worker_cfg(tmp, mode, feed_frames),
+                       broker=broker, install_signals=False, console_log=False)
+    worker = WorkerApp(rt)
+    prod = _mk_qm(broker).get_queue("transactions", "p")
+    lines = [f"tx|jvm0|svc{i % 8:02d}|l{t}-{i}|1|{(170000000 + t) * 10000 - 100 - i}|"
+             f"{(170000000 + t) * 10000 + i}|{100 + i}|Y"
+             for t in range(3) for i in range(40)]
+    if use_frames:
+        for k in range(0, len(lines), 32):
+            chunk = lines[k:k + 32]
+            prod.write_frames(frames.encode_lines(chunk), len(chunk))
+    else:
+        for ln in lines:
+            prod.write_line(ln)
+    broker.pump()
+    if mode == "atLeastOnce":
+        worker.drain_delivery_pending()
+        if bounce:
+            # crash-redelivery BEFORE the checkpoint ack: same msg_ids come
+            # back and the dedup window must drop every frame batch whole
+            assert broker.bounce() > 0
+            broker.pump()
+            worker.drain_delivery_pending()
+        worker.save_state()
+        assert broker.unacked_count() == 0
+    else:
+        worker.drain_intake(10)
+        worker.save_state()
+    got = []
+    _mk_qm(broker).get_queue("db_insert", "c",
+                             lambda l, h=None, t=None: got.append(l)
+                             ).start_consume()
+    broker.pump()
+    worker.shutdown()
+    return got
+
+
+@pytest.mark.parametrize("mode", ["atLeastOnce", "atMostOnce"])
+def test_worker_frames_record_identical(tmp_path, mode):
+    base = _worker_run(str(tmp_path / "a"), mode, use_frames=False)
+    fr = _worker_run(str(tmp_path / "b"), mode, use_frames=True)
+    nf = _worker_run(str(tmp_path / "c"), mode, use_frames=True,
+                     feed_frames=False)
+    assert base == fr  # frame intake == per-line intake, record for record
+    assert base == nf  # feedFrames=False decodes at feed time, same records
+
+
+def test_worker_frame_redelivery_deduped(tmp_path):
+    base = _worker_run(str(tmp_path / "a"), "atLeastOnce", use_frames=True)
+    red = _worker_run(str(tmp_path / "b"), "atLeastOnce", use_frames=True,
+                      bounce=True)
+    assert base == red  # redelivered batches absorbed exactly once

@@ -300,6 +300,50 @@ def test_kill_switch_env_disables_native(monkeypatch):
 
 
 @needs_native
+@pytest.mark.parametrize("seed", [7, 31])
+def test_frame_mode_emission_identical(tmp_path, seed):
+    """Frame tier (ISSUE 16): the same corpus through a native-backed and a
+    reference parser, both in frame mode, must emit bit-identical APF1
+    batches — and the decoded record stream must equal what the per-record
+    object path would have handed to on_record for the queue."""
+    from apmbackend_tpu.transport import frames
+
+    paths = write_fixture_logs(str(tmp_path), n_transactions=200, seed=seed)
+
+    def run(use_native, frame_mode):
+        blobs, queue_csv, db_csv = [], [], []
+        kw = {}
+        if frame_mode:
+            kw = dict(frame_sink=lambda b, n: blobs.append(bytes(b)),
+                      frame_max_records=64)
+        now = [1000.0]
+        parser = TransactionParser(
+            lambda tx, db: (db_csv if db else queue_csv).append(tx.to_csv()),
+            server_from_path=lambda fp: SERVER, use_native=use_native,
+            clock=lambda: now[0], **kw)
+        assert (parser._native is not None) == use_native
+        plan = chunked_plan(paths.values(), chunk=1536, seed=seed)
+        for step in plan:
+            if step[0] == "advance":
+                now[0] += step[1]
+            else:
+                parser.read_lines(step[0], step[1])
+        parser.drain()
+        return blobs, queue_csv, db_csv, dict(parser.counters)
+
+    n_blobs, _n_q, n_db, n_cnt = run(True, True)
+    r_blobs, _r_q, r_db, r_cnt = run(False, True)
+    assert n_blobs == r_blobs  # bit-identical batches, both parser paths
+    assert n_db == r_db
+    _b, ref_queue, ref_db, _c = run(True, False)
+    decoded = [l for b in n_blobs for l in frames.decode_lines(b)]
+    assert decoded == ref_queue  # frame stream == object-path queue stream
+    assert n_db == ref_db
+    assert n_cnt["frame_records_out"] == r_cnt["frame_records_out"] == len(decoded) > 0
+    assert n_cnt["frames_emitted"] == len(n_blobs) > 1
+
+
+@needs_native
 def test_counters_and_exporter_fields(tmp_path):
     """The new fast-path counters feed the exporter (satellite 5): present,
     monotonic, and consistent with the line totals."""
