@@ -352,6 +352,16 @@ class TransactionParser:
         self.frame_sink = frame_sink
         self._frame_buf: list = []
         self._frame_max = max(1, int(frame_max_records))
+        # carriage (APC1 trailer): per-record ingest stamps + the sampled
+        # batch trace_id ride IN the frame, so e2e latency and trace
+        # stitching survive fabrics that strip or never carry headers (the
+        # pipelined shm-ring hop). APM_NO_FRAME_CARRIAGE=1 kills it —
+        # frames then ship the bit-identical pre-carriage wire.
+        self._frame_carriage = (
+            frame_sink is not None
+            and os.environ.get("APM_NO_FRAME_CARRIAGE", "") in ("", "0")
+        )
+        self._frame_ts: list = []  # per-record time.time(), parallel to _frame_buf
         # stage counters (ROADMAP "replay is parser-bound" quantification;
         # exported by obs.views.register_parser, surfaced by bench_replay):
         # plain dict ints — this is the per-line hot loop, registry
@@ -389,6 +399,14 @@ class TransactionParser:
         from ..obs.trace import get_tracer
 
         self._obs_tracer = get_tracer()
+        # attribution plane (obs/attrib): the parser owns two stages —
+        # the scan itself (mirrors parse_ns at chunk granularity) and the
+        # frame pack. Cached clock references; no-ops when the plane is off.
+        from ..obs.attrib import STAGE_FRAME_PACK, STAGE_PARSER_SCAN, get_attrib
+
+        _att = get_attrib()
+        self._att_scan = _att.clock(STAGE_PARSER_SCAN)
+        self._att_pack = _att.clock(STAGE_FRAME_PACK)
         # logId -> acctNum (backfill source)
         self.acct_cache = TTLCache(acct_ttl_s, clock=clock)
         # the native ingest fast path (marker pre-filter + field extraction
@@ -455,17 +473,40 @@ class TransactionParser:
 
     # -- frame emission ------------------------------------------------------
     def flush_frames(self) -> None:
-        """Pack buffered frame-mode lines into one APF1 batch and hand it to
-        frame_sink. Called at chunk/sweep/drain boundaries and when the
+        """Pack buffered frame-mode lines into one APF1 batch — plus the
+        carriage trailer (per-record ingest deltas off the batch's min
+        stamp, and a head-sampled trace_id: one should_sample compare per
+        BATCH, deterministic in the frames_emitted sequence) — and hand it
+        to frame_sink. Called at chunk/sweep/drain boundaries and when the
         buffer reaches frame_max_records; a sink failure raises
         ConsumerError (batch dropped loudly, like a failed on_record)."""
         buf = self._frame_buf
         if not buf:
             return
+        ts = self._frame_ts
         self._frame_buf = []
+        self._frame_ts = []
         from ..transport import frames as _frames
 
+        t0 = time.perf_counter()
         blob = _frames.encode_lines(buf)
+        if self._frame_carriage and len(ts) == len(buf):
+            base = min(ts)
+            tr = self._obs_tracer
+            seq = self.counters["frames_emitted"]
+            trace_id = ""
+            if tr.should_sample(seq):
+                trace_id = f"tf-{os.getpid():x}-{seq}"
+            blob = _frames.append_carriage(
+                blob, base,
+                [int((t - base) * 1000.0 + 0.5) for t in ts], trace_id,
+            )
+            if trace_id:
+                # the batch's ingest span: raw-read anchor (chunk boundary)
+                # -> packed and handed to the fabric
+                tr.span(trace_id, "ingest", tr.ingest_start or base,
+                        time.time(), records=len(buf))
+        self._att_pack.add_busy(time.perf_counter() - t0)
         self.counters["frames_emitted"] += 1
         try:
             self.frame_sink(blob, len(buf))
@@ -519,6 +560,8 @@ class TransactionParser:
                 server, service, log_id, acct_num, start_ms, end_ms,
                 elapsed, top,
             ))
+            if self._frame_carriage:
+                self._frame_ts.append(time.time())
             if len(self._frame_buf) >= self._frame_max:
                 self.flush_frames()
             return
@@ -861,7 +904,9 @@ class TransactionParser:
             if self.logger:
                 self.logger.error(f"Unparseable log line in {file_path}: {e}: {line[:200]!r}")
         finally:
-            c["parse_ns"] += time.perf_counter_ns() - t0
+            dt = time.perf_counter_ns() - t0
+            c["parse_ns"] += dt
+            self._att_scan.add_busy(dt * 1e-9)
 
     # -- batch API (native ingest fast path) ---------------------------------
     def read_lines(self, file_path: str, data: Union[bytes, str]) -> int:
@@ -896,7 +941,9 @@ class TransactionParser:
         try:
             return self._read_lines_native(file_path, data)
         finally:
-            c["parse_ns"] += time.perf_counter_ns() - t0
+            dt = time.perf_counter_ns() - t0
+            c["parse_ns"] += dt
+            self._att_scan.add_busy(dt * 1e-9)
             if self._frame_buf:
                 self._flush_frames_safe(file_path)
 

@@ -85,25 +85,35 @@ class PyTailer:
         self.on_exit = on_exit
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # wall-clock attribution (obs.attrib): delivery busy, poll idle,
+        # pause-file waits blocked (the pause file IS downstream backpressure)
+        from ..obs.attrib import STAGE_TAILER_READ, get_attrib
+
+        self._att_read = get_attrib().clock(STAGE_TAILER_READ)
 
     def _deliver(self, buf: str) -> str:
         """Push complete lines from ``buf``; returns the partial tail."""
-        if self.on_lines is not None:
-            cut = buf.rfind("\n")
-            if cut < 0:
-                return buf
-            try:
-                self.on_lines(self.file_path, buf[: cut + 1])
-            except Exception:
-                pass  # consumer bug must not kill the tail
-            return buf[cut + 1:]
-        while "\n" in buf:
-            line, buf = buf.split("\n", 1)
-            try:
-                self.on_line(self.file_path, line)
-            except Exception:
-                pass
-        return buf
+        t0 = time.perf_counter() if self._att_read.enabled else 0.0
+        try:
+            if self.on_lines is not None:
+                cut = buf.rfind("\n")
+                if cut < 0:
+                    return buf
+                try:
+                    self.on_lines(self.file_path, buf[: cut + 1])
+                except Exception:
+                    pass  # consumer bug must not kill the tail
+                return buf[cut + 1:]
+            while "\n" in buf:
+                line, buf = buf.split("\n", 1)
+                try:
+                    self.on_line(self.file_path, line)
+                except Exception:
+                    pass
+            return buf
+        finally:
+            if self._att_read.enabled:
+                self._att_read.add_busy(time.perf_counter() - t0)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, name=f"tail-{os.path.basename(self.file_path)}", daemon=True)
@@ -142,6 +152,7 @@ class PyTailer:
                 if self.pause_file is not None and self.pause_file.exists():
                     # hold position while paused (perl_tail.pl:36-41)
                     time.sleep(self.poll_interval_s)
+                    self._att_read.add_blocked(self.poll_interval_s)
                     continue
                 try:
                     st = os.stat(self.file_path)
@@ -167,6 +178,7 @@ class PyTailer:
                     buf = self._deliver(buf + chunk)
                 else:
                     time.sleep(self.poll_interval_s)
+                    self._att_read.add_idle(self.poll_interval_s)
             if fh:
                 fh.close()
             # graceful stop() is not a tail death: fail-fast on_exit fires
@@ -204,8 +216,12 @@ class NativeTailer:
         self._proc: Optional[subprocess.Popen] = None
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        from ..obs.attrib import STAGE_TAILER_READ, get_attrib
+
+        self._att_read = get_attrib().clock(STAGE_TAILER_READ)
 
     def _deliver(self, complete: bytes) -> None:
+        t0 = time.perf_counter() if self._att_read.enabled else 0.0
         try:
             if self.on_lines is not None:
                 # raw byte chunk straight into the parser's batch API (the
@@ -216,6 +232,9 @@ class NativeTailer:
                     self.on_line(self.file_path, line.decode("utf-8", "replace"))
         except Exception:
             pass  # consumer bug must not kill the pump
+        finally:
+            if self._att_read.enabled:
+                self._att_read.add_busy(time.perf_counter() - t0)
 
     def start(self, from_start: bool = False) -> None:
         argv = [self.binary_path, self.file_path, self.pause_file_path]

@@ -431,6 +431,9 @@ class ManagerApp:
             # stitches spans ACROSS children by trace_id (the distributed half
             # of the trace plane)
             runtime.telemetry.add_route("/trace", self._trace_route)
+            # likewise /attrib: the fleet-merged stage table + bottleneck
+            # verdict over every child's attribution plane
+            runtime.telemetry.add_route("/attrib", self._attrib_route)
             runtime.telemetry.add_health("fleet", self._fleet_health)
 
         # -- durable telemetry spine (obs/store + recorder + SLO, §8.4) ------
@@ -743,6 +746,39 @@ class ManagerApp:
         trace_id = (query.get("trace_id") or [None])[0]
         body = self.scrape_traces(trace_id)
         return 200, "application/json", _json.dumps(body, indent=1, default=repr)
+
+    def scrape_attribution(self, timeout_s: float = 2.0) -> dict:
+        """GET every child's /attrib, fold in the manager's own process
+        plane (colocated producers), and merge into one fleet-wide stage
+        table + bottleneck verdict (obs.attrib.merge_snapshots). A down
+        child contributes an error marker instead of failing the merge."""
+        import json as _json
+        import urllib.request
+
+        from ..obs.attrib import get_attrib, merge_snapshots
+
+        snapshots = [get_attrib().snapshot()]
+        children: dict = {}
+        for name, url in self._child_metrics_targets():
+            try:
+                with urllib.request.urlopen(f"{url}/attrib", timeout=timeout_s) as resp:
+                    snap = _json.loads(resp.read().decode("utf-8", "replace"))
+                if not snap.get("module") or snap.get("module") == "apm":
+                    snap["module"] = name
+                snapshots.append(snap)
+                children[name] = "ok"
+            except Exception as e:
+                children[name] = f"error: {e!r}"
+        body = merge_snapshots(snapshots)
+        body["child_status"] = children
+        return body
+
+    def _attrib_route(self, _query):
+        import json as _json
+
+        return 200, "application/json", _json.dumps(
+            self.scrape_attribution(), indent=1, default=repr
+        )
 
     def _fleet_health(self) -> dict:
         """Aggregated child liveness for the manager's own /healthz: process

@@ -41,6 +41,7 @@ Everything here is stdlib-only and import-light: no jax at import time
 path — a driver with telemetry disabled never touches this package.
 """
 
+from .attrib import AttributionPlane, StageClock, get_attrib, merge_snapshots, set_attrib
 from .decisions import DecisionRing, get_decisions
 from .exporter import TelemetryServer, telemetry_active
 from .flight import FlightRecorder
@@ -60,6 +61,7 @@ from .trace import SpanRing, Tracer, get_tracer
 from .tracing import TickTracer
 
 __all__ = [
+    "AttributionPlane",
     "DecisionRing",
     "FleetRecorder",
     "FlightRecorder",
@@ -67,18 +69,22 @@ __all__ = [
     "SLOEngine",
     "Sample",
     "SpanRing",
+    "StageClock",
     "TelemetryServer",
     "TickTracer",
     "TimeSeriesStore",
     "Tracer",
     "eval_range",
+    "get_attrib",
     "get_decisions",
     "get_registry",
     "get_tracer",
     "histogram_quantile",
     "make_query_route",
+    "merge_snapshots",
     "parse_prom_text",
     "relabel_metrics",
+    "set_attrib",
     "set_registry",
     "telemetry_active",
 ]

@@ -1,0 +1,460 @@
+"""Pipeline-wide wall-clock attribution: where does a second of wall go?
+
+The telemetry plane answers "how fast" (throughput counters) and "how
+long" (latency histograms); the tick tracer decomposes one tick. None of
+them answer the ROADMAP's standing question — *which stage owns the
+wall-clock* — so every bottleneck diagnosis (replay is parser-bound,
+fleet e2e is tick-cadence-bound) had to be reconstructed by hand across
+bench reports. This module makes that attribution a first-class,
+queryable signal:
+
+- :class:`StageClock` — per-stage busy / blocked-on-downstream / idle
+  second accumulators. Cost discipline is the PR 2 rule: deltas are taken
+  from ``time.perf_counter()`` values the call sites already have at
+  existing sync boundaries (tick t0..t3, parser parse_ns, ring spin
+  deadlines) — no new device syncs, no hot-path locks. Each clock is
+  written by the one thread that owns its stage (the shm-ring SPSC
+  discipline); readers take a snapshot of plain floats, so a torn read
+  costs at most one in-flight delta, never a crash.
+- :class:`Occupancy` — time-weighted occupancy for the buffered resources
+  (producer pause buffer, worker intake ring, frame FIFO, shm ring):
+  ``sample(level)`` integrates ``level`` over the time it was held, which
+  generalizes the instantaneous ``apm_shmring_occupancy_bytes`` gauge
+  into "how full was it *on average*, and at peak".
+- :class:`AttributionPlane` — the process-wide table of clocks +
+  occupancies, exported to the registry (``apm_stage_*_seconds_total``,
+  ``apm_occupancy_*``) so the PR 12 TimeSeriesStore's self-sample
+  persists stage shares for ``/query`` range plots, and served by the
+  exporter's ``GET /attrib`` with a critical-path verdict.
+
+The bottleneck estimator (:func:`estimate`): every stage contributes its
+busy share and blocked share of the observation window; the wall the
+instrumented stages do NOT account for is the pipeline waiting for the
+next tick boundary to arrive in the stream (ticks fire on data labels —
+``feed`` only ticks when a record's 10 s label advances), reported as the
+implicit ``tick_cadence`` candidate. The verdict is the argmax share:
+``{"bottleneck": "tick_cadence", "reason": "drain_wait 71% of window"}``
+for a cadence-dominated fleet, ``parser_scan`` for a parser-bound replay.
+bench_replay/bench_rolling certify both namings under reproducible
+inputs, and bench_rolling's ON-vs-OFF A/B gates accounting overhead <2%.
+
+Kill switch: ``APM_NO_ATTRIB=1`` (or ``configure(enabled=False)``) makes
+:meth:`AttributionPlane.clock` hand out a shared no-op clock — call
+sites keep their single cached reference and pay one dead method call.
+Stdlib + numpy-free like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .registry import MetricsRegistry, Sample, get_registry
+
+# canonical stage names (call sites may mint others; these keep the
+# table, the docs, and the bench assertions in one vocabulary)
+STAGE_TAILER_READ = "tailer_read"
+STAGE_PARSER_SCAN = "parser_scan"
+STAGE_FRAME_PACK = "frame_pack"
+STAGE_TRANSPORT_SEND = "transport_send"
+STAGE_TRANSPORT_PUMP = "transport_pump"
+STAGE_SHMRING_PUSH = "shmring_push"
+STAGE_SHMRING_POP = "shmring_pop"
+STAGE_INTAKE_PUSH = "intake_push"
+STAGE_WORKER_FEED = "worker_feed"
+STAGE_TICK_DISPATCH = "tick_dispatch"
+STAGE_TICK_REBUILD = "tick_rebuild"
+STAGE_TICK_TX_DRAIN = "tick_tx_drain"
+STAGE_TICK_EMIT = "tick_emit"
+STAGE_SINK_ABSORB = "sink_absorb"
+
+# the implicit candidate: wall no instrumented stage accounts for —
+# waiting for the stream to reach the next tick boundary
+CADENCE = "tick_cadence"
+
+
+class StageClock:
+    """Busy/blocked/idle accumulators for ONE stage.
+
+    Single-writer: the thread that owns the stage adds deltas; plain
+    float adds under the GIL, no lock on the hot path. ``enabled`` lets
+    call sites skip even the perf_counter pair when the plane is off.
+    """
+
+    __slots__ = ("stage", "busy_s", "blocked_s", "idle_s", "events")
+
+    enabled = True
+
+    def __init__(self, stage: str):
+        self.stage = stage
+        self.busy_s = 0.0
+        self.blocked_s = 0.0
+        self.idle_s = 0.0
+        self.events = 0
+
+    def add_busy(self, dt: float) -> None:
+        if dt > 0.0:
+            self.busy_s += dt
+            self.events += 1
+
+    def add_blocked(self, dt: float) -> None:
+        if dt > 0.0:
+            self.blocked_s += dt
+
+    def add_idle(self, dt: float) -> None:
+        if dt > 0.0:
+            self.idle_s += dt
+
+    def snapshot(self) -> dict:
+        return {
+            "busy_s": self.busy_s,
+            "blocked_s": self.blocked_s,
+            "idle_s": self.idle_s,
+            "events": self.events,
+        }
+
+
+class _NullClock(StageClock):
+    """The disabled plane's shared clock: same API, zero accumulation."""
+
+    __slots__ = ()
+
+    enabled = False
+
+    def add_busy(self, dt: float) -> None:
+        pass
+
+    def add_blocked(self, dt: float) -> None:
+        pass
+
+    def add_idle(self, dt: float) -> None:
+        pass
+
+
+_NULL_CLOCK = _NullClock("_disabled")
+
+
+class Occupancy:
+    """Time-weighted occupancy of one bounded resource.
+
+    ``sample(level)`` charges the PREVIOUS level for the time it was
+    held; the average is the integral over elapsed time, so a buffer
+    that spikes for 1 ms out of 10 s averages near zero instead of
+    whatever the scrape happened to catch. Single-writer like
+    :class:`StageClock`."""
+
+    __slots__ = ("resource", "capacity", "_level", "_last", "_integral",
+                 "peak", "_t0")
+
+    enabled = True
+
+    def __init__(self, resource: str, capacity: Optional[float] = None):
+        self.resource = resource
+        self.capacity = capacity
+        self._level = 0.0
+        self._t0 = self._last = time.perf_counter()
+        self._integral = 0.0
+        self.peak = 0.0
+
+    def sample(self, level: float) -> None:
+        now = time.perf_counter()
+        self._integral += self._level * (now - self._last)
+        self._last = now
+        self._level = float(level)
+        if level > self.peak:
+            self.peak = float(level)
+
+    def time_avg(self) -> float:
+        now = time.perf_counter()
+        elapsed = now - self._t0
+        if elapsed <= 0.0:
+            return 0.0
+        return (self._integral + self._level * (now - self._last)) / elapsed
+
+    def snapshot(self) -> dict:
+        out = {
+            "avg": self.time_avg(),
+            "peak": self.peak,
+            "level": self._level,
+        }
+        if self.capacity:
+            out["capacity"] = self.capacity
+            out["utilization"] = out["avg"] / self.capacity
+        return out
+
+
+class _NullOccupancy(Occupancy):
+    __slots__ = ()
+
+    enabled = False
+
+    def sample(self, level: float) -> None:
+        pass
+
+
+_NULL_OCC = _NullOccupancy("_disabled")
+
+
+def estimate(stages: Dict[str, dict], window_s: float) -> dict:
+    """The critical-path verdict over a stage table.
+
+    Every stage candidates twice — its busy share (it IS the work) and
+    its blocked share (it is starved BY downstream; rendered as
+    ``<stage>_wait``). The unaccounted wall candidates as the implicit
+    ``tick_cadence``/``drain_wait`` (ticks fire on stream labels, so
+    un-attributed wall is the pipeline waiting for the next boundary).
+    Stages may run on parallel threads, so shares can sum past 1.0; the
+    unaccounted remainder is clamped at zero, which only ever
+    *understates* cadence wait — the conservative direction."""
+    window_s = max(float(window_s), 1e-9)
+    candidates = []  # (stage, mode, share)
+    accounted = 0.0
+    for stage, st in stages.items():
+        busy = float(st.get("busy_s", 0.0))
+        blocked = float(st.get("blocked_s", 0.0))
+        accounted += busy + blocked
+        candidates.append((stage, "busy", busy / window_s))
+        if blocked > 0.0:
+            candidates.append((stage, "blocked", blocked / window_s))
+    cadence_share = max(0.0, 1.0 - accounted / window_s)
+    candidates.append((CADENCE, "drain_wait", cadence_share))
+    stage, mode, share = max(candidates, key=lambda c: c[2])
+    if mode == "busy":
+        reason = f"busy {share * 100.0:.0f}% of window"
+    elif mode == "blocked":
+        reason = f"{stage}_wait {share * 100.0:.0f}% of window"
+    else:
+        reason = f"drain_wait {share * 100.0:.0f}% of window"
+    return {
+        "bottleneck": stage,
+        "mode": mode,
+        "share": round(share, 4),
+        "reason": reason,
+        "verdict": f"bottleneck: {stage}, confidence: {reason}",
+        "window_s": round(window_s, 3),
+    }
+
+
+class AttributionPlane:
+    """The process-wide attribution table (one per process; see
+    :func:`get_attrib`). Creation of clocks/occupancies is locked; the
+    accumulators themselves are single-writer lock-free."""
+
+    def __init__(self, module: str = "apm", enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("APM_NO_ATTRIB", "") in ("", "0")
+        self.module = module
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._clocks: Dict[str, StageClock] = {}  # guarded-by: _lock (creation; accumulation is single-writer)
+        self._occ: Dict[str, Occupancy] = {}  # guarded-by: _lock (creation)
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+
+    # -- wiring ---------------------------------------------------------------
+    def configure(self, *, module: Optional[str] = None,
+                  enabled: Optional[bool] = None) -> "AttributionPlane":
+        if module is not None:
+            self.module = module
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        return self
+
+    def clock(self, stage: str) -> StageClock:
+        """Create-or-get the stage's clock; the shared no-op when the
+        plane is disabled (call sites cache the reference either way)."""
+        if not self.enabled:
+            return _NULL_CLOCK
+        with self._lock:
+            clock = self._clocks.get(stage)
+            if clock is None:
+                clock = self._clocks[stage] = StageClock(stage)
+            return clock
+
+    def occupancy(self, resource: str,
+                  capacity: Optional[float] = None) -> Occupancy:
+        if not self.enabled:
+            return _NULL_OCC
+        with self._lock:
+            occ = self._occ.get(resource)
+            if occ is None:
+                occ = self._occ[resource] = Occupancy(resource, capacity)
+            elif capacity is not None and occ.capacity is None:
+                occ.capacity = capacity
+            return occ
+
+    def reset(self) -> None:
+        """Restart the observation window (bench phase boundaries)."""
+        with self._lock:
+            self._clocks.clear()
+            self._occ.clear()
+            self._t0 = time.perf_counter()
+            self._wall0 = time.time()
+
+    def window_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- views ----------------------------------------------------------------
+    def stage_table(self) -> Dict[str, dict]:
+        with self._lock:
+            clocks = list(self._clocks.values())
+        return {c.stage: c.snapshot() for c in clocks}
+
+    def occupancy_table(self) -> Dict[str, dict]:
+        with self._lock:
+            occs = list(self._occ.values())
+        return {o.resource: o.snapshot() for o in occs}
+
+    def snapshot(self) -> dict:
+        """The full attribution picture: the /attrib body, the flight
+        recorder's ``attribution`` source, and the bench certification
+        input — one shape everywhere."""
+        window = self.window_s()
+        stages = self.stage_table()
+        body = {
+            "module": self.module,
+            "enabled": self.enabled,
+            "window_s": round(window, 3),
+            "window_start_unixtime": self._wall0,
+            "stages": {
+                s: dict(
+                    st,
+                    busy_share=round(st["busy_s"] / max(window, 1e-9), 4),
+                    blocked_share=round(st["blocked_s"] / max(window, 1e-9), 4),
+                )
+                for s, st in stages.items()
+            },
+            "occupancy": self.occupancy_table(),
+        }
+        body["estimate"] = estimate(stages, window)
+        return body
+
+    def bottleneck(self) -> dict:
+        return estimate(self.stage_table(), self.window_s())
+
+    # -- registry export ------------------------------------------------------
+    def collect(self) -> List[Sample]:
+        """Scrape-time samples — the store's self-sample persists these,
+        so ``/query`` can plot ``rate(apm_stage_busy_seconds_total[60s])``
+        stage shares over time."""
+        out: List[Sample] = []
+        labels = {"module": self.module}
+        for stage, st in self.stage_table().items():
+            sl = dict(labels, stage=stage)
+            out.append(Sample(
+                "apm_stage_busy_seconds_total", sl, st["busy_s"], "counter",
+                "Wall seconds the stage spent doing its own work",
+            ))
+            out.append(Sample(
+                "apm_stage_blocked_seconds_total", sl, st["blocked_s"],
+                "counter",
+                "Wall seconds the stage spent blocked on downstream",
+            ))
+            out.append(Sample(
+                "apm_stage_idle_seconds_total", sl, st["idle_s"], "counter",
+                "Wall seconds the stage spent idle (no input pending)",
+            ))
+            out.append(Sample(
+                "apm_stage_events_total", sl, st["events"], "counter",
+                "Busy intervals the stage accumulated",
+            ))
+        for resource, oc in self.occupancy_table().items():
+            rl = dict(labels, resource=resource)
+            out.append(Sample(
+                "apm_occupancy_avg", rl, oc["avg"], "gauge",
+                "Time-weighted average occupancy of the buffered resource",
+            ))
+            out.append(Sample(
+                "apm_occupancy_peak", rl, oc["peak"], "gauge",
+                "Peak occupancy of the buffered resource",
+            ))
+            out.append(Sample(
+                "apm_occupancy_level", rl, oc["level"], "gauge",
+                "Most recently sampled occupancy of the buffered resource",
+            ))
+        return out
+
+    _registered_into: Optional[int] = None
+
+    def install(self, registry: Optional[MetricsRegistry] = None) -> None:
+        """Idempotently register the collector (the views.py _MARK
+        discipline: standalone mode builds several runtimes over one
+        process registry — one collector, not four)."""
+        reg = registry if registry is not None else get_registry()
+        if self._registered_into == id(reg):
+            return
+        self._registered_into = id(reg)
+        reg.add_collector(self.collect)
+
+
+# -- the process-global plane -------------------------------------------------
+
+_plane = AttributionPlane()
+
+
+def get_attrib() -> AttributionPlane:
+    """The process-wide attribution plane every stage records into."""
+    return _plane
+
+
+def configure(**kwargs) -> AttributionPlane:
+    """Configure the process plane in place (ModuleRuntime wiring; tests)."""
+    return _plane.configure(**kwargs)
+
+
+def set_attrib(plane: AttributionPlane) -> AttributionPlane:
+    """Swap the process-global plane (test/bench isolation); returns the
+    old one. Call sites cache clock references at construction, so a swap
+    takes effect for components built AFTER it — the bench A/B pattern."""
+    global _plane
+    old, _plane = _plane, plane
+    return old
+
+
+def merge_snapshots(snapshots: List[dict]) -> dict:
+    """Fleet-merge child /attrib bodies: stage seconds sum across
+    children (stages run in parallel processes — the estimator's
+    parallel-threads caveat already covers this), occupancy keeps each
+    child's row under ``<module>:<resource>``, and the verdict is
+    recomputed over the merged table with the widest child window."""
+    stages: Dict[str, dict] = {}
+    occupancy: Dict[str, dict] = {}
+    window = 0.0
+    children = []
+    for snap in snapshots:
+        if not snap:
+            continue
+        children.append(snap.get("module", "?"))
+        window = max(window, float(snap.get("window_s", 0.0)))
+        for stage, st in (snap.get("stages") or {}).items():
+            agg = stages.setdefault(
+                stage, {"busy_s": 0.0, "blocked_s": 0.0, "idle_s": 0.0,
+                        "events": 0})
+            agg["busy_s"] += float(st.get("busy_s", 0.0))
+            agg["blocked_s"] += float(st.get("blocked_s", 0.0))
+            agg["idle_s"] += float(st.get("idle_s", 0.0))
+            agg["events"] += int(st.get("events", 0))
+        for resource, oc in (snap.get("occupancy") or {}).items():
+            occupancy[f"{snap.get('module', '?')}:{resource}"] = oc
+    body = {
+        "children": children,
+        "window_s": round(window, 3),
+        "stages": stages,
+        "occupancy": occupancy,
+    }
+    body["estimate"] = estimate(stages, window)
+    return body
+
+
+def make_attrib_route(plane_fn: Optional[Callable[[], AttributionPlane]] = None):
+    """``GET /attrib`` route body for :meth:`TelemetryServer.add_route`."""
+    import json
+
+    def route(_query):
+        plane = plane_fn() if plane_fn is not None else get_attrib()
+        return 200, "application/json", json.dumps(
+            plane.snapshot(), indent=1, default=repr)
+
+    return route

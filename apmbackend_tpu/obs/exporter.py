@@ -23,6 +23,10 @@ exposed as :attr:`TelemetryServer.port`). Routes:
 - ``GET /trace[?trace_id=&n=]`` — recent spans from the process trace
   ring (obs.trace), JSON; the per-module half of distributed traces (the
   manager's own ``/trace`` stitches across children by trace_id).
+- ``GET /attrib`` — the wall-clock attribution plane (obs.attrib): the
+  per-stage busy/blocked/idle table, time-weighted occupancies, and the
+  critical-path bottleneck verdict (the manager's ``/attrib`` merges
+  across children).
 - ``GET /decisions[?trace_id=&n=]`` — recent alert decision records
   (obs.decisions): why each page fired, resolvable by trace_id.
 - ``GET /flight?reason=...`` — on-demand flight-recorder bundle when the
@@ -156,6 +160,13 @@ class TelemetryServer:
         }
         return 200, "application/json", json.dumps(body, indent=1, default=repr)
 
+    def _handle_attrib(self, _query) -> Tuple[int, str, str]:
+        from .attrib import get_attrib
+
+        return 200, "application/json", json.dumps(
+            get_attrib().snapshot(), indent=1, default=repr
+        )
+
     def _handle_decisions(self, query) -> Tuple[int, str, str]:
         from .decisions import get_decisions
 
@@ -239,6 +250,7 @@ class TelemetryServer:
                     "/healthz": outer._handle_healthz,
                     "/profile": outer._handle_profile,
                     "/trace": outer._handle_trace,
+                    "/attrib": outer._handle_attrib,
                     "/decisions": outer._handle_decisions,
                     "/flight": outer._handle_flight,
                     **outer._routes,
@@ -275,7 +287,7 @@ class TelemetryServer:
         if self.logger:
             self.logger.info(
                 f"Telemetry exporter listening on http://{self.host}:{self.port} "
-                f"(/metrics /healthz /profile /trace /decisions /flight)"
+                f"(/metrics /healthz /profile /trace /attrib /decisions /flight)"
             )
         return self.port
 

@@ -82,6 +82,21 @@ def register_memory_broker(broker, registry: Optional[MetricsRegistry] = None) -
     reg.add_collector(collect)
 
 
+def register_attribution(module: Optional[str], registry: Optional[MetricsRegistry] = None) -> None:
+    """Export the process attribution plane (obs.attrib) into the registry:
+    ``apm_stage_{busy,blocked,idle}_seconds_total`` + occupancy gauges.
+    Idempotent inside the plane itself (one collector per registry) — the
+    standalone four-runtimes-one-registry topology registers once. A None
+    ``module`` installs without claiming the label (non-exporter runtimes,
+    mirroring the tracer's module rule)."""
+    from .attrib import get_attrib
+
+    plane = get_attrib()
+    if module is not None:
+        plane.configure(module=module)
+    plane.install(registry)
+
+
 def register_parser(parser, module: str, registry: Optional[MetricsRegistry] = None) -> None:
     """Correlation-parser stage counters (the ROADMAP "replay is
     parser-bound" quantification): line/record throughput, parse time,

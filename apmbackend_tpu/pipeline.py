@@ -1398,10 +1398,30 @@ class PipelineDriver:
                 "Records per ingest scatter",
                 buckets=DEFAULT_COUNT_BUCKETS,
             )
+            # wall-clock attribution (obs.attrib): the tick stage splits the
+            # TickTracer already measures double as busy seconds for the
+            # bottleneck estimator — same perf_counter boundaries, zero new
+            # syncs
+            from .obs.attrib import (
+                STAGE_TICK_DISPATCH,
+                STAGE_TICK_EMIT,
+                STAGE_TICK_REBUILD,
+                STAGE_TICK_TX_DRAIN,
+                get_attrib,
+            )
+
+            _att = get_attrib()
+            self._att_tick = {
+                "dispatch": _att.clock(STAGE_TICK_DISPATCH),
+                "rebuild": _att.clock(STAGE_TICK_REBUILD),
+                "tx_drain": _att.clock(STAGE_TICK_TX_DRAIN),
+                "emit": _att.clock(STAGE_TICK_EMIT),
+            }
         else:
             self._tracer = None
             self._trace = None
             self._decisions = None
+            self._att_tick = None
         self._refresh_params()
         # emission pipelining (tpuEngine.asyncEmission / the async_emission
         # kwarg; default OFF): hold each tick's TickEmission and fetch it
@@ -2033,16 +2053,16 @@ class PipelineDriver:
         else:
             self._process_emission(new_label, emission, self.registry.count)
         if tr is not None:
-            tr.record(
-                new_label,
-                {
-                    "dispatch": t1 - t0,
-                    "rebuild": t2 - t1,
-                    "tx_drain": t3 - t2,
-                    "emit": time.perf_counter() - t3,
-                },
-                catchup_labels=catchup,
-            )
+            stages = {
+                "dispatch": t1 - t0,
+                "rebuild": t2 - t1,
+                "tx_drain": t3 - t2,
+                "emit": time.perf_counter() - t3,
+            }
+            tr.record(new_label, stages, catchup_labels=catchup)
+            if self._att_tick is not None:
+                for k, clk in self._att_tick.items():
+                    clk.add_busy(stages[k])
 
     # apm: sync-boundary: THE emit readback — the one blocking sync per tick the cost model budgets for (async emission overlaps it with the next dispatch)
     def _process_emission(self, new_label: int, emission: TickEmission, count: int) -> None:

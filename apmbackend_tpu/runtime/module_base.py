@@ -228,6 +228,15 @@ class ModuleRuntime:
                 ring_size=int(obs_cfg.get("traceRingSize", 512)),
                 module=prefix if self.telemetry is not None else None,
             )
+            # wall-clock attribution plane (obs/attrib): install the stage/
+            # occupancy collector into the process registry (idempotent —
+            # standalone runs four runtimes over one registry); like the
+            # tracer, only the exporter-owning runtime claims the module
+            # label. _self_sample persists the series into the store, so
+            # /query can plot stage shares over time.
+            from ..obs.views import register_attribution
+
+            register_attribution(prefix if self.telemetry is not None else None)
             # crash flight recorder (obs/flight): bundles on degradation/
             # signals/exceptions plus the kill−9 journal+sentinel shadow
             flight_dir = obs_cfg.get("flightDir")
@@ -248,6 +257,14 @@ class ModuleRuntime:
                 self.flight.add_source("traces", lambda: get_tracer().ring.spans(n=128))
                 self.flight.add_source("decisions", lambda: get_decisions().recent(64))
                 self.flight.add_source("process_health", self._process_health)
+                # where the wall went when the process died: per-stage
+                # busy/blocked table + bottleneck verdict, and the shm-ring
+                # header counters (a stuck ring is visible even after the
+                # peer process is gone — the file persists)
+                from ..obs.attrib import get_attrib as _get_attrib
+
+                self.flight.add_source("attribution", lambda: _get_attrib().snapshot())
+                self.flight.add_source("shmring", self._shmring_stats)
                 # a leftover sentinel = the previous process died without a
                 # clean shutdown (kill−9/OOM): promote its last journal NOW
                 self.flight.recover_crash()
@@ -294,6 +311,31 @@ class ModuleRuntime:
                     self.flight.add_source("store_tail", lambda: self.store.tail(32))
                     if self.slo is not None:
                         self.flight.add_source("slo", lambda: self.slo.status())
+
+    def _shmring_stats(self) -> dict:
+        """Header counters of every ring file in the shm fabric directory —
+        a read-only peek (transport.shmring.ring_stats), so the flight
+        snapshot never creates rings or races a peer's init. Empty when
+        the broker backend is not shmring or the directory is absent."""
+        from ..transport import effective_broker_backend
+
+        if effective_broker_backend(self.config) != "shmring":
+            return {}
+        from ..transport.shmring import ring_stats
+
+        tcfg = self.config.get("transport", {}) or {}
+        directory = tcfg.get("shmRingDirectory", "spool/shmring")
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError:
+            return {}
+        out = {}
+        for fn in names:
+            if fn.endswith(".ring"):
+                st = ring_stats(os.path.join(directory, fn))
+                if st is not None:
+                    out[fn[: -len(".ring")]] = st
+        return out
 
     def _self_sample(self) -> None:
         """Snapshot the process registry — plus spans/decisions not yet

@@ -328,6 +328,21 @@ class WorkerApp:
         self._overflow_max = int(eng_cfg.get("intakeOverflowMaxLines", 200_000))
         self.intake_dropped = 0
         self._ring_spin_s = float(eng_cfg.get("ringFullMaxBlockSeconds", 2.0))
+        # wall-clock attribution (obs.attrib): intake-side stage clocks +
+        # time-weighted occupancy of the ring-adjacent FIFOs. Plain float
+        # adds on the owning threads at existing boundaries — no locks, no
+        # device syncs (the PR 2 rule).
+        from ..obs.attrib import STAGE_INTAKE_PUSH, STAGE_WORKER_FEED, get_attrib
+
+        _att = get_attrib()
+        self._att_feed = _att.clock(STAGE_WORKER_FEED)
+        self._att_push = _att.clock(STAGE_INTAKE_PUSH)
+        self._att_frame_occ = _att.occupancy(
+            "frame_fifo_records", capacity=self._overflow_max
+        )
+        self._att_overflow_occ = _att.occupancy(
+            "intake_overflow_lines", capacity=self._overflow_max
+        )
         if self._at_least_once:
             # exact token<->effect accounting requires the direct feed path:
             # the ring batches lines detached from their delivery tokens and
@@ -966,6 +981,9 @@ class WorkerApp:
                         self._trace_fifo.append((self._ring_pushed, trace_ctx))
                     return
                 time.sleep(0.001)
+                # ring-full backpressure = the push stage blocked on the
+                # device loop (sleep granularity is honest enough here)
+                self._att_push.add_blocked(0.001)
             else:
                 self._ring_pushed += 1
                 if trace_ctx is not None:
@@ -1004,15 +1022,28 @@ class WorkerApp:
         if n == 0:
             return
         trace_ctx = None
-        if headers and self.driver._tracer is not None:
-            ts = headers.get("ingest_ts")
-            if ts is not None:
-                # one stamp per record keeps _note_intake's n-for-n pop
-                # accounting aligned with the record counts feeds report
-                self._intake_ts_fifo.extend([ts] * n)
-            tid = headers.get("trace_id")
+        if self.driver._tracer is not None:
+            h = headers or {}
+            car = _frames.read_carriage(blob)
+            if car is not None:
+                # in-band APC1 carriage: true per-record parse-time stamps.
+                # This is the only latency channel that survives the
+                # header-less shm-ring direct-send path, and it keeps the
+                # ingest->emit series honest per record instead of
+                # flattening a whole batch onto one transport stamp.
+                base, deltas, _tid = car
+                self._intake_ts_fifo.extend(base + d / 1000.0 for d in deltas)
+            else:
+                ts = h.get("ingest_ts")
+                if ts is not None:
+                    # one stamp per record keeps _note_intake's n-for-n pop
+                    # accounting aligned with the record counts feeds report
+                    self._intake_ts_fifo.extend([ts] * n)
+            # header trace_id wins (transport may have re-stamped); the
+            # carriage tid backstops fabrics that carry no headers at all
+            tid = h.get("trace_id") or _frames.carriage_trace_id(blob) or None
             if tid is not None and self.driver._trace is not None:
-                trace_ctx = self._frame_trace_context(tid, headers, blob)
+                trace_ctx = self._frame_trace_context(tid, h, blob)
         if (
             self._feed_frames
             and self._ring is not None
@@ -1045,6 +1076,7 @@ class WorkerApp:
         with self._frame_lock:
             self._frame_pending.append((blob, n))
             self._frame_pending_records += n
+            self._att_frame_occ.sample(self._frame_pending_records)
             while self._frame_pending_records > self._overflow_max:
                 _old, on = self._frame_pending.popleft()
                 self._frame_pending_records -= on
@@ -1062,6 +1094,7 @@ class WorkerApp:
             out = list(self._frame_pending)
             self._frame_pending.clear()
             self._frame_pending_records = 0
+            self._att_frame_occ.sample(0)
         return out
 
     def _feed_frame(self, blob: bytes, n: int) -> None:
@@ -1168,9 +1201,16 @@ class WorkerApp:
                     # sampled trace context rides the pending entry so the
                     # bulk drain registers it right before the feed; a broker
                     # redelivery kept the ORIGINAL trace_id (headers survive
-                    # requeue like msg_id), so the trace extends across a
-                    # crash instead of splitting
+                    # requeue like msg_id, and for frame batches the APC1
+                    # carriage carries it IN the payload), so the trace
+                    # extends across a crash instead of splitting
                     tid = h.get("trace_id")
+                    if frame:
+                        car = _frames.read_carriage(line)
+                        if car is not None:
+                            if ts is None:
+                                ts = car[0]  # parse-time base stamp
+                            tid = tid or (car[2] or None)
                     ctx = None
                     if tid is not None and self.driver._trace is not None:
                         ctx = (
@@ -1296,6 +1336,7 @@ class WorkerApp:
     def _enqueue_overflow(self, line: str) -> None:
         with self._overflow_lock:
             self._overflow.append(line)
+            self._att_overflow_occ.sample(len(self._overflow))
             if len(self._overflow) > self._overflow_max:
                 self._overflow.popleft()
                 self.intake_dropped += 1
@@ -1309,7 +1350,9 @@ class WorkerApp:
     def _drain_overflow_locked_pop(self, max_batch: int) -> list:
         with self._overflow_lock:
             n = min(len(self._overflow), max_batch)
-            return [self._overflow.popleft() for _ in range(n)]
+            out = [self._overflow.popleft() for _ in range(n)]
+            self._att_overflow_occ.sample(len(self._overflow))
+            return out
 
     def _ring_loop(self) -> None:
         """Device-loop thread: pop micro-batches off the intake ring and feed
@@ -1340,6 +1383,8 @@ class WorkerApp:
                         self._feed_lines(batch)
                 else:
                     time.sleep(0.002)
+                    # nothing to pop anywhere: the device loop is idle
+                    self._att_feed.add_idle(0.002)
                 continue
             recs.append(rec)
             if len(recs) >= max_batch:
@@ -1369,9 +1414,12 @@ class WorkerApp:
             # sampled traces whose lines this feed absorbs go live on the
             # driver first: their tick may fire inside this very feed
             self._drain_trace_fifo(self._ring_fed + n)
+        t0 = time.perf_counter() if self._att_feed.enabled else 0.0
         try:
             with self._driver_lock:
                 fn()
+            if self._att_feed.enabled:
+                self._att_feed.add_busy(time.perf_counter() - t0)
         except Exception:
             # the device loop must survive a bad batch: a dead loop would
             # wedge the broker thread against a full ring forever. The batch

@@ -290,9 +290,14 @@ class DBWriter:
         self._thread: Optional[threading.Thread] = None
         # trace plane: close sampled db_insert-queue trace contexts with a
         # "sink" span at buffer absorb (the last hop of the db_insert leg)
+        from ..obs.attrib import STAGE_SINK_ABSORB, get_attrib
         from ..obs.trace import get_tracer
 
         self._obs_tracer = get_tracer()
+        # wall-clock attribution (obs.attrib): insert flushes double as the
+        # sink_absorb stage's busy time — same perf_counter pair DBStats
+        # already pays
+        self._att_absorb = get_attrib().clock(STAGE_SINK_ABSORB)
         if start_timer:
             self._thread = threading.Thread(target=self._timer_loop, daemon=True, name="dbwriter-timer")
             self._thread.start()
@@ -372,9 +377,11 @@ class DBWriter:
                     self._deadlines[etype] = self.clock() + self.max_ms / 1000.0
                     self._wake.set()
             return False
+        elapsed = time.perf_counter() - start
         if self.db_stats is not None:
             self.db_stats.add_inserted(len(drained))
-            self.db_stats.add_elapsed_ms((time.perf_counter() - start) * 1000.0)
+            self.db_stats.add_elapsed_ms(elapsed * 1000.0)
+        self._att_absorb.add_busy(elapsed)
         return True
 
     def process_all(self) -> None:

@@ -116,6 +116,18 @@ def make_lag_observer(config: dict, *, redis_module=None, pika_module=None):
             ),
             None,
         )
+    if backend == "shmring":
+        from ..transport.shmring import ShmRingLagObserver
+
+        # read-only header peek over the ring FILES: an open ShmRingChannel
+        # would answer 0 for rings this fresh process never touched (and
+        # assert_queue would materialize empty rings under the fabric)
+        return (
+            ShmRingLagObserver(
+                transport_cfg.get("shmRingDirectory", "spool/shmring")
+            ),
+            None,
+        )
     raise ValueError(f"Unknown brokerBackend: {backend}")
 
 

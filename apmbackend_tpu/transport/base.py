@@ -163,6 +163,15 @@ class ProducerQueue(EventEmitter):
         from ..obs.trace import get_tracer
 
         self._tracer = get_tracer()
+        # attribution plane (obs/attrib): transport-entry send busy,
+        # blocked-while-paused (pause entry -> drain retry), and the pause
+        # buffer's time-weighted occupancy. Cached references; no-ops off.
+        from ..obs.attrib import STAGE_TRANSPORT_SEND, get_attrib
+
+        _att = get_attrib()
+        self._att_send = _att.clock(STAGE_TRANSPORT_SEND)
+        self._att_buf_occ = _att.occupancy(f"producer_buffer:{queue_name}")
+        self._pause_t0: Optional[float] = None  # guarded-by: _lock
         from ..obs import get_registry
 
         # buffer depth is the flow-control health signal: the runtime's
@@ -207,16 +216,24 @@ class ProducerQueue(EventEmitter):
             else:
                 self.buffer.append((line, headers))
             self._enforce_cap_locked()
+            self._att_buf_occ.sample(len(self.buffer))
             return False
         payload = line.encode("utf-8") if isinstance(line, str) else line
-        ok = self.channel.send(self.queue_name, payload, headers)
+        if self._att_send.enabled:
+            t0 = time.perf_counter()
+            ok = self.channel.send(self.queue_name, payload, headers)
+            self._att_send.add_busy(time.perf_counter() - t0)
+        else:
+            ok = self.channel.send(self.queue_name, payload, headers)
         if not ok:
             if requeue_front:
                 self.buffer.insert(0, (line, headers))
             else:
                 self.buffer.append((line, headers))
             self._enforce_cap_locked()
+            self._att_buf_occ.sample(len(self.buffer))
             self.paused = True
+            self._pause_t0 = time.perf_counter()
             return True
         if verbose and self.logger:
             self.logger.info(f"QUEUE: {self.queue_name} ::: {line!r}"
@@ -332,15 +349,24 @@ class ProducerQueue(EventEmitter):
             if self.partition is not None:
                 headers["partition"] = self.partition
             tr = self._tracer
-            if tr.rate > 0 and seq % tr.rate == 0:
-                trace_id = "t-" + headers["msg_id"]
-                headers["trace_id"] = trace_id
-                start = tr.ingest_start
-                tr.span(
-                    trace_id, "ingest",
-                    now if start is None or start > now else start, now,
-                    queue=self.queue_name,
-                )
+            if tr.rate > 0:
+                # a carriage-traced batch keeps the parser's trace_id (the
+                # ingest span is already recorded at flush); only an
+                # untraced batch gets the producer's own head sample
+                from . import frames as _frames
+
+                car_tid = _frames.carriage_trace_id(blob)
+                if car_tid:
+                    headers["trace_id"] = car_tid
+                elif seq % tr.rate == 0:
+                    trace_id = "t-" + headers["msg_id"]
+                    headers["trace_id"] = trace_id
+                    start = tr.ingest_start
+                    tr.span(
+                        trace_id, "ingest",
+                        now if start is None or start > now else start, now,
+                        queue=self.queue_name,
+                    )
             entered_pause = self._send_locked(blob, headers, verbose)
             overflowed, self._overflow_note = self._overflow_note, 0
         if overflowed:
@@ -358,11 +384,17 @@ class ProducerQueue(EventEmitter):
         (queue.js:230-243). Runs under the lock so a concurrent write_line
         cannot jump the FIFO order while the buffer drains."""
         with self._lock:
+            if self._pause_t0 is not None:
+                # the pause episode up to this drain was time this producer
+                # spent blocked on its downstream fabric
+                self._att_send.add_blocked(time.perf_counter() - self._pause_t0)
+                self._pause_t0 = None
             self.paused = False
             while self.buffer and not self.paused:
                 line, headers = self.buffer.pop(0)
                 self._send_locked(line, headers, False, requeue_front=True)
             remaining = len(self.buffer)
+            self._att_buf_occ.sample(remaining)
         if remaining and self.logger:
             self.logger.info(
                 f"Records still remaining in {self.queue_name} buffer, waiting for next drain: "

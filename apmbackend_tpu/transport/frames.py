@@ -59,6 +59,31 @@ The encoder has a native fast path (``apmfrm_pack`` in native/parser.cpp —
 plain numerics parsed in C++, exotic records flagged and patched here via
 ``js_parse_int``) and a pure-Python fallback; ``APM_FRAMES_NO_NATIVE=1``
 forces the fallback, and tests pin the two bit-identical.
+
+Carriage trailer (the frame-native observability plane)
+-------------------------------------------------------
+
+Batch-granular header stamping went dark on per-record latency: one
+``ingest_ts`` per batch collapses 512 records onto a single stamp, and the
+pipelined shm-ring hop (``channel.send`` straight from the parser) carries
+no headers at all. The OPTIONAL carriage trailer rides after the lines
+region and restores both axes in-band::
+
+    +0   b"APC1"                      carriage magic
+    +4   u32  nrec                    echo of the batch header's nrec
+    +8   f8   ingest_base             unix seconds, min ingest stamp
+    +16  u16  trace_len               sampled trace_id byte length (0 = none)
+    +18  u16[nrec] delta_ms           per-record (ingest_ts - base) millis,
+                                      clamped to [0, 65535]
+    +18+2*nrec  trace_id utf-8 bytes
+
+A blob WITHOUT the trailer is byte-identical to the pre-carriage wire and
+every reader still accepts it (``read_carriage`` → None); the writer-side
+kill switch is ``APM_NO_FRAME_CARRIAGE=1`` (parser flush — mirroring
+``APM_NO_FRAMES``). Because the trailer is payload, not headers, it
+survives every fabric — spool replay, redis/AMQP redelivery (the original
+trace_id rides the redelivered payload, matching per-line header
+retention), and the header-less shm ring.
 """
 
 from __future__ import annotations
@@ -75,6 +100,11 @@ FRAME_MAGIC = b"APF1"
 HEADER = struct.Struct("<4sIQ")  # magic, nrec, lines_off
 HEADER_SIZE = HEADER.size  # 16
 RECORD_SIZE = 32
+
+CARRIAGE_MAGIC = b"APC1"
+_CARRIAGE_HDR = struct.Struct("<4sIdH")  # magic, nrec echo, ingest_base, trace_len
+_CARRIAGE_HDR_SIZE = _CARRIAGE_HDR.size  # 18
+_DELTA_MAX = 0xFFFF
 
 FL_EXOTIC = 0x01
 FL_NONTX = 0x02
@@ -121,8 +151,12 @@ class FrameError(ValueError):
     pass
 
 
-def _check(blob) -> Tuple[int, int]:
-    """Validate the batch envelope; returns (nrec, lines_off)."""
+def _check(blob) -> Tuple[int, int, int]:
+    """Validate the batch envelope; returns (nrec, lines_off, lines_end).
+
+    ``lines_end`` is the byte offset one past the lines region (including
+    the final separator): ``len(blob)`` for a bare batch, the carriage
+    trailer's start otherwise. Any other surplus is a torn blob."""
     if len(blob) < HEADER_SIZE:
         raise FrameError(f"frame batch shorter than its header ({len(blob)}B)")
     magic, nrec, lines_off = HEADER.unpack_from(bytes(blob[:HEADER_SIZE]), 0)
@@ -135,25 +169,37 @@ def _check(blob) -> Tuple[int, int]:
         )
     rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
     want = int(lines_off) + int(rec["line_len"].sum()) + int(nrec)
-    if want != len(blob):
-        # a torn lines region must fail loudly, not feed a truncated line
+    if want == len(blob):
+        return int(nrec), int(lines_off), want
+    if want < len(blob) and bytes(blob[want : want + 4]) == CARRIAGE_MAGIC:
+        # surplus bytes are acceptable ONLY as a valid carriage trailer
+        # that consumes the blob exactly to its end
+        if len(blob) >= want + _CARRIAGE_HDR_SIZE:
+            _magic, cn, _base, tlen = _CARRIAGE_HDR.unpack_from(
+                bytes(blob[want : want + _CARRIAGE_HDR_SIZE]), 0
+            )
+            if cn == nrec and want + _CARRIAGE_HDR_SIZE + 2 * cn + tlen == len(blob):
+                return int(nrec), int(lines_off), want
         raise FrameError(
-            f"frame batch size mismatch: header wants {want}B, got {len(blob)}B"
+            f"frame carriage trailer torn: lines end {want}B, got {len(blob)}B"
         )
-    return int(nrec), int(lines_off)
+    # a torn lines region must fail loudly, not feed a truncated line
+    raise FrameError(
+        f"frame batch size mismatch: header wants {want}B, got {len(blob)}B"
+    )
 
 
 def records(blob) -> np.ndarray:
     """Zero-copy structured view of the per-record headers."""
-    nrec, _lines_off = _check(blob)
+    nrec, _lines_off, _end = _check(blob)
     return np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
 
 
 def lines_region(blob) -> memoryview:
     """The newline-joined lines region WITHOUT the trailing separator —
     directly feedable to the bulk CSV decoder (feed_csv_bytes)."""
-    nrec, lines_off = _check(blob)
-    mv = memoryview(blob)[lines_off:]
+    nrec, lines_off, lines_end = _check(blob)
+    mv = memoryview(blob)[lines_off:lines_end]
     if nrec and len(mv) and mv[-1] == 0x0A:
         mv = mv[:-1]
     return mv
@@ -169,7 +215,7 @@ def line_offsets(rec: np.ndarray) -> np.ndarray:
 
 def iter_lines(blob) -> List[bytes]:
     """Every line as bytes, verbatim (no trailing separator)."""
-    nrec, lines_off = _check(blob)
+    nrec, lines_off, _end = _check(blob)
     rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
     offs = line_offsets(rec)
     mv = memoryview(blob)
@@ -300,6 +346,86 @@ def encode_lines(lines: Iterable) -> bytes:
     return _encode_python(lines_b)
 
 
+# ------------------------------------------------------------- carriage plane
+
+
+def has_carriage(blob) -> bool:
+    """True when the batch carries an APC1 trailer (validated envelope)."""
+    _nrec, _off, lines_end = _check(blob)
+    return lines_end < len(blob)
+
+
+def append_carriage(blob, ingest_base: float, delta_ms, trace_id: str = "") -> bytes:
+    """Append the carriage trailer to a bare batch: per-record ingest
+    stamps as ``base + u16 delta-millis`` (clamped to 65.535 s — a record
+    older than that saturates rather than wraps) plus an optional sampled
+    ``trace_id``. Returns a NEW blob; the input is never mutated."""
+    nrec, _off, lines_end = _check(blob)
+    if lines_end != len(blob):
+        raise FrameError("frame batch already carries a trailer")
+    deltas = np.asarray(delta_ms, dtype=np.int64)
+    if len(deltas) != nrec:
+        raise FrameError(
+            f"carriage wants {nrec} per-record deltas, got {len(deltas)}"
+        )
+    tid = trace_id.encode("utf-8") if trace_id else b""
+    if len(tid) > _DELTA_MAX:
+        tid = tid[:_DELTA_MAX]
+    packed = np.clip(deltas, 0, _DELTA_MAX).astype("<u2").tobytes()
+    return (
+        bytes(blob)
+        + _CARRIAGE_HDR.pack(CARRIAGE_MAGIC, nrec, float(ingest_base), len(tid))
+        + packed
+        + tid
+    )
+
+
+def read_carriage(blob) -> Optional[Tuple[float, np.ndarray, str]]:
+    """``(ingest_base, u16 delta-millis array, trace_id)`` from the trailer,
+    or None for a bare (pre-carriage / kill-switched) batch. The deltas
+    array is a zero-copy view into the blob."""
+    nrec, _off, lines_end = _check(blob)
+    if lines_end == len(blob):
+        return None
+    _magic, _cn, base, tlen = _CARRIAGE_HDR.unpack_from(
+        bytes(blob[lines_end : lines_end + _CARRIAGE_HDR_SIZE]), 0
+    )
+    deltas = np.frombuffer(
+        blob, "<u2", count=nrec, offset=lines_end + _CARRIAGE_HDR_SIZE
+    )
+    tid_off = lines_end + _CARRIAGE_HDR_SIZE + 2 * nrec
+    trace_id = bytes(blob[tid_off : tid_off + tlen]).decode("utf-8", "replace")
+    return float(base), deltas, trace_id
+
+
+def strip_carriage(blob) -> bytes:
+    """The bare batch without its trailer — byte-identical to the
+    pre-carriage wire (compat escape hatch; tests pin this)."""
+    _nrec, _off, lines_end = _check(blob)
+    return bytes(blob[:lines_end])
+
+
+def carriage_trace_id(blob) -> str:
+    """The trailer's sampled trace_id, or "" (no carriage / unsampled /
+    torn blob) — the producer's is-this-batch-already-traced probe; never
+    raises."""
+    try:
+        car = read_carriage(blob)
+    except Exception:
+        return ""
+    return car[2] if car is not None else ""
+
+
+def record_ingest_ts(blob) -> Optional[np.ndarray]:
+    """Per-record ingest stamps (unix seconds, f8, length nrec) recovered
+    from the carriage, or None for a bare batch."""
+    car = read_carriage(blob)
+    if car is None:
+        return None
+    base, deltas, _tid = car
+    return base + deltas.astype(np.float64) / 1000.0
+
+
 # ---------------------------------------------------------- partition plane
 
 _FNV_OFFSET = 0x811C9DC5
@@ -319,7 +445,7 @@ def partition_ids(blob, n_partitions: int, key: str = "service") -> List[int]:
     computes from a parsed line, without parsing one. Records without a
     routing key land on partition 0 (the ``tx_partition_key`` None rule —
     FL_NOSVC marks those for either key kind)."""
-    nrec, lines_off = _check(blob)
+    nrec, lines_off, _end = _check(blob)
     rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
     offs = line_offsets(rec)
     mv = memoryview(blob)
@@ -342,15 +468,29 @@ def partition_ids(blob, n_partitions: int, key: str = "service") -> List[int]:
 def split_by_partition(blob, n_partitions: int,
                        key: str = "service") -> Dict[int, bytes]:
     """Split one mixed batch into per-partition sub-batches (record order
-    preserved within each partition) — the fleet producer's frame router."""
+    preserved within each partition) — the fleet producer's frame router.
+    A carriage trailer is split along with its records: every sub-batch
+    keeps its own delta slice (same base, same sampled trace_id), so fleet
+    routing never collapses per-record ingest stamps back to batch
+    granularity."""
     parts = partition_ids(blob, n_partitions, key)
     if not parts:
         return {}
+    car = read_carriage(blob)
     lines = iter_lines(blob)
     grouped: Dict[int, List[bytes]] = {}
-    for p, lb in zip(parts, lines):
+    grouped_deltas: Dict[int, List[int]] = {}
+    for i, (p, lb) in enumerate(zip(parts, lines)):
         grouped.setdefault(p, []).append(lb)
-    return {p: encode_lines(g) for p, g in grouped.items()}
+        if car is not None:
+            grouped_deltas.setdefault(p, []).append(int(car[1][i]))
+    out = {}
+    for p, g in grouped.items():
+        sub = encode_lines(g)
+        if car is not None:
+            sub = append_carriage(sub, car[0], grouped_deltas[p], car[2])
+        out[p] = sub
+    return out
 
 
 def count_partition_mismatches(blob, n_partitions: int, expected: int,
@@ -372,7 +512,7 @@ def count_partition_mismatches(blob, n_partitions: int, expected: int,
 
 def summarize(blob) -> dict:
     """Cheap batch stats for logs/benches: record counts + byte split."""
-    nrec, lines_off = _check(blob)
+    nrec, lines_off, lines_end = _check(blob)
     rec = np.frombuffer(blob, RECORD_DTYPE, count=nrec, offset=HEADER_SIZE)
     n_tx = int(np.count_nonzero((rec["flags"] & FL_NONTX) == 0)) if nrec else 0
     n_exotic = int(np.count_nonzero(rec["flags"] & FL_EXOTIC)) if nrec else 0
@@ -381,7 +521,8 @@ def summarize(blob) -> dict:
         "tx": n_tx,
         "exotic": n_exotic,
         "header_bytes": lines_off,
-        "line_bytes": len(blob) - lines_off,
+        "line_bytes": lines_end - lines_off,
+        "carriage_bytes": len(blob) - lines_end,
     }
 
 

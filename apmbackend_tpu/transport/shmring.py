@@ -186,6 +186,61 @@ class _Ring:
             os.close(self._fd)
 
 
+def ring_stats(path: str) -> Optional[dict]:
+    """Read-only header peek at an EXISTING ring file — the observer path
+    (``qstat --lag``, flight-recorder sources). Never creates or maps the
+    file: a CLI probe must not materialize empty rings in the fabric
+    directory or race a peer's init. ``None`` when the file is absent,
+    short, or not yet initialized (no magic)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return None
+    try:
+        raw = os.pread(fd, _HDR.size, 0)
+    except OSError:
+        return None
+    finally:
+        os.close(fd)
+    if len(raw) < _HDR.size:
+        return None
+    magic, capacity, tail, head, msgs_in, msgs_out = _HDR.unpack(raw)
+    if magic != MAGIC or capacity <= 0:
+        return None
+    return {
+        "capacity": int(capacity),
+        "used_bytes": int(tail - head),
+        "lag": int(msgs_in - msgs_out),
+        "msgs_in": int(msgs_in),
+        "msgs_out": int(msgs_out),
+    }
+
+
+class ShmRingLagObserver:
+    """The ``Channel.queue_lag`` contract over ring FILES instead of open
+    channel state: ``ShmRingChannel.queue_lag`` answers 0 for rings the
+    process never opened, which is correct for a worker but useless for an
+    out-of-process observer. This reads the mmap header counters of
+    whatever ring files exist — disconnected (absent) rings read 0 by the
+    lag-row contract, never raise."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.directory, f"{name}.ring")
+
+    def queue_lag(self, name: str) -> int:
+        st = ring_stats(self._path(name))
+        return st["lag"] if st is not None else 0
+
+    def queue_stats(self, name: str) -> Optional[dict]:
+        return ring_stats(self._path(name))
+
+    def close(self) -> None:  # observer holds no fds between reads
+        pass
+
+
 class ShmRingChannel(Channel):
     """Channel over per-queue mmap SPSC rings (DESIGN.md §7.1 "shmring").
 
@@ -200,6 +255,22 @@ class ShmRingChannel(Channel):
         self.ring_bytes = int(ring_bytes)
         self.logger = logger
         self._lock = threading.Lock()
+        # wall-clock attribution (obs.attrib): push/pop busy at the memcpy
+        # boundaries we already pay, pump idle on empty polls, and a
+        # time-weighted occupancy per ring (the integral the instantaneous
+        # apm_shmring_occupancy_bytes gauge cannot give the estimator)
+        from ..obs.attrib import (
+            STAGE_SHMRING_POP,
+            STAGE_SHMRING_PUSH,
+            STAGE_TRANSPORT_PUMP,
+            get_attrib,
+        )
+
+        self._att = get_attrib()
+        self._att_push = self._att.clock(STAGE_SHMRING_PUSH)
+        self._att_pop = self._att.clock(STAGE_SHMRING_POP)
+        self._att_pump = self._att.clock(STAGE_TRANSPORT_PUMP)
+        self._att_occ: Dict[str, object] = {}  # guarded-by: _lock (queue -> Occupancy)
         self._rings: Dict[str, _Ring] = {}  # guarded-by: _lock
         self._consumers: Dict[str, Callable] = {}  # guarded-by: _lock (queue -> wrapped cb)
         self._tags: Dict[str, str] = {}  # guarded-by: _lock (consumer_tag -> queue)
@@ -223,6 +294,9 @@ class ShmRingChannel(Channel):
                 "(produced, not yet consumed)",
                 labels={"queue": name},
             ).set_fn(lambda r=ring: float(r.used()))
+            self._att_occ[name] = self._att.occupancy(
+                f"shmring:{name}", capacity=ring.capacity
+            )
         return ring
 
     def assert_queue(self, name: str) -> None:
@@ -232,7 +306,13 @@ class ShmRingChannel(Channel):
     def send(self, name: str, payload: bytes, headers: Optional[dict] = None) -> bool:
         with self._lock:
             ring = self._ring_locked(name)
-            ok = ring.push(payload, headers)
+            if self._att_push.enabled:
+                t0 = time.perf_counter()
+                ok = ring.push(payload, headers)
+                self._att_push.add_busy(time.perf_counter() - t0)
+            else:
+                ok = ring.push(payload, headers)
+            self._att_occ[name].sample(ring.used())
             if not ok:
                 self._pressured.add(name)
         return ok
@@ -272,6 +352,7 @@ class ShmRingChannel(Channel):
         invoke their callbacks outside the lock (a callback that writes a
         downstream queue on this same channel must not deadlock)."""
         batch = []
+        t0 = time.perf_counter() if self._att_pop.enabled else 0.0
         with self._lock:
             for name, cb in list(self._consumers.items()):
                 ring = self._rings.get(name)
@@ -287,6 +368,9 @@ class ShmRingChannel(Channel):
                     # ring delivery can only ever be the first one
                     headers["redelivered"] = False
                     batch.append((cb, rec[0], headers))
+                self._att_occ[name].sample(ring.used())
+        if batch and self._att_pop.enabled:
+            self._att_pop.add_busy(time.perf_counter() - t0)
         for cb, payload, headers in batch:
             try:
                 cb(payload, headers)
@@ -327,6 +411,7 @@ class ShmRingChannel(Channel):
                 try:
                     if self.pump_once() == 0:
                         self._stop.wait(poll_s)
+                        self._att_pump.add_idle(poll_s)
                 except Exception as e:  # keep the pump alive across surprises
                     if self.logger:
                         self.logger.error(f"shmring pump error: {e}")

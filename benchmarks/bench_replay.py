@@ -145,18 +145,43 @@ def _measure(n_transactions: int, n_services: int, tx_per_bucket) -> dict:
         }
         # parser compute share of the FRAME-MODE e2e wall: bare frame-mode
         # parser (no-op sink) isolates the scan+pack stage the same way the
-        # object-path share below isolates scan+TxEntry emission
-        bare_fr = TransactionParser(lambda tx, db: None,
-                                    frame_sink=lambda b, n: None,
-                                    frame_max_records=512)
-        bare_fr_replay = ReplayDriver(bare_fr)
-        t0 = time.perf_counter()
-        bare_fr_replay.feed_dir(d)
-        bare_fr_replay.finish()
-        bare_fr_elapsed = time.perf_counter() - t0
+        # object-path share below isolates scan+TxEntry emission. The run
+        # executes under a PRIVATE attribution plane (set_attrib swap; the
+        # parser binds its stage clocks at construction, so it must be
+        # built after the swap): a bare replay is sequential, so the wall
+        # is almost entirely parser_scan busy time and the estimator must
+        # name it — the ISSUE 17 known-bottleneck certification for the
+        # frame-mode replay configuration.
+        from apmbackend_tpu.obs.attrib import (AttributionPlane, get_attrib,
+                                               set_attrib)
+
+        att_plane = AttributionPlane(module="bench_replay")
+        prev_plane = set_attrib(att_plane)
+        try:
+            bare_fr = TransactionParser(lambda tx, db: None,
+                                        frame_sink=lambda b, n: None,
+                                        frame_max_records=512)
+            bare_fr_replay = ReplayDriver(bare_fr)
+            t0 = time.perf_counter()
+            bare_fr_replay.feed_dir(d)
+            bare_fr_replay.finish()
+            bare_fr_elapsed = time.perf_counter() - t0
+            att_snap = att_plane.snapshot()
+        finally:
+            set_attrib(prev_plane)
         frames_ab["parse_s"] = round(bare_fr_elapsed, 3)
         frames_ab["share_of_e2e_wall"] = round(
             bare_fr_elapsed / max(fr_elapsed, 1e-9), 3)
+        est = att_snap["estimate"]
+        frames_ab["attribution"] = {
+            "expected_bottleneck": "parser_scan",
+            "bottleneck": est["bottleneck"],
+            "certified": est["bottleneck"] == "parser_scan",
+            "verdict": est["verdict"],
+            "share": est["share"],
+            "stage_busy_s": {s: round(st["busy_s"], 4)
+                             for s, st in att_snap["stages"].items()},
+        }
 
         # pipelined frames e2e — the tentpole's production shape: the parser
         # thread packs APF1 batches into the shared-memory ring (send=False

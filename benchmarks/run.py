@@ -40,6 +40,49 @@ def _run_isolated(name: str, quick: bool) -> dict:
     )
 
 
+def _trajectory_row(res: dict) -> dict:
+    """One consolidated-index entry per result line: the headline triple
+    plus the ISSUE 17 attribution certifications, WITHOUT the full details
+    blob (the per-bench JSON lines keep that)."""
+    row = {k: res[k] for k in ("metric", "value", "unit", "vs_baseline")
+           if k in res}
+    if "error" in res:
+        row["error"] = res["error"]
+    d = res.get("details") or {}
+    att = d.get("attribution")
+    if not isinstance(att, dict) and isinstance(d.get("frames"), dict):
+        att = d["frames"].get("attribution")
+    if isinstance(att, dict):
+        row["attribution"] = {
+            k: att[k]
+            for k in ("expected_bottleneck", "bottleneck", "certified",
+                      "verdict", "overhead_pct", "within_gate")
+            if k in att
+        }
+    return row
+
+
+def _write_trajectory(rows, quick: bool) -> str:
+    """Write the consolidated ``BENCH_TRAJECTORY.json`` index at the repo
+    root (the BENCH_r09.json location convention): every run refreshes one
+    machine-readable summary of the latest suite pass instead of leaving
+    the trajectory scattered across stdout logs."""
+    import os
+    import time
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_TRAJECTORY.json")
+    body = {
+        "generated_unixtime": round(time.time(), 3),
+        "quick": bool(quick),
+        "results": rows,
+    }
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(body, fh, indent=1)
+        fh.write("\n")
+    return out
+
+
 def main(argv=None) -> int:
     from . import REGISTRY
     from .common import enable_compile_cache
@@ -80,8 +123,13 @@ def main(argv=None) -> int:
             os.path.abspath(__file__))), "BENCH_r09.json")
         with open(out, "w", encoding="utf-8") as fh:
             fh.write(line + "\n")
+        _write_trajectory([_trajectory_row(res)], args.quick)
         d = res.get("details", {})
         slo = d.get("slo", {})
+        att = d.get("attribution", {})
+        print(f"attribution: bottleneck={att.get('bottleneck')} "
+              f"certified={att.get('certified')} ({att.get('verdict')})",
+              file=sys.stderr, flush=True)
         print(f"slo: compliant={slo.get('compliant')} "
               f"fast={slo.get('fast_burning')} slow={slo.get('slow_burning')} "
               f"({slo.get('recorder_rows')} rows recorded over "
@@ -94,6 +142,7 @@ def main(argv=None) -> int:
 
     names = args.config or sorted(REGISTRY)
     failed = 0
+    traj_rows = []
     for name in names:
         # the podshard margin is the one number the project is named after,
         # and single runs on a loaded one-core host swing ~±20% (VERDICT r5
@@ -118,10 +167,14 @@ def main(argv=None) -> int:
                     "values": [r.get("value") for r in runs],
                 }
             print(json.dumps(res), flush=True)
+            traj_rows.append(_trajectory_row(res))
         except Exception as e:  # one failing bench must not hide the others
             failed += 1
-            print(json.dumps({"metric": name, "error": f"{type(e).__name__}: {e}"}),
-                  file=sys.stderr, flush=True)
+            err = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(err), file=sys.stderr, flush=True)
+            traj_rows.append(_trajectory_row(err))
+    out = _write_trajectory(traj_rows, args.quick)
+    print(f"trajectory index: {out}", file=sys.stderr, flush=True)
     return 1 if failed else 0
 
 

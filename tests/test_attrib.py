@@ -1,0 +1,578 @@
+"""Wall-clock attribution plane (ISSUE 17): stage clocks, time-weighted
+occupancy, the bottleneck estimator, the APC1 frame carriage, /attrib on
+exporter + manager, qstat --lag over the shmring fabric, flight-recorder
+attribution/shmring sources, and the frames-on e2e regressions (stitched
+trace + populated e2e latency histograms, ALO redelivery keeping the
+original carriage trace_id)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from apmbackend_tpu.config import default_config
+from apmbackend_tpu.obs import MetricsRegistry, TelemetryServer, parse_prom_text, set_registry
+from apmbackend_tpu.obs.attrib import (
+    CADENCE,
+    STAGE_PARSER_SCAN,
+    AttributionPlane,
+    Occupancy,
+    StageClock,
+    estimate,
+    get_attrib,
+    merge_snapshots,
+    set_attrib,
+)
+from apmbackend_tpu.obs.trace import Tracer, get_tracer, set_tracer
+from apmbackend_tpu.transport import frames
+from apmbackend_tpu.transport.memory import MemoryBroker, MemoryChannel
+
+
+@pytest.fixture(autouse=True)
+def fresh_attrib_plane():
+    """Isolate the process-global plane + registry + tracer per test:
+    clocks accumulated by pipelines in OTHER tests must not leak into
+    snapshot/estimator assertions."""
+    old_plane = set_attrib(AttributionPlane())
+    old_reg = set_registry(MetricsRegistry())
+    old_tr = set_tracer(Tracer())
+    yield
+    set_attrib(old_plane)
+    set_registry(old_reg)
+    set_tracer(old_tr)
+
+
+def fetch(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def samples_by_name(text):
+    out = {}
+    for name, labels, value in parse_prom_text(text):
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+# -- accumulators --------------------------------------------------------------
+
+
+def test_stage_clock_accumulates_and_ignores_nonpositive():
+    c = StageClock("x")
+    c.add_busy(0.5)
+    c.add_busy(0.25)
+    c.add_blocked(0.1)
+    c.add_idle(0.2)
+    c.add_busy(-1.0)  # clock skew / re-entrant timer: never subtract
+    c.add_blocked(0.0)
+    snap = c.snapshot()
+    assert snap["busy_s"] == pytest.approx(0.75)
+    assert snap["blocked_s"] == pytest.approx(0.1)
+    assert snap["idle_s"] == pytest.approx(0.2)
+    assert snap["events"] == 2  # one per positive busy interval
+
+
+def test_occupancy_time_weighted_average_and_peak():
+    occ = Occupancy("fifo", capacity=100)
+    occ.sample(80)
+    time.sleep(0.03)
+    occ.sample(0)
+    time.sleep(0.01)
+    snap = occ.snapshot()
+    assert snap["peak"] == 80
+    assert snap["level"] == 0
+    # the 80-level was held ~3/4 of the window: the time-weighted average
+    # must land well above zero and below the peak
+    assert 10 < snap["avg"] < 80
+    assert snap["capacity"] == 100
+    assert snap["utilization"] == pytest.approx(snap["avg"] / 100)
+
+
+# -- the estimator -------------------------------------------------------------
+
+
+def test_estimate_names_busy_blocked_and_cadence():
+    # busy-dominated: a sequential replay where the parser owns the wall
+    est = estimate({"parser_scan": {"busy_s": 0.9, "blocked_s": 0.0}}, 1.0)
+    assert est["bottleneck"] == "parser_scan" and est["mode"] == "busy"
+    assert est["share"] == pytest.approx(0.9)
+    assert est["verdict"].startswith("bottleneck: parser_scan")
+
+    # blocked-dominated: upstream starved BY downstream backpressure
+    est = estimate(
+        {"intake_push": {"busy_s": 0.05, "blocked_s": 0.7},
+         "worker_feed": {"busy_s": 0.1, "blocked_s": 0.0}}, 1.0)
+    assert est["bottleneck"] == "intake_push" and est["mode"] == "blocked"
+    assert "intake_push_wait" in est["reason"]
+
+    # mostly-unaccounted wall: the pipeline is waiting for the next tick
+    # boundary to arrive in the stream
+    est = estimate({"tick_dispatch": {"busy_s": 0.1, "blocked_s": 0.0}}, 1.0)
+    assert est["bottleneck"] == CADENCE and est["mode"] == "drain_wait"
+    assert est["share"] == pytest.approx(0.9)
+
+    # parallel threads can account past the window; cadence clamps at zero
+    est = estimate({"a": {"busy_s": 0.9}, "b": {"busy_s": 0.8}}, 1.0)
+    assert est["bottleneck"] == "a"
+
+
+def test_plane_snapshot_collect_and_install_idempotent():
+    plane = get_attrib().configure(module="worker")
+    plane.clock(STAGE_PARSER_SCAN).add_busy(0.4)
+    plane.clock("tick_dispatch").add_blocked(0.1)
+    plane.occupancy("frame_fifo", capacity=10).sample(5)
+
+    snap = plane.snapshot()
+    assert snap["module"] == "worker" and snap["enabled"] is True
+    assert snap["stages"]["parser_scan"]["busy_s"] == pytest.approx(0.4)
+    # share = busy / window; the window here is milliseconds old, so the
+    # share can exceed 1.0 — only its presence and sign are contractual
+    assert snap["stages"]["parser_scan"]["busy_share"] > 0
+    assert "frame_fifo" in snap["occupancy"]
+    assert snap["estimate"]["bottleneck"]
+
+    reg = MetricsRegistry()
+    plane.install(reg)
+    plane.install(reg)  # idempotent per registry
+    s = samples_by_name(reg.render())
+    busy = {lb["stage"]: v for lb, v in s["apm_stage_busy_seconds_total"]}
+    assert busy["parser_scan"] == pytest.approx(0.4)
+    assert len([v for lb, v in s["apm_stage_busy_seconds_total"]
+                if lb["stage"] == "parser_scan"]) == 1
+    blocked = {lb["stage"]: v for lb, v in s["apm_stage_blocked_seconds_total"]}
+    assert blocked["tick_dispatch"] == pytest.approx(0.1)
+    events = {lb["stage"]: v for lb, v in s["apm_stage_events_total"]}
+    assert events["parser_scan"] == 1
+    occ = {lb["resource"]: v for lb, v in s["apm_occupancy_peak"]}
+    assert occ["frame_fifo"] == 5
+    assert "apm_occupancy_avg" in s and "apm_occupancy_level" in s
+    assert all(lb["module"] == "worker"
+               for lb, _v in s["apm_stage_busy_seconds_total"])
+
+
+def test_kill_switch_hands_out_shared_noop_clock(monkeypatch):
+    monkeypatch.setenv("APM_NO_ATTRIB", "1")
+    plane = AttributionPlane()
+    assert plane.enabled is False
+    c = plane.clock("anything")
+    assert c.enabled is False
+    c.add_busy(5.0)
+    c.add_blocked(5.0)
+    assert c.snapshot()["busy_s"] == 0.0
+    o = plane.occupancy("ring")
+    o.sample(99)
+    assert o.snapshot()["peak"] == 0.0
+    assert plane.snapshot()["stages"] == {}
+
+
+def test_set_attrib_swap_binds_components_built_after():
+    mine = AttributionPlane(module="bench")
+    prev = set_attrib(mine)
+    try:
+        assert get_attrib() is mine
+        get_attrib().clock("s").add_busy(1.0)
+        assert mine.stage_table()["s"]["busy_s"] == 1.0
+        assert "s" not in prev.stage_table()
+    finally:
+        assert set_attrib(prev) is mine
+
+
+def test_merge_snapshots_sums_stages_and_namespaces_occupancy():
+    a = AttributionPlane(module="worker0")
+    a.clock("tick_dispatch").add_busy(0.2)
+    a.occupancy("ring").sample(3)
+    b = AttributionPlane(module="worker1")
+    b.clock("tick_dispatch").add_busy(0.3)
+    b.clock("sink_absorb").add_busy(0.1)
+    sa, sb = a.snapshot(), b.snapshot()
+    sa["window_s"], sb["window_s"] = 2.0, 5.0
+
+    merged = merge_snapshots([sa, sb])
+    assert merged["children"] == ["worker0", "worker1"]
+    assert merged["window_s"] == 5.0
+    assert merged["stages"]["tick_dispatch"]["busy_s"] == pytest.approx(0.5)
+    assert merged["stages"]["sink_absorb"]["busy_s"] == pytest.approx(0.1)
+    assert "worker0:ring" in merged["occupancy"]
+    # 0.6 s accounted over a 5 s window: the fleet verdict is cadence wait
+    assert merged["estimate"]["bottleneck"] == CADENCE
+
+
+# -- /attrib routes ------------------------------------------------------------
+
+
+def test_exporter_attrib_route_serves_snapshot():
+    get_attrib().configure(module="w")
+    get_attrib().clock(STAGE_PARSER_SCAN).add_busy(0.2)
+    server = TelemetryServer(MetricsRegistry(), port=0, module="w")
+    server.start()
+    try:
+        status, body = fetch(f"{server.url}/attrib")
+        assert status == 200
+        out = json.loads(body)
+        assert out["module"] == "w"
+        assert out["stages"]["parser_scan"]["busy_s"] == pytest.approx(0.2)
+        assert "verdict" in out["estimate"]
+    finally:
+        server.stop()
+
+
+def test_manager_attrib_route_merges_children(tmp_path):
+    from apmbackend_tpu.manager.manager import ManagerApp
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    # the process plane doubles as every same-process "child": the route
+    # must fold child bodies + its own snapshot without error
+    get_attrib().clock("tick_dispatch").add_busy(0.25)
+    child = TelemetryServer(MetricsRegistry(), port=0, module="worker")
+    child.start()
+
+    cfg = default_config()
+    cfg["logDir"] = str(tmp_path / "logs")
+    cfg["applicationManager"]["moduleSettings"] = [
+        {"module": "apmbackend_tpu.runtime.worker", "metricsPort": child.port},
+    ]
+    cfg["applicationManager"]["metricsPort"] = 0
+    runtime = ModuleRuntime(
+        "applicationManager", config=cfg, install_signals=False, console_log=False
+    )
+    app = ManagerApp(runtime, spawn_children=False)
+    try:
+        status, body = fetch(f"{runtime.telemetry.url}/attrib")
+        assert status == 200
+        out = json.loads(body)
+        assert len(out["children"]) == 2  # manager's own plane + the child
+        assert out["child_status"]["worker"] == "ok"
+        # one process, one plane: both bodies carry the same clock; the
+        # merge sums them and recomputes the verdict over the fleet table
+        assert out["stages"]["tick_dispatch"]["busy_s"] == pytest.approx(0.5)
+        assert out["estimate"]["bottleneck"]
+
+        # a dead child degrades to a recorded error, not a failed route
+        child.stop()
+        status, body = fetch(f"{runtime.telemetry.url}/attrib")
+        assert status == 200
+        out = json.loads(body)
+        assert out["child_status"]["worker"].startswith("error:")
+    finally:
+        app.alerts.stop()
+        app.shutdown()
+        runtime.stop_timers()
+        child.stop()
+
+
+# -- APC1 carriage -------------------------------------------------------------
+
+LINES = [
+    f"tx|jvm{i % 2}|svc{i % 5:02d}|c{i}|1|{17000000000 + i}|{17000000100 + i}|"
+    f"{100 + i}|Y"
+    for i in range(12)
+]
+
+
+def test_carriage_roundtrip_strip_and_record_ts():
+    bare = frames.encode_lines(LINES)
+    assert not frames.has_carriage(bare)
+    assert frames.read_carriage(bare) is None
+    assert frames.carriage_trace_id(bare) == ""
+    assert frames.record_ingest_ts(bare) is None
+
+    base = 1700000000.25
+    deltas = [i * 3 for i in range(len(LINES))]
+    blob = frames.append_carriage(bare, base, deltas, "t-abc123")
+    assert frames.has_carriage(blob)
+    got_base, got_deltas, tid = frames.read_carriage(blob)
+    assert got_base == pytest.approx(base)
+    assert list(got_deltas) == deltas
+    assert tid == "t-abc123"
+    assert frames.carriage_trace_id(blob) == "t-abc123"
+    ts = frames.record_ingest_ts(blob)
+    assert ts is not None and len(ts) == len(LINES)
+    assert ts[3] == pytest.approx(base + 0.009)
+
+    # the decode surface is carriage-blind: same records, same lines
+    assert frames.decode_lines(blob) == frames.decode_lines(bare)
+    assert frames.frame_count(blob) == len(LINES)
+    # strip returns the EXACT pre-carriage wire (the PR 16 bit-identity)
+    assert frames.strip_carriage(blob) == bare
+
+    # double-append must refuse: one trailer per batch
+    with pytest.raises(frames.FrameError):
+        frames.append_carriage(blob, base, deltas)
+    # delta count must match the record count
+    with pytest.raises(frames.FrameError):
+        frames.append_carriage(bare, base, deltas[:-1])
+
+
+def test_carriage_delta_saturates_at_u16():
+    bare = frames.encode_lines(LINES[:2])
+    blob = frames.append_carriage(bare, 0.0, [70_000, -5])
+    _b, deltas, _t = frames.read_carriage(blob)
+    assert list(deltas) == [65535, 0]  # clamp, never wrap
+
+
+def test_split_by_partition_reappends_carriage_per_subbatch():
+    bare = frames.encode_lines(LINES)
+    blob = frames.append_carriage(
+        bare, 2.0, list(range(len(LINES))), "t-split")
+    parts = frames.split_by_partition(blob, 3)
+    assert sum(frames.frame_count(b) for b in parts.values()) == len(LINES)
+    for sub in parts.values():
+        base, deltas, tid = frames.read_carriage(sub)
+        assert base == pytest.approx(2.0) and tid == "t-split"
+        # each record kept ITS stamp: sub-batch deltas are a subset
+        assert set(int(d) for d in deltas) <= set(range(len(LINES)))
+
+
+def test_parser_carriage_kill_switch_is_bit_identical(tmp_path, monkeypatch):
+    from apmbackend_tpu.ingest.parser import TransactionParser
+
+    log = tmp_path / "app.log"
+    fixture = None
+
+    def run():
+        blobs = []
+        p = TransactionParser(lambda tx, db: None,
+                              frame_sink=lambda b, n: blobs.append(bytes(b)),
+                              frame_max_records=8)
+        p.read_lines(str(log), fixture)
+        p.flush_frames()
+        return blobs
+
+    from apmbackend_tpu.ingest.replay import write_fixture_logs
+    write_fixture_logs(str(tmp_path / "fx"), n_transactions=40, seed=3)
+    fx = sorted(os.listdir(tmp_path / "fx"))[0]
+    with open(tmp_path / "fx" / fx, "rb") as fh:
+        fixture = fh.read()
+
+    on_blobs = run()
+    assert on_blobs and all(frames.has_carriage(b) for b in on_blobs)
+
+    monkeypatch.setenv("APM_NO_FRAME_CARRIAGE", "1")
+    off_blobs = run()
+    assert all(not frames.has_carriage(b) for b in off_blobs)
+    # kill switch OFF wire == carriage wire minus the trailer, bit for bit
+    assert off_blobs == [frames.strip_carriage(b) for b in on_blobs]
+
+
+# -- ALO redelivery keeps the carriage trace_id --------------------------------
+
+
+def _alo_worker(tmp_path):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.runtime.worker import WorkerApp
+
+    broker = MemoryBroker()
+    cfg = default_config()
+    eng = cfg["tpuEngine"]
+    eng["serviceCapacity"] = 16
+    eng["samplesPerBucket"] = 16
+    eng["deliveryMode"] = "atLeastOnce"
+    eng["resumeFileFullPath"] = str(tmp_path / "engine.resume.npz")
+    cfg["streamCalcZScore"]["defaults"] = [
+        {"LAG": 4, "THRESHOLD": 20, "INFLUENCE": 0.1}]
+    cfg["streamProcessAlerts"]["alertsResumeFileFullPath"] = None
+    cfg["logDir"] = None
+    rt = ModuleRuntime("tpuEngine", config=cfg, broker=broker,
+                       install_signals=False, console_log=False)
+    return broker, rt, WorkerApp(rt)
+
+
+def test_alo_redelivery_keeps_original_carriage_trace_id(tmp_path):
+    """A frame batch delivered WITHOUT a trace header (the header-less
+    shm-ring posture) anchors its trace on the APC1 carriage tid; a
+    broker redelivery of the same batch is deduped whole, so the trace
+    never splits into a second id."""
+    get_tracer().configure(sample_rate=1, ring_size=4096)
+    broker, rt, worker = _alo_worker(tmp_path)
+    try:
+        base = 170_300_000
+        lines = [f"tx|jvm0|svc{i % 4:02d}|a{i}|1|{base * 10000 - 100}|"
+                 f"{base * 10000 + i}|{100 + i}|Y" for i in range(8)]
+        blob = frames.append_carriage(
+            frames.encode_lines(lines), time.time(),
+            [i for i in range(len(lines))], "t-carried-1")
+        ch = MemoryChannel(broker)
+        assert ch.send("transactions", blob, headers={"msg_id": "m-frame-1"})
+        broker.pump()
+        worker.drain_delivery_pending()
+        worker.save_state()  # epoch commit acks the delivery
+
+        feed = [s for s in get_tracer().ring.spans() if s["name"] == "feed"]
+        assert feed and all(s["trace_id"] == "t-carried-1" for s in feed)
+        n_feed = len(feed)
+
+        # redeliver the SAME batch (crash-before-ack shape): the dedup
+        # window drops it whole — no second feed span, no new trace_id
+        assert ch.send("transactions", blob,
+                       headers={"msg_id": "m-frame-1", "redelivered": True})
+        broker.pump()
+        worker.drain_delivery_pending()
+        feed2 = [s for s in get_tracer().ring.spans() if s["name"] == "feed"]
+        assert len(feed2) == n_feed
+        assert {s["trace_id"] for s in feed2} == {"t-carried-1"}
+        assert worker._deduped_total == 1
+    finally:
+        worker.shutdown()
+        rt.stop_timers()
+
+
+# -- qstat --lag over the shmring fabric ---------------------------------------
+
+
+def test_ring_stats_reads_header_without_creating(tmp_path):
+    from apmbackend_tpu.transport.shmring import ShmRingChannel, ring_stats
+
+    path = str(tmp_path / "transactions.ring")
+    assert ring_stats(path) is None  # absent: no file created
+    assert not os.path.exists(path)
+
+    ch = ShmRingChannel(str(tmp_path), ring_bytes=65536)
+    ch.assert_queue("transactions")
+    for i in range(5):
+        assert ch.send("transactions", f"l{i}".encode())
+    st = ring_stats(path)
+    assert st is not None
+    assert st["lag"] == 5 and st["msgs_in"] == 5 and st["msgs_out"] == 0
+    assert st["capacity"] > 0 and st["used_bytes"] > 0
+    ch.close()
+
+    # torn/garbage file: None, not an exception
+    with open(str(tmp_path / "bad.ring"), "wb") as fh:
+        fh.write(b"notaring")
+    assert ring_stats(str(tmp_path / "bad.ring")) is None
+
+
+def test_qstat_lag_shmring_backend(tmp_path, capsys, monkeypatch):
+    from apmbackend_tpu.tools import qstat
+    from apmbackend_tpu.transport.shmring import ShmRingChannel
+
+    ring_dir = str(tmp_path / "shmring")
+    ch = ShmRingChannel(ring_dir, ring_bytes=65536)
+    ch.assert_queue("transactions")
+    for i in range(7):
+        assert ch.send("transactions", f"l{i}".encode())
+    ch.close()
+
+    cfg = default_config()
+    cfg["brokerBackend"] = "shmring"
+    cfg["transport"] = {"shmRingDirectory": ring_dir}
+    observer, warning = qstat.make_lag_observer(cfg)
+    assert warning is None
+    rows = dict(qstat.lag_rows(observer, ["transactions", "db_insert"]))
+    # 7 pushed, none popped: header-counter lag; untouched queues read 0
+    # (the observer NEVER materializes a ring file for them)
+    assert rows["transactions"] == 7
+    assert rows["db_insert"] == 0
+    assert not os.path.exists(os.path.join(ring_dir, "db_insert.ring"))
+    observer.close()
+
+    # the CLI path renders the same table
+    monkeypatch.setattr("apmbackend_tpu.config.default_config", lambda: cfg)
+    assert qstat.main(["--lag"]) == 0
+    out = capsys.readouterr().out
+    assert "transactions" in out and "7" in out
+
+
+# -- flight recorder sources ---------------------------------------------------
+
+
+def test_flight_bundle_embeds_attribution_and_shmring(tmp_path):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+    from apmbackend_tpu.transport.shmring import ShmRingChannel
+
+    ring_dir = str(tmp_path / "shmring")
+    ch = ShmRingChannel(ring_dir, ring_bytes=65536)
+    ch.assert_queue("transactions")
+    assert ch.send("transactions", b"x")
+    ch.close()
+
+    get_attrib().clock("worker_feed").add_busy(0.05)
+    cfg = default_config()
+    cfg["logDir"] = None
+    cfg["brokerBackend"] = "shmring"
+    cfg["transport"] = {"shmRingDirectory": ring_dir}
+    cfg["observability"]["flightDir"] = str(tmp_path / "flight")
+    cfg["tpuEngine"]["metricsPort"] = 0
+    runtime = ModuleRuntime(
+        "tpuEngine", config=cfg, broker=MemoryBroker(),
+        install_signals=False, console_log=False,
+    )
+    try:
+        snap = runtime.flight.snapshot("test")
+        att = snap["attribution"]
+        assert att["stages"]["worker_feed"]["busy_s"] == pytest.approx(0.05)
+        assert "estimate" in att
+        assert snap["shmring"]["transactions"]["lag"] == 1
+    finally:
+        runtime.stop_timers()
+
+
+def test_flight_shmring_source_empty_for_other_backends(tmp_path):
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    cfg = default_config()  # memory backend
+    cfg["logDir"] = None
+    cfg["observability"]["flightDir"] = str(tmp_path / "flight")
+    cfg["tpuEngine"]["metricsPort"] = 0
+    runtime = ModuleRuntime(
+        "tpuEngine", config=cfg, broker=MemoryBroker(),
+        install_signals=False, console_log=False,
+    )
+    try:
+        snap = runtime.flight.snapshot("test")
+        assert snap["shmring"] == {}
+        assert "attribution" in snap
+    finally:
+        runtime.stop_timers()
+
+
+# -- frames-on e2e regressions -------------------------------------------------
+
+
+def test_frames_on_replay_stitches_trace_and_fills_e2e_histograms(tmp_path):
+    """ISSUE 17 regression: with transport.frameMode ON, a replayed stream
+    still produces (a) a stitched ingest->...->tick->emit trace (the tid
+    rides the APC1 carriage + headers) and (b) a POPULATED
+    apm_e2e_ingest_to_emit_seconds histogram — before the carriage, frame
+    batches carried no per-record stamps and both signals went dark."""
+    from apmbackend_tpu.ingest.replay import write_fixture_logs
+    from apmbackend_tpu.standalone import StandalonePipeline
+    from tests.test_standalone import small_config
+
+    logs = tmp_path / "fixture_logs"
+    write_fixture_logs(str(logs), n_transactions=200, seed=13)
+    cfg = small_config(tmp_path, metricsPort=0)
+    cfg["transport"]["frameMode"] = True
+    cfg["observability"]["traceSampleRate"] = 1
+    cfg["observability"]["traceRingSize"] = 16384
+
+    pipe = StandalonePipeline(config=cfg, tail=False, install_signals=False)
+    try:
+        fed = pipe.replay(str(logs))
+        assert fed > 0
+        status, text = fetch(f"{pipe.lead.telemetry.url}/metrics")
+        assert status == 200
+        s = samples_by_name(text)
+        assert s["apm_frames_emitted_total"][0][1] > 0  # frame mode was live
+        assert s["apm_e2e_ingest_to_emit_seconds_count"][0][1] > 0
+
+        by_trace = {}
+        for span in get_tracer().ring.spans():
+            by_trace.setdefault(span["trace_id"], set()).add(span["name"])
+        stitched = [names for names in by_trace.values()
+                    if {"ingest", "feed", "tick", "emit"} <= names]
+        assert stitched, by_trace
+
+        # the attribution plane saw the replay: parser + tick stages have
+        # busy seconds on the process table
+        stages = get_attrib().stage_table()
+        assert stages.get("parser_scan", {}).get("busy_s", 0) > 0
+        assert stages.get("tick_dispatch", {}).get("busy_s", 0) > 0
+    finally:
+        pipe.shutdown()
