@@ -47,12 +47,20 @@ from .shardmodel import ShardedEpochModel
 # means "at these bounds": N messages, window W, fault budgets per run.
 SCOPES = {
     "small": [
-        # ~15k states total, well under a second — the --lint gate
+        # ~58k states total, ~2 s — the --lint gate (hard 15 s budget in
+        # tests/test_protocol_models.py, sized for full-suite contention)
         lambda: AloModel(kind="memory"),
         lambda: AloModel(kind="amqp"),
         lambda: AloModel(kind="spool"),
         lambda: DeltaChainModel(),
         lambda: ShardedEpochModel(),
+        # the automatic-rebalance policy as a transition system: moves
+        # chosen by watermark state over a P > N keyspace, release/adopt/
+        # abort handoff in flight — certifies fleet-exactly-once +
+        # owner-locality + bounded-consecutive-moves for the controller
+        lambda: ShardedEpochModel(n_shards=2, n_partitions=4, n_msgs=3,
+                                  crashes=1, bounces=0, dups=1,
+                                  rebalances=2, policy=True),
     ],
     "deep": [
         # minutes-scale exhaustive sweep — the --model tier
@@ -67,6 +75,12 @@ SCOPES = {
                                   rebalances=2),
         lambda: ShardedEpochModel(n_shards=3, n_msgs=3, crashes=1,
                                   bounces=1, dups=1, rebalances=1),
+        lambda: ShardedEpochModel(n_shards=2, n_partitions=4, n_msgs=3,
+                                  crashes=1, bounces=1, dups=1,
+                                  rebalances=2, policy=True),
+        lambda: ShardedEpochModel(n_shards=3, n_partitions=6, n_msgs=4,
+                                  crashes=1, bounces=0, dups=1,
+                                  rebalances=2, policy=True),
     ],
 }
 

@@ -274,8 +274,9 @@ def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
       (the implementation rolls those effects back at recovery, proven
       bit-identical by the chaos tier).
     - **owner-locality of consumption**: a shard only takes deliveries
-      from partition queues it currently owns — initially the identity
-      map, then per completed ``handoff_import``.
+      from partition queues it currently owns — initially the striped map
+      ``p % n_shards`` (identity when ``n_shards`` is not given, the
+      legacy P == N call shape), then per completed ``handoff_import``.
     - **quiesced handoff pairing**: every ``handoff_import`` matches the
       latest ``handoff_export`` of that partition (same id set), nothing
       consumes the partition queue between the two, and exports state
@@ -294,6 +295,10 @@ def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
     committed: Dict[str, int] = {}  # msg -> shard whose effect is durable
     provisional: Dict[int, set] = {}  # shard -> absorbed-not-yet-committed
 
+    def boot_owner(p: int) -> int:
+        # the worker's fresh-boot striping (worker._initial_partitions)
+        return p % n_shards if n_shards else p
+
     def partition_of(queue: Optional[str]) -> Optional[int]:
         prefix = f"{base}.p"
         if not queue or not queue.startswith(prefix):
@@ -307,7 +312,7 @@ def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
         if kind == "deliver":
             p = partition_of(ev.get("queue"))
             if p is not None:
-                cur = owner.get(p, p)  # identity map until a handoff lands
+                cur = owner.get(p, boot_owner(p))  # striped until a handoff lands
                 if p in in_flight:
                     bad(i, ev, f"delivery from q.p{p} during its handoff "
                                f"window (released, not yet adopted)")
@@ -333,8 +338,9 @@ def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
             ids = frozenset(ev.get("ids") or ())
             if int(ev.get("unacked", 0)) != 0:
                 bad(i, ev, f"export of p{p} with a non-empty unacked ledger")
-            if owner.get(p, p) != sh:
-                bad(i, ev, f"s{sh} exported p{p} owned by s{owner.get(p, p)}")
+            if owner.get(p, boot_owner(p)) != sh:
+                bad(i, ev, f"s{sh} exported p{p} owned by "
+                           f"s{owner.get(p, boot_owner(p))}")
             in_flight[p] = (sh, ids)
         elif kind == "handoff_import":
             p = int(ev.get("partition", -1))
@@ -358,5 +364,5 @@ def check_fleet_trace(events: List[dict], *, n_shards: Optional[int] = None,
             # adopter rolled back: ownership stays in flight (controller
             # must retry adopt); re-arm the export record
             ids = frozenset(ev.get("ids") or ())
-            in_flight[p] = (owner.get(p, p), ids)
+            in_flight[p] = (owner.get(p, boot_owner(p)), ids)
     return out

@@ -140,6 +140,28 @@ MUTANTS: Dict[str, Tuple[str, Callable[[], object]]] = {
         "mismatches instead of absorbing them)",
         lambda: ShardedEpochModel(mutations=("partition_header_mismatch",)),
     ),
+    "shard-rebalance-storm": (
+        "the automatic rebalance controller has NO cooldown — it issues "
+        "a second move off the SAME stale metrics scrape, moving load "
+        "away from a donor that its own first move already fixed: "
+        "unbounded consecutive moves, the fleet churns instead of "
+        "converging (why rebalancer.decide enforces one move per "
+        "cooldown window)",
+        lambda: ShardedEpochModel(
+            n_partitions=4, crashes=1, bounces=0, dups=0, rebalances=2,
+            policy=True, mutations=("rebalance_storm",)),
+    ),
+    "shard-rebalance-oscillation": (
+        "the automatic rebalance controller has NO hysteresis — the "
+        "watermark band admits zero-improvement moves and a just-moved "
+        "partition immediately re-qualifies, so one hot partition "
+        "ping-pongs between two shards forever (why rebalancer.decide "
+        "requires the gap to STRICTLY exceed the moved load, and blocks "
+        "re-moving a partition until its queue is touched again)",
+        lambda: ShardedEpochModel(
+            n_partitions=4, crashes=1, bounces=0, dups=0, rebalances=2,
+            policy=True, mutations=("rebalance_oscillation",)),
+    ),
 }
 
 # Proven-indistinguishable variants (see module docstring): these MUST

@@ -1,18 +1,23 @@
 """Model of pod-scale sharded epochs — the ROADMAP spine, pre-verified.
 
 N workers consume service-hash partitions of the ``transactions`` queue
-(the producer shards by service key; one transport queue per partition),
-each running its OWN at-least-once epoch cycle with a per-shard dedup
-window and per-shard delta chain. The fleet-level invariant the pod-scale
-item needs certified before it is built:
+(the producer shards by service key; one transport queue per partition —
+P >= N partitions, striped ``p % N`` at boot, so a rebalance moves a fine
+grain instead of half a shard's keyspace), each running its OWN
+at-least-once epoch cycle with a per-shard dedup window and per-shard
+delta chain. The fleet-level invariants certified before the code ships:
 
 - **fleet-exactly-once**: every message's effect lands in durable state
   exactly once across ALL shards (a per-shard dedup window cannot see
   another shard's absorbs — routing discipline is what keeps the windows
-  sufficient);
+  sufficient); a handoff file in flight counts as a durable location.
 - **owner-locality** (at quiescence): the effect lives on the shard that
   owns the message's partition under the final map — reads/serving hit
   the owner, so an effect stranded on a previous owner is a lost write.
+- **bounded-consecutive-moves** (policy mode): the controller never
+  issues two moves off one stale scrape (a rebalance storm), and a moved
+  partition never immediately returns to the shard it just left (a
+  rebalance oscillation / ping-pong).
 
 The per-shard cycle is deliberately coarser than alo.py (atomic
 persist+ack commit, no feed buffer): those interleavings are verified
@@ -23,6 +28,27 @@ per-shard chain manifests enable (parallel/checkpoint.py orbax meta):
 wait until a has NO unacked deliveries, then move p's ownership together
 with its dedup-window entries and its rows of durable/volatile state.
 
+**Policy mode** (``policy=True``) replaces the oracle rebalance with the
+automatic controller of ``parallel/rebalancer.py`` as a transition
+system — moves are CHOSEN by watermark state, not by an adversary:
+
+- ``scrape``: the controller refreshes its view — per-partition loads
+  plus the partition→shard attribution AS OF the scrape (metrics are a
+  snapshot; the controller's world is always slightly stale).
+- ``release(p: a->b)``: fires only when the VIEW says donor load >= the
+  high watermark, recipient load <= the low watermark, the gap STRICTLY
+  exceeds the moved partition's load (the hysteresis band: the move must
+  strictly improve balance), the partition is re-armed (it has not moved
+  since its queue was last touched — the per-partition move budget), and
+  the cooldown window has passed (at most one move per scrape). The
+  release exports p's rows + window into an in-flight handoff record and
+  drops them from the donor (release commit) — NOBODY owns p's queue
+  until the adopt or abort lands.
+- ``adopt``: the recipient imports the in-flight record (import commit).
+- ``abort``: the adopter never saw the handoff file — the releaser
+  re-adopts its OWN export (the controller's abort path); ownership and
+  state return to the donor, the record is garbage.
+
 Mutations: ``rebalance_mid_epoch`` (ownership moves while deliveries are
 in flight, no handoff — the original shard absorbs and commits a message
 whose redelivery the new owner also absorbs), ``rebalance_drops_window``
@@ -30,20 +56,32 @@ whose redelivery the new owner also absorbs), ``rebalance_drops_window``
 look fresh to the new owner), ``partition_header_mismatch`` (the producer
 stamps/routes by a wrong partition hash — one drifted partitioner build
 in a fleet — so a message lands on a queue whose owner is not the
-service's owner; its effect strands off-owner and serving reads miss it).
+service's owner; its effect strands off-owner and serving reads miss it),
+``rebalance_storm`` (policy mode: the cooldown is gone — the controller
+acts twice on ONE stale scrape, moving partitions off a donor that its
+own first move already fixed), ``rebalance_oscillation`` (policy mode:
+hysteresis is gone — the band admits zero-improvement moves and a
+just-moved partition immediately re-qualifies, so it ping-pongs between
+two shards forever).
 
-IMPLEMENTED by ``parallel/fleet.py`` + ``runtime/worker.py`` (PR 9), kept
-in sync per the README "verifying a protocol change" workflow: publish =
-``FleetPartitioner.write_line`` (stable FNV-1a ``service_partition``,
-partition id stamped in headers); the per-shard cycle = the fleet-mode
-``WorkerApp`` epoch cycle with per-queue ``_DedupWindow``s; the quiesced
-rebalance = ``WorkerApp.release_partition`` (pause → commit+ack until the
-ledger is empty → export rows+window → drop → release commit) then
+IMPLEMENTED by ``parallel/fleet.py`` + ``runtime/worker.py`` (PR 9) and
+``parallel/rebalancer.py`` (ISSUE 18), kept in sync per the README
+"verifying a protocol change" workflow: publish =
+``FleetPartitioner.write_line`` (stable FNV-1a ``service_partition`` over
+``fleet.partitions`` >= ``fleet.shards``, partition id stamped in
+headers); the per-shard cycle = the fleet-mode ``WorkerApp`` epoch cycle
+with per-queue ``_DedupWindow``s; the quiesced rebalance =
+``WorkerApp.release_partition`` (pause → commit+ack until the ledger is
+empty → export rows+window → drop → release commit) then
 ``WorkerApp.adopt_partition`` (import rows+window → import commit →
-consume), the two commits being the linearization points the model's
-atomic ``rebalance`` transition abstracts. The header-mismatch defense in
-``_consume_at_least_once`` (reject + count, never absorb) is why the
-mismatch mutant's violation cannot happen in the live fleet.
+consume), the two commits being the linearization points; the abort =
+the controller re-issuing the adopt TO THE RELEASER with its own export
+(``RebalanceController._abort_move``). The policy clauses map 1:1 onto
+``rebalancer.decide``: scrape = the controller's metrics read, the
+watermarks/band/cooldown/re-arm are ``fleet.rebalance.*`` config. The
+header-mismatch defense in ``_consume_at_least_once`` (reject + count,
+never absorb) is why the mismatch mutant's violation cannot happen in
+the live fleet.
 """
 
 from __future__ import annotations
@@ -51,57 +89,89 @@ from __future__ import annotations
 from collections import namedtuple
 from typing import Iterator, Optional, Tuple
 
-# pmap:    partition -> owning shard
+# pmap:    partition -> owning shard (-1 while in flight during a handoff)
 # queues:  per-partition FIFO of msg ids
 # ledgers: per-shard tuple of (gen, msg) unacked deliveries
 # gens:    per-shard broker connection generation
 # windows/pwindows: per-shard dedup windows (in-memory / persisted)
 # vol/dur: per-shard per-msg effect counts
 # crashes/bounces/dups/rebalances: remaining budgets
+# view:    controller's last-scraped per-partition loads (policy mode)
+# vmap:    partition->shard attribution AS OF that scrape (policy mode)
+# cool:    cooldown — scrapes required before the next move may fire
+# streak:  moves issued since the last scrape (storm detector)
+# lastmove: (p, frm, to) of the last policy move, (-1, -1, -1) when the
+#          moved partition's queue has been touched since (re-armed)
+# pingpong: latched True when a policy move exactly reverses lastmove
+# inflight: () or one (p, frm, to, win, pwin, rows) handoff record
 S = namedtuple(
     "S",
     "sent pmap queues ledgers gens windows pwindows tokens vol dur "
-    "crashes bounces dups rebalances",
+    "crashes bounces dups rebalances view vmap cool streak lastmove "
+    "pingpong inflight",
 )
 
 _MUTATIONS = frozenset({"rebalance_mid_epoch", "rebalance_drops_window",
-                        "partition_header_mismatch"})
+                        "partition_header_mismatch", "rebalance_storm",
+                        "rebalance_oscillation"})
+_POLICY_MUTATIONS = frozenset({"rebalance_storm", "rebalance_oscillation"})
+
+_NO_MOVE = (-1, -1, -1)
 
 
 class ShardedEpochModel:
     def __init__(self, *, n_shards: int = 2, n_msgs: int = 3,
+                 n_partitions: Optional[int] = None,
                  window: Optional[int] = None, crashes: int = 1,
                  bounces: int = 1, dups: int = 1, rebalances: int = 1,
+                 policy: bool = False, high: int = 1, low: int = 0,
+                 cooldown: int = 1,
                  mutations: Tuple[str, ...] = ()):
         bad = set(mutations) - _MUTATIONS
         if bad:
             raise ValueError(f"unknown mutations: {sorted(bad)}")
+        if set(mutations) & _POLICY_MUTATIONS and not policy:
+            raise ValueError(
+                "rebalance_storm/rebalance_oscillation are policy-mode "
+                "mutations (pass policy=True)")
         self.k = n_shards
         self.n = n_msgs
+        self.np = n_shards if n_partitions is None else n_partitions
+        if self.np < self.k:
+            raise ValueError("n_partitions must be >= n_shards")
         self.w = n_msgs if window is None else window
         self.crashes = crashes
         self.bounces = bounces
         self.dups = dups
         self.rebalances = rebalances
+        self.policy = policy
+        self.high = high
+        self.low = low
+        self.cooldown = cooldown
         self.mut = frozenset(mutations)
-        self.name = "sharded-epochs" + (
+        self.name = "sharded-epochs" + ("+policy" if policy else "") + (
             f"[{'+'.join(sorted(self.mut))}]" if self.mut else "")
         self.scope = {
-            "shards": n_shards, "msgs": n_msgs, "window": self.w,
-            "crashes": crashes, "bounces": bounces, "dups": dups,
-            "rebalances": rebalances,
+            "shards": n_shards, "partitions": self.np, "msgs": n_msgs,
+            "window": self.w, "crashes": crashes, "bounces": bounces,
+            "dups": dups, "rebalances": rebalances,
         }
+        if policy:
+            self.scope.update(policy=True, high=high, low=low,
+                              cooldown=cooldown)
 
     def part(self, m: int) -> int:
-        """The service-hash partition of message m."""
-        return m % self.k
+        """The service-hash partition of message m (P >= N partitions)."""
+        return m % self.np
 
     def initial(self) -> S:
         zrow = (0,) * self.n
         return S(
             sent=0,
-            pmap=tuple(range(self.k)),
-            queues=((),) * self.k,
+            # striped boot ownership: partition p belongs to shard p % N
+            # (identity when P == N) — worker._initial_partitions
+            pmap=tuple(p % self.k for p in range(self.np)),
+            queues=((),) * self.np,
             ledgers=((),) * self.k,
             gens=(0,) * self.k,
             windows=((),) * self.k,
@@ -111,6 +181,11 @@ class ShardedEpochModel:
             dur=(zrow,) * self.k,
             crashes=self.crashes, bounces=self.bounces, dups=self.dups,
             rebalances=self.rebalances,
+            view=(0,) * self.np if self.policy else (),
+            vmap=tuple(p % self.k for p in range(self.np))
+            if self.policy else (),
+            cool=0, streak=0, lastmove=_NO_MOVE, pingpong=False,
+            inflight=(),
         )
 
     # -- tuple surgery -------------------------------------------------------
@@ -122,6 +197,14 @@ class ShardedEpochModel:
     def _bump(cls, mat: tuple, sh: int, m: int) -> tuple:
         row = mat[sh]
         return cls._set(mat, sh, cls._set(row, m, min(2, row[m] + 1)))
+
+    def _rearm(self, s: S, p: int) -> S:
+        """Partition p's queue was touched (publish/deliver/requeue): the
+        controller's per-partition move budget re-arms — a later move of p
+        is adaptation to new load, not oscillation."""
+        if s.lastmove != _NO_MOVE and s.lastmove[0] == p:
+            return s._replace(lastmove=_NO_MOVE)
+        return s
 
     def _receive(self, s: S, sh: int, m: int, token) -> S:
         """Delivery (or chaos dup) reaching shard ``sh``'s worker."""
@@ -149,11 +232,169 @@ class ShardedEpochModel:
         for _g, m in reversed(s.ledgers[sh]):
             p = self.part(m)
             queues[p] = (m,) + queues[p]
+            s = self._rearm(s, p)
         return s._replace(
             queues=tuple(queues),
             ledgers=self._set(s.ledgers, sh, ()),
             gens=self._set(s.gens, sh, s.gens[sh] + 1),
         )
+
+    def _move_state(self, s: S, p: int, a: int, b: int,
+                    drop_window: bool = False) -> S:
+        """Atomic quiesced handoff of partition p's window entries + state
+        rows from shard a to b (the oracle transition's body; the policy
+        path splits it into release/adopt with an in-flight record)."""
+        ns = s._replace(pmap=self._set(s.pmap, p, b))
+        if not drop_window:
+            moved = tuple(m for m in s.windows[a] if self.part(m) == p)
+            kept = tuple(m for m in s.windows[a] if self.part(m) != p)
+            ns = ns._replace(
+                windows=self._set(
+                    self._set(ns.windows, a, kept),
+                    b, ns.windows[b] + moved))
+            pmoved = tuple(m for m in s.pwindows[a] if self.part(m) == p)
+            pkept = tuple(m for m in s.pwindows[a] if self.part(m) != p)
+            ns = ns._replace(
+                pwindows=self._set(
+                    self._set(ns.pwindows, a, pkept),
+                    b, ns.pwindows[b] + pmoved))
+        # state-row handoff (vol == dur for p's msgs after quiesce; move
+        # both so restores stay consistent)
+        vol, dur = ns.vol, ns.dur
+        for m in range(self.n):
+            if self.part(m) != p:
+                continue
+            for mat_name in ("vol", "dur"):
+                mat = vol if mat_name == "vol" else dur
+                moved_v = min(2, mat[b][m] + mat[a][m])
+                mat = self._set(mat, b, self._set(mat[b], m, moved_v))
+                mat = self._set(mat, a, self._set(mat[a], m, 0))
+                if mat_name == "vol":
+                    vol = mat
+                else:
+                    dur = mat
+        return ns._replace(vol=vol, dur=dur)
+
+    # -- policy helpers ------------------------------------------------------
+    def _scraped_loads(self, s: S) -> tuple:
+        return tuple(len(q) for q in s.queues)
+
+    def _view_load(self, s: S, sh: int) -> int:
+        """Shard sh's load AS THE CONTROLLER SEES IT: stale per-partition
+        loads attributed by the stale ownership map — exactly what a
+        /metrics scrape yields (rebalancer.observe_fleet)."""
+        return sum(s.view[p] for p in range(self.np) if s.vmap[p] == sh)
+
+    def _policy_actions(self, s: S, out) -> None:
+        # scrape: refresh the view (loads + attribution), tick the
+        # cooldown down, reset the per-scrape move streak
+        loads = self._scraped_loads(s)
+        ns = s._replace(view=loads, vmap=s.pmap, cool=max(0, s.cool - 1),
+                        streak=0)
+        if ns != s:
+            out.append(("scrape", ns))
+
+        storm = "rebalance_storm" in self.mut
+        wobble = "rebalance_oscillation" in self.mut
+
+        # adopt / abort of the in-flight handoff record
+        if s.inflight:
+            p, a, b, win, pwin, rows = s.inflight
+            ns = s._replace(pmap=self._set(s.pmap, p, b), inflight=())
+            ns = ns._replace(
+                windows=self._set(ns.windows, b, ns.windows[b] + win),
+                pwindows=self._set(ns.pwindows, b, ns.pwindows[b] + pwin))
+            vol, dur = ns.vol, ns.dur
+            for m, cnt in rows:
+                vol = self._set(
+                    vol, b, self._set(vol[b], m, min(2, vol[b][m] + cnt)))
+                dur = self._set(
+                    dur, b, self._set(dur[b], m, min(2, dur[b][m] + cnt)))
+            out.append((f"adopt(q{p}->s{b})", ns._replace(vol=vol, dur=dur)))
+            # abort: the adopter never saw the file — the RELEASER
+            # re-adopts its own export; ownership returns to the donor
+            ns = s._replace(pmap=self._set(s.pmap, p, a), inflight=())
+            ns = ns._replace(
+                windows=self._set(ns.windows, a, ns.windows[a] + win),
+                pwindows=self._set(ns.pwindows, a, ns.pwindows[a] + pwin))
+            vol, dur = ns.vol, ns.dur
+            for m, cnt in rows:
+                vol = self._set(
+                    vol, a, self._set(vol[a], m, min(2, vol[a][m] + cnt)))
+                dur = self._set(
+                    dur, a, self._set(dur[a], m, min(2, dur[a][m] + cnt)))
+            out.append((f"abort(q{p}->s{a})",
+                        ns._replace(vol=vol, dur=dur)))
+            return  # one move at a time: no new release while in flight
+
+        if s.rebalances <= 0:
+            return
+        if s.cool > 0 and not storm:
+            return  # cooldown: at most one move per scrape window
+        for p in range(self.np):
+            a = s.pmap[p]
+            if a < 0 or s.vmap[p] != a:
+                continue  # controller's stale owner is wrong: release fails
+            if s.ledgers[a]:
+                continue  # release quiesces first (worker-side protocol)
+            lp = s.view[p]
+            if lp < 1:
+                continue
+            if not wobble and s.lastmove != _NO_MOVE and s.lastmove[0] == p:
+                continue  # hysteresis re-arm: p moved and was not touched
+            va = self._view_load(s, a)
+            if va < self.high:
+                continue
+            for b in range(self.k):
+                if b == a:
+                    continue
+                vb = self._view_load(s, b)
+                if vb > self.low:
+                    continue
+                gap = va - vb
+                # hysteresis band: the move must STRICTLY improve the
+                # balance; the oscillation mutant admits the equality
+                # case, where the move just relocates the imbalance
+                if (gap >= lp) if wobble else (gap > lp):
+                    # the releaser QUIESCES first: save_state until
+                    # nothing is pending — a commit (dur:=vol, window
+                    # persisted, tokens acked) happens INSIDE the
+                    # release, so uncommitted volatile effects travel
+                    # with the export instead of stranding on the donor
+                    sa = s._replace(
+                        dur=self._set(s.dur, a, s.vol[a]),
+                        pwindows=self._set(s.pwindows, a, s.windows[a]),
+                        tokens=self._set(s.tokens, a, ()))
+                    win = tuple(m for m in sa.windows[a]
+                                if self.part(m) == p)
+                    kept = tuple(m for m in sa.windows[a]
+                                 if self.part(m) != p)
+                    pwin = tuple(m for m in sa.pwindows[a]
+                                 if self.part(m) == p)
+                    pkept = tuple(m for m in sa.pwindows[a]
+                                  if self.part(m) != p)
+                    rows = tuple(
+                        (m, sa.dur[a][m]) for m in range(self.n)
+                        if self.part(m) == p and sa.dur[a][m])
+                    vol, dur = sa.vol, sa.dur
+                    for m, _c in rows:
+                        vol = self._set(
+                            vol, a, self._set(vol[a], m, 0))
+                        dur = self._set(
+                            dur, a, self._set(dur[a], m, 0))
+                    ns = sa._replace(
+                        rebalances=s.rebalances - 1,
+                        pmap=self._set(s.pmap, p, -1),
+                        windows=self._set(s.windows, a, kept),
+                        pwindows=self._set(s.pwindows, a, pkept),
+                        vol=vol, dur=dur,
+                        inflight=(p, a, b, win, pwin, rows),
+                        cool=0 if storm else self.cooldown,
+                        streak=s.streak + 1,
+                        pingpong=s.pingpong or s.lastmove == (p, b, a),
+                        lastmove=(p, a, b),
+                    )
+                    out.append((f"release(q{p}:s{a}->s{b})", ns))
 
     # -- transition relation -------------------------------------------------
     def actions(self, s: S) -> Iterator[Tuple[str, S]]:
@@ -165,20 +406,22 @@ class ShardedEpochModel:
                 # a drifted producer stamps (and therefore routes by) the
                 # wrong partition: the message reaches a queue whose owner
                 # is NOT the owner of the service's real partition
-                p = (p + 1) % self.k
-            out.append((f"publish(m{m}->q{p})", s._replace(
+                p = (p + 1) % self.np
+            ns = self._rearm(s, p)
+            out.append((f"publish(m{m}->q{p})", ns._replace(
                 sent=s.sent + 1,
                 queues=self._set(s.queues, p, s.queues[p] + (m,)))))
 
         for sh in range(self.k):
             # deliver: shard sh pops the front of a partition queue it owns
             if len(s.ledgers[sh]) < self.w:
-                for p in range(self.k):
+                for p in range(self.np):
                     if s.pmap[p] != sh or not s.queues[p]:
                         continue
                     m, rest = s.queues[p][0], s.queues[p][1:]
                     token = (s.gens[sh], m)
-                    ns = s._replace(
+                    ns = self._rearm(s, p)
+                    ns = ns._replace(
                         queues=self._set(s.queues, p, rest),
                         ledgers=self._set(s.ledgers, sh, s.ledgers[sh] + (token,)))
                     out.append((f"deliver(m{m}->s{sh})",
@@ -220,12 +463,18 @@ class ShardedEpochModel:
                 ns = self._requeue_shard(ns, sh)
             out.append(("bounce", ns))
 
+        if self.policy:
+            # the watermark controller chooses the moves (release/adopt/
+            # abort + scrape); the oracle transition below is disabled
+            self._policy_actions(s, out)
+            return out
+
         # rebalance: partition p moves a -> b. The CORRECT protocol is a
         # quiesced handoff: a has nothing unacked, and p's dedup-window
         # entries + state rows move with the ownership (per-shard chain
         # manifest handoff). The mutants break exactly those two clauses.
         if s.rebalances > 0:
-            for p in range(self.k):
+            for p in range(self.np):
                 a = s.pmap[p]
                 for b in range(self.k):
                     if b == a:
@@ -233,41 +482,13 @@ class ShardedEpochModel:
                     mid_epoch = "rebalance_mid_epoch" in self.mut
                     if s.ledgers[a] and not mid_epoch:
                         continue  # not quiesced: handoff must wait
-                    ns = s._replace(
-                        rebalances=s.rebalances - 1,
-                        pmap=self._set(s.pmap, p, b))
-                    if not mid_epoch and "rebalance_drops_window" not in self.mut:
-                        moved = tuple(m for m in s.windows[a] if self.part(m) == p)
-                        kept = tuple(m for m in s.windows[a] if self.part(m) != p)
-                        ns = ns._replace(
-                            windows=self._set(
-                                self._set(ns.windows, a, kept),
-                                b, ns.windows[b] + moved))
-                        pmoved = tuple(m for m in s.pwindows[a] if self.part(m) == p)
-                        pkept = tuple(m for m in s.pwindows[a] if self.part(m) != p)
-                        ns = ns._replace(
-                            pwindows=self._set(
-                                self._set(ns.pwindows, a, pkept),
-                                b, ns.pwindows[b] + pmoved))
-                    if not mid_epoch:
-                        # state-row handoff (vol == dur for p's msgs after
-                        # quiesce; move both so restores stay consistent)
-                        vol, dur = ns.vol, ns.dur
-                        for m in range(self.n):
-                            if self.part(m) != p:
-                                continue
-                            for mat_name in ("vol", "dur"):
-                                mat = vol if mat_name == "vol" else dur
-                                moved_v = min(2, mat[b][m] + mat[a][m])
-                                mat = self._set(
-                                    mat, b, self._set(mat[b], m, moved_v))
-                                mat = self._set(
-                                    mat, a, self._set(mat[a], m, 0))
-                                if mat_name == "vol":
-                                    vol = mat
-                                else:
-                                    dur = mat
-                        ns = ns._replace(vol=vol, dur=dur)
+                    ns = s._replace(rebalances=s.rebalances - 1)
+                    if mid_epoch:
+                        ns = ns._replace(pmap=self._set(ns.pmap, p, b))
+                    else:
+                        ns = self._move_state(
+                            ns, p, a, b,
+                            drop_window="rebalance_drops_window" in self.mut)
                     out.append((f"rebalance(q{p}:s{a}->s{b})", ns))
         return out
 
@@ -275,11 +496,23 @@ class ShardedEpochModel:
     def invariant(self, s: S) -> Optional[str]:
         for m in range(self.n):
             total = sum(s.dur[sh][m] for sh in range(self.k))
+            if s.inflight:
+                total += sum(c for mm, c in s.inflight[5] if mm == m)
             if total >= 2:
                 where = ",".join(
                     f"s{sh}" for sh in range(self.k) if s.dur[sh][m])
                 return (f"m{m} effected {total}x across shards [{where}] "
                         f"(fleet exactly-once violated)")
+        if self.policy:
+            if s.streak > 1:
+                return (f"{s.streak} consecutive moves off ONE stale "
+                        f"scrape (rebalance storm: bounded-consecutive-"
+                        f"moves violated — no cooldown between decisions)")
+            if s.pingpong:
+                p, a, b = s.lastmove
+                return (f"partition q{p} ping-ponged straight back "
+                        f"s{a}->s{b} with its queue untouched (rebalance "
+                        f"oscillation: hysteresis violated)")
         # owner-locality at quiescence: everything delivered, absorbed,
         # committed and acked — effects must sit on the owning shard
         quiescent = (
@@ -287,6 +520,7 @@ class ShardedEpochModel:
             and not any(s.queues) and not any(s.ledgers)
             and not any(s.tokens)
             and s.vol == s.dur
+            and not s.inflight
         )
         if quiescent:
             for m in range(self.n):
@@ -303,11 +537,19 @@ class ShardedEpochModel:
 
     def describe(self, s: S) -> str:
         qs = " ".join(
-            f"q{p}[{','.join(f'm{m}' for m in q)}]->s{s.pmap[p]}"
+            f"q{p}[{','.join(f'm{m}' for m in q)}]->"
+            f"{'~' if s.pmap[p] < 0 else f's{s.pmap[p]}'}"
             for p, q in enumerate(s.queues))
         shards = " ".join(
             f"s{sh}(led={len(s.ledgers[sh])} win=[{','.join(f'm{m}' for m in s.windows[sh])}] "
             f"vol={''.join(str(c) for c in s.vol[sh])} "
             f"dur={''.join(str(c) for c in s.dur[sh])})"
             for sh in range(self.k))
-        return f"sent={s.sent} {qs} {shards}"
+        pol = ""
+        if self.policy:
+            pol = (f" view={','.join(str(v) for v in s.view)} "
+                   f"cool={s.cool} streak={s.streak}")
+            if s.inflight:
+                p, a, b = s.inflight[:3]
+                pol += f" inflight(q{p}:s{a}->s{b})"
+        return f"sent={s.sent} {qs} {shards}{pol}"

@@ -558,11 +558,43 @@ _DEFAULT_CONFIG: dict = {
     # ownership of a partition moves only with an empty unacked ledger and
     # carries the partition queue's dedup-window ids + the partition's
     # state rows (WorkerApp.release_partition / adopt_partition).
+    # partitions decouples the keyspace grain from the process count
+    # (P >= N): 0 means auto (4x shards, min 1 per shard), so a rebalance
+    # moves a fine slice instead of half a shard's keyspace. Boot
+    # ownership is striped (partition p -> shard p % N). controlDir, when
+    # set, makes each fleet worker poll a durable per-shard control file
+    # (shard<k>.ctl.json, tmp+rename, seq-numbered) for release/adopt
+    # commands — the channel the rebalance controller drives; commands
+    # survive kill -9 of either side and are re-executed on restart.
+    # rebalance.* is the automatic controller policy (parallel/
+    # rebalancer.py, pre-verified as a transition system in
+    # analysis/protocol/shardmodel.py policy mode): enabled freezes/
+    # unfreezes the whole plane (moves stop, observation continues);
+    # highWatermark/lowWatermark bound donor/recipient lag (messages) for
+    # a move to qualify; the hysteresis band requires the donor-recipient
+    # gap to STRICTLY exceed the moved partition's lag; cooldownSeconds
+    # enforces at most one move per window (the anti-storm clause);
+    # movesPerPartition is the per-partition budget between touches (the
+    # anti-oscillation clause); intervalSeconds is the observe/decide
+    # cadence; moveTimeoutSeconds bounds one release->adopt handoff
+    # before the controller aborts it (the releaser re-adopts its own
+    # export).
     "fleet": {
         "shards": 0,
+        "partitions": 0,
         "partitionKey": "service",
         "shardId": None,
         "epochStallSeconds": 300.0,
+        "controlDir": None,
+        "rebalance": {
+            "enabled": False,
+            "highWatermark": 64,
+            "lowWatermark": 16,
+            "cooldownSeconds": 30.0,
+            "movesPerPartition": 1,
+            "intervalSeconds": 5.0,
+            "moveTimeoutSeconds": 60.0,
+        },
     },
     # TPU-native engine settings (no reference equivalent: this is the device
     # configuration for the batched step function that replaces the per-message

@@ -28,7 +28,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..integrations import EmailSender, GrafanaClient
 from ..utils.counters import capped_append
@@ -501,6 +501,52 @@ class ManagerApp:
                 if self.slo is not None:
                     runtime.flight.add_source("slo", lambda: self.slo.status())
 
+        # -- ISSUE 18: the self-managing fleet (automatic rebalance) ---------
+        # fleet.rebalance.enabled + fleet.controlDir turn the supervisor
+        # into the rebalance controller: observe per-partition lag off the
+        # shard scrapes (plus SLO fast-burn state), run the pure watermark
+        # policy, and execute at most one verified release→adopt move per
+        # cooldown window through the durable control-file channel. First
+        # tick runs recover() — a controller that died mid-move resolves
+        # its own wreckage before making new decisions. Freeze switch:
+        # set fleet.rebalance.enabled false and reload.
+        self.rebalancer = None
+        self._rebalance_recovered = False
+        fleet_cfg = config.get("fleet", {}) or {}
+        rb_cfg = dict(fleet_cfg.get("rebalance", {}) or {})
+        ctl_dir = fleet_cfg.get("controlDir")
+        shard_mods = self._fleet_shard_modules()
+        if bool(rb_cfg.get("enabled")) and ctl_dir and len(shard_mods) >= 2:
+            from ..parallel.rebalancer import CtlPeer, RebalanceController
+
+            os.makedirs(str(ctl_dir), exist_ok=True)
+            peers = {
+                k: CtlPeer(
+                    os.path.join(str(ctl_dir), f"shard{k}.ctl.json"),
+                    alive=(lambda m: lambda: m.proc is not None
+                           and m.proc.poll() is None)(mod),
+                )
+                for k, mod in shard_mods.items()
+            }
+            self.rebalancer = RebalanceController(
+                str(ctl_dir), peers, self._rebalance_observation, rb_cfg,
+                logger=logger,
+            )
+            reg.add_collector(self.rebalancer.collect_metrics)
+            runtime.every(
+                max(0.1, float(rb_cfg.get("intervalSeconds", 5.0))),
+                self._rebalance_tick, name="rebalance",
+            )
+            if getattr(runtime, "flight", None) is not None:
+                runtime.flight.add_source(
+                    "rebalance",
+                    lambda: {"moves": self.rebalancer.moves_total,
+                             "aborts": self.rebalancer.aborts_total,
+                             "skipped_cooldown":
+                                 self.rebalancer.skipped_cooldown_total,
+                             "stale_gc":
+                                 self.rebalancer.stale_handoffs_gc_total})
+
         if spawn_children:
             self.annotate("Restarting all modules")
             for mod in self.modules:
@@ -661,6 +707,69 @@ class ManagerApp:
         except Exception:
             return None
 
+    # -- automatic rebalance (ISSUE 18) ---------------------------------------
+    def _fleet_shard_modules(self) -> Dict[int, object]:
+        """{shard_id: ModuleProc} for the sharded worker children — the
+        shard id rides each child's APM_SHARD_ID (expand_module_settings
+        stamped it; the worker derived its partition set from it)."""
+        out = {}
+        for mod in self.modules:
+            sid = (mod.extra_env or {}).get("APM_SHARD_ID")
+            if sid is not None:
+                out[int(sid)] = mod
+        return out
+
+    def _shard_scrapes(self, timeout_s: float = 2.0) -> Dict[int, str]:
+        """{shard_id: raw /metrics body} for every live shard child. A
+        dead shard contributes nothing — its partitions drop out of the
+        attribution, which is exactly what the controller must see (it
+        cannot move what nobody reports owning)."""
+        import urllib.request
+
+        host = str(self.runtime.config.get("observability", {})
+                   .get("metricsHost", "127.0.0.1"))
+        out = {}
+        for k, mod in self._fleet_shard_modules().items():
+            port = mod.setting.get("metricsPort")
+            if not port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://{host}:{int(port)}/metrics",
+                        timeout=timeout_s) as resp:
+                    out[k] = resp.read().decode("utf-8", "replace")
+            except Exception:
+                pass
+        return out
+
+    def _rebalance_observation(self):
+        """One controller scrape: per-partition lag + ownership
+        attribution off the shard exports (stale TOGETHER — the policy
+        model's view+vmap), and the SLO engine's fast-burning partitions
+        mapped to their owning shards."""
+        from ..obs.slo import burning_partitions
+        from ..parallel.rebalancer import observation_from_metrics
+
+        obs = observation_from_metrics(self._shard_scrapes())
+        if self.slo is not None:
+            burning = burning_partitions(self.slo.status().get("results"))
+            obs.burning = {obs.owners[p] for p in burning if p in obs.owners}
+        return obs
+
+    def _rebalance_tick(self) -> None:
+        """Timer body: recover leftovers once (retried until it lands —
+        shards may still be booting on the first passes), then one
+        observe → decide → execute pass. Never raises into the timer."""
+        if self.rebalancer is None:
+            return
+        try:
+            if not self._rebalance_recovered:
+                self.rebalancer.recover()
+                self._rebalance_recovered = True
+            self.rebalancer.tick()
+        except Exception as e:
+            self.runtime.logger.warning(f"rebalance tick failed: {e}")
+
     # -- fleet telemetry aggregation ------------------------------------------
     def _child_metrics_targets(self) -> List[tuple]:
         """[(name, url)] for children whose moduleSettings carry a
@@ -683,6 +792,9 @@ class ManagerApp:
 
         from ..obs import relabel_metrics
 
+        shard_names = {mod.name: k
+                       for k, mod in self._fleet_shard_modules().items()}
+        bodies: Dict[int, str] = {}
         parts = []
         for name, url in self._child_metrics_targets():
             up = 1
@@ -690,11 +802,26 @@ class ManagerApp:
                 with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
                     body = resp.read().decode("utf-8", "replace")
                 parts.append(relabel_metrics(body, {"module": name}))
+                if name in shard_names:
+                    bodies[shard_names[name]] = body
             except Exception:
                 up = 0
             parts.append(
                 f'# TYPE apm_fleet_child_up gauge\napm_fleet_child_up{{module="{name}"}} {up}\n'
             )
+        if bodies:
+            # the partition -> shard ownership map (ISSUE 18): derived from
+            # each shard's apm_partition_lag attribution, so /fleet answers
+            # "who serves partition K right now" without a control probe
+            from ..parallel.rebalancer import observation_from_metrics
+
+            obs = observation_from_metrics(bodies)
+            if obs.owners:
+                parts.append("# TYPE apm_fleet_partition_owner gauge\n")
+                for p in sorted(obs.owners):
+                    parts.append(
+                        f'apm_fleet_partition_owner{{partition="{p}"}} '
+                        f"{obs.owners[p]}\n")
         return "".join(parts)
 
     def _fleet_route(self, _query):

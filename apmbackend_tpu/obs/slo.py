@@ -79,7 +79,35 @@ DEFAULT_OBJECTIVES = [
         "threshold": 60.0,
         "target": 0.99,
     },
+    {
+        # the rebalance controller's emergency signal (ISSUE 18): one
+        # burn rate per KEYSPACE partition (P > N grain), fed by the
+        # worker's apm_partition_lag gauge. A fast burn here qualifies
+        # the owning shard as a rebalance donor even below the high
+        # watermark (parallel/rebalancer.py reads it via
+        # burning_partitions()).
+        "name": "partition_lag",
+        "kind": "gauge",
+        "series": "apm_partition_lag",
+        "threshold": 10000.0,
+        "target": 0.99,
+        "per": "partition",
+    },
 ]
+
+
+def burning_partitions(results: List[dict]) -> set:
+    """Partition ids currently under FAST burn of the ``partition_lag``
+    objective — the SLO → rebalance-policy bridge. Accepts the engine's
+    last evaluation (``SLOEngine.status()["results"]`` or the list
+    returned by ``evaluate()``); tolerates absent/foreign objectives."""
+    out = set()
+    for r in results or []:
+        if (r.get("objective") == "partition_lag"
+                and r.get("severity") == "fast"
+                and str(r.get("key", "")).isdigit()):
+            out.add(int(r["key"]))
+    return out
 
 
 def _delta(points: List[Tuple[float, float]]) -> float:

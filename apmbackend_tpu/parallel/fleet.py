@@ -64,6 +64,23 @@ def service_partition(key: str, n_partitions: int) -> int:
     return h % n_partitions
 
 
+def resolve_partitions(shards: int, partitions: int = 0) -> int:
+    """The effective partition count of a fleet: ``fleet.partitions`` when
+    set, else 4 partitions per shard (P > N is the point — a rebalance
+    moves a fine grain, not half a shard's keyspace). P < N would strand
+    workers with nothing to own, so it is a config error."""
+    if shards <= 0:
+        raise ValueError("resolve_partitions needs shards > 0")
+    if partitions in (0, None):
+        return shards * 4
+    p = int(partitions)
+    if p < shards:
+        raise ValueError(
+            f"fleet.partitions={p} < fleet.shards={shards}: every shard "
+            f"needs at least one partition to own")
+    return p
+
+
 def partition_queue(base: str, p: int) -> str:
     """The transport channel of partition ``p`` (``transactions.p3``)."""
     return f"{base}.p{p}"
@@ -253,6 +270,7 @@ class FleetShardProc:
             "--workdir", h.workdir,
             "--shard-id", str(self.shard_id),
             "--shards", str(h.shards),
+            "--partitions", str(h.partitions),
             "--capacity", str(h.capacity),
             "--samples-per-bucket", str(h.samples_per_bucket),
             "--save-every-s", str(h.save_every_s),
@@ -286,16 +304,26 @@ class FleetShardProc:
             self.proc.wait(timeout=30)
             self.h._mark_event("crash", shard=self.shard_id, gen=self.generation)
 
-    def control(self, cmd: str, timeout_s: float = 120.0, **fields) -> dict:
-        """Write one control request and block for the child's durable ack.
-        Raises on child-reported failure (with its error string) or child
-        death — the caller decides whether to retry."""
+    def request(self, cmd: str, **fields) -> int:
+        """Durably write one control request (tmp+rename, seq-numbered)
+        WITHOUT waiting — the request outlives both sides of the channel:
+        a restarted child finds a pending seq above its done-file and
+        re-executes it, and a restarted controller can re-await the same
+        seq. Returns the request's seq."""
         self._ctl_seq += 1
         req = dict(fields, cmd=cmd, seq=self._ctl_seq)
         tmp = self.ctl_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(req, fh)
         os.replace(tmp, self.ctl_path)
+        return self._ctl_seq
+
+    def wait_done(self, seq: int, timeout_s: float = 120.0, *,
+                  cmd: str = "?", die_on_death: bool = True) -> dict:
+        """Block for the child's durable ack of request ``seq``. Raises on
+        child-reported failure (with its error string), child death (when
+        ``die_on_death`` — the rebalance controller passes False so it can
+        restart the child and re-await the SAME seq), or timeout."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             try:
@@ -303,19 +331,30 @@ class FleetShardProc:
                     done = json.load(fh)
             except (OSError, ValueError):
                 done = None
-            if done and int(done.get("seq", -1)) == self._ctl_seq:
+            if done and int(done.get("seq", -1)) == seq:
                 if not done.get("ok"):
                     raise RuntimeError(
                         f"shard {self.shard_id} {cmd} failed: {done.get('error')}"
                     )
                 return done.get("result") or {}
-            if self.proc is not None and self.proc.poll() is not None:
+            if die_on_death and self.proc is not None \
+                    and self.proc.poll() is not None:
                 raise RuntimeError(
                     f"shard {self.shard_id} died (rc={self.proc.returncode}) "
                     f"during {cmd}; see {self.log_path}"
                 )
             time.sleep(0.02)
         raise TimeoutError(f"shard {self.shard_id} {cmd} timed out")
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def control(self, cmd: str, timeout_s: float = 120.0, **fields) -> dict:
+        """Write one control request and block for the child's durable ack.
+        Raises on child-reported failure (with its error string) or child
+        death — the caller decides whether to retry."""
+        seq = self.request(cmd, **fields)
+        return self.wait_done(seq, timeout_s, cmd=cmd)
 
     def stats(self) -> dict:
         with open(self.stats_path, "r", encoding="utf-8") as fh:
@@ -327,7 +366,8 @@ class FleetHarness:
     N real shard subprocesses over one durable spool directory, rebalance
     control, and merged observability for assertions and the fleet bench."""
 
-    def __init__(self, workdir: str, *, shards: int = 4, capacity: int = 64,
+    def __init__(self, workdir: str, *, shards: int = 4, partitions: int = 0,
+                 capacity: int = 64,
                  samples_per_bucket: int = 64, save_every_s: float = 0.4,
                  feed_delay_s: float = 0.05, checkpoint_mode: str = "delta",
                  compact_every: int = 0, partition_key: str = "service",
@@ -341,6 +381,7 @@ class FleetHarness:
         self.spool_dir = os.path.join(self.workdir, "spool")
         os.makedirs(self.spool_dir, exist_ok=True)
         self.shards = shards
+        self.partitions = resolve_partitions(shards, partitions)
         self.capacity = capacity
         self.samples_per_bucket = samples_per_bucket
         self.save_every_s = save_every_s
@@ -357,7 +398,7 @@ class FleetHarness:
         self._producer_channel = SpoolChannel(self.spool_dir)
         self._qm = QueueManager(lambda _d: self._producer_channel, 3600)
         self.partitioner = FleetPartitioner(
-            self._qm, base_queue, shards, key=partition_key
+            self._qm, base_queue, self.partitions, key=partition_key
         )
         self.procs: Dict[int, FleetShardProc] = {
             k: FleetShardProc(self, k) for k in range(shards)
@@ -371,7 +412,7 @@ class FleetHarness:
         # cannot stall every scrape pass
         self._port_waited: set = set()
         self.sent_per_queue: Dict[str, int] = {
-            partition_queue(base_queue, p): 0 for p in range(shards)
+            partition_queue(base_queue, p): 0 for p in range(self.partitions)
         }
 
     # -- stream --------------------------------------------------------------
@@ -577,6 +618,7 @@ def _shard_main(argv=None) -> int:
     ap.add_argument("--workdir", required=True)
     ap.add_argument("--shard-id", type=int, required=True)
     ap.add_argument("--shards", type=int, required=True)
+    ap.add_argument("--partitions", type=int, default=0)
     ap.add_argument("--capacity", type=int, default=64)
     ap.add_argument("--samples-per-bucket", type=int, default=64)
     ap.add_argument("--save-every-s", type=float, default=0.4)
@@ -607,12 +649,13 @@ def _shard_main(argv=None) -> int:
     eng["deliveryMode"] = "atLeastOnce"
     eng["deliveryFeedMaxDelaySeconds"] = args.feed_delay_s
     eng["metricsPort"] = 0 if args.metrics else None
-    cfg["fleet"] = {
+    cfg["fleet"].update({
         "shards": args.shards,
+        "partitions": args.partitions,
         "partitionKey": args.partition_key,
         "shardId": None,  # APM_SHARD_ID env wins (set by the harness)
         "epochStallSeconds": 300.0,
-    }
+    })
     if args.checkpoint_mode == "delta":
         eng["checkpointMode"] = "delta"
         # {shard}-templating exercised on purpose: one config, N chains
@@ -666,14 +709,11 @@ def _shard_main(argv=None) -> int:
     done_path = os.path.join(workdir, "DONE.json")
     stats_path = os.path.join(workdir, f"shard{k}.stats.json")
     resume_out = os.path.join(workdir, f"shard{k}.engine.npz")
-    last_ctl = 0
-    # a restarted child must not re-execute a pre-crash control request:
-    # resume the sequence from the durable done-file
-    try:
-        with open(ctl_done, "r", encoding="utf-8") as fh:
-            last_ctl = int(json.load(fh).get("seq", 0))
-    except (OSError, ValueError):
-        pass
+    # a restarted child must not re-execute an ALREADY-ACKED control
+    # request: resume the sequence from the durable done-file (a pending
+    # request with seq above it IS re-executed — that is the channel's
+    # kill -9 recovery)
+    last_ctl = worker._read_ctl_seq(ctl_done)
 
     def poll_control() -> None:
         nonlocal last_ctl
@@ -685,21 +725,7 @@ def _shard_main(argv=None) -> int:
         seq = int(req.get("seq", 0))
         if seq <= last_ctl:
             return
-        out = {"seq": seq, "ok": True}
-        try:
-            cmd = req.get("cmd")
-            if cmd == "release":
-                out["result"] = worker.release_partition(
-                    int(req["partition"]), req["path"]
-                )
-            elif cmd == "adopt":
-                out["result"] = worker.adopt_partition(
-                    int(req["partition"]), req["path"]
-                )
-            else:
-                raise ValueError(f"unknown control command {cmd!r}")
-        except Exception as e:  # report, never die: the controller decides
-            out = {"seq": seq, "ok": False, "error": f"{type(e).__name__}: {e}"}
+        out = worker._exec_control(req)
         last_ctl = seq
         tmp = ctl_done + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:

@@ -1,0 +1,574 @@
+"""Automatic fleet rebalance: a pure watermark policy and the durable
+controller that executes its moves (DESIGN.md §10, ISSUE 18).
+
+The PR 9 fleet gave every partition handoff a verified protocol
+(``release_partition``/``adopt_partition``, two commits bracketing a
+durable handoff file) but a HUMAN chose the moves. This module is the
+chooser: a deterministic policy over scraped per-partition lag, executed
+through the same verified protocol, pre-verified itself as a transition
+system in ``analysis/protocol/shardmodel.py`` (policy mode — the
+``shard-rebalance-storm`` and ``shard-rebalance-oscillation`` mutants
+show what each policy clause prevents).
+
+Three pieces, separable on purpose:
+
+- :func:`decide` — the PURE policy. Input: one :class:`Observation`
+  (per-partition lag + partition→shard attribution + SLO burn state, all
+  AS SCRAPED — the controller's world is always slightly stale, which is
+  exactly what the model models) plus the mutable :class:`PolicyState`
+  and the ``fleet.rebalance`` config. Output: at most ONE move per call,
+  or a no-move verdict with its reason. No I/O, no clocks, no
+  randomness: same inputs ⇒ same decision, so replayed fixtures converge
+  bit-identically and every decision is explainable after the fact.
+- :class:`CtlPeer` — one shard's end of the durable control-file channel
+  (the FleetShardProc protocol: seq-numbered request file, tmp+rename,
+  polled done file). Requests outlive both sides: a kill −9'd worker
+  re-executes the pending request at boot, a restarted controller
+  re-awaits the same seq.
+- :class:`RebalanceController` — observe → decide → execute, with
+  retry/timeout/abort. The abort path is the modeled one: if the adopter
+  never saw the handoff file, the RELEASER re-adopts its own export
+  (``adopt_partition`` is the inverse of ``release_partition`` and a
+  re-adopt of an owned partition is a no-op, so abort is idempotent).
+  :meth:`RebalanceController.recover` resolves moves a dead controller
+  left mid-flight — complete them if nobody owns the partition, then GC
+  every stale handoff file (counted: ``apm_rebalance_stale_handoffs_gc_
+  total``).
+
+Policy clauses (each maps to a model clause and a mutant):
+
+========================  =====================================  =========
+clause                    config                                 mutant
+========================  =====================================  =========
+high watermark            rebalance.highWatermark                —
+low watermark             rebalance.lowWatermark                 —
+hysteresis band           gap must STRICTLY exceed moved lag     oscillation
+per-partition re-arm      rebalance.movesPerPartition            oscillation
+cooldown                  rebalance.cooldownSeconds              storm
+one move per decision     structural (decide returns <= 1)       storm
+========================  =====================================  =========
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .fleet import partition_queue, read_handoff
+
+_HANDOFF_RE = re.compile(r"^handoff-p(\d+)-s(\d+)-s(\d+)\.npz$")
+
+
+def handoff_path(workdir: str, p: int, frm: int, to: int) -> str:
+    return os.path.join(workdir, f"handoff-p{p}-s{frm}-s{to}.npz")
+
+
+def parse_handoff_name(name: str) -> Optional[Tuple[int, int, int]]:
+    """``handoff-p3-s0-s1.npz`` -> (3, 0, 1); None for foreign files."""
+    m = _HANDOFF_RE.match(name)
+    return (int(m.group(1)), int(m.group(2)), int(m.group(3))) if m else None
+
+
+class Observation:
+    """One controller scrape: per-partition backlog and the partition →
+    shard attribution as of the SAME scrape (never mix a fresh lag view
+    with a fresher ownership view — the model's vmap travels with its
+    view), plus the shards currently under SLO fast burn."""
+
+    def __init__(self, lags: Dict[int, float], owners: Dict[int, int],
+                 burning: Optional[set] = None):
+        self.lags = dict(lags)
+        self.owners = dict(owners)
+        self.burning = set(burning or ())
+
+    def shard_load(self, sh: int) -> float:
+        return sum(l for p, l in self.lags.items()
+                   if self.owners.get(p) == sh)
+
+
+class PolicyState:
+    """The controller's memory between decisions — everything the policy
+    clauses need that one observation cannot carry."""
+
+    def __init__(self):
+        self.cooldown_until = 0.0  # monotonic deadline of the move window
+        # partition -> (lag at its last move, moves since re-arm): the
+        # hysteresis re-arm — a moved partition may move again only after
+        # its observed lag CHANGES (new load is new information; identical
+        # lag means nothing happened and a reverse move would be a
+        # ping-pong, the oscillation mutant's counterexample)
+        self.moved: Dict[int, Tuple[float, int]] = {}
+        self.last_move: Optional[Tuple[int, int, int]] = None
+
+
+def decide(obs: Observation, state: PolicyState, cfg: dict,
+           now: float) -> dict:
+    """The pure policy: at most one move per call. Returns a decision
+    record (JSON-able, goes verbatim into the decision ring):
+    ``{"move": (p, frm, to), ...}`` or ``{"move": None, "reason": ...}``.
+    Deterministic tie-breaks (lowest shard id, then highest lag, then
+    lowest partition id) keep replayed fixtures bit-identical."""
+    high = float(cfg.get("highWatermark", 64))
+    low = float(cfg.get("lowWatermark", 16))
+    budget = int(cfg.get("movesPerPartition", 1))
+
+    if now < state.cooldown_until:
+        return {"move": None, "reason": "cooldown",
+                "until_s": round(state.cooldown_until - now, 3)}
+
+    # re-arm moved partitions whose lag changed since their move
+    for p, (lag_at_move, _n) in list(state.moved.items()):
+        if obs.lags.get(p, 0.0) != lag_at_move:
+            del state.moved[p]
+
+    shards = sorted(set(obs.owners.values()))
+    if len(shards) < 2:
+        return {"move": None, "reason": "single-shard"}
+    loads = {sh: obs.shard_load(sh) for sh in shards}
+
+    # donors: hottest first; SLO fast burn qualifies a shard as a donor
+    # even below the high watermark (the burn IS the emergency signal)
+    donors = sorted(
+        (sh for sh in shards
+         if loads[sh] >= high or sh in obs.burning),
+        key=lambda sh: (-loads[sh], sh))
+    best = None
+    for a in donors:
+        for b in sorted(shards, key=lambda sh: (loads[sh], sh)):
+            if b == a or loads[b] > low:
+                continue
+            gap = loads[a] - loads[b]
+            for p in sorted((p for p, o in obs.owners.items() if o == a),
+                            key=lambda p: (-obs.lags.get(p, 0.0), p)):
+                lp = obs.lags.get(p, 0.0)
+                if lp < 1:
+                    continue
+                moved = state.moved.get(p)
+                if moved is not None and moved[1] >= budget:
+                    continue  # not re-armed: per-partition move budget
+                if gap <= lp:
+                    continue  # hysteresis: must STRICTLY improve balance
+                cand = (p, a, b)
+                if best is None:
+                    best = (cand, loads[a], loads[b], lp)
+                break
+            if best:
+                break
+        if best:
+            break
+    if best is None:
+        reason = "balanced" if not donors else "no-qualifying-move"
+        return {"move": None, "reason": reason,
+                "loads": {str(s): loads[s] for s in shards}}
+    (p, a, b), va, vb, lp = best
+    return {
+        "move": [p, a, b],
+        "donor_load": va, "recipient_load": vb, "partition_lag": lp,
+        "loads": {str(s): loads[s] for s in shards},
+        "burning": sorted(obs.burning),
+        "reason": "slo-burn" if (a in obs.burning and va < high)
+        else "watermark",
+    }
+
+
+def apply_move(state: PolicyState, decision: dict, cfg: dict,
+               now: float) -> None:
+    """Advance the policy memory for one executed move (separate from
+    :func:`decide` so a decision that failed to EXECUTE does not burn
+    the cooldown window)."""
+    p, frm, to = decision["move"]
+    state.cooldown_until = now + float(cfg.get("cooldownSeconds", 30.0))
+    lag, n = state.moved.get(p, (None, 0))
+    state.moved[p] = (float(decision.get("partition_lag", 0.0)), n + 1)
+    state.last_move = (p, frm, to)
+
+
+class CtlPeer:
+    """One shard's durable control channel, standalone (the manager's
+    side — FleetShardProc implements the same protocol with a subprocess
+    handle attached). ``alive`` defaults to True: a supervised child is
+    the supervisor's job to restart, the request file waits for it."""
+
+    def __init__(self, ctl_path: str, *, alive: Callable[[], bool] = None):
+        self.ctl_path = ctl_path
+        self.ctl_done_path = ctl_path + ".done"
+        self._alive = alive
+        self._ctl_seq = 0
+        # resume the seq past any request already on disk — a controller
+        # restart must not reuse (and alias) a seq the child already saw
+        for path in (self.ctl_path, self.ctl_done_path):
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    self._ctl_seq = max(self._ctl_seq,
+                                        int(json.load(fh).get("seq", 0)))
+            except (OSError, ValueError):
+                pass
+
+    def alive(self) -> bool:
+        return True if self._alive is None else bool(self._alive())
+
+    def request(self, cmd: str, **fields) -> int:
+        self._ctl_seq += 1
+        req = dict(fields, cmd=cmd, seq=self._ctl_seq)
+        tmp = self.ctl_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(req, fh)
+        os.replace(tmp, self.ctl_path)
+        return self._ctl_seq
+
+    def wait_done(self, seq: int, timeout_s: float = 120.0, *,
+                  cmd: str = "?", die_on_death: bool = True) -> dict:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with open(self.ctl_done_path, "r", encoding="utf-8") as fh:
+                    done = json.load(fh)
+            except (OSError, ValueError):
+                done = None
+            if done and int(done.get("seq", -1)) == seq:
+                if not done.get("ok"):
+                    raise RuntimeError(f"{cmd} failed: {done.get('error')}")
+                return done.get("result") or {}
+            if die_on_death and not self.alive():
+                raise RuntimeError(f"peer died during {cmd}")
+            time.sleep(0.02)
+        raise TimeoutError(f"{cmd} timed out after {timeout_s}s")
+
+    def control(self, cmd: str, timeout_s: float = 120.0, **fields) -> dict:
+        return self.wait_done(self.request(cmd, **fields), timeout_s,
+                              cmd=cmd)
+
+
+class RebalanceController:
+    """Observe → decide → execute, durably. One instance per fleet.
+
+    ``peers``: {shard_id: CtlPeer-like} (request/wait_done/alive).
+    ``observe``: () -> :class:`Observation` — scraped metrics in
+    production (:func:`observation_from_metrics`), spool cursors in the
+    deterministic harness (:func:`spool_observer`).
+    ``restart``: optional (shard_id) -> None — when given, a peer that
+    dies mid-move is restarted and the SAME request seq re-awaited (the
+    worker re-executes the pending control file at boot; the handoff
+    protocol makes re-execution idempotent). Without it, a dead peer
+    fails the move into the abort path.
+    """
+
+    def __init__(self, workdir: str, peers: Dict[int, object],
+                 observe: Callable[[], Observation], cfg: dict, *,
+                 restart: Optional[Callable[[int], None]] = None,
+                 logger=None, clock: Callable[[], float] = time.monotonic):
+        self.workdir = os.path.abspath(workdir)
+        self.peers = peers
+        self.observe = observe
+        self.cfg = dict(cfg or {})
+        self.restart = restart
+        self.logger = logger
+        self.clock = clock
+        self.state = PolicyState()
+        # counters (DESIGN.md §8): single-threaded controller, no lock
+        self.moves_total = 0
+        self.aborts_total = 0
+        self.skipped_cooldown_total = 0
+        self.stale_handoffs_gc_total = 0
+        self._move_seq = 0
+
+    # -- observability -------------------------------------------------------
+    def _record(self, kind: str, **fields) -> None:
+        from ..obs.decisions import get_decisions
+
+        fields.update(kind=kind, plane="rebalance")
+        try:
+            get_decisions().record(fields)
+        except Exception:
+            pass
+        if self.logger is not None:
+            self.logger.info(f"rebalance {kind}: "
+                             + json.dumps(fields, default=repr))
+
+    def collect_metrics(self):
+        """Telemetry collector (obs registry shape)."""
+        from ..obs import Sample
+
+        yield Sample("apm_rebalance_moves_total", {}, self.moves_total,
+                     "counter", "Partition moves the controller completed")
+        yield Sample("apm_rebalance_aborts_total", {}, self.aborts_total,
+                     "counter",
+                     "Moves aborted (releaser re-adopted its own export)")
+        yield Sample("apm_rebalance_skipped_cooldown_total", {},
+                     self.skipped_cooldown_total, "counter",
+                     "Decisions suppressed by the cooldown window")
+        yield Sample("apm_rebalance_stale_handoffs_gc_total", {},
+                     self.stale_handoffs_gc_total, "counter",
+                     "Stale handoff files garbage-collected")
+
+    # -- the loop body -------------------------------------------------------
+    def tick(self) -> dict:
+        """One observe → decide → execute pass; returns the decision
+        record (with ``executed``/``aborted`` when a move was tried).
+        A frozen controller (rebalance.enabled false) only observes."""
+        if not self.cfg.get("enabled", True):
+            return {"move": None, "reason": "frozen"}
+        now = self.clock()
+        obs = self.observe()
+        decision = decide(obs, self.state, self.cfg, now)
+        if decision.get("reason") == "cooldown":
+            self.skipped_cooldown_total += 1
+            return decision
+        if decision["move"] is None:
+            return decision
+        p, frm, to = decision["move"]
+        ok = self._execute_move(p, frm, to, decision)
+        decision["executed"] = ok
+        if ok:
+            apply_move(self.state, decision, self.cfg, now)
+        return decision
+
+    def _set_owner(self, p: int, sh: int) -> None:
+        """Keep an observer-side ownership view (spool_observer) in step
+        with executed moves; metrics-based observers re-derive ownership
+        from each scrape and expose no ``owners`` attribute."""
+        owners = getattr(self.observe, "owners", None)
+        if owners is not None:
+            owners[p] = sh
+
+    # -- move execution (release -> adopt, with abort) -----------------------
+    def _await(self, shard: int, seq: int, cmd: str,
+               timeout_s: float) -> dict:
+        """Await one durable ack, restarting a dead peer when we can —
+        the pending request survives the kill and is re-executed by the
+        restarted worker (ctl seq resume in the fleet child)."""
+        peer = self.peers[shard]
+        deadline = time.monotonic() + timeout_s
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(f"s{shard} {cmd} timed out")
+            try:
+                return peer.wait_done(seq, timeout_s=left, cmd=cmd,
+                                      die_on_death=True)
+            except RuntimeError as e:
+                if "died" in str(e) and self.restart is not None:
+                    self._record("peer-restart", shard=shard, cmd=cmd,
+                                 seq=seq)
+                    self.restart(shard)
+                    continue
+                raise
+
+    def _execute_move(self, p: int, frm: int, to: int,
+                      decision: dict) -> bool:
+        timeout_s = float(self.cfg.get("moveTimeoutSeconds", 60.0))
+        path = handoff_path(self.workdir, p, frm, to)
+        self._move_seq += 1
+        self._record("move-start", partition=p, frm=frm, to=to,
+                     move=self._move_seq, decision=decision)
+        try:
+            seq = self.peers[frm].request("release", partition=p, path=path)
+            released = self._await(frm, seq, f"release(p{p})", timeout_s)
+        except Exception as e:
+            # release never committed (or the releaser reported failure):
+            # nothing moved. Resolve leftovers defensively — a re-executed
+            # release on a restarted child may have committed even though
+            # the error surfaced here.
+            self._record("move-failed", partition=p, frm=frm, to=to,
+                         stage="release", error=f"{type(e).__name__}: {e}")
+            self._resolve_file(p, frm, to, path)
+            return False
+        try:
+            seq = self.peers[to].request("adopt", partition=p, path=path)
+            self._await(to, seq, f"adopt(p{p})", timeout_s)
+        except Exception as e:
+            # THE ABORT PATH (modeled: shardmodel policy-mode `abort`):
+            # the adopter never landed the import — the releaser re-adopts
+            # its OWN export, ownership returns to the donor.
+            self._record("move-abort", partition=p, frm=frm, to=to,
+                         error=f"{type(e).__name__}: {e}")
+            self._abort_move(p, frm, path)
+            return False
+        self.moves_total += 1
+        self._set_owner(p, to)
+        self._record("move-done", partition=p, frm=frm, to=to,
+                     rows=released.get("rows"))
+        self._gc_file(path)
+        return True
+
+    def _abort_move(self, p: int, frm: int, path: str) -> bool:
+        """Releaser re-adopts its own export. If the release never
+        committed this is a no-op (already-owned check precedes the file
+        read, so even a TORN file aborts cleanly); if the release DID
+        commit, the file is re-imported. A re-adopt that itself fails
+        (torn file after a committed release = the rows' only copy is
+        corrupt) is recorded loudly and the file is KEPT as evidence —
+        never GC'd, never silently retried."""
+        timeout_s = float(self.cfg.get("moveTimeoutSeconds", 60.0))
+        try:
+            seq = self.peers[frm].request("adopt", partition=p, path=path)
+            self._await(frm, seq, f"abort-readopt(p{p})", timeout_s)
+        except Exception as e:
+            self._record("abort-failed", partition=p, frm=frm, path=path,
+                         error=f"{type(e).__name__}: {e}")
+            if self.logger is not None:
+                self.logger.error(
+                    f"rebalance abort FAILED for p{p}: releaser s{frm} "
+                    f"could not re-adopt {path} ({e}) — handoff file kept")
+            return False
+        self._set_owner(p, frm)
+        self.aborts_total += 1
+        self._record("move-aborted", partition=p, frm=frm)
+        self._gc_file(path)
+        return True
+
+    def _gc_file(self, path: str) -> None:
+        try:
+            os.unlink(path)
+            self.stale_handoffs_gc_total += 1
+        except OSError:
+            pass
+
+    def _resolve_file(self, p: int, frm: int, to: int, path: str) -> str:
+        """Complete-or-abort one handoff file after a failed/ambiguous
+        release (the single-file core of :meth:`recover`): a re-executed
+        release on a restarted child may have committed even though the
+        error surfaced controller-side, so ownership — not the error — is
+        the ground truth. Owned by either side ⇒ the file is stale, GC.
+        Owned by nobody ⇒ the file holds the only copy of its rows:
+        finish the move (adopt on the recipient), or abort (releaser
+        re-adopts) when the file is torn/unreadable."""
+        if not os.path.exists(path):
+            return "no-file"
+        timeout_s = float(self.cfg.get("moveTimeoutSeconds", 60.0))
+        try:
+            owned = self.owned_map(timeout_s)
+        except Exception as e:
+            self._record("resolve-probe-failed", partition=p, frm=frm,
+                         to=to, error=f"{type(e).__name__}: {e}")
+            return "unresolved"  # leave the file; recover() gets it later
+        if p in owned.get(to, []):
+            res = "stale-completed"
+        elif p in owned.get(frm, []):
+            res = "stale-aborted"
+        else:
+            try:
+                read_handoff(path)  # torn file must fail into abort
+                seq = self.peers[to].request("adopt", partition=p, path=path)
+                self._await(to, seq, f"resolve-adopt(p{p})", timeout_s)
+                self.moves_total += 1
+                self._set_owner(p, to)
+                res = "completed"
+            except Exception as e:
+                self._record("resolve-abort", partition=p, frm=frm, to=to,
+                             error=f"{type(e).__name__}: {e}")
+                return ("aborted" if self._abort_move(p, frm, path)
+                        else "abort-failed")
+        self._gc_file(path)
+        self._record("resolve", partition=p, frm=frm, to=to, resolution=res)
+        return res
+
+    # -- crash recovery (manager died mid-decision/mid-move) -----------------
+    def owned_map(self, timeout_s: float = 30.0) -> Dict[int, List[int]]:
+        """{shard: sorted owned partitions} via the ownership probe."""
+        out = {}
+        for sh, peer in sorted(self.peers.items()):
+            seq = peer.request("owned")
+            out[sh] = self._await(sh, seq, "owned", timeout_s)["partitions"]
+        return out
+
+    def recover(self) -> List[dict]:
+        """Resolve every handoff file a dead controller left behind:
+        completed moves and aborted moves leave stale files (GC'd, with
+        the counter), a move killed between release-commit and
+        adopt-commit is COMPLETED (nobody owns the partition, the file is
+        the only copy of its rows — adopt it on the intended recipient,
+        falling back to re-adopt on the releaser). Returns the
+        resolutions, one record per file."""
+        try:
+            names = sorted(os.listdir(self.workdir))
+        except OSError:
+            return []
+        pending = [(n, parse_handoff_name(n)) for n in names]
+        pending = [(n, t) for n, t in pending if t is not None]
+        if not pending:
+            return []
+        owned = self.owned_map()
+        for sh, parts in owned.items():
+            for p in parts:
+                self._set_owner(p, sh)
+        out = []
+        for name, (p, frm, to) in pending:
+            path = os.path.join(self.workdir, name)
+            if p in owned.get(to, []):
+                res = "stale-completed"  # adopt committed before the crash
+            elif p in owned.get(frm, []):
+                res = "stale-aborted"  # release never committed (or abort did)
+            else:
+                # mid-move: the file holds the only copy — finish the move
+                try:
+                    read_handoff(path)  # torn file must fail into abort
+                    seq = self.peers[to].request("adopt", partition=p,
+                                                 path=path)
+                    self._await(to, seq, f"recover-adopt(p{p})",
+                                float(self.cfg.get("moveTimeoutSeconds", 60.0)))
+                    self.moves_total += 1
+                    self._set_owner(p, to)
+                    res = "completed"
+                except Exception as e:
+                    self._record("recover-abort", partition=p, frm=frm,
+                                 to=to, error=f"{type(e).__name__}: {e}")
+                    aborted = self._abort_move(p, frm, path)
+                    out.append({"file": name, "resolution":
+                                "aborted" if aborted else "abort-failed"})
+                    continue
+            self._gc_file(path)
+            self._record("recover", file=name, resolution=res)
+            out.append({"file": name, "resolution": res})
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Observers
+# ---------------------------------------------------------------------------
+
+
+def spool_observer(harness) -> Callable[[], Observation]:
+    """Deterministic observation for the FleetHarness: per-partition lag
+    from the spool (records sent minus the ack cursor — the exact backlog,
+    no scrape jitter), ownership tracked from the striped boot map plus
+    the controller's completed moves (the harness observer and controller
+    share one process, so the view IS the controller's own)."""
+    owners = {p: p % harness.shards for p in range(harness.partitions)}
+
+    def observe() -> Observation:
+        lags = {}
+        for p in range(harness.partitions):
+            qname = partition_queue(harness.base_queue, p)
+            sent = harness.sent_per_queue.get(qname, 0)
+            lags[p] = max(0, sent - harness.acked(p))
+        return Observation(lags, owners)
+
+    observe.owners = owners  # the controller's move executor updates this
+    return observe
+
+
+def observation_from_metrics(scrapes: Dict[int, str],
+                             burning: Optional[set] = None) -> Observation:
+    """Build an Observation from per-shard Prometheus text exposition
+    (the manager's ``scrape_fleet`` output): each shard exports
+    ``apm_partition_lag{partition="K"}`` ONLY for partitions it owns, so
+    one scrape carries both the load view and the ownership attribution
+    — stale together, exactly the model's view+vmap."""
+    lags: Dict[int, float] = {}
+    owners: Dict[int, int] = {}
+    pat = re.compile(
+        r'^apm_partition_lag\{([^}]*)\}\s+([0-9eE+.\-]+)', re.M)
+    part_pat = re.compile(r'partition="(\d+)"')
+    for sh, text in scrapes.items():
+        for m in pat.finditer(text or ""):
+            pm = part_pat.search(m.group(1))
+            if not pm:
+                continue
+            p = int(pm.group(1))
+            lags[p] = float(m.group(2))
+            owners[p] = sh
+    return Observation(lags, owners, burning)

@@ -112,6 +112,7 @@ class WorkerApp:
             sid = fleet_cfg.get("shardId")
         self.shard_id: Optional[int] = int(sid) if sid is not None else None
         self._fleet = self._fleet_shards > 0 and self.shard_id is not None
+        self._fleet_partitions = 0
         if self._fleet:
             if not self._at_least_once:
                 raise ValueError(
@@ -123,6 +124,13 @@ class WorkerApp:
                     f"shard id {self.shard_id} out of range for "
                     f"fleet.shards={self._fleet_shards}"
                 )
+            from ..parallel.fleet import resolve_partitions
+
+            # P >= N: the keyspace grain is fleet.partitions (auto 4x
+            # shards), NOT the shard count — the routing hash, the header
+            # check, and boot ownership all use P
+            self._fleet_partitions = resolve_partitions(
+                self._fleet_shards, int(fleet_cfg.get("partitions", 0) or 0))
         self._partition_key = str(fleet_cfg.get("partitionKey", "service"))
         self._partition_base = in_queue_name
         self._epoch_stall_s = float(fleet_cfg.get("epochStallSeconds", 300.0) or 0.0)
@@ -498,6 +506,23 @@ class WorkerApp:
             get_registry().add_collector(self._collect_metrics)
         if getattr(runtime, "telemetry", None) is not None:
             runtime.telemetry.add_health("engine", self._health)
+        # -- durable control channel (fleet.controlDir) ----------------------
+        # The rebalance controller's way into a SUPERVISED worker: the same
+        # seq-numbered request/done file protocol the fleet harness drives
+        # (a request survives kill -9 of either side; a restarted worker
+        # re-executes the pending seq). The harness child (_shard_main)
+        # polls inline instead, so controlDir stays None there.
+        self._ctl_dir = (str(fleet_cfg.get("controlDir"))
+                         if self._fleet and fleet_cfg.get("controlDir")
+                         else None)
+        if self._ctl_dir:
+            os.makedirs(self._ctl_dir, exist_ok=True)
+            self._ctl_path = os.path.join(
+                self._ctl_dir, f"shard{self.shard_id}.ctl.json")
+            self._ctl_done_path = self._ctl_path + ".done"
+            self._ctl_last = self._read_ctl_seq(self._ctl_done_path)
+            runtime.every(0.1, self._poll_control_file, name="fleet-ctl")
+
         flight = getattr(runtime, "flight", None)
         if flight is not None:
             # worker-specific flight-recorder sources: the tick-span ring
@@ -551,11 +576,11 @@ class WorkerApp:
         from ..parallel.fleet import service_partition
 
         key_is_service = self._partition_key != "server"
-        shards = self._fleet_shards
+        n_parts = self._fleet_partitions
 
         def pred(server: str, service: str) -> bool:
             return service_partition(
-                service if key_is_service else server, shards
+                service if key_is_service else server, n_parts
             ) == p
 
         return pred
@@ -583,10 +608,12 @@ class WorkerApp:
     def _initial_partitions(self) -> set:
         """Partitions this shard owns at boot: whatever queues the restored
         delivery tree carries (ownership rides the checkpoint — a released
-        partition must stay released across a crash), or the identity
-        partition on a fresh boot (no delivery state ever committed)."""
+        partition must stay released across a crash), or the striped set
+        ``{p : p % N == shard_id}`` on a fresh boot (no delivery state ever
+        committed) — the shardmodel initial pmap."""
         if self.driver.delivery_state is None:
-            return {self.shard_id}
+            return {p for p in range(self._fleet_partitions)
+                    if p % self._fleet_shards == self.shard_id}
         with self._driver_lock:
             owned = {
                 self._queue_partition(q) for q in self._windows
@@ -735,6 +762,24 @@ class WorkerApp:
                 yield Sample("apm_shard_owned_partitions", lbl,
                              len(per_queue), "gauge",
                              "Partition queues this shard currently owns")
+                # per-partition backlog: the rebalance controller's input
+                # signal (rebalancer.observe_fleet parses exactly this
+                # series to build its load view + ownership attribution)
+                for qname, consumer in list(self.in_queues.items()):
+                    p = self._queue_partition(qname)
+                    if p is None:
+                        continue
+                    lag_fn = getattr(
+                        getattr(consumer, "channel", None), "queue_lag", None)
+                    if lag_fn is None:
+                        continue
+                    try:
+                        lag = float(lag_fn(qname))
+                    except Exception:
+                        continue
+                    yield Sample("apm_partition_lag",
+                                 dict(lbl, partition=str(p)), lag, "gauge",
+                                 "Unconsumed backlog of one owned partition queue")
 
     def _health(self) -> dict:
         """The /healthz engine section: tick liveness, emission/intake
@@ -1140,7 +1185,7 @@ class WorkerApp:
                     # partial absorption would strand the stray records'
                     # effects on a non-owner.
                     mismatch = _frames.count_partition_mismatches(
-                        line, self._fleet_shards, expected,
+                        line, self._fleet_partitions, expected,
                         key=self._partition_key,
                     ) > 0
                 if mismatch:
@@ -1832,6 +1877,7 @@ class WorkerApp:
                     "base": self._partition_base,
                     "key": self._partition_key,
                     "shards": self._fleet_shards,
+                    "partitions": self._fleet_partitions,
                     "from_shard": self.shard_id,
                     "epoch": self._delivery_epoch,
                     "window": list(w.fifo),
@@ -1892,6 +1938,16 @@ class WorkerApp:
                 f"{self._partition_base!r}, file carries "
                 f"p{meta.get('partition')} of {meta.get('base')!r}"
             )
+        if int(meta.get("partitions", self._fleet_partitions)) \
+                != self._fleet_partitions:
+            # a record exported under a different keyspace grain routed its
+            # rows by a different hash modulus — adopting it would violate
+            # routing discipline for every row in it
+            raise ValueError(
+                f"handoff record mismatch: exporter ran "
+                f"fleet.partitions={meta.get('partitions')}, this shard "
+                f"runs {self._fleet_partitions}"
+            )
         with self._driver_lock:
             # pending feeds of OUR queues must reach the engine before the
             # import commit snapshots it (drain-before-commit invariant)
@@ -1936,9 +1992,61 @@ class WorkerApp:
     def owned_partitions(self) -> list:
         """Sorted partition ids this shard currently owns (fleet mode)."""
         return sorted(
-            p for p in (self._queue_partition(q) for q in self.in_queues)
+            p for p in (self._queue_partition(q) for q in list(self.in_queues))
             if p is not None
         )
+
+    # -- durable control-file channel ---------------------------------------
+    @staticmethod
+    def _read_ctl_seq(done_path: str) -> int:
+        import json as _json
+
+        try:
+            with open(done_path, "r", encoding="utf-8") as fh:
+                return int(_json.load(fh).get("seq", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _exec_control(self, req: dict) -> dict:
+        """Execute one control request -> the durable done record. Shared
+        by the harness child's inline poll and the controlDir timer; never
+        raises — the controller reads the error and decides (retry/abort),
+        the worker stays up."""
+        seq = int(req.get("seq", 0))
+        try:
+            cmd = req.get("cmd")
+            if cmd == "release":
+                result = self.release_partition(
+                    int(req["partition"]), req["path"])
+            elif cmd == "adopt":
+                result = self.adopt_partition(
+                    int(req["partition"]), req["path"])
+            elif cmd == "owned":
+                result = {"partitions": self.owned_partitions()}
+            else:
+                raise ValueError(f"unknown control command {cmd!r}")
+            return {"seq": seq, "ok": True, "result": result}
+        except Exception as e:
+            return {"seq": seq, "ok": False,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    def _poll_control_file(self) -> None:
+        import json as _json
+
+        try:
+            with open(self._ctl_path, "r", encoding="utf-8") as fh:
+                req = _json.load(fh)
+        except (OSError, ValueError):
+            return
+        seq = int(req.get("seq", 0))
+        if seq <= self._ctl_last:
+            return
+        out = self._exec_control(req)
+        self._ctl_last = seq
+        tmp = self._ctl_done_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            _json.dump(out, fh, default=repr)
+        os.replace(tmp, self._ctl_done_path)
 
     def shutdown(self) -> None:
         if self._closed:

@@ -276,6 +276,46 @@ class ChaosWorkerHarness:
 
         return list_bundles(self.flight_dir)
 
+    def wait_rearmed(self, n_bundles: int, timeout_s: float = 60.0) -> None:
+        """Block until the restarted child has promoted the previous
+        generation's journal+sentinel shadow into crash bundle ``n_bundles``
+        (boot-time ``recover_crash``) AND its OWN live journal carries the
+        worker sources again (WorkerApp registered + a journal tick ran).
+
+        The spool cursor can race far past the nominal kill points, so
+        without this the next SIGKILL can land mid-boot — before the
+        recorder re-arms (two crashes legitimately collapse into one
+        promotion) or before the journal is source-populated. Crucially the
+        journal must be the *current* generation's: ``recover_crash``
+        consumes only the sentinel, so the dead generation's journal (which
+        already had ``engine_health``) stays on disk until the new child's
+        first tick overwrites it. The journal's ``pid`` stamp (obs/flight
+        ``snapshot``) is matched against the live child to reject that
+        stale read.
+        """
+        assert self.proc is not None and self.proc.poll() is None, \
+            "wait_rearmed needs a live child (call start() first)"
+        journal = os.path.join(self.flight_dir, "tpu_worker.journal.json")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            promoted = sum(
+                1 for _p, b in self.flight_bundles() if b.get("recovered")
+            )
+            if promoted >= n_bundles:
+                try:
+                    with open(journal, "r", encoding="utf-8") as fh:
+                        body = json.load(fh)
+                except Exception:
+                    body = {}
+                if ("engine_health" in body
+                        and body.get("pid") == self.proc.pid):
+                    return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"crash bundle {n_bundles} / re-armed journal (pid "
+            f"{self.proc.pid}) never appeared; see {self.log_path}"
+        )
+
     # -- stream --------------------------------------------------------------
     def send_line(self, line: str) -> None:
         self._seq += 1

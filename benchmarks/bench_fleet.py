@@ -19,9 +19,12 @@ chain dir. Two phases:
   cost included) and the line throughput are reported alongside.
 - **rebalance drill**: a quiesced partition handoff under LIVE traffic
   (producer keeps streaming into the moving partition's queue), then a
-  drain; certifies zero loss / zero double-effect by exact accounting
-  (every produced line acked, every absorb unique, merged event logs
-  replay clean through the per-shard AND fleet conformance checkers).
+  controller-driven drill (ISSUE 18: the watermark policy executes real
+  moves over the fine-grained P > N keyspace through the durable ctl
+  channel until it converges and goes quiet), then a drain; certifies
+  zero loss / zero double-effect by exact accounting (every produced
+  line acked, every absorb unique, merged event logs replay clean
+  through the per-shard AND fleet conformance checkers).
 
 p50 detection = pooled per-tick dispatch latency across shards during the
 measured phase, under real contention — the <=100 ms budget of the north
@@ -94,14 +97,16 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
 
     # in-flight slack for the flow-control window, in TRANSPORT units:
     # spool records are lines in object mode, per-partition batches in
-    # frame mode (sent_per_queue counts what the ack cursor advances over)
-    label_slack = shards if frame_mode else per_label
+    # frame mode (sent_per_queue counts what the ack cursor advances over).
+    # The keyspace is fine-grained (ISSUE 18: P = 4 x shards by default),
+    # so frame mode writes up to h.partitions batches per label.
+    label_slack = h.partitions if frame_mode else per_label
 
     def total_sent() -> int:
         return sum(h.sent_per_queue.values())
 
     def total_acked() -> int:
-        return sum(h.acked(p) for p in range(shards))
+        return sum(h.acked(p) for p in range(h.partitions))
 
     def wait_drained(slack: int, timeout_s: float = 600.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -181,6 +186,81 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
         for t in range(drill_t0 + 1, drill_t0 + drill_labels):
             send_label(t, per_label)
         wait_drained(0)
+
+        # -- ISSUE 18: controller-driven rebalance drill -------------------
+        # The watermark policy + RebalanceController drive REAL partition
+        # moves through the durable control-file channel against the live
+        # shards, under continued traffic. The lag profile is synthetic
+        # and deterministic (a moved partition reads as drained), so the
+        # drill certifies the control plane — moves converge, observer
+        # ownership stays consistent with what the shards report, zero
+        # loss folds into the whole-run accounting below.
+        from apmbackend_tpu.parallel.rebalancer import (
+            Observation, RebalanceController)
+
+        P = h.partitions
+        drill_owners = {p: p % shards for p in range(P)}
+        drill_owners[shards - 1] = 0  # the manual drill above moved it
+        donor = 0
+        donor_parts = sorted(p for p, sh in drill_owners.items()
+                             if sh == donor)
+        hot = {donor_parts[0]: 150.0}
+        if len(donor_parts) > 1:
+            hot[donor_parts[1]] = 40.0
+
+        def drill_observe() -> Observation:
+            lags = {}
+            for p in range(P):
+                if drill_owners[p] == donor and p in hot:
+                    lags[p] = hot[p]
+                elif drill_owners[p] == donor:
+                    lags[p] = 30.0
+                else:
+                    lags[p] = 5.0
+            return Observation(lags, dict(drill_owners))
+
+        drill_observe.owners = drill_owners  # controller updates on moves
+        ctl = RebalanceController(
+            workdir, {k: h.procs[k] for k in range(shards)}, drill_observe,
+            {"enabled": True, "highWatermark": 100.0, "lowWatermark": 70.0,
+             "cooldownSeconds": 0.05, "movesPerPartition": 1,
+             "moveTimeoutSeconds": 120.0},
+        )
+        drill_moves: list = []
+        drill_ticks = 0
+        quiet = 0
+        drill_wall0 = time.monotonic()
+        converge_wall = drill_wall0
+        while quiet < 3 and drill_ticks < 8 * P:
+            d = ctl.tick()
+            drill_ticks += 1
+            if d.get("executed"):
+                drill_moves.append(list(d["move"]))
+                converge_wall = time.monotonic()
+                quiet = 0
+            elif d.get("reason") != "cooldown":
+                quiet += 1
+            send_label(drill_t0 + drill_labels + drill_ticks, per_label)
+            time.sleep(0.06)
+        wait_drained(0)
+        real_owned = ctl.owned_map()
+        view_owned = {}
+        for p, sh in drill_owners.items():
+            view_owned.setdefault(sh, []).append(p)
+        rebalance_drill = {
+            "partitions": P,
+            "moves": drill_moves,
+            "n_moves": ctl.moves_total,
+            "aborts": ctl.aborts_total,
+            "skipped_cooldown": ctl.skipped_cooldown_total,
+            "ticks": drill_ticks,
+            "converged": quiet >= 3,
+            "time_to_converge_s": round(converge_wall - drill_wall0, 3),
+            "owned_map": {str(k): v for k, v in sorted(real_owned.items())},
+            "owner_view_consistent": all(
+                sorted(real_owned.get(sh, [])) == sorted(view_owned.get(sh, []))
+                for sh in range(shards)),
+        }
         # -- ISSUE 17: fleet-merged wall-clock attribution -----------------
         # re-scrape every shard's /attrib while the fleet is still alive
         # and diff against the post-warmup baseline: the certification
@@ -265,10 +345,13 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
 
         # live rows per shard DURING the measured phase (the full service
         # population is registered in warmup; the drill's row moves happen
-        # after t1, so st["services"] would misattribute them)
+        # after t1, so st["services"] would misattribute them). Routing is
+        # over the fine-grained P-partition keyspace; boot ownership is
+        # striped p % shards (ISSUE 18).
         rows_measured = {k: 0 for k in range(shards)}
         for i in range(services):
-            rows_measured[service_partition(_key(i)[1], shards)] += 1
+            p = service_partition(_key(i)[1], h.partitions)
+            rows_measured[p % shards] += 1
         fleet_rate = 0.0
         total_metric_ticks = 0
         detection_ms: list = []
@@ -319,7 +402,7 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
         shard_violations = []
         for k in range(shards):
             shard_violations += check_protocol_trace(h.shard_events(k))
-        fleet_violations = check_fleet_trace(events)
+        fleet_violations = check_fleet_trace(events, n_shards=shards)
         rebalance_cert = {
             "partition": shards - 1,
             "from_shard": shards - 1,
@@ -369,8 +452,14 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
                 "aggregate_wall_metrics_per_s": round(wall_rate, 1),
                 "lines_per_s_e2e": round((labels * per_label) / wall, 1),
                 "measured_wall_s": round(wall, 3),
+                "partitions": h.partitions,
                 "per_shard": per_shard,
                 "rebalance": rebalance_cert,
+                # ISSUE 18: the watermark controller executing real moves
+                # over the fine-grained keyspace through the durable ctl
+                # channel — converge-then-quiet, observer view vs probed
+                # ownership
+                "rebalance_drill": rebalance_drill,
                 # ISSUE 12: multi-window burn-rate compliance over what the
                 # fleet recorder persisted DURING the bench (every shard's
                 # /metrics + /trace + /decisions, shard-labeled)

@@ -95,10 +95,13 @@ fi
 # --fleet: the pod-scale sharded-spine tier — the slow multi-process
 # scenarios (N real worker shards over a durable spool: kill −9 one shard
 # mid-stream with bit-identical recovery, live-traffic quiesced rebalance
-# with fleet trace conformance) plus every fast in-process fleet test.
-# Tier-1 keeps only the in-process fast paths; run this before touching
-# parallel/fleet.py, the worker's partition handoff, or shardmodel.py:
-# ./run_tests.sh --fleet [pytest args...].
+# with fleet trace conformance, and the ISSUE 18 self-managing drills:
+# watermark-controller convergence on a skewed load then quiet, kill −9
+# of the releasing shard mid-move, manager death mid-decision with
+# recover()) plus every fast in-process fleet test. Tier-1 keeps only
+# the in-process fast paths; run this before touching parallel/fleet.py,
+# parallel/rebalancer.py, the worker's partition handoff, or
+# shardmodel.py: ./run_tests.sh --fleet [pytest args...].
 if [ "$1" = "--fleet" ]; then
     shift
     exec env -u PYTHONPATH JAX_PLATFORMS=cpu \

@@ -221,36 +221,6 @@ def test_kill9_crash_equivalence_subprocess(tmp_path):
     assert stats_g["acked"] == len(lines)
     assert stats_g["deduped_total"] == 0
 
-    def wait_rearmed(n_bundles, timeout_s=60.0):
-        """Block until the restarted child has promoted the previous
-        generation's journal+sentinel shadow into crash bundle ``n_bundles``
-        (boot-time recover_crash) AND its live journal carries the worker
-        sources again (WorkerApp registered + a journal tick ran). The
-        spool cursor can race far past the nominal kill points, so without
-        this the next SIGKILL can land mid-boot — before the recorder
-        re-arms (two crashes legitimately collapse into one promotion) or
-        before the journal is source-populated."""
-        import json as _json
-
-        journal = os.path.join(chaos.flight_dir, "tpu_worker.journal.json")
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            promoted = sum(
-                1 for _p, b in chaos.flight_bundles() if b.get("recovered")
-            )
-            if promoted >= n_bundles:
-                try:
-                    with open(journal, "r", encoding="utf-8") as fh:
-                        if "engine_health" in _json.load(fh):
-                            return
-                except Exception:
-                    pass
-            time.sleep(0.05)
-        raise TimeoutError(
-            f"crash bundle {n_bundles} / re-armed journal never appeared; "
-            f"see {chaos.log_path}"
-        )
-
     chaos = ChaosWorkerHarness(str(tmp_path / "chaos"), dup_p=0.08, seed=7)
     for line in lines:
         chaos.send_line(line)
@@ -259,7 +229,10 @@ def test_kill9_crash_equivalence_subprocess(tmp_path):
     chaos.kill9()
     first_kill_cursor = chaos.acked()
     chaos.start()
-    wait_rearmed(1)
+    # wait_rearmed matches the live journal's pid stamp against the new
+    # child, so a stale pre-kill journal (recover_crash consumes only the
+    # sentinel) can't satisfy the re-arm check early.
+    chaos.wait_rearmed(1)
     chaos.wait_acked(2 * len(lines) // 3)
     chaos.kill9()
     assert chaos.acked() >= first_kill_cursor  # the cursor never regresses

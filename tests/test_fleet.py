@@ -301,7 +301,8 @@ def test_handoff_file_roundtrip(tmp_path):
 # -- in-process fleet: rebalance golden equivalence ---------------------------
 
 
-def _mk_fleet_worker(broker, k, shards, tmp_path=None, **eng_overrides):
+def _mk_fleet_worker(broker, k, shards, tmp_path=None, partitions=None,
+                     **eng_overrides):
     from apmbackend_tpu.runtime.module_base import ModuleRuntime
     from apmbackend_tpu.runtime.worker import WorkerApp
 
@@ -312,8 +313,11 @@ def _mk_fleet_worker(broker, k, shards, tmp_path=None, **eng_overrides):
         deliveryFeedMaxDelaySeconds=0.05,
     ))
     cfg["tpuEngine"].update(eng_overrides)
+    # legacy P == N identity unless the test asks for a finer keyspace
     cfg["fleet"] = {"shards": shards, "partitionKey": "service",
-                    "shardId": k, "epochStallSeconds": 300.0}
+                    "shardId": k, "epochStallSeconds": 300.0,
+                    "partitions": shards if partitions is None
+                    else partitions}
     cfg["streamCalcZScore"]["defaults"] = [
         {"LAG": 6, "THRESHOLD": 3.0, "INFLUENCE": 0.1}
     ]
@@ -732,3 +736,590 @@ def test_shard_conformance_handoff_mirror():
         {"ev": "handoff_export", "partition": 1, "ids": ["m1"], "unacked": 2},
     ]
     assert any("quiesce" in v for v in check_protocol_trace(broken))
+
+
+# -- P > N fine-grained keyspace (ISSUE 18) -----------------------------------
+
+
+def test_service_partition_pinned_values_p16():
+    """The P > N keyspace pins a SECOND modulus: fleet.partitions is part
+    of the persistence contract exactly like the hash itself (rows and
+    dedup windows route by service_partition(key, P), not N)."""
+    assert [service_partition(s, 16) for s in FIXTURE_SERVICES] == [
+        15, 12, 5, 2, 3, 0, 9, 6, 7, 4, 6, 9]
+    assert service_partition("getOffers", 16) == 0
+    assert service_partition("svc00042", 16) == 9
+
+
+def test_resolve_partitions_defaults_and_floor():
+    from apmbackend_tpu.parallel.fleet import resolve_partitions
+
+    assert resolve_partitions(2, 0) == 8     # default: 4x shards
+    assert resolve_partitions(3, 0) == 12
+    assert resolve_partitions(1, 0) == 4
+    assert resolve_partitions(2, 8) == 8     # explicit wins
+    assert resolve_partitions(2, 2) == 2     # P == N still legal
+    with pytest.raises(ValueError):
+        resolve_partitions(4, 2)             # P < N: a shard owns nothing
+
+
+@pytest.mark.parametrize("transport", ["memory", "spool", "redis"])
+def test_partition_header_roundtrip_high_partition_id(transport, tmp_path):
+    """Partition ids above n_shards (the P > N grain) survive the header
+    round-trip on every fabric — a partition id is a keyspace coordinate,
+    not a shard id, and must never be clamped to the fleet size."""
+    P, PID = 8, 6  # 2-shard fleet, partition id 6 > 2
+
+    if transport == "memory":
+        broker = MemoryBroker()
+        make = lambda d: MemoryChannel(broker)  # noqa: E731
+        pump = broker.pump
+    elif transport == "spool":
+        from apmbackend_tpu.transport.spool import SpoolChannel
+
+        chans = []
+
+        def make(d):
+            ch = SpoolChannel(str(tmp_path / "spool"))
+            chans.append(ch)
+            return ch
+
+        pump = lambda: [c.deliver() for c in chans]  # noqa: E731
+    else:
+        from fake_redis import FakeRedisServer, make_fake_redis
+
+        from apmbackend_tpu.transport.redis_streams import RedisStreamsChannel
+
+        server = FakeRedisServer()
+        mod = make_fake_redis(server)
+        chans = []
+
+        def make(d):
+            ch = RedisStreamsChannel("redis://fake", redis_module=mod)
+            chans.append(ch)
+            return ch
+
+        pump = lambda: [c.pump_once() for c in chans]  # noqa: E731
+
+    qname = partition_queue("transactions", PID)
+    qm_p = QueueManager(lambda d: make("p"), 3600)
+    q = qm_p.get_queue(qname, "p")
+    q.partition = PID
+    got = []
+    qm_c = QueueManager(lambda d: make("c"), 3600)
+    qm_c.get_queue(
+        qname, "c",
+        lambda line, headers=None, token=None: got.append(headers),
+        manual_ack=True,
+    ).start_consume()
+    q.write_line(_tx(0, 5))
+    pump()
+    assert len(got) == 1
+    assert got[0]["partition"] == PID
+    assert parse_partition(qname, "transactions") == PID
+
+
+def test_frame_path_partition_p_gt_n():
+    """Frame-mode routing at P > N: split_by_partition over an 8-way
+    keyspace matches the per-line hash, every sub-batch is mismatch-free
+    for ITS partition, and the partitioner stamps the fine-grained id."""
+    from apmbackend_tpu.transport import frames
+
+    lines = [_tx(0, i, svc=s) for i, s in enumerate(FIXTURE_SERVICES)]
+    blob = frames.encode_lines(lines)
+    ids = frames.partition_ids(blob, 8)
+    assert ids == [service_partition(s, 8) for s in FIXTURE_SERVICES]
+    parts = frames.split_by_partition(blob, 8)
+    assert set(parts) == set(ids)
+    for p, sub in parts.items():
+        assert frames.count_partition_mismatches(sub, 8, p) == 0
+
+    broker = MemoryBroker()
+    qm = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    part = FleetPartitioner(qm, "transactions", 8)
+    seen = {}
+    qm_c = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    for p in range(8):
+        qm_c.get_queue(
+            partition_queue("transactions", p), "c",
+            (lambda p_: lambda line, headers=None, token=None:
+             seen.setdefault(p_, []).append(headers))(p),
+        ).start_consume()
+    sent = part.write_frames(blob)
+    broker.pump()
+    assert sum(sent.values()) == len(lines)
+    for p, hs in seen.items():
+        assert all(h["partition"] == p for h in hs)
+
+
+def test_worker_striped_boot_and_high_partition_handoff(tmp_path):
+    """Two shards over an 8-partition keyspace: fresh boot stripes the
+    ownership (p % N), per-partition lag is exported under the partition
+    label, and a partition id above n_shards moves through release/adopt
+    exactly like the P == N case."""
+    broker = MemoryBroker()
+    w0, rt0 = _mk_fleet_worker(broker, 0, 2, partitions=8)
+    w1, rt1 = _mk_fleet_worker(broker, 1, 2, partitions=8)
+    try:
+        assert w0.owned_partitions() == [0, 2, 4, 6]
+        assert w1.owned_partitions() == [1, 3, 5, 7]
+        qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        part = FleetPartitioner(qm_p, "transactions", 8)
+        for t in range(4):
+            for i, s in enumerate(FIXTURE_SERVICES):
+                part.write_line(_tx(t, i, svc=s))
+        broker.pump()
+        for w in (w0, w1):
+            w.drain_delivery_pending()
+            w.save_state()
+        # apm_partition_lag carries the PARTITION id, one series per
+        # owned partition, attributed to the owning shard
+        for w, want in ((w0, {0, 2, 4, 6}), (w1, {1, 3, 5, 7})):
+            lag = [s for s in w._collect_metrics()
+                   if s.name == "apm_partition_lag"]
+            assert {int(s.labels["partition"]) for s in lag} == want
+            assert all(s.labels["apm_shard_id"] == str(w.shard_id)
+                       for s in lag)
+        # move p5 (> n_shards): the handoff carries the P=8 routing grain
+        hf = str(tmp_path / "handoff-p5-s1-s0.npz")
+        meta = w1.release_partition(5, hf)
+        assert meta["partition"] == 5 and meta["partitions"] == 8
+        res = w0.adopt_partition(5, hf)
+        assert res["rows"] == meta["rows"] > 0
+        assert w0.owned_partitions() == [0, 2, 4, 5, 6]
+        assert w1.owned_partitions() == [1, 3, 7]
+        # live traffic for p5 services reaches the new owner
+        n_before = w0.driver.registry.count
+        for i, s in enumerate(FIXTURE_SERVICES):
+            if service_partition(s, 8) == 5:
+                part.write_line(_tx(9, i, svc=s))
+        broker.pump()
+        w0.drain_delivery_pending()
+        w0.save_state()
+        assert broker.unacked_count() == 0
+        assert w0.driver.registry.count == n_before  # same keys, absorbed
+    finally:
+        rt0.stop_timers()
+        rt1.stop_timers()
+
+
+def test_handoff_grain_mismatch_rejected(tmp_path):
+    """A handoff exported under a different fleet.partitions grain must
+    be refused: its rows were routed by a different modulus."""
+    broker = MemoryBroker()
+    w8, rt8 = _mk_fleet_worker(broker, 1, 2, partitions=8)
+    w2, rt2 = _mk_fleet_worker(MemoryBroker(), 0, 2, partitions=2)
+    try:
+        qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+        part = FleetPartitioner(qm_p, "transactions", 8)
+        for i, s in enumerate(FIXTURE_SERVICES):
+            part.write_line(_tx(0, i, svc=s))
+        broker.pump()
+        w8.drain_delivery_pending()
+        w8.save_state()
+        hf = str(tmp_path / "h.npz")
+        w8.release_partition(1, hf)
+        with pytest.raises(ValueError, match="partitions=8"):
+            w2.adopt_partition(1, hf)
+    finally:
+        rt8.stop_timers()
+        rt2.stop_timers()
+
+
+def test_torn_handoff_read_fails_loudly(tmp_path):
+    """A torn handoff file (partial write, external truncation) must
+    raise out of read_handoff — never parse as an empty record — so the
+    controller lands in the abort path instead of absorbing a void."""
+    a = _driver()
+    a.feed_csv_batch([_tx(0, i) for i in range(20)])
+    a.flush()
+    data = a.export_service_rows(lambda srv, svc: True)
+    meta = {"partition": 1, "queue": "transactions.p1",
+            "base": "transactions", "window": ["m1"], "epoch": 1}
+    path = str(tmp_path / "h.npz")
+    write_handoff(path, data, meta)
+    blob = open(path, "rb").read()
+    for cut in (0, 10, len(blob) // 2, len(blob) - 1):
+        with open(path, "wb") as fh:
+            fh.write(blob[:cut])
+        with pytest.raises(Exception):
+            read_handoff(path)
+
+
+# -- rebalance policy (pure) --------------------------------------------------
+
+
+def _obs(lags, owners=None, burning=None):
+    from apmbackend_tpu.parallel.rebalancer import Observation
+
+    owners = owners or {p: p % 2 for p in lags}
+    return Observation(lags, owners, burning)
+
+
+_POLICY_CFG = {"highWatermark": 64, "lowWatermark": 16,
+               "cooldownSeconds": 30.0, "movesPerPartition": 1}
+
+
+def test_policy_watermark_move_and_determinism():
+    from apmbackend_tpu.parallel.rebalancer import PolicyState, decide
+
+    lags = {0: 100.0, 1: 5.0, 2: 10.0, 3: 0.0}
+    d1 = decide(_obs(lags), PolicyState(), _POLICY_CFG, 0.0)
+    d2 = decide(_obs(lags), PolicyState(), _POLICY_CFG, 0.0)
+    assert d1 == d2  # pure: same observation, same decision
+    assert d1["move"] == [0, 0, 1] and d1["reason"] == "watermark"
+    # balanced fleet: no move, explained
+    d3 = decide(_obs({0: 5.0, 1: 5.0}), PolicyState(), _POLICY_CFG, 0.0)
+    assert d3["move"] is None and d3["reason"] == "balanced"
+
+
+def test_policy_cooldown_one_move_per_window():
+    """The storm clause: after an executed move the window closes — the
+    SAME stale observation cannot trigger a second move until the
+    cooldown expires (shard-rebalance-storm shows the counterexample)."""
+    from apmbackend_tpu.parallel.rebalancer import (
+        PolicyState, apply_move, decide)
+
+    lags = {0: 100.0, 1: 5.0, 2: 50.0, 3: 0.0}
+    st = PolicyState()
+    d = decide(_obs(lags), st, _POLICY_CFG, 0.0)
+    assert d["move"] == [0, 0, 1]
+    apply_move(st, d, _POLICY_CFG, 0.0)
+    d2 = decide(_obs(lags), st, _POLICY_CFG, 10.0)
+    assert d2["move"] is None and d2["reason"] == "cooldown"
+    d3 = decide(_obs(lags), st, _POLICY_CFG, 31.0)  # window reopened
+    assert d3["move"] is not None
+
+
+def test_policy_budget_blocks_same_partition_until_lag_changes():
+    """The oscillation clause: a moved partition whose observed lag has
+    NOT changed is not re-armed — the stale view that justified the move
+    cannot justify the reverse move (shard-rebalance-oscillation)."""
+    from apmbackend_tpu.parallel.rebalancer import (
+        PolicyState, apply_move, decide)
+
+    lags = {0: 100.0, 1: 0.0, 2: 10.0, 3: 0.0}
+    st = PolicyState()
+    d = decide(_obs(lags), st, _POLICY_CFG, 0.0)
+    assert d["move"] == [0, 0, 1]
+    apply_move(st, d, _POLICY_CFG, 0.0)
+    # cooldown expired, attribution refreshed (p0 now on s1), p0 lag
+    # unchanged: s1 is hot but p0 may not bounce back
+    owners = {0: 1, 1: 1, 2: 0, 3: 1}
+    d2 = decide(_obs(lags, owners), st, _POLICY_CFG, 40.0)
+    assert d2["move"] is None or d2["move"][0] != 0
+    # new lag = new information: p0 re-arms (and the band still clears)
+    lags2 = {0: 80.0, 1: 25.0, 2: 10.0, 3: 0.0}
+    d3 = decide(_obs(lags2, owners), st, _POLICY_CFG, 80.0)
+    assert d3["move"] == [0, 1, 0]
+
+
+def test_policy_hysteresis_band_strict():
+    """Moving a partition whose lag EQUALS the donor/recipient gap only
+    swaps the imbalance — the band must be strictly wider than the moved
+    lag or nothing moves."""
+    from apmbackend_tpu.parallel.rebalancer import PolicyState, decide
+
+    # gap = 70 - 0 = 70, biggest partition lag = 70: equality, no move
+    d = decide(_obs({0: 70.0, 1: 0.0}), PolicyState(), _POLICY_CFG, 0.0)
+    assert d["move"] is None and d["reason"] == "no-qualifying-move"
+    # split load: moving p2 (30 < gap 80) strictly improves
+    d2 = decide(_obs({0: 50.0, 2: 30.0, 1: 0.0, 3: 0.0}),
+                PolicyState(), _POLICY_CFG, 0.0)
+    assert d2["move"] == [0, 0, 1]  # hottest qualifying first
+
+
+def test_policy_slo_burn_qualifies_donor_below_watermark():
+    from apmbackend_tpu.parallel.rebalancer import PolicyState, decide
+
+    lags = {0: 30.0, 1: 1.0, 2: 5.0, 3: 0.0}
+    d = decide(_obs(lags), PolicyState(), _POLICY_CFG, 0.0)
+    assert d["move"] is None  # 35 < high: watermark alone says no
+    d2 = decide(_obs(lags, burning={0}), PolicyState(), _POLICY_CFG, 0.0)
+    assert d2["move"] == [0, 0, 1] and d2["reason"] == "slo-burn"
+
+
+def test_policy_recipient_must_be_cool():
+    """No move lands on a shard above the LOW watermark — a recipient
+    near the high mark would immediately re-donate (ping-pong)."""
+    from apmbackend_tpu.parallel.rebalancer import PolicyState, decide
+
+    d = decide(_obs({0: 100.0, 1: 20.0, 2: 0.0, 3: 0.0}),
+               PolicyState(), _POLICY_CFG, 0.0)
+    assert d["move"] is None and d["reason"] == "no-qualifying-move"
+
+
+# -- rebalance controller (execution, abort, recovery) ------------------------
+
+
+class _DirectPeer:
+    """In-process peer: drives a WorkerApp's _exec_control directly (the
+    durable channel collapses to a dict — CtlPeer's file protocol is
+    exercised by the multiprocess tests in test_fleet_chaos.py)."""
+
+    def __init__(self, worker):
+        self.worker = worker
+        self.seq = 0
+        self.done = {}
+        self.fail_cmds = set()  # cmds to fail once (injected fault)
+
+    def alive(self):
+        return True
+
+    def request(self, cmd, **fields):
+        self.seq += 1
+        if cmd in self.fail_cmds:
+            self.fail_cmds.discard(cmd)
+            self.done[self.seq] = {"seq": self.seq, "ok": False,
+                                   "error": "Injected: peer fault"}
+        else:
+            req = dict(fields, cmd=cmd, seq=self.seq)
+            self.done[self.seq] = self.worker._exec_control(req)
+        return self.seq
+
+    def wait_done(self, seq, timeout_s=120.0, *, cmd="?",
+                  die_on_death=True):
+        done = self.done[seq]
+        if not done.get("ok"):
+            raise RuntimeError(f"{cmd} failed: {done.get('error')}")
+        return done.get("result") or {}
+
+
+def _ctl_fixture(tmp_path, broker=None):
+    from apmbackend_tpu.parallel.rebalancer import (
+        Observation, RebalanceController)
+
+    broker = broker or MemoryBroker()
+    w0, rt0 = _mk_fleet_worker(broker, 0, 2, partitions=8)
+    w1, rt1 = _mk_fleet_worker(broker, 1, 2, partitions=8)
+    qm_p = QueueManager(lambda d: MemoryChannel(broker), 3600)
+    part = FleetPartitioner(qm_p, "transactions", 8)
+    for t in range(4):
+        for i, s in enumerate(FIXTURE_SERVICES):
+            part.write_line(_tx(t, i, svc=s))
+    broker.pump()
+    for w in (w0, w1):
+        w.drain_delivery_pending()
+        w.save_state()
+    owners = {p: p % 2 for p in range(8)}
+    lags = {p: 0.0 for p in range(8)}
+    # skew: p0 (on shard 0) is hot; p2's extra load keeps the band
+    # strictly wider than p0's own lag (the hysteresis clause)
+    lags[0] = 100.0
+    lags[2] = 10.0
+
+    def observe():
+        return Observation(lags, owners)
+
+    observe.owners = owners
+    cfg = dict(_POLICY_CFG, moveTimeoutSeconds=10.0, intervalSeconds=0.1)
+    ctl = RebalanceController(
+        str(tmp_path), {0: _DirectPeer(w0), 1: _DirectPeer(w1)},
+        observe, cfg)
+    return ctl, (w0, w1), (rt0, rt1), lags, owners
+
+
+def test_controller_executes_policy_move(tmp_path):
+    from apmbackend_tpu.parallel.rebalancer import handoff_path
+
+    ctl, (w0, w1), rts, lags, owners = _ctl_fixture(tmp_path)
+    try:
+        d = ctl.tick()
+        assert d["move"] == [0, 0, 1] and d["executed"] is True
+        assert w0.owned_partitions() == [2, 4, 6]
+        assert w1.owned_partitions() == [0, 1, 3, 5, 7]
+        assert owners[0] == 1  # observer view followed the move
+        assert ctl.moves_total == 1 and ctl.aborts_total == 0
+        # the handoff file is GC'd after the adopt commit
+        assert not __import__("os").path.exists(
+            handoff_path(str(tmp_path), 0, 0, 1))
+        assert ctl.stale_handoffs_gc_total == 1
+        # cooldown: the very next tick is suppressed and counted
+        d2 = ctl.tick()
+        assert d2["reason"] == "cooldown"
+        assert ctl.skipped_cooldown_total == 1
+        names = {s.name: s.value for s in ctl.collect_metrics()}
+        assert names["apm_rebalance_moves_total"] == 1
+        assert names["apm_rebalance_skipped_cooldown_total"] == 1
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_controller_frozen_only_observes(tmp_path):
+    ctl, workers, rts, lags, owners = _ctl_fixture(tmp_path)
+    try:
+        ctl.cfg["enabled"] = False
+        assert ctl.tick() == {"move": None, "reason": "frozen"}
+        assert ctl.moves_total == 0
+        assert workers[0].owned_partitions() == [0, 2, 4, 6]
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_controller_abort_releaser_readopts(tmp_path):
+    """Adopter fault mid-move: the releaser re-adopts its OWN export —
+    ownership returns to the donor, nothing is lost, the move counts as
+    an abort and the cooldown is NOT burned (the decision failed to
+    execute)."""
+    ctl, (w0, w1), rts, lags, owners = _ctl_fixture(tmp_path)
+    try:
+        ctl.peers[1].fail_cmds.add("adopt")
+        d = ctl.tick()
+        assert d["move"] == [0, 0, 1] and d["executed"] is False
+        assert w0.owned_partitions() == [0, 2, 4, 6]  # back home
+        assert w1.owned_partitions() == [1, 3, 5, 7]
+        assert owners[0] == 0
+        assert ctl.aborts_total == 1 and ctl.moves_total == 0
+        # no cooldown burned: the next tick retries (and succeeds)
+        d2 = ctl.tick()
+        assert d2["executed"] is True
+        assert w1.owned_partitions() == [0, 1, 3, 5, 7]
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_controller_recover_completes_mid_move(tmp_path):
+    """Manager died between release-commit and adopt: the handoff file
+    holds the only copy of the rows. recover() probes live ownership,
+    finishes the move on the intended recipient, and GCs the file."""
+    import os as _os
+
+    from apmbackend_tpu.parallel.rebalancer import handoff_path
+
+    ctl, (w0, w1), rts, lags, owners = _ctl_fixture(tmp_path)
+    try:
+        path = handoff_path(str(tmp_path), 0, 0, 1)
+        w0.release_partition(0, path)  # the dead manager got this far
+        assert _os.path.exists(path)
+        res = ctl.recover()
+        assert res == [{"file": _os.path.basename(path),
+                        "resolution": "completed"}]
+        assert w1.owned_partitions() == [0, 1, 3, 5, 7]
+        assert not _os.path.exists(path)
+        assert ctl.moves_total == 1
+        assert ctl.stale_handoffs_gc_total == 1
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_controller_recover_stale_and_torn_files(tmp_path):
+    """Stale files are resolved by the OWNERSHIP probe, not file content
+    (a torn file whose partition is still owned somewhere is just
+    garbage — GC'd, counted); a torn file for a partition NOBODY owns is
+    the data-loss alarm: the abort path fails loudly and the file is
+    KEPT as evidence, never silently GC'd."""
+    import os as _os
+
+    from apmbackend_tpu.parallel.rebalancer import handoff_path
+
+    ctl, (w0, w1), rts, lags, owners = _ctl_fixture(tmp_path)
+    try:
+        # stale-completed: p1 is owned by shard 1 == `to` of this file
+        stale = handoff_path(str(tmp_path), 1, 0, 1)
+        with open(stale, "wb") as fh:
+            fh.write(b"leftover")
+        # stale-aborted: torn file, but shard 0 (frm) still owns p2 —
+        # ownership says the release never committed, content irrelevant
+        stale2 = handoff_path(str(tmp_path), 2, 0, 1)
+        with open(stale2, "wb") as fh:
+            fh.write(b"PK\x03\x04 torn npz prefix")
+        # torn + nobody owns: release p4 COMMITTED (rows dropped from
+        # w0), then the only copy got corrupted
+        torn = handoff_path(str(tmp_path), 4, 0, 1)
+        w0.release_partition(4, torn)
+        blob = open(torn, "rb").read()
+        with open(torn, "wb") as fh:
+            fh.write(blob[: len(blob) // 2])
+        res = {r["file"]: r["resolution"] for r in ctl.recover()}
+        assert res[_os.path.basename(stale)] == "stale-completed"
+        assert res[_os.path.basename(stale2)] == "stale-aborted"
+        assert res[_os.path.basename(torn)] == "abort-failed"
+        assert not _os.path.exists(stale) and not _os.path.exists(stale2)
+        assert _os.path.exists(torn)  # evidence kept
+        assert ctl.stale_handoffs_gc_total == 2
+        assert ctl.aborts_total == 0  # the abort did NOT succeed
+        assert w0.owned_partitions() == [0, 2, 6]  # p4 genuinely lost
+    finally:
+        for rt in rts:
+            rt.stop_timers()
+
+
+def test_manager_rebalance_wiring_and_fleet_owner_map(tmp_path):
+    """fleet.rebalance.enabled + controlDir turn the supervisor into the
+    controller: one CtlPeer per shard child (APM_SHARD_ID), the scraped
+    observation carries lag + ownership from the SAME bodies, and /fleet
+    grows the partition -> shard map derived from that attribution."""
+    from apmbackend_tpu.manager.manager import ManagerApp
+    from apmbackend_tpu.obs import MetricsRegistry, TelemetryServer
+    from apmbackend_tpu.runtime.module_base import ModuleRuntime
+
+    srvs = []
+    for k, parts in ((0, (0, 2)), (1, (1, 3))):
+        reg = MetricsRegistry()
+        for p in parts:
+            reg.gauge(
+                "apm_partition_lag", "per-partition backlog",
+                labels={"partition": str(p), "queue": f"transactions.p{p}"},
+            ).set(10.0 * (p + 1))
+        srv = TelemetryServer(reg, port=0, module=f"worker{k}")
+        srv.start()
+        srvs.append(srv)
+    cfg = default_config()
+    cfg["logDir"] = str(tmp_path)
+    cfg["fleet"]["controlDir"] = str(tmp_path / "ctl")
+    cfg["fleet"]["rebalance"].update(
+        enabled=True, intervalSeconds=3600.0, moveTimeoutSeconds=0.2)
+    cfg["applicationManager"]["moduleSettings"] = [
+        {"module": "apmbackend_tpu.runtime.worker", "shards": 2,
+         "metricsPort": 9999},
+    ]
+    cfg["applicationManager"]["metricsPort"] = 0
+    runtime = ModuleRuntime("applicationManager", config=cfg,
+                            install_signals=False, console_log=False)
+    app = ManagerApp(runtime, spawn_children=False)
+    try:
+        assert app.rebalancer is not None
+        assert sorted(app.rebalancer.peers) == [0, 1]
+        # aim the scrape inventory at the fake shard exporters
+        for k, srv in enumerate(srvs):
+            app.modules[k].setting["metricsPort"] = srv.port
+        obs = app._rebalance_observation()
+        assert obs.owners == {0: 0, 2: 0, 1: 1, 3: 1}
+        assert obs.lags == {0: 10.0, 2: 30.0, 1: 20.0, 3: 40.0}
+        text = app.scrape_fleet()
+        assert 'apm_fleet_partition_owner{partition="0"} 0' in text
+        assert 'apm_fleet_partition_owner{partition="2"} 0' in text
+        assert 'apm_fleet_partition_owner{partition="3"} 1' in text
+        # the freeze switch: a frozen controller only observes
+        app.rebalancer.cfg["enabled"] = False
+        assert app.rebalancer.tick() == {"move": None, "reason": "frozen"}
+    finally:
+        app.alerts.stop()
+        app.shutdown()
+        runtime.stop_timers()
+        for s in srvs:
+            s.stop()
+
+
+def test_slo_burning_partitions_extraction():
+    """The SLO -> policy bridge: fast burns of the partition_lag
+    objective surface as partition ids; everything else is ignored."""
+    from apmbackend_tpu.obs.slo import DEFAULT_OBJECTIVES, burning_partitions
+
+    assert any(o["name"] == "partition_lag" and o["per"] == "partition"
+               and o["series"] == "apm_partition_lag"
+               for o in DEFAULT_OBJECTIVES)
+    res = [
+        {"objective": "partition_lag", "key": "3", "severity": "fast"},
+        {"objective": "partition_lag", "key": "5", "severity": "slow"},
+        {"objective": "queue_lag", "key": "transactions.p1",
+         "severity": "fast"},
+        {"objective": "partition_lag", "key": "7", "severity": "fast"},
+    ]
+    assert burning_partitions(res) == {3, 7}
+    assert burning_partitions([]) == set()
+    assert burning_partitions(None) == set()

@@ -48,6 +48,9 @@ def _send_labels(h, t0, t1, per_label=40, services=12, seed=0):
 
 def _fleet(workdir, **kw):
     kw.setdefault("shards", 2)
+    # legacy scenarios pin P == N (identity partition map); the ISSUE 18
+    # rebalance scenarios below run the fine-grained default (P = 4N)
+    kw.setdefault("partitions", kw["shards"])
     kw.setdefault("capacity", 64)
     kw.setdefault("save_every_s", 0.3)
     kw.setdefault("lags", "6")
@@ -367,3 +370,197 @@ def test_recorder_survives_shard_kill9_and_slo_burn_alert(tmp_path):
         store.close()
         h.close()
         set_tracer(old_tracer)
+
+
+# -- ISSUE 18: the self-managing fleet (automatic rebalance) -------------------
+
+
+# services pinned per P=8 partition (service_partition(svc, 8), see
+# test_fleet.py's pinned-values test): p0<-svc005, p2<-svc003,
+# p4<-svc001/svc009, p6<-svc007/svc010 all stripe to shard 0 at boot
+_P8_HOT = {0: "svc005", 2: "svc003", 4: "svc001", 6: "svc007"}
+_P8_COOL = {1: "svc006", 3: "svc004", 5: "svc002", 7: "svc000"}
+
+# the deterministic skewed-load fixture the policy replays: shard 0's
+# partitions carry 20x the backlog of shard 1's
+_SKEW_PROFILE = {0: 100.0, 2: 100.0, 4: 100.0, 6: 100.0,
+                 1: 5.0, 3: 5.0, 5: 5.0, 7: 5.0}
+_CTL_CFG = {"enabled": True, "highWatermark": 150.0, "lowWatermark": 130.0,
+            "cooldownSeconds": 1.0, "movesPerPartition": 1,
+            "moveTimeoutSeconds": 60.0}
+
+
+def _send_skewed(h, t0, t1, per=6):
+    """Real traffic matching the skew profile's shape: hot services on
+    shard 0's partitions, a trickle on shard 1's."""
+    for t in range(t0, t1):
+        for p, svc in _P8_HOT.items():
+            for seq in range(per):
+                e = 100 + (t * 7 + seq * 13 + p) % 50
+                h.send_line(
+                    f"tx|jvm1|{svc}|h{p}-{t}-{seq}|1|{(BASE + t) * 10000 - e}|"
+                    f"{(BASE + t) * 10000 + seq}|{e}|Y")
+        for p, svc in _P8_COOL.items():
+            e = 100 + (t * 11 + p) % 50
+            h.send_line(
+                f"tx|jvm2|{svc}|c{p}-{t}|1|{(BASE + t) * 10000 - e}|"
+                f"{(BASE + t) * 10000 + 900 + p}|{e}|Y")
+
+
+def _mk_controller(h, *, restart=None, clock=None):
+    from apmbackend_tpu.parallel.rebalancer import (
+        Observation, RebalanceController)
+
+    owners = {p: p % h.shards for p in range(h.partitions)}
+
+    def observe():
+        return Observation(dict(_SKEW_PROFILE), owners)
+
+    observe.owners = owners
+    return RebalanceController(
+        h.workdir, {k: h.procs[k] for k in range(h.shards)}, observe,
+        dict(_CTL_CFG), restart=restart,
+        clock=clock or (lambda: 0.0))
+
+
+def _golden_decisions(h, ticks):
+    """Pure-policy replay of the fixture: what the controller SHOULD
+    decide, with moves applied to a simulated ownership map only."""
+    from apmbackend_tpu.parallel.rebalancer import (
+        Observation, PolicyState, apply_move, decide)
+
+    owners = {p: p % h.shards for p in range(h.partitions)}
+    st, out, now = PolicyState(), [], 0.0
+    for _ in range(ticks):
+        now += 2.0
+        d = decide(Observation(dict(_SKEW_PROFILE), owners), st,
+                   _CTL_CFG, now)
+        out.append(d)
+        if d["move"]:
+            apply_move(st, d, _CTL_CFG, now)
+            owners[d["move"][0]] = d["move"][2]
+    return out
+
+
+def test_controller_converges_on_skew_then_quiet(tmp_path):
+    """The acceptance drill: replay the deterministic skewed fixture
+    against a LIVE 2-shard / 8-partition fleet. The controller makes at
+    most K moves then goes quiet (every further tick is an explained
+    no-move), the executed decision sequence is BIT-IDENTICAL to the
+    pure-policy golden replay, and the moved fleet loses nothing."""
+    h = _fleet(tmp_path, shards=2, partitions=8)
+    try:
+        h.start_all()
+        _send_skewed(h, 0, 3)
+        h.wait_acked(0, 10, timeout_s=120)
+        now = [0.0]
+        ctl = _mk_controller(h, clock=lambda: now[0])
+        TICKS, K = 8, 4
+        decisions = []
+        for _ in range(TICKS):
+            now[0] += 2.0  # cooldown window passes between ticks
+            decisions.append(ctl.tick())
+        moves = [d["move"] for d in decisions if d.get("move")]
+        assert moves == [[0, 0, 1], [2, 0, 1]]  # hottest first, then next
+        assert len(moves) <= K and ctl.moves_total == len(moves)
+        assert all(d.get("executed") for d in decisions if d.get("move"))
+        # quiet: after convergence EVERY tick explains why it sits still
+        tail = decisions[len(moves):]
+        assert tail and all(
+            d["move"] is None and d["reason"] == "no-qualifying-move"
+            for d in tail)
+        # bit-identical to the pure-policy golden replay
+        stripped = [{k: v for k, v in d.items() if k != "executed"}
+                    for d in decisions]
+        assert stripped == _golden_decisions(h, TICKS)
+        # live ownership followed the moves
+        owned = ctl.owned_map()
+        assert owned == {0: [4, 6], 1: [0, 1, 2, 3, 5, 7]}
+        # traffic after convergence: zero loss through the moved map
+        _send_skewed(h, 3, 6)
+        stats = h.finish(timeout_s=300)
+        assert stats[0]["owned_partitions"] == [4, 6]
+        assert stats[1]["owned_partitions"] == [0, 1, 2, 3, 5, 7]
+        for p in range(8):
+            assert h.acked(p) == h.sent_per_queue[f"transactions.p{p}"], p
+        for k in (0, 1):
+            assert check_protocol_trace(h.shard_events(k)) == []
+        assert check_fleet_trace(h.merged_events(), n_shards=2) == []
+    finally:
+        h.close()
+
+
+def test_controller_survives_kill9_of_releaser_mid_move(tmp_path):
+    """kill −9 the releaser with the release request pending: the durable
+    request outlives the child, the controller restarts it, the restarted
+    worker re-executes the SAME seq, and the move completes — zero loss,
+    conformant logs, one move counted."""
+    h = _fleet(tmp_path, shards=2, partitions=8)
+    try:
+        h.start_all()
+        _send_skewed(h, 0, 3)
+        h.wait_acked(0, 10, timeout_s=120)
+        h.wait_acked(1, 1, timeout_s=120)
+        # the releaser is DEAD when the decision fires: the request file
+        # waits in front of a corpse until the controller restarts it
+        h.kill9(0)
+        restarts = []
+
+        def restart(k):
+            restarts.append(k)
+            h.start(k)
+
+        now = [0.0]
+        ctl = _mk_controller(h, restart=restart, clock=lambda: now[0])
+        now[0] += 2.0
+        d = ctl.tick()
+        assert d["move"] == [0, 0, 1] and d["executed"] is True
+        assert restarts == [0]
+        assert ctl.moves_total == 1 and ctl.aborts_total == 0
+        assert ctl.owned_map() == {0: [2, 4, 6], 1: [0, 1, 3, 5, 7]}
+        _send_skewed(h, 3, 5)
+        h.finish(timeout_s=300)
+        for p in range(8):
+            assert h.acked(p) == h.sent_per_queue[f"transactions.p{p}"], p
+        for k in (0, 1):
+            assert check_protocol_trace(h.shard_events(k)) == []
+        assert check_fleet_trace(h.merged_events(), n_shards=2) == []
+    finally:
+        h.close()
+
+
+def test_controller_recovers_manager_death_mid_move(tmp_path):
+    """The manager dies BETWEEN release-commit and adopt: the handoff
+    file on disk holds the rows' only copy. A fresh controller's
+    recover() probes live ownership, completes the move on the intended
+    recipient, GCs the file, and the fleet loses nothing."""
+    import os as _os
+
+    from apmbackend_tpu.parallel.rebalancer import handoff_path
+
+    h = _fleet(tmp_path, shards=2, partitions=8)
+    try:
+        h.start_all()
+        _send_skewed(h, 0, 3)
+        h.wait_acked(0, 10, timeout_s=120)
+        # the dead manager got exactly this far: release committed
+        path = handoff_path(h.workdir, 0, 0, 1)
+        released = h.procs[0].control("release", partition=0, path=path)
+        assert released["rows"] > 0 and _os.path.exists(path)
+        # ...and a NEW controller (manager restart) resolves the wreck
+        ctl = _mk_controller(h)
+        res = ctl.recover()
+        assert res == [{"file": _os.path.basename(path),
+                        "resolution": "completed"}]
+        assert not _os.path.exists(path)
+        assert ctl.moves_total == 1 and ctl.stale_handoffs_gc_total == 1
+        assert ctl.owned_map() == {0: [2, 4, 6], 1: [0, 1, 3, 5, 7]}
+        _send_skewed(h, 3, 5)
+        h.finish(timeout_s=300)
+        for p in range(8):
+            assert h.acked(p) == h.sent_per_queue[f"transactions.p{p}"], p
+        for k in (0, 1):
+            assert check_protocol_trace(h.shard_events(k)) == []
+        assert check_fleet_trace(h.merged_events(), n_shards=2) == []
+    finally:
+        h.close()

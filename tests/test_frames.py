@@ -466,6 +466,7 @@ def test_fleet_harness_send_lines_counts_spool_records(tmp_path):
 
     h = FleetHarness(str(tmp_path), shards=3, capacity=64, lags="6")
     try:
+        assert h.partitions == 12  # ISSUE 18 default: 4 partitions/shard
         lines = [f"tx|jvm{i % 4}|svc{i % 11}|x{i}|1|100|200|{i}|Y"
                  for i in range(90)]
         routed = h.send_lines(lines)
@@ -476,8 +477,9 @@ def test_fleet_harness_send_lines_counts_spool_records(tmp_path):
         for p, n in routed.items():
             q = partition_queue(h.base_queue, p)
             assert h.sent_per_queue[q] == 1
-            assert n == len([l for l in lines
-                             if service_partition(tx_partition_key(l), 3) == p])
+            assert n == len([
+                l for l in lines
+                if service_partition(tx_partition_key(l), h.partitions) == p])
     finally:
         h.close()
 
