@@ -330,6 +330,27 @@ _DEFAULT_CONFIG: dict = {
         "selfSampleSeconds": 2.0,
         "storeRetentionSeconds": 900.0,
     },
+    # Fleet query plane (obs/queryplane, DESIGN.md §10.5): the manager
+    # replaces its per-process /query /trace /decisions /attrib with a
+    # fleet-wide router — single-service queries go to the owning shard
+    # via the pinned service_partition hash + live owner map, everything
+    # else scatter-gathers (counters summed, histogram buckets merged
+    # before the quantile, spans/decisions deduped) and a dead shard is
+    # served from the recorder store with explicit partial/stale marking.
+    "queryPlane": {
+        "enabled": True,
+        # TTL read-through cache for dashboard-repeated queries; 0 disables
+        "cacheTtlSeconds": 2.0,
+        # bounded shard fan-out concurrency per request
+        "fanoutConcurrency": 8,
+        # per-shard sub-request timeout; a slower shard degrades to the store
+        "timeoutSeconds": 2.0,
+        # bounded requeries when the owner map seq moves mid-fanout
+        "moveRetries": 2,
+        # owner-map refresh cadence (manager re-derives it from shard
+        # scrapes; the standalone CLI polls /fleet at this cadence)
+        "ownerRefreshSeconds": 5.0,
+    },
     # SLO burn-rate engine (obs/slo, DESIGN.md §8.4): Google-SRE multi-window
     # burn rates evaluated over the telemetry store. A "fast" burn (both
     # windows >= fastBurnThreshold) pages through the alert/decision path and

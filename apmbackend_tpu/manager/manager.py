@@ -547,6 +547,49 @@ class ManagerApp:
                              "stale_gc":
                                  self.rebalancer.stale_handoffs_gc_total})
 
+        # -- ISSUE 20: the fleet query plane (the read front door) -----------
+        # queryPlane.enabled mounts obs.queryplane over this exporter,
+        # REPLACING the per-process /query /trace /decisions /attrib
+        # mounted above: single-service queries route to the owning shard
+        # via the pinned hash + the owner map re-derived from shard
+        # scrapes; cross-service queries scatter-gather; dead shards are
+        # served from the recorder store with partial/stale marking.
+        self.queryplane = None
+        qp_cfg = config.get("queryPlane", {}) or {}
+        if bool(qp_cfg.get("enabled", True)) \
+                and getattr(runtime, "telemetry", None) is not None:
+            from ..obs.queryplane import QueryPlane
+
+            qp_partitions = 0
+            if shard_mods:
+                from ..parallel.fleet import OwnerMap, resolve_partitions
+
+                qp_partitions = resolve_partitions(
+                    len(shard_mods), int(fleet_cfg.get("partitions", 0) or 0))
+                self._owner_map = OwnerMap()
+                self._owner_lock = threading.Lock()
+                self._owner_read_ts = 0.0  # guarded-by: _owner_lock
+                self._owner_refresh_s = float(
+                    qp_cfg.get("ownerRefreshSeconds", 5.0))
+            self.queryplane = QueryPlane(
+                self._child_metrics_targets,
+                owners=self._queryplane_owners if shard_mods else None,
+                store=self.recorder_store,
+                partitions=qp_partitions,
+                partition_key=str(fleet_cfg.get("partitionKey", "service")),
+                registry=reg,
+                cache_ttl_s=float(qp_cfg.get("cacheTtlSeconds", 2.0)),
+                fanout=int(qp_cfg.get("fanoutConcurrency", 8)),
+                timeout_s=float(qp_cfg.get("timeoutSeconds", 2.0)),
+                move_retries=int(qp_cfg.get("moveRetries", 2)),
+                freshness=(self.recorder.freshness
+                           if self.recorder is not None else None),
+                logger=logger,
+            )
+            for qp_path, qp_fn in self.queryplane.make_routes().items():
+                runtime.telemetry.add_route(qp_path, qp_fn)
+            runtime.telemetry.add_health("queryplane", self.queryplane.health)
+
         if spawn_children:
             self.annotate("Restarting all modules")
             for mod in self.modules:
@@ -755,6 +798,32 @@ class ManagerApp:
             burning = burning_partitions(self.slo.status().get("results"))
             obs.burning = {obs.owners[p] for p in burning if p in obs.owners}
         return obs
+
+    def _queryplane_owners(self):
+        """The query plane's routing feed: ``(seq, {partition: module
+        name})``, re-derived from the shard scrapes' ownership
+        attribution at most every ownerRefreshSeconds (routing reads are
+        per-request; the scrape is not). OwnerMap bumps the seq only on
+        real change, so steady-state rescrapes never force query
+        retries; a failed scrape pass keeps serving the last good map."""
+        with self._owner_lock:
+            now = time.monotonic()
+            refresh = now - self._owner_read_ts >= self._owner_refresh_s
+            if refresh:
+                self._owner_read_ts = now
+        if refresh:
+            try:
+                from ..parallel.rebalancer import observation_from_metrics
+
+                obs = observation_from_metrics(self._shard_scrapes())
+                names = {k: mod.name
+                         for k, mod in self._fleet_shard_modules().items()}
+                self._owner_map.update({
+                    p: names[s] for p, s in obs.owners.items() if s in names
+                })
+            except Exception as e:
+                self.runtime.logger.debug(f"owner-map refresh failed: {e}")
+        return self._owner_map.read()
 
     def _rebalance_tick(self) -> None:
         """Timer body: recover leftovers once (retried until it lands —

@@ -35,6 +35,10 @@ optimization (PAPERS.md: arxiv 1712.08285 per-stage timing, arxiv
 - :mod:`.slo` — Google-SRE multi-window burn-rate evaluation over the
   store (detection latency, per-queue lag/wait, epoch age), paging
   through the decision ring and degrading ``/healthz`` on fast burn.
+- :mod:`.queryplane` — the fleet read front door (DESIGN.md §10.5):
+  hash-routed single-service queries, scatter-gather merges with
+  sum-then-quantile histogram semantics, and a durable degraded read
+  path through the recorder store with per-shard freshness marking.
 
 Everything here is stdlib-only and import-light: no jax at import time
 (the /profile route imports it lazily), no hard dependency from any hot
@@ -54,9 +58,10 @@ from .registry import (
     relabel_metrics,
     set_registry,
 )
+from .queryplane import QueryPlane
 from .recorder import FleetRecorder
 from .slo import SLOEngine
-from .store import TimeSeriesStore, eval_range, make_query_route
+from .store import TimeSeriesStore, eval_range, make_query_route, matrix_doc
 from .trace import SpanRing, Tracer, get_tracer
 from .tracing import TickTracer
 
@@ -66,6 +71,7 @@ __all__ = [
     "FleetRecorder",
     "FlightRecorder",
     "MetricsRegistry",
+    "QueryPlane",
     "SLOEngine",
     "Sample",
     "SpanRing",
@@ -81,6 +87,7 @@ __all__ = [
     "get_tracer",
     "histogram_quantile",
     "make_query_route",
+    "matrix_doc",
     "merge_snapshots",
     "parse_prom_text",
     "relabel_metrics",

@@ -36,6 +36,11 @@ exposed as :attr:`TelemetryServer.port`). Routes:
 - ``GET /metrics?exemplars=1`` — OpenMetrics-style exposition with
   histogram bucket exemplars (``# {trace_id="..."} value ts``).
 - extra routes via :meth:`add_route` (the manager mounts ``/fleet``).
+  Registered routes OVERRIDE the built-ins at the same path — the
+  manager uses this to replace its per-process ``/query`` ``/trace``
+  ``/decisions`` ``/attrib`` with the fleet-wide query plane
+  (obs.queryplane), which scatter-gathers across children and falls
+  back to the recorder store for dead shards.
 
 Health providers and routes are plain callables so modules register
 without this module importing them (no cycle into pipeline/runtime).

@@ -74,6 +74,10 @@ class FleetRecorder:
         }
         self._errors_by_module: Dict[str, int] = {}  # guarded-by: _lock
         self._last = {"ts": 0.0, "targets": 0, "ok": 0}  # guarded-by: _lock
+        # wall time of the last SUCCESSFUL scrape per target — the query
+        # plane's per-shard freshness source (how stale is the durable
+        # fallback for a dead shard)
+        self._last_ok_by_module: Dict[str, float] = {}  # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if registry is not None:
@@ -181,6 +185,8 @@ class FleetRecorder:
             try:
                 self._scrape_target(name, base.rstrip("/"), now)
                 ok += 1
+                with self._lock:
+                    self._last_ok_by_module[name] = now
             except Exception as e:
                 self._note_error(name)
                 if self._logger:
@@ -226,9 +232,17 @@ class FleetRecorder:
         if t is not None:
             t.join(timeout=self.timeout_s + self.interval_s + 1.0)
 
+    def freshness(self) -> Dict[str, float]:
+        """{target name: unixtime of its last successful scrape} — what the
+        query plane reports as per-shard staleness when serving a dead
+        shard from the durable store."""
+        with self._lock:
+            return dict(self._last_ok_by_module)
+
     def status(self) -> dict:
         with self._lock:
             out = {"last": dict(self._last), "counts": dict(self._counts),
-                   "errors_by_module": dict(self._errors_by_module)}
+                   "errors_by_module": dict(self._errors_by_module),
+                   "freshness": dict(self._last_ok_by_module)}
         out["store"] = self.store.stats()
         return out

@@ -688,7 +688,7 @@ class TimeSeriesStore:
 # ---------------------------------------------------------------------------
 
 _EXPR_RE = re.compile(
-    r"^\s*(?:(?P<fn>rate|histogram_quantile)\s*\(\s*"
+    r"^\s*(?:(?P<fn>rate|increase|histogram_quantile)\s*\(\s*"
     r"(?:(?P<q>[0-9.]+)\s*,\s*)?)?"
     r"(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<sel>[^}]*)\})?"
@@ -767,6 +767,9 @@ def eval_range(
     - ``name`` / ``name{label="v"}`` — instant vector per step
     - ``rate(name[Ns])`` — reset-aware counter rate (window defaults to
       4×step when ``[Ns]`` is omitted)
+    - ``increase(name[Ns])`` — the reset-aware counter increase itself
+      (undivided). The query plane merges histograms with this: per-shard
+      bucket increases are summable, per-shard quantiles are not.
     - ``histogram_quantile(q, name[Ns])`` — prometheus quantile over the
       ``name_bucket`` series, grouped by labels minus ``le``. Buckets are
       WINDOWED first (reset-aware increase over ``[Ns]``, defaulting to
@@ -834,13 +837,16 @@ def eval_range(
         return {"expr": expr, "start": start, "end": end, "step": step,
                 "series": series_out}
 
-    lb = window if fn == "rate" else lookback
+    lb = window if fn in ("rate", "increase") else lookback
     groups = store.series_points(name, start - lb, end, sel)
     for key, pts in sorted(groups.items()):
         pts_out = []
         for t in steps:
             if fn == "rate":
                 v = _rate(pts, t, window)
+            elif fn == "increase":
+                got = _increase(pts, t, window)
+                v = got[0] if got is not None else None
             else:
                 v = _instant(pts, t, lookback)
             pts_out.append([t, None if v is None or not math.isfinite(v) else v])
@@ -849,15 +855,34 @@ def eval_range(
             "series": series_out}
 
 
+def matrix_doc(doc: dict) -> dict:
+    """Convert an :func:`eval_range` result into Prometheus range-matrix
+    JSON (``format=matrix``): one ``{metric, values}`` entry per labelset,
+    values as ``[unixtime, "string"]`` pairs with null points dropped —
+    the shape a Grafana JSON datasource consumes directly. Extra serving
+    fields the query plane added (``shards``/``partial``/...) do not
+    belong to the Prometheus schema and are not carried over."""
+    result = []
+    for s in doc.get("series", []):
+        values = [[t, repr(float(v))] for t, v in s.get("points", [])
+                  if v is not None]
+        result.append({"metric": dict(s.get("labels", {})), "values": values})
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": result}}
+
+
 def make_query_route(store_fn: Callable[[], Optional[TimeSeriesStore]]):
     """Build a TelemetryServer ``/query`` route over a store accessor.
 
     ``GET /query?series=<expr>&start=&end=&step=`` evaluates a range
     expression; ``GET /query?kind=spans|decisions|names|stats`` reads the
     other record kinds (the dead-shard triage path). Label filters ride
-    as plain query params (e.g. ``&module=shard0``).
+    as plain query params (e.g. ``&module=shard0``). ``&format=matrix``
+    reshapes series results into Prometheus range-matrix JSON (a Grafana
+    JSON datasource consumes it directly); the default shape is unchanged.
     """
-    _reserved = {"series", "kind", "start", "end", "step", "limit", "q"}
+    _reserved = {"series", "kind", "start", "end", "step", "limit", "q",
+                 "format", "cache"}
 
     def route(query):
         # the exporter hands parse_qs dicts (list values) and expects a
@@ -887,6 +912,8 @@ def make_query_route(store_fn: Callable[[], Optional[TimeSeriesStore]]):
                 body = {"kind": "stats", "stats": store.stats()}
             elif q.get("series"):
                 body = eval_range(store, q["series"], start, end, step)
+                if q.get("format") == "matrix":
+                    body = matrix_doc(body)
             else:
                 return 400, "text/plain; charset=utf-8", \
                     "need ?series=<expr> or ?kind=spans|decisions|names|stats\n"

@@ -40,6 +40,8 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -104,6 +106,83 @@ def tx_partition_key(line: str, key: str = "service") -> Optional[str]:
     if len(p) < 4 or p[0] != "tx":
         return None
     return p[1] if key == "server" else p[2]
+
+
+# ---------------------------------------------------------------------------
+# Owner map: the seq-versioned partition -> shard read API (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class OwnerMap:
+    """Seq-versioned view of partition → owner for read-side routing.
+
+    The fleet query plane routes single-service reads by
+    ``service_partition`` + this map, and needs rebalance consistency: a
+    query racing a partition handoff must notice the move and retry
+    rather than double-count or drop the moving partition. The contract
+    is therefore *read-with-a-seq*: :meth:`read` returns ``(seq,
+    owners)`` atomically, and the seq bumps ONLY when ownership actually
+    changed — a reader that sees the same seq before and after its
+    fan-out knows no partition moved underneath it.
+
+    Owner values are routing-target names (opaque strings — the
+    manager uses module names, the harness ``shard<k>``); feeds that
+    observe integer shard ids convert before :meth:`update`. Partitions
+    absent from the map have no known owner (their shard is dead or not
+    yet scraped) and the reader falls back to scatter.
+
+    Thread-safe: updated from scrape/rebalance paths, read from HTTP
+    handler threads.
+    """
+
+    def __init__(self, owners: Optional[Dict[int, str]] = None):
+        self._lock = threading.Lock()
+        self._seq = 0  # guarded-by: _lock
+        self._owners: Dict[int, str] = {}  # guarded-by: _lock
+        if owners:
+            self.update(owners)
+
+    def update(self, owners: Dict[int, str]) -> int:
+        """Replace the whole map; bumps the seq only on real change (a
+        steady-state rescrape that observes the same ownership must not
+        force query retries). Returns the current seq."""
+        new = {int(p): o for p, o in dict(owners).items()}
+        with self._lock:
+            if new != self._owners:
+                self._owners = new
+                self._seq += 1
+            return self._seq
+
+    def move(self, partition: int, owner: str) -> int:
+        """Record one executed handoff (the controller's post-adopt
+        bookkeeping); bumps the seq only if the owner changed."""
+        with self._lock:
+            if self._owners.get(int(partition)) != owner:
+                self._owners[int(partition)] = owner
+                self._seq += 1
+            return self._seq
+
+    def read(self) -> Tuple[int, Dict[int, str]]:
+        """``(seq, owners copy)`` — one atomic view+version."""
+        with self._lock:
+            return self._seq, dict(self._owners)
+
+
+_OWNER_LINE_RE = re.compile(
+    r'^apm_fleet_partition_owner\{[^}]*partition="(\d+)"[^}]*\}\s+'
+    r'([0-9eE+.\-]+)', re.M)
+
+
+def owner_map_from_fleet_text(text: str) -> Dict[int, int]:
+    """Parse ``apm_fleet_partition_owner{partition="K"} <shard>`` lines out
+    of a manager ``/fleet`` exposition -> {partition: shard id}. The
+    standalone query plane bootstraps its owner feed from this (the
+    manager synthesizes those lines from each shard's
+    ``apm_partition_lag`` attribution)."""
+    out: Dict[int, int] = {}
+    for m in _OWNER_LINE_RE.finditer(text or ""):
+        out[int(m.group(1))] = int(float(m.group(2)))
+    return out
 
 
 class FleetPartitioner:
@@ -414,6 +493,12 @@ class FleetHarness:
         self.sent_per_queue: Dict[str, int] = {
             partition_queue(base_queue, p): 0 for p in range(self.partitions)
         }
+        # seq-versioned routing view for the query plane: seeded with the
+        # static modulo placement the shards boot with, advanced by
+        # rebalance() as handoffs execute
+        self.owner_map = OwnerMap(
+            {p: f"shard{p % shards}" for p in range(self.partitions)}
+        )
 
     # -- stream --------------------------------------------------------------
     def send_line(self, line: str) -> int:
@@ -509,6 +594,7 @@ class FleetHarness:
             "adopt", partition=p, path=handoff, timeout_s=timeout_s
         )
         self._mark_event("rebalance", partition=p, frm=frm, to=to)
+        self.owner_map.move(p, f"shard{to}")
         return {"released": released, "adopted": adopted, "path": handoff}
 
     # -- completion ----------------------------------------------------------

@@ -465,6 +465,95 @@ class ChaosWorkerHarness:
         self._producer.close()
 
 
+class QueryLoad:
+    """Concurrent dashboard-load generator against query-plane URLs —
+    the read-side chaos instrument (ISSUE 20). Seeded like every chaos
+    seam: thread ``i`` walks its own ``Random(seed + i)`` URL sequence,
+    so a failing drill replays the same request mix. Collects status
+    codes, transport errors, and latencies; ``stop()`` returns the
+    summary the kill−9 drill asserts on (zero 5xx, p95 bound).
+
+    Degraded-serving honesty is the point: an HTTP error status is
+    recorded under its code (a 5xx mid-drill is a FINDING), while a
+    transport-level failure (connection refused while the front door
+    itself restarts) counts separately as an error, not a 5xx.
+    """
+
+    def __init__(self, urls: List[str], *, threads: int = 4,
+                 timeout_s: float = 5.0, seed: int = 0):
+        if not urls:
+            raise ValueError("QueryLoad needs at least one URL")
+        self.urls = list(urls)
+        self.threads = max(1, int(threads))
+        self.timeout_s = float(timeout_s)
+        self.seed = int(seed)
+        self._stop = threading.Event()
+        self._workers: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._codes: dict = {}  # guarded-by: _lock
+        self._latencies_ms: List[float] = []  # guarded-by: _lock
+        self._errors = 0  # guarded-by: _lock
+
+    def _one(self, rng: random.Random) -> None:
+        import urllib.error
+        import urllib.request
+
+        url = rng.choice(self.urls)
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                resp.read()
+                code = resp.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        except Exception:
+            with self._lock:
+                self._errors += 1
+            return
+        ms = (time.monotonic() - t0) * 1000.0
+        with self._lock:
+            self._codes[code] = self._codes.get(code, 0) + 1
+            self._latencies_ms.append(ms)
+
+    def start(self) -> "QueryLoad":
+        def run(i):
+            rng = random.Random(self.seed + i)
+            while not self._stop.is_set():
+                self._one(rng)
+
+        self._workers = [
+            threading.Thread(target=run, args=(i,), daemon=True,
+                             name=f"query-load-{i}")
+            for i in range(self.threads)
+        ]
+        for t in self._workers:
+            t.start()
+        return self
+
+    def stop(self) -> dict:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=self.timeout_s + 1.0)
+        with self._lock:
+            lats = sorted(self._latencies_ms)
+            codes = dict(self._codes)
+            errors = self._errors
+
+        def pct(p):
+            if not lats:
+                return None
+            return lats[min(len(lats) - 1, int(p * (len(lats) - 1)))]
+
+        return {
+            "requests": sum(codes.values()),
+            "codes": codes,
+            "five_xx": sum(n for c, n in codes.items() if 500 <= c < 600),
+            "errors": errors,
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+        }
+
+
 def _child_main(argv=None) -> int:
     """The harness child: the production worker epoch cycle over a spool.
 

@@ -271,6 +271,26 @@ def range_query_store(store_dir: str, expr: str, start: float, end: float,
 def format_range_result(doc: dict) -> str:
     lines = [f"# {doc.get('expr')}  [{doc.get('start'):.0f} .. "
              f"{doc.get('end'):.0f}] step {doc.get('step'):g}s"]
+    # a fleet query plane answer carries per-shard serving provenance:
+    # show WHICH shards answered live, which were served stale from the
+    # durable store (and how old that slice is), and which were dead —
+    # the triage runbook's first question about a partial result
+    shards = doc.get("shards")
+    if isinstance(shards, dict) and shards:
+        flags = []
+        if doc.get("partial"):
+            flags.append("PARTIAL")
+        if doc.get("stale"):
+            flags.append("STALE")
+        if doc.get("cached"):
+            flags.append("cached")
+        lines.append("# shards: " + (" ".join(flags) if flags else "all live"))
+        for name in sorted(shards):
+            st = shards[name] or {}
+            fresh = st.get("freshness_s")
+            fresh_s = "-" if fresh is None else f"{fresh:g}s"
+            lines.append(f"#   {name:<12} {st.get('status', '?'):<6} "
+                         f"freshness={fresh_s}")
     series = doc.get("series", [])
     if not series:
         lines.append("(no matching series)")
@@ -344,7 +364,13 @@ def slo_health_url(url: str, timeout_s: float = 5.0) -> dict:
             body = json.loads(resp.read().decode("utf-8", "replace"))
     except urllib.error.HTTPError as e:  # 503 = fast-burn; body is the answer
         body = json.loads(e.read().decode("utf-8", "replace"))
-    return {"status": body.get("status"), "slo": body.get("slo")}
+    out = {"status": body.get("status"), "slo": body.get("slo")}
+    # against a fleet query plane (the manager front door) the healthz
+    # also carries per-shard serving state — surface it beside the SLO
+    # so "is the answer itself degraded" rides along with burn rates
+    if body.get("queryplane") is not None:
+        out["queryplane"] = body.get("queryplane")
+    return out
 
 
 def main(argv=None) -> int:
@@ -369,7 +395,9 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--range", dest="range_expr", metavar="EXPR",
         help="evaluate a range query (name, rate(name[Ns]), "
-        "histogram_quantile(q, name)) via --metrics-url /query or --store",
+        "histogram_quantile(q, name)) via --metrics-url /query or --store; "
+        "point --metrics-url at the manager's fleet query plane to get the "
+        "merged fleet answer with per-shard freshness/staleness printed",
     )
     ap.add_argument("--start", type=float,
                     help="range start unix ts (default: end - 900)")
@@ -380,7 +408,8 @@ def main(argv=None) -> int:
                     help="range step seconds (default 15)")
     ap.add_argument("--slo", action="store_true",
                     help="evaluate SLO burn rates over --store, or show a "
-                    "live engine's /healthz slo section via --metrics-url")
+                    "live engine's /healthz slo section via --metrics-url "
+                    "(a query-plane URL adds its per-shard serving state)")
     ap.add_argument("--at", type=float,
                     help="--slo evaluation instant (default: newest stored "
                     "sample)")

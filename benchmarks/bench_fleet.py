@@ -54,6 +54,215 @@ def _tx(t: int, i: int, seq: int, base: int, elapsed: int) -> str:
     )
 
 
+def _queryplane_cert(h, rec_store, recorder, quick: bool) -> dict:
+    """ISSUE 20: certify the fleet query plane against the LIVE fleet.
+
+    Four legs, all over real shard subprocesses:
+
+    - **routing**: for a sample of services, a single-service query must be
+      answered by EXACTLY the owning shard per the live owner map
+      (``shards_queried`` is the proof carried in the response);
+    - **merge**: a deterministic two-store scatter fixture whose merged
+      answer must be bit-equal (``==`` on the series lists, no tolerance)
+      to a single golden store holding both shards' rows;
+    - **serving**: QueryLoad QPS + p50/p95 against the mounted plane with
+      the TTL cache on, then the same shape with ``cache=0`` for the
+      read-through delta;
+    - **degraded drill**: kill −9 one shard UNDER the query load; the load
+      must see zero 5xx, and a post-kill query must answer ``partial`` with
+      the victim marked ``stale`` + a positive freshness from the recorder
+      store. The victim is restarted before returning so the fleet drains
+      and finishes clean.
+    """
+    import json as _json
+    import os as _os
+    import urllib.parse as _uparse
+    import urllib.request as _urlreq
+
+    from apmbackend_tpu.obs import (
+        MetricsRegistry, QueryPlane, TelemetryServer, TimeSeriesStore,
+        eval_range, make_query_route)
+    from apmbackend_tpu.parallel.fleet import service_partition
+    from apmbackend_tpu.testing.chaos import QueryLoad
+
+    shards = len(h.procs)
+    reg = MetricsRegistry()
+    plane = QueryPlane(
+        lambda: h.metrics_targets(timeout_s=0.5),
+        owners=h.owner_map.read,
+        store=rec_store,
+        partitions=h.partitions,
+        registry=reg,
+        freshness=recorder.freshness,
+        cache_ttl_s=0.25,
+        timeout_s=2.0,
+    )
+    psrv = TelemetryServer(reg, port=0, module="queryplane")
+    for path, fn in plane.make_routes().items():
+        psrv.add_route(path, fn)
+    psrv.start()
+    base = psrv.url
+
+    def _get(path, **params):
+        qs = _uparse.urlencode(params)
+        with _urlreq.urlopen(f"{base}{path}?{qs}", timeout=10.0) as resp:
+            return _json.loads(resp.read().decode("utf-8", "replace"))
+
+    fix_a = fix_b = fix_g = None
+    srv_a = srv_b = None
+    load_summary = {}
+    try:
+        now = time.time()
+        # -- leg 1: single-service routing vs the live owner map ----------
+        _seq, owners = h.owner_map.read()
+        routing = []
+        for i in (0, 1, 2, 5):
+            svc = _key(i)[1]
+            p = service_partition(svc, h.partitions)
+            doc = _get("/query", series="apm_engine_tx_ingested_total",
+                       service=svc, start=f"{now - 120:.0f}",
+                       end=f"{now:.0f}", step="10", cache="0")
+            routing.append({
+                "service": svc, "partition": p, "owner": owners.get(p),
+                "shards_queried": doc.get("shards_queried"),
+                "exact": doc.get("shards_queried") == [owners.get(p)],
+            })
+        routing_exact = all(r["exact"] for r in routing)
+
+        # -- leg 2: scatter merge bit-equal to a single-store golden ------
+        # label-disjoint per-shard slices (the fleet case: each shard owns
+        # its services) so golden == concatenation — equality must be
+        # BIT-equal, the whole point of merging buckets/increases rather
+        # than per-shard quantiles
+        t0f = 1_000_000.0
+        fixdir = _os.path.join(h.workdir, "qp_fixture")
+        fix_a = TimeSeriesStore(_os.path.join(fixdir, "a"))
+        fix_b = TimeSeriesStore(_os.path.join(fixdir, "b"))
+        fix_g = TimeSeriesStore(_os.path.join(fixdir, "golden"))
+        for t in range(8):
+            rows_a = [("apm_fix_total", {"service": "svcA"}, 5.0 * t)]
+            rows_b = [("apm_fix_total", {"service": "svcB"}, 2.0 * t)]
+            fix_a.append_samples(rows_a, ts=t0f + t)
+            fix_b.append_samples(rows_b, ts=t0f + t)
+            fix_g.append_samples(rows_a + rows_b, ts=t0f + t)
+        srv_a = TelemetryServer(MetricsRegistry(), port=0)
+        srv_a.add_route("/query", make_query_route(lambda: fix_a))
+        srv_b = TelemetryServer(MetricsRegistry(), port=0)
+        srv_b.add_route("/query", make_query_route(lambda: fix_b))
+        pa, pb = srv_a.start(), srv_b.start()
+        fix_plane = QueryPlane(
+            lambda: [("fa", f"http://127.0.0.1:{pa}"),
+                     ("fb", f"http://127.0.0.1:{pb}")],
+            cache_ttl_s=0.0, timeout_s=5.0)
+        merge_checks = {}
+        for expr in ("apm_fix_total", "rate(apm_fix_total[2s])",
+                     "increase(apm_fix_total[2s])"):
+            st, _ct, body = fix_plane.make_routes()["/query"]({
+                "series": [expr], "start": [f"{t0f + 2}"],
+                "end": [f"{t0f + 7}"], "step": ["1"]})
+            fleet_doc = _json.loads(body)
+            golden = eval_range(fix_g, expr, t0f + 2, t0f + 7, 1.0)
+            merge_checks[expr] = bool(
+                st == 200 and fleet_doc["series"] == golden["series"])
+        merge_bitequal = all(merge_checks.values())
+
+        # -- leg 3: serving under load, cache on vs off -------------------
+        load_urls = [
+            f"{base}/query?" + _uparse.urlencode(
+                {"series": "rate(apm_engine_tx_ingested_total[10s])"}),
+            f"{base}/query?" + _uparse.urlencode(
+                {"series": "apm_engine_tx_ingested_total"}),
+            f"{base}/trace?n=32",
+            f"{base}/decisions?n=32",
+        ]
+        span = 1.0 if quick else 3.0
+        lt0 = time.monotonic()
+        warm = QueryLoad(load_urls, threads=4, seed=3).start()
+        time.sleep(span)
+        warm_sum = warm.stop()
+        warm_wall = time.monotonic() - lt0
+        lt0 = time.monotonic()
+        cold = QueryLoad([u + "&cache=0" for u in load_urls
+                          if u.startswith(f"{base}/query")],
+                         threads=4, seed=4).start()
+        time.sleep(span)
+        cold_sum = cold.stop()
+        cold_wall = time.monotonic() - lt0
+
+        # -- leg 4: kill −9 one shard UNDER query load --------------------
+        victim = shards - 1
+        drill = QueryLoad(load_urls, threads=4, seed=5).start()
+        time.sleep(0.4)
+        h.kill9(victim)
+        time.sleep(2.0 if quick else 3.0)
+        now = time.time()
+        post = _get("/query", series="apm_engine_tx_ingested_total",
+                    start=f"{now - 600:.0f}", end=f"{now:.0f}",
+                    step="10", cache="0")
+        load_summary = drill.stop()
+        vstat = (post.get("shards") or {}).get(f"shard{victim}", {})
+        drill_cert = {
+            "victim": f"shard{victim}",
+            "requests": load_summary["requests"],
+            "five_xx": load_summary["five_xx"],
+            "client_errors": load_summary["errors"],
+            "p50_ms": load_summary["p50_ms"],
+            "p95_ms": load_summary["p95_ms"],
+            "post_kill_partial": bool(post.get("partial")),
+            "post_kill_stale": bool(post.get("stale")),
+            "victim_status": vstat.get("status"),
+            "victim_freshness_s": vstat.get("freshness_s"),
+            "zero_5xx": load_summary["five_xx"] == 0
+            and load_summary["errors"] == 0,
+            "p95_under_250ms": (load_summary["p95_ms"] is not None
+                                and load_summary["p95_ms"] <= 250.0),
+        }
+        h.start(victim)  # restore: the fleet must drain + finish clean
+
+        stats = plane.stats()
+        certified = bool(
+            routing_exact and merge_bitequal
+            and drill_cert["zero_5xx"] and drill_cert["post_kill_partial"]
+            and drill_cert["post_kill_stale"]
+            and drill_cert["victim_status"] == "stale"
+            and (drill_cert["victim_freshness_s"] or 0) > 0
+            and drill_cert["p95_under_250ms"]
+        )
+        return {
+            "certified": certified,
+            "routing": {"exact": routing_exact, "samples": routing},
+            "merge_bitequal": merge_bitequal,
+            "merge_checks": merge_checks,
+            "serving": {
+                "cache_on": dict(warm_sum,
+                                 qps=round(warm_sum["requests"] / warm_wall, 1),
+                                 codes={str(k): v for k, v
+                                        in warm_sum["codes"].items()}),
+                "cache_off": dict(cold_sum,
+                                  qps=round(cold_sum["requests"] / cold_wall, 1),
+                                  codes={str(k): v for k, v
+                                         in cold_sum["codes"].items()}),
+                "cache_hit_ratio": round(
+                    stats["cache_hits"] / max(1, stats["requests"]), 4),
+            },
+            "degraded_drill": drill_cert,
+            "plane_stats": {
+                "requests": stats["requests"],
+                "errors": stats["errors"],
+                "cache_hits": stats["cache_hits"],
+                "owner_seq": stats["owner_seq"],
+            },
+        }
+    finally:
+        psrv.stop()
+        for srv in (srv_a, srv_b):
+            if srv is not None:
+                srv.stop()
+        for stx in (fix_a, fix_b, fix_g):
+            if stx is not None:
+                stx.close()
+
+
 def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
         services: int = 7200, per_label: int = 512, labels: int = 48,
         warmup_labels: int = 16, lags: str = "360,8640",
@@ -145,6 +354,17 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
         for t in range(1, warmup_labels):
             send_label(t, per_label)
         wait_drained(0)
+
+        # -- ISSUE 20: fleet query plane certification ---------------------
+        # BEFORE the measured phase on purpose: the drill kill −9s a shard,
+        # which wipes that shard's in-memory tick-tracer ring — killed here,
+        # the restarted process's ring still holds the whole measured phase
+        # for the detection accounting below. The boot-striped owner map is
+        # exact at this point (no rebalance has run yet), so the routing
+        # leg certifies against the real topology.
+        queryplane_cert = _queryplane_cert(h, rec_store, recorder, quick)
+        wait_drained(0)
+        time.sleep(0.5)  # let the victim's replay/compile settle
 
         # -- ISSUE 17 baseline: scrape /attrib now so the certification
         # after the drill can diff it out — warmup holds the first-tick
@@ -324,6 +544,12 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
                        for r in slo_results if r.get("severity") == "slow"})
         slo_cert = {
             "objectives_evaluated": len(slo_results),
+            # the window includes the ISSUE 20 kill −9 drill: its replay
+            # redelivers items with their ORIGINAL enqueue stamps, so a
+            # queue_wait burn on the victim's partitions is the SLO engine
+            # observing the drill honestly (timing-dependent on how much
+            # the kill left unacked), not a serving regression
+            "window_includes_kill9_drill": True,
             "fast_burning": fast,
             "slow_burning": slow,
             "compliant": not fast,
@@ -467,6 +693,12 @@ def run(quick: bool = False, *, shards: int = 4, capacity: int = 2048,
                 # ISSUE 17: fleet-merged /attrib — the bottleneck estimator
                 # must name tick_cadence for the flow-controlled e2e shape
                 "attribution": attribution_cert,
+                # ISSUE 20: hash-routed scatter-gather serving over the
+                # live fleet — exact single-service routing, bit-equal
+                # cross-shard merge, QPS/latency with the cache on/off,
+                # and the kill −9 degraded-read drill (zero 5xx, partial/
+                # stale marking, p95 <= 250 ms under concurrent load)
+                "queryplane": queryplane_cert,
             },
         )
     finally:

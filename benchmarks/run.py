@@ -59,6 +59,24 @@ def _trajectory_row(res: dict) -> dict:
                       "verdict", "overhead_pct", "within_gate")
             if k in att
         }
+    qp = d.get("queryplane")
+    if isinstance(qp, dict):
+        # ISSUE 20: the query-plane serving certification — routing/merge/
+        # degraded-drill verdicts plus the headline serving numbers
+        serving = qp.get("serving") or {}
+        drill = qp.get("degraded_drill") or {}
+        row["queryplane"] = {
+            "certified": qp.get("certified"),
+            "routing_exact": (qp.get("routing") or {}).get("exact"),
+            "merge_bitequal": qp.get("merge_bitequal"),
+            "qps_cached": (serving.get("cache_on") or {}).get("qps"),
+            "qps_uncached": (serving.get("cache_off") or {}).get("qps"),
+            "cache_hit_ratio": serving.get("cache_hit_ratio"),
+            "drill_p95_ms": drill.get("p95_ms"),
+            "drill_zero_5xx": drill.get("zero_5xx"),
+            "drill_partial_stale": bool(drill.get("post_kill_partial"))
+            and bool(drill.get("post_kill_stale")),
+        }
     return row
 
 
@@ -135,9 +153,19 @@ def main(argv=None) -> int:
               f"({slo.get('recorder_rows')} rows recorded over "
               f"{slo.get('recorder_scrapes')} scrapes)",
               file=sys.stderr, flush=True)
+        qp = d.get("queryplane", {})
+        qp_drill = qp.get("degraded_drill", {})
+        print(f"queryplane: certified={qp.get('certified')} "
+              f"routing_exact={qp.get('routing', {}).get('exact')} "
+              f"merge_bitequal={qp.get('merge_bitequal')} "
+              f"drill(5xx={qp_drill.get('five_xx')} "
+              f"p95={qp_drill.get('p95_ms')}ms "
+              f"partial={qp_drill.get('post_kill_partial')})",
+              file=sys.stderr, flush=True)
         ok = bool(d.get("meets_1m_aggregate")) and bool(d.get("meets_100ms_budget")) \
             and bool(d.get("rebalance", {}).get("zero_loss")) \
-            and bool(d.get("rebalance", {}).get("conformance_clean"))
+            and bool(d.get("rebalance", {}).get("conformance_clean")) \
+            and bool(qp.get("certified"))
         return 0 if ok else 1
 
     names = args.config or sorted(REGISTRY)

@@ -98,15 +98,19 @@ fi
 # with fleet trace conformance, and the ISSUE 18 self-managing drills:
 # watermark-controller convergence on a skewed load then quiet, kill −9
 # of the releasing shard mid-move, manager death mid-decision with
-# recover()) plus every fast in-process fleet test. Tier-1 keeps only
+# recover(), and the ISSUE 20 query-plane drill: kill −9 one shard under
+# live dashboard query load and require partial/stale-marked answers
+# from the recorder store with zero 5xx) plus every fast in-process
+# fleet test and the query-plane merge/routing suite. Tier-1 keeps only
 # the in-process fast paths; run this before touching parallel/fleet.py,
-# parallel/rebalancer.py, the worker's partition handoff, or
-# shardmodel.py: ./run_tests.sh --fleet [pytest args...].
+# parallel/rebalancer.py, obs/queryplane.py, the worker's partition
+# handoff, or shardmodel.py: ./run_tests.sh --fleet [pytest args...].
 if [ "$1" = "--fleet" ]; then
     shift
     exec env -u PYTHONPATH JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -m pytest tests/test_fleet.py tests/test_fleet_chaos.py \
+        tests/test_queryplane.py \
         tests/test_protocol_models.py \
         -m "slow or not slow" "$@"
 fi

@@ -564,3 +564,112 @@ def test_controller_recovers_manager_death_mid_move(tmp_path):
         assert check_fleet_trace(h.merged_events(), n_shards=2) == []
     finally:
         h.close()
+
+
+def test_queryplane_kill9_drill_partial_stale_zero_5xx(tmp_path):
+    """ISSUE 20 CI drill: a fleet query plane over a live 2-shard fleet
+    with the recorder store as the durable read path; kill -9 one shard
+    MID-query-load. (a) the concurrent dashboard load never sees a 5xx —
+    the dead shard's slice degrades to the recorder store; (b) a post-kill
+    query answers 200 with ``partial``/``stale`` marking and a positive
+    per-shard freshness for the victim; (c) pre-kill, a single-service
+    query is answered by exactly the owning shard per the owner map."""
+    import urllib.parse
+
+    from apmbackend_tpu.obs import (
+        FleetRecorder,
+        MetricsRegistry,
+        QueryPlane,
+        TelemetryServer,
+        TimeSeriesStore,
+    )
+    from apmbackend_tpu.testing.chaos import QueryLoad
+
+    h = _fleet(tmp_path, metrics=True)
+    store = TimeSeriesStore(str(tmp_path / "rec-store"))
+    rec = None
+    psrv = None
+    try:
+        h.start_all()
+        rec = FleetRecorder(
+            store, lambda: h.metrics_targets(timeout_s=30.0),
+            interval_s=0.25)
+        rec.start()
+        _send_labels(h, 0, 4)
+        for p in range(h.partitions):
+            h.wait_acked(p, h.sent_per_queue[f"transactions.p{p}"],
+                         timeout_s=120)
+        time.sleep(0.8)  # a couple of recorder passes + shard self-samples
+
+        reg = MetricsRegistry()
+        plane = QueryPlane(
+            lambda: h.metrics_targets(timeout_s=0.5),
+            owners=h.owner_map.read,
+            store=store,
+            partitions=h.partitions,
+            registry=reg,
+            freshness=rec.freshness,
+            cache_ttl_s=0.25,
+            timeout_s=2.0,
+        )
+        psrv = TelemetryServer(reg, port=0, module="queryplane")
+        for route_path, route_fn in plane.make_routes().items():
+            psrv.add_route(route_path, route_fn)
+        psrv.start()
+        base = psrv.url
+        now = time.time()
+
+        # (c) single-service routing: exactly the owning shard answers
+        svc = "svc003"
+        p = service_partition(svc, h.partitions)
+        owner = h.owner_map.read()[1][p]
+        qs = urllib.parse.urlencode({
+            "series": "apm_engine_tx_ingested_total", "service": svc,
+            "start": f"{now - 120:.0f}", "end": f"{now:.0f}", "step": "10"})
+        status, doc = _fetch(f"{base}/query?{qs}")
+        assert status == 200
+        assert doc["shards_queried"] == [owner]
+        assert doc["partial"] is False
+
+        urls = [
+            f"{base}/query?" + urllib.parse.urlencode(
+                {"series": "rate(apm_engine_tx_ingested_total[10s])"}),
+            f"{base}/query?" + urllib.parse.urlencode(
+                {"series": "apm_queue_lag"}),
+            f"{base}/trace?n=64",
+            f"{base}/decisions?n=64",
+        ]
+        load = QueryLoad(urls, threads=3, seed=11).start()
+        time.sleep(0.6)
+        h.kill9(1)  # -- the drill: victim dies under live dashboard load
+        time.sleep(2.5)
+        summary = load.stop()
+        # (a) degraded serving, never failed serving
+        assert summary["five_xx"] == 0, summary
+        assert summary["errors"] == 0, summary
+        assert summary["requests"] > 0
+        assert summary["codes"].get(200, 0) == summary["requests"]
+
+        # (b) explicit post-kill query: partial + stale + freshness
+        now = time.time()
+        qs = urllib.parse.urlencode({
+            "series": "apm_engine_tx_ingested_total", "cache": "0",
+            "start": f"{now - 600:.0f}", "end": f"{now:.0f}", "step": "10"})
+        status, doc = _fetch(f"{base}/query?{qs}")
+        assert status == 200
+        assert doc["partial"] is True and doc["stale"] is True
+        assert doc["shards"]["shard0"]["status"] == "live"
+        assert doc["shards"]["shard1"]["status"] == "stale"
+        assert doc["shards"]["shard1"]["freshness_s"] > 0
+        # the dead shard's slice really is in the merged answer
+        assert any(s["points"] and any(v is not None for _t, v in s["points"])
+                   for s in doc["series"])
+
+        h.start(1)  # restore the victim so the fleet drains clean
+        h.finish(timeout_s=300)
+    finally:
+        if rec is not None:
+            rec.stop()
+        if psrv is not None:
+            psrv.stop()
+        h.close()

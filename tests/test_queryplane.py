@@ -1,0 +1,699 @@
+"""Fleet query plane tests (ISSUE 20): owner-map routing, scatter-gather
+merge math (sum-then-quantile histograms, reset-aware cross-shard rates),
+the durable degraded read path with partial/stale marking, the TTL
+coalescing cache, retry-on-move rebalance consistency, format=matrix,
+and the qstat rendering of per-shard freshness."""
+
+import json
+import math
+import socket
+import threading
+import time
+
+import pytest
+
+from apmbackend_tpu.obs.exporter import TelemetryServer
+from apmbackend_tpu.obs.queryplane import (
+    QueryPlane,
+    _TTLCache,
+    _merge_histogram,
+    _merge_series,
+)
+from apmbackend_tpu.obs.registry import MetricsRegistry, histogram_quantile
+from apmbackend_tpu.obs.store import (
+    TimeSeriesStore,
+    eval_range,
+    make_query_route,
+    matrix_doc,
+)
+from apmbackend_tpu.parallel.fleet import (
+    OwnerMap,
+    owner_map_from_fleet_text,
+    service_partition,
+)
+
+T0 = 1_000_000.0
+
+
+# -- fixtures ----------------------------------------------------------------
+
+def mem_store(tmp_path, name, rows_by_t):
+    st = TimeSeriesStore(str(tmp_path / name))
+    for t, rows in rows_by_t:
+        st.append_samples(rows, ts=t)
+    return st
+
+
+def shard_server(store=None, spans=(), decisions=(), attrib=None):
+    """A minimal live shard endpoint: /query over ``store`` plus static
+    /trace /decisions /attrib bodies — the per-module exporter contract
+    the plane scatters to."""
+    srv = TelemetryServer(registry=MetricsRegistry(), port=0)
+    if store is not None:
+        srv.add_route("/query", make_query_route(lambda: store))
+    srv.add_route("/trace", lambda q: (
+        200, "application/json", json.dumps({"spans": list(spans)})))
+    srv.add_route("/decisions", lambda q: (
+        200, "application/json", json.dumps({"decisions": list(decisions)})))
+    if attrib is not None:
+        srv.add_route("/attrib", lambda q: (
+            200, "application/json", json.dumps(attrib)))
+    port = srv.start()
+    return srv, f"http://127.0.0.1:{port}"
+
+
+def dead_url():
+    """A URL nothing listens on (bound then released port)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def call(plane, path, **params):
+    """Invoke a plane route directly (the exporter normalizes parse_qs
+    lists the same way); returns (status, parsed body)."""
+    status, _ctype, body = plane.make_routes()[path](
+        {k: [str(v)] for k, v in params.items()})
+    try:
+        return status, json.loads(body)
+    except json.JSONDecodeError:
+        return status, body
+
+
+# -- owner map ---------------------------------------------------------------
+
+def test_owner_map_seq_bumps_only_on_change():
+    om = OwnerMap({0: "shard0", 1: "shard1"})
+    seq0, owners = om.read()
+    assert owners == {0: "shard0", 1: "shard1"}
+    assert om.update({0: "shard0", 1: "shard1"}) == seq0  # steady rescrape
+    assert om.move(0, "shard0") == seq0  # no-op move
+    seq1 = om.move(0, "shard1")
+    assert seq1 == seq0 + 1
+    seq2, owners2 = om.read()
+    assert seq2 == seq1 and owners2[0] == "shard1"
+    owners2[0] = "mutated"  # read returns a copy
+    assert om.read()[1][0] == "shard1"
+
+
+def test_owner_map_from_fleet_text():
+    text = (
+        "# HELP apm_fleet_partition_owner x\n"
+        'apm_fleet_partition_owner{partition="3"} 1\n'
+        'apm_fleet_partition_owner{module="manager",partition="7"} 0\n'
+        "apm_other_metric 4\n"
+    )
+    assert owner_map_from_fleet_text(text) == {3: 1, 7: 0}
+    assert owner_map_from_fleet_text("") == {}
+
+
+# -- routing -----------------------------------------------------------------
+
+def test_single_service_routes_to_owning_shard_only(tmp_path):
+    parts = 8
+    svc = "svc42"
+    p = service_partition(svc, parts)
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": svc}, 3.0 * i)])
+        for i in range(6)])
+    sb = mem_store(tmp_path, "b", [
+        (T0 + i, [("apm_tx_total", {"service": "other"}, 7.0 * i)])
+        for i in range(6)])
+    srv_a, url_a = shard_server(sa)
+    srv_b, url_b = shard_server(sb)
+    try:
+        om = OwnerMap({p: "shard0"})
+        plane = QueryPlane(
+            lambda: [("shard0", url_a), ("shard1", url_b)],
+            owners=om.read, partitions=parts)
+        st, doc = call(
+            plane, "/query",
+            series=f'rate(apm_tx_total{{service="{svc}"}}[4s])',
+            start=T0, end=T0 + 5, step=1)
+        assert st == 200
+        assert doc["shards_queried"] == ["shard0"]
+        assert list(doc["shards"]) == ["shard0"]
+        assert len(doc["series"]) == 1
+        assert doc["series"][0]["labels"] == {"service": svc}
+        # explicit ?service= routes the same without a selector label
+        st, doc2 = call(plane, "/query", series="rate(apm_tx_total[4s])",
+                        service=svc, start=T0, end=T0 + 5, step=1)
+        assert st == 200 and doc2["shards_queried"] == ["shard0"]
+        # unknown owner (partition not in the map) falls back to scatter
+        st, doc3 = call(plane, "/query", series="rate(apm_tx_total[4s])",
+                        service="unmapped-svc", start=T0, end=T0 + 5, step=1)
+        assert st == 200 and set(doc3["shards_queried"]) == {"shard0", "shard1"}
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_scatter_merge_bit_equal_to_single_store_golden(tmp_path):
+    rows = lambda svc, k: [
+        (T0 + i, [("apm_tx_total", {"service": svc}, k * i)])
+        for i in range(8)]
+    sa = mem_store(tmp_path, "a", rows("alpha", 10.0))
+    sb = mem_store(tmp_path, "b", rows("beta", 5.0))
+    golden = mem_store(tmp_path, "g", [
+        (T0 + i, [("apm_tx_total", {"service": "alpha"}, 10.0 * i),
+                  ("apm_tx_total", {"service": "beta"}, 5.0 * i)])
+        for i in range(8)])
+    srv_a, url_a = shard_server(sa)
+    srv_b, url_b = shard_server(sb)
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a), ("shard1", url_b)])
+        for expr in ("apm_tx_total", "rate(apm_tx_total[4s])",
+                     "increase(apm_tx_total[4s])"):
+            st, doc = call(plane, "/query", series=expr,
+                           start=T0, end=T0 + 7, step=1)
+            gdoc = eval_range(golden, expr, T0, T0 + 7, 1)
+            assert st == 200
+            assert doc["series"] == gdoc["series"], expr
+            assert doc["partial"] is False and doc["stale"] is False
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+# -- merge math --------------------------------------------------------------
+
+def _bucket_rows(counts_by_le, t, extra=None):
+    rows = []
+    for le, v in counts_by_le.items():
+        rows.append(("apm_lat_seconds_bucket",
+                     dict({"le": le}, **(extra or {})), v))
+    return [(t, rows)]
+
+
+def test_histogram_bucket_merge_beats_per_shard_quantile_average(tmp_path):
+    # skewed placement: shard A holds 100 sub-0.1s observations, shard B
+    # 100 observations in (1, 10]. The true fleet p50 sits in the 0.1
+    # bucket; averaging the two per-shard p50s lands near 2.8 — the
+    # failure mode sum-then-quantile exists to prevent.
+    a0 = {"0.1": 0.0, "1": 0.0, "10": 0.0, "+Inf": 0.0}
+    a1 = {"0.1": 100.0, "1": 100.0, "10": 100.0, "+Inf": 100.0}
+    b1 = {"0.1": 0.0, "1": 0.0, "10": 100.0, "+Inf": 100.0}
+    sa = mem_store(tmp_path, "a",
+                   _bucket_rows(a0, T0) + _bucket_rows(a1, T0 + 10))
+    sb = mem_store(tmp_path, "b",
+                   _bucket_rows(a0, T0) + _bucket_rows(b1, T0 + 10))
+    merged1 = {le: a1[le] + b1[le] for le in a1}
+    golden = mem_store(tmp_path, "g",
+                       _bucket_rows(a0, T0) + _bucket_rows(merged1, T0 + 10))
+    srv_a, url_a = shard_server(sa)
+    srv_b, url_b = shard_server(sb)
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a), ("shard1", url_b)])
+        expr = "histogram_quantile(0.5, apm_lat_seconds[20s])"
+        st, doc = call(plane, "/query", series=expr,
+                       start=T0 + 10, end=T0 + 10, step=1)
+        gdoc = eval_range(golden, expr, T0 + 10, T0 + 10, 1)
+        assert st == 200
+        assert doc["series"] == gdoc["series"]
+        fleet_p50 = doc["series"][0]["points"][0][1]
+        assert fleet_p50 == pytest.approx(0.1)
+        # the wrong math: per-shard quantiles averaged
+        pa = eval_range(sa, expr, T0 + 10, T0 + 10, 1)["series"][0]["points"][0][1]
+        pb = eval_range(sb, expr, T0 + 10, T0 + 10, 1)["series"][0]["points"][0][1]
+        averaged = (pa + pb) / 2.0
+        assert averaged != fleet_p50
+        # merged equals the single-store truth exactly; averaging misses
+        # it by more than an order of magnitude on this fixture
+        assert abs(averaged - fleet_p50) > 1.0
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_counter_reset_aware_rate_merge_across_shards(tmp_path):
+    # shard A's counter resets mid-window (process restart); shard B is
+    # monotone. Each shard's rate must be computed reset-aware BEFORE the
+    # cross-shard sum — the PR 12 review-fix shape, now cross-shard: a
+    # naive merged delta would go negative across A's reset.
+    sa = mem_store(tmp_path, "a", [
+        (T0 + 0, [("apm_tx_total", {"service": "s"}, 100.0)]),
+        (T0 + 2, [("apm_tx_total", {"service": "s"}, 120.0)]),
+        (T0 + 4, [("apm_tx_total", {"service": "s"}, 5.0)]),   # reset
+        (T0 + 6, [("apm_tx_total", {"service": "s"}, 25.0)]),
+    ])
+    sb = mem_store(tmp_path, "b", [
+        (T0 + 0, [("apm_tx_total", {"service": "s"}, 0.0)]),
+        (T0 + 2, [("apm_tx_total", {"service": "s"}, 10.0)]),
+        (T0 + 4, [("apm_tx_total", {"service": "s"}, 20.0)]),
+        (T0 + 6, [("apm_tx_total", {"service": "s"}, 30.0)]),
+    ])
+    srv_a, url_a = shard_server(sa)
+    srv_b, url_b = shard_server(sb)
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a), ("shard1", url_b)])
+        expr = "rate(apm_tx_total[6s])"
+        st, doc = call(plane, "/query", series=expr,
+                       start=T0 + 6, end=T0 + 6, step=1)
+        assert st == 200
+        merged = doc["series"][0]["points"][0][1]
+        ra = eval_range(sa, expr, T0 + 6, T0 + 6, 1)["series"][0]["points"][0][1]
+        rb = eval_range(sb, expr, T0 + 6, T0 + 6, 1)["series"][0]["points"][0][1]
+        assert merged == pytest.approx(ra + rb)
+        # reset-awareness: A's window increase is 20+25 over 4s observed
+        # span, never negative; a naive delta would have been 25-120 < 0
+        assert ra > 0 and merged > rb
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_merge_series_none_is_absent_not_zero():
+    docs = [
+        {"series": [{"labels": {"q": "x"},
+                     "points": [[0, 1.0], [1, None], [2, None]]}]},
+        {"series": [{"labels": {"q": "x"},
+                     "points": [[0, 2.0], [1, 4.0], [2, None]]}]},
+    ]
+    out = _merge_series(docs)
+    assert out[0]["points"] == [[0, 3.0], [1, 4.0], [2, None]]
+
+
+def test_merge_histogram_groups_minus_le():
+    docs = [{"series": [
+        {"labels": {"le": "0.1"}, "points": [[0, 50.0]]},
+        {"labels": {"le": "+Inf"}, "points": [[0, 100.0]]},
+    ]}, {"series": [
+        {"labels": {"le": "0.1"}, "points": [[0, 0.0]]},
+        {"labels": {"le": "+Inf"}, "points": [[0, 100.0]]},
+    ]}]
+    out = _merge_histogram(docs, 0.5)
+    assert len(out) == 1 and out[0]["labels"] == {}
+    expect = histogram_quantile([(0.1, 50.0), (math.inf, 200.0)], 0.5)
+    assert out[0]["points"][0][1] == pytest.approx(expect)
+
+
+# -- degraded read path ------------------------------------------------------
+
+def test_dead_shard_served_from_store_partial_stale(tmp_path):
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": "alpha"}, 10.0 * i)])
+        for i in range(8)])
+    srv_a, url_a = shard_server(sa)
+    # the durable recorder store holds the dead shard's slice, module-labeled
+    durable = mem_store(tmp_path, "rec", [
+        (T0 + i, [("apm_tx_total",
+                   {"service": "beta", "module": "shard1"}, 5.0 * i)])
+        for i in range(8)])
+    golden = mem_store(tmp_path, "g", [
+        (T0 + i, [("apm_tx_total", {"service": "alpha"}, 10.0 * i),
+                  ("apm_tx_total", {"service": "beta"}, 5.0 * i)])
+        for i in range(8)])
+    last_ok = T0 + 7
+    try:
+        plane = QueryPlane(
+            lambda: [("shard0", url_a), ("shard1", dead_url())],
+            store=durable,
+            freshness=lambda: {"shard1": last_ok},
+            timeout_s=1.0)
+        expr = "rate(apm_tx_total[4s])"
+        st, doc = call(plane, "/query", series=expr,
+                       start=T0, end=T0 + 7, step=1)
+        assert st == 200  # degrade, never 5xx
+        assert doc["partial"] is True and doc["stale"] is True
+        assert doc["shards"]["shard0"]["status"] == "live"
+        assert doc["shards"]["shard1"]["status"] == "stale"
+        fresh = doc["shards"]["shard1"]["freshness_s"]
+        assert fresh is not None and fresh > 0
+        # the merged answer is bit-equal to the all-live golden: the
+        # module label is stripped off the store slice before merging
+        gdoc = eval_range(golden, expr, T0, T0 + 7, 1)
+        assert doc["series"] == gdoc["series"]
+    finally:
+        srv_a.stop()
+
+
+def test_dead_shard_without_store_marked_dead(tmp_path):
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": "alpha"}, float(i))])
+        for i in range(4)])
+    srv_a, url_a = shard_server(sa)
+    try:
+        plane = QueryPlane(
+            lambda: [("shard0", url_a), ("shard1", dead_url())],
+            timeout_s=1.0)
+        st, doc = call(plane, "/query", series="apm_tx_total",
+                       start=T0, end=T0 + 3, step=1)
+        assert st == 200
+        assert doc["partial"] is True and doc["stale"] is False
+        assert doc["shards"]["shard1"] == {"status": "dead",
+                                           "freshness_s": None}
+    finally:
+        srv_a.stop()
+
+
+# -- cache -------------------------------------------------------------------
+
+def test_ttl_cache_coalesces_inflight_computes():
+    cache = _TTLCache(30.0)
+    calls = []
+    gate = threading.Event()
+    results = []
+
+    def compute():
+        calls.append(1)
+        gate.wait(5.0)
+        return {"v": 42}
+
+    def worker():
+        results.append(cache.get_or_compute("k", compute))
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # let one leader enter compute, followers queue
+    gate.set()
+    for t in threads:
+        t.join(10.0)
+    assert len(calls) == 1  # exactly one compute
+    assert len(results) == 6
+    assert sum(1 for _v, hit in results if not hit) == 1  # one leader miss
+    assert all(v == {"v": 42} for v, _hit in results)
+
+
+def test_ttl_cache_expires_and_disabled():
+    cache = _TTLCache(0.05)
+    v1, hit1 = cache.get_or_compute("k", lambda: 1)
+    v2, hit2 = cache.get_or_compute("k", lambda: 2)
+    assert (v1, hit1, v2, hit2) == (1, False, 1, True)
+    time.sleep(0.08)
+    v3, hit3 = cache.get_or_compute("k", lambda: 3)
+    assert (v3, hit3) == (3, False)
+    off = _TTLCache(0.0)
+    assert off.get_or_compute("k", lambda: 4) == (4, False)
+
+
+def test_plane_cache_hit_and_bypass(tmp_path):
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": "a"}, float(i))])
+        for i in range(4)])
+    srv_a, url_a = shard_server(sa)
+    try:
+        reg = MetricsRegistry()
+        plane = QueryPlane(lambda: [("shard0", url_a)], registry=reg,
+                           cache_ttl_s=30.0)
+        params = dict(series="apm_tx_total", start=T0, end=T0 + 3, step=1)
+        _st, d1 = call(plane, "/query", **params)
+        _st, d2 = call(plane, "/query", **params)
+        assert d1["cached"] is False and d2["cached"] is True
+        assert d1["series"] == d2["series"]
+        _st, d3 = call(plane, "/query", cache=0, **params)
+        assert d3["cached"] is False
+        text = reg.render()
+        assert "apm_queryplane_cache_hits_total 1" in text
+    finally:
+        srv_a.stop()
+
+
+# -- rebalance consistency ---------------------------------------------------
+
+def test_retry_on_move_is_bounded_and_counted(tmp_path):
+    parts = 4
+    svc = "svcmove"
+    p = service_partition(svc, parts)
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": svc}, float(i))])
+        for i in range(4)])
+    srv_a, url_a = shard_server(sa)
+    try:
+        # an owner feed that bumps its seq on EVERY read: pathological
+        # perpetual rebalance — the plane must still answer after
+        # move_retries bounded requeries
+        seqs = iter(range(1, 100))
+
+        def storm():
+            return next(seqs), {p: "shard0"}
+
+        reg = MetricsRegistry()
+        plane = QueryPlane(lambda: [("shard0", url_a)], owners=storm,
+                           partitions=parts, move_retries=2, registry=reg,
+                           cache_ttl_s=0.0)
+        st, doc = call(plane, "/query", series="apm_tx_total", service=svc,
+                       start=T0, end=T0 + 3, step=1)
+        assert st == 200
+        assert doc["move_retries"] == 2  # hit the bound, then served
+        assert "apm_queryplane_move_retries_total 2" in reg.render()
+
+        om = OwnerMap({p: "shard0"})
+        plane2 = QueryPlane(lambda: [("shard0", url_a)], owners=om.read,
+                            partitions=parts, cache_ttl_s=0.0)
+        st, doc = call(plane2, "/query", series="apm_tx_total", service=svc,
+                       start=T0, end=T0 + 3, step=1)
+        assert st == 200 and doc["move_retries"] == 0  # stable map: no retry
+        assert doc["owner_seq"] == om.read()[0]
+    finally:
+        srv_a.stop()
+
+
+# -- format=matrix -----------------------------------------------------------
+
+def test_matrix_doc_shape():
+    doc = {"series": [
+        {"labels": {"service": "a"}, "points": [[1.0, 2.5], [2.0, None]]},
+    ]}
+    m = matrix_doc(doc)
+    assert m["status"] == "success"
+    assert m["data"]["resultType"] == "matrix"
+    assert m["data"]["result"] == [
+        {"metric": {"service": "a"}, "values": [[1.0, "2.5"]]}]
+
+
+def test_store_route_format_matrix(tmp_path):
+    st = mem_store(tmp_path, "s", [
+        (T0 + i, [("apm_tx_total", {"service": "a"}, float(i))])
+        for i in range(4)])
+    route = make_query_route(lambda: st)
+    status, _ct, body = route({"series": ["apm_tx_total"],
+                               "start": [str(T0)], "end": [str(T0 + 3)],
+                               "step": ["1"], "format": ["matrix"]})
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["data"]["resultType"] == "matrix"
+    assert doc["data"]["result"][0]["metric"] == {"service": "a"}
+    # default format unchanged
+    status, _ct, body = route({"series": ["apm_tx_total"],
+                               "start": [str(T0)], "end": [str(T0 + 3)],
+                               "step": ["1"]})
+    assert "series" in json.loads(body)
+
+
+def test_plane_format_matrix(tmp_path):
+    sa = mem_store(tmp_path, "a", [
+        (T0 + i, [("apm_tx_total", {"service": "a"}, float(i))])
+        for i in range(4)])
+    srv_a, url_a = shard_server(sa)
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a)])
+        st, doc = call(plane, "/query", series="apm_tx_total", format="matrix",
+                       start=T0, end=T0 + 3, step=1)
+        assert st == 200
+        assert doc["status"] == "success"
+        assert doc["data"]["resultType"] == "matrix"
+    finally:
+        srv_a.stop()
+
+
+def test_increase_expression_in_store(tmp_path):
+    st = mem_store(tmp_path, "s", [
+        (T0, [("apm_tx_total", {}, 10.0)]),
+        (T0 + 5, [("apm_tx_total", {}, 40.0)]),
+    ])
+    doc = eval_range(st, "increase(apm_tx_total[10s])", T0 + 5, T0 + 5, 1)
+    assert doc["series"][0]["points"][0][1] == pytest.approx(30.0)
+
+
+# -- traces / decisions / attrib --------------------------------------------
+
+def test_trace_scatter_dedups_by_identity(tmp_path):
+    span = {"trace_id": "t1", "name": "tick", "start": T0, "dur": 1.0}
+    other = {"trace_id": "t2", "name": "feed", "start": T0 + 1, "dur": 2.0}
+    srv_a, url_a = shard_server(spans=[span, other])
+    srv_b, url_b = shard_server(spans=[span])  # duplicate across shards
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a), ("shard1", url_b)])
+        st, doc = call(plane, "/trace")
+        assert st == 200
+        assert doc["count"] == 2
+        ids = {(s["trace_id"], s["name"]) for s in doc["spans"]}
+        assert ids == {("t1", "tick"), ("t2", "feed")}
+        assert doc["partial"] is False
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_decisions_fallback_from_store(tmp_path):
+    dec_live = {"trace_id": "t1", "ts": T0, "service": "a", "channel": "email"}
+    dec_dead = {"trace_id": "t2", "ts": T0 + 1, "service": "b",
+                "channel": "email"}
+    srv_a, url_a = shard_server(decisions=[dec_live])
+    durable = TimeSeriesStore(str(tmp_path / "rec"))
+    durable.append_decisions([dec_dead], extra={"module": "shard1"})
+    try:
+        plane = QueryPlane(
+            lambda: [("shard0", url_a), ("shard1", dead_url())],
+            store=durable, timeout_s=1.0)
+        st, doc = call(plane, "/decisions")
+        assert st == 200
+        assert doc["partial"] is True and doc["stale"] is True
+        traces = {d["trace_id"] for d in doc["decisions"]}
+        assert traces == {"t1", "t2"}
+    finally:
+        srv_a.stop()
+
+
+def test_attrib_merges_live_and_store_synthesized(tmp_path):
+    live_snap = {
+        "module": "shard0", "window_s": 10.0,
+        "stages": {"tick": {"busy_s": 4.0, "blocked_s": 1.0, "idle_s": 5.0,
+                            "events": 7}},
+        "occupancy": {},
+    }
+    srv_a, url_a = shard_server(attrib=live_snap)
+    durable = TimeSeriesStore(str(tmp_path / "rec"))
+    durable.append_samples(
+        [("apm_stage_busy_seconds_total", {"stage": "tick"}, 3.0),
+         ("apm_stage_blocked_seconds_total", {"stage": "tick"}, 2.0),
+         ("apm_stage_idle_seconds_total", {"stage": "tick"}, 5.0),
+         ("apm_stage_events_total", {"stage": "tick"}, 9.0)],
+        ts=T0, extra_labels={"module": "shard1"})
+    try:
+        plane = QueryPlane(
+            lambda: [("shard0", url_a), ("shard1", dead_url())],
+            store=durable, timeout_s=1.0)
+        st, doc = call(plane, "/attrib")
+        assert st == 200
+        assert doc["partial"] is True and doc["stale"] is True
+        assert set(doc["children"]) == {"shard0", "shard1"}
+        # stage seconds summed across the live and the synthesized child
+        assert doc["stages"]["tick"]["busy_s"] == pytest.approx(7.0)
+        assert doc["stages"]["tick"]["events"] == 16
+    finally:
+        srv_a.stop()
+
+
+def test_query_kind_names_and_stats(tmp_path):
+    sa = mem_store(tmp_path, "a", [(T0, [("apm_tx_total", {}, 1.0)])])
+    durable = mem_store(tmp_path, "rec",
+                        [(T0, [("apm_dead_total", {"module": "x"}, 1.0)])])
+    srv_a, url_a = shard_server(sa)
+    try:
+        plane = QueryPlane(lambda: [("shard0", url_a)], store=durable)
+        st, doc = call(plane, "/query", kind="names")
+        assert st == 200
+        assert {"apm_tx_total", "apm_dead_total"} <= set(doc["names"])
+        st, doc = call(plane, "/query", kind="stats")
+        assert st == 200
+        assert "plane" in doc and "store" in doc
+        assert doc["plane"]["requests"] >= 1
+    finally:
+        srv_a.stop()
+
+
+def test_bad_expression_is_400_not_error(tmp_path):
+    reg = MetricsRegistry()
+    plane = QueryPlane(lambda: [], registry=reg)
+    st, _body = call(plane, "/query", series="sum(rate(x[1s])) by (y)")
+    assert st == 400
+    st, _body = call(plane, "/query")  # neither series nor kind
+    assert st == 400
+    assert "apm_queryplane_errors_total 0" in reg.render()
+
+
+def test_serving_metrics_exported(tmp_path):
+    sa = mem_store(tmp_path, "a", [(T0, [("apm_tx_total", {}, 1.0)])])
+    srv_a, url_a = shard_server(sa)
+    try:
+        reg = MetricsRegistry()
+        plane = QueryPlane(lambda: [("shard0", url_a)], registry=reg,
+                           cache_ttl_s=0.0)
+        call(plane, "/query", series="apm_tx_total", start=T0, end=T0, step=1)
+        call(plane, "/trace")
+        text = reg.render()
+        assert 'apm_queryplane_requests_total{route="query"} 1' in text
+        assert 'apm_queryplane_requests_total{route="trace"} 1' in text
+        assert "apm_queryplane_fanout_shards_total 2" in text
+        assert "apm_queryplane_latency_seconds_count 2" in text
+        health = plane.health()
+        assert health["ok"] is True and health["degraded"] is False
+    finally:
+        srv_a.stop()
+
+
+# -- qstat rendering ---------------------------------------------------------
+
+def test_qstat_renders_per_shard_freshness():
+    from apmbackend_tpu.tools.qstat import format_range_result
+
+    doc = {
+        "expr": "apm_tx_total", "start": T0, "end": T0 + 9, "step": 1.0,
+        "series": [{"labels": {"service": "a"},
+                    "points": [[T0, 1.0], [T0 + 1, 2.0]]}],
+        "shards": {"shard0": {"status": "live", "freshness_s": 0.0},
+                   "shard1": {"status": "stale", "freshness_s": 4.25},
+                   "shard2": {"status": "dead", "freshness_s": None}},
+        "partial": True, "stale": True, "cached": False,
+    }
+    out = format_range_result(doc)
+    assert "PARTIAL" in out and "STALE" in out
+    assert "shard1" in out and "freshness=4.25s" in out
+    assert "shard2" in out and "dead" in out
+    # a plain per-store doc renders without the shard block
+    plain = format_range_result({"expr": "x", "start": T0, "end": T0 + 1,
+                                 "step": 1.0, "series": []})
+    assert "shards" not in plain
+
+
+def test_qstat_slo_health_includes_queryplane_section(monkeypatch):
+    from apmbackend_tpu.tools import qstat
+
+    body = {"status": "ok", "slo": {"fast": False},
+            "queryplane": {"ok": True, "degraded": True}}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return json.dumps(body).encode()
+
+    monkeypatch.setattr("urllib.request.urlopen",
+                        lambda *a, **k: _Resp())
+    out = qstat.slo_health_url("http://x/healthz")
+    assert out["queryplane"]["degraded"] is True
+    # without a plane section the key stays absent (per-module healthz)
+    body2 = {"status": "ok", "slo": {}}
+    body.clear()
+    body.update(body2)
+    out2 = qstat.slo_health_url("http://x/healthz")
+    assert "queryplane" not in out2
+
+
+# -- QueryLoad ---------------------------------------------------------------
+
+def test_query_load_summarizes_codes_and_latency(tmp_path):
+    from apmbackend_tpu.testing.chaos import QueryLoad
+
+    sa = mem_store(tmp_path, "a", [(T0, [("apm_tx_total", {}, 1.0)])])
+    srv_a, url_a = shard_server(sa)
+    try:
+        load = QueryLoad(
+            [f"{url_a}/query?series=apm_tx_total&start={T0}&end={T0}&step=1"],
+            threads=2, seed=7).start()
+        time.sleep(0.4)
+        summary = load.stop()
+        assert summary["requests"] > 0
+        assert summary["five_xx"] == 0
+        assert summary["codes"].get(200, 0) == summary["requests"]
+        assert summary["p95_ms"] is not None
+    finally:
+        srv_a.stop()
